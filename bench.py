@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Benchmark: PTB char-LSTM training throughput (BASELINE.md north-star).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value     = sequences/sec/chip for the full train step (fwd+BPTT+update) on
+            config 1 (1-layer, hidden=128, char vocab) on the default device.
+baseline  = the same config run single-process on CPU float32 — the accepted
+            stand-in for the reference's Spark-CPU executor throughput
+            (BASELINE.md: "Spark-CPU baseline ... to be measured"; Spark is
+            not installable offline). Measured once and cached in
+            BASELINE_MEASURED.json; delete that file to re-measure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+B, T, HIDDEN, LAYERS, STEPS, WARMUP = 64, 64, 128, 1, 50, 5
+CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BASELINE_MEASURED.json")
+
+
+def measure(compute_dtype: str, steps: int, warmup: int) -> float:
+    """Train-step throughput (seq/sec) on the current default backend."""
+    import jax
+    import numpy as np
+
+    from lstm_tensorspark_tpu.data import get_dataset, lm_batch_stream
+    from lstm_tensorspark_tpu.models import LMConfig, init_lm, lm_loss
+    from lstm_tensorspark_tpu.train import make_optimizer, make_train_step
+    from lstm_tensorspark_tpu.train.loop import init_train_state
+
+    data = get_dataset("ptb_char")
+    cfg = LMConfig(
+        vocab_size=len(data["vocab"]),
+        hidden_size=HIDDEN,
+        num_layers=LAYERS,
+        compute_dtype=compute_dtype,
+    )
+
+    def loss_fn(params, batch, rng):
+        return lm_loss(params, batch, cfg)
+
+    opt = make_optimizer("sgd", 0.5)
+    params = init_lm(jax.random.PRNGKey(0), cfg)
+    state = init_train_state(params, opt, jax.random.PRNGKey(1))
+    step = make_train_step(loss_fn, opt)
+
+    batches = lm_batch_stream(data["train"], B, T)
+    it = iter(batches)
+    for _ in range(warmup):
+        state, m = step(state, next(it))
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, next(it))
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    return B * steps / dt
+
+
+def cpu_baseline() -> float:
+    """Single-process CPU float32 reference throughput, cached."""
+    if os.path.exists(CACHE):
+        with open(CACHE) as f:
+            return json.load(f)["cpu_seq_per_sec"]
+    # fresh interpreter so the CPU platform can be forced cleanly
+    code = (
+        "import jax, json;"
+        "jax.config.update('jax_platforms','cpu');"
+        "import bench;"
+        "print('CPUBASE', bench.measure('float32', steps=10, warmup=2))"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=os.path.dirname(CACHE) or ".",
+    )
+    line = [l for l in out.stdout.splitlines() if l.startswith("CPUBASE")]
+    if not line:
+        raise RuntimeError(f"cpu baseline failed: {out.stderr[-2000:]}")
+    value = float(line[0].split()[1])
+    with open(CACHE, "w") as f:
+        json.dump({"cpu_seq_per_sec": value, "config": {
+            "B": B, "T": T, "hidden": HIDDEN, "layers": LAYERS,
+            "dtype": "float32", "note": "single-process CPU stand-in for Spark-CPU baseline",
+        }}, f, indent=1)
+    return value
+
+
+def main() -> int:
+    baseline = cpu_baseline()
+    value = measure("bfloat16", STEPS, WARMUP)
+    print(json.dumps({
+        "metric": "ptb_char_lstm_train_seq_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "seq/sec",
+        "vs_baseline": round(value / baseline, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

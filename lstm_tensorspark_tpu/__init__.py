@@ -1,0 +1,24 @@
+"""lstm_tensorspark_tpu — a TPU-native LSTM training framework.
+
+A from-scratch rebuild of the capabilities of
+EmanuelOverflow/LSTM-TensorSpark (a hand-rolled TensorFlow LSTM trained
+data-parallel via PySpark mapPartitions/treeAggregate/broadcast), redesigned
+for TPU: the cell is a pure function unrolled with `jax.lax.scan` and
+jit-compiled by XLA; gradient averaging is `lax.psum` over the ICI mesh
+(`shard_map`); parameters live replicated on-device, so the reference's
+per-round parameter broadcast and gradient tree-reduce disappear.
+
+Reference provenance: the reference mount was empty during the survey
+(SURVEY.md §0), so parity claims cite SURVEY.md sections (tagged [D]/[P]/[I])
+rather than file:line.
+
+Layout:
+  ops/       — LSTM cell math, scan unroll, remat, masking (SURVEY.md §2 L2/L1)
+  models/    — LM / classifier / seq2seq model families (SURVEY.md §6 configs)
+  parallel/  — mesh, data/tensor/sequence parallel backends (SURVEY.md §2 L3)
+  train/     — train loop, optimizer, checkpoint, metrics (SURVEY.md §2 L4)
+  data/      — corpora, vocab, batching (SURVEY.md §2 "Data pipeline")
+  cli.py     — reference-parity CLI entrypoint (SURVEY.md §2 L5)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,268 @@
+"""Training entrypoint — reference CLI parity (SURVEY.md §2 L5 [D]: "keeps
+its CLI ... launches on a TPU pod with no Spark JVM").
+
+The reference's flag surface (hidden units, layers, epochs, learning rate,
+partitions, data path — SURVEY.md §1 L5 row) is preserved; ``--num-partitions``
+maps to the number of mesh devices on the data axis, the direct successor of
+the RDD partition count. Where ``spark-submit main.py --flags`` launched a
+JVM driver, ``python main.py --flags`` (or ``python -m
+lstm_tensorspark_tpu.cli``) builds a device mesh and jit-compiles the train
+step; multi-host pods launch the same script once per host with
+``--num-processes/--process-id/--coordinator``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="lstm_tensorspark_tpu",
+        description="TPU-native LSTM training (LSTM-TensorSpark capabilities, no Spark)",
+    )
+    # --- reference flag surface (SURVEY.md §1 L5) ---
+    p.add_argument("--data-path", type=str, default=None, help="corpus directory (falls back to synthetic stand-in)")
+    p.add_argument("--hidden-units", type=int, default=128)
+    p.add_argument("--num-layers", type=int, default=1)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--learning-rate", type=float, default=1.0)
+    p.add_argument("--num-partitions", type=int, default=None,
+                   help="data-parallel shards (reference: RDD partitions) — defaults to all devices")
+    # --- capability extensions ---
+    p.add_argument("--dataset", type=str, default="ptb_char",
+                   choices=["ptb_char", "wikitext2", "wikitext103", "imdb", "uci_electricity"])
+    p.add_argument("--batch-size", type=int, default=32, help="global batch size")
+    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--optimizer", type=str, default="sgd",
+                   choices=["sgd", "momentum", "adam", "adamw", "rmsprop"])
+    p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument("--clip-norm", type=float, default=None)
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--tie-embeddings", action="store_true")
+    p.add_argument("--compute-dtype", type=str, default="bfloat16",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--remat-chunk", type=int, default=None,
+                   help="jax.checkpoint chunk size over time (long sequences)")
+    p.add_argument("--scan-unroll", type=int, default=1)
+    p.add_argument("--stateful", action="store_true",
+                   help="stateful truncated BPTT: carry recurrent state across contiguous windows")
+    p.add_argument("--num-steps", type=int, default=None,
+                   help="total step budget for the job, resume-inclusive (overrides epochs)")
+    p.add_argument("--eval-every", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--jsonl", type=str, default=None, help="metrics JSONL path")
+    p.add_argument("--checkpoint-dir", type=str, default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--resume", action="store_true", help="resume from latest checkpoint in --checkpoint-dir")
+    p.add_argument("--profile-dir", type=str, default=None, help="jax.profiler trace output dir")
+    p.add_argument("--backend", type=str, default="auto", choices=["auto", "single", "dp"],
+                   help="auto: dp when >1 device/partition")
+    # --- multi-host control plane (SURVEY.md §7 step 4) ---
+    p.add_argument("--coordinator", type=str, default=None)
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from .parallel import distributed_init
+    distributed_init(args.coordinator, args.num_processes, args.process_id)
+
+    from .train.metrics import MetricsLogger
+    logger = MetricsLogger(args.jsonl)
+
+    if args.dataset in ("ptb_char", "wikitext2", "wikitext103"):
+        rc = _run_lm(args, logger)
+    elif args.dataset == "imdb":
+        rc = _run_classifier(args, logger)
+    else:
+        rc = _run_forecaster(args, logger)
+    logger.close()
+    return rc
+
+
+def _select_backend(args):
+    """Resolve (mesh or None, shards). None mesh → single-chip path.
+
+    ``--backend dp`` is honored even with one device/partition (a 1-wide
+    shard_map — useful to validate DP semantics anywhere); ``auto`` picks
+    dp only when more than one shard is in play."""
+    from .parallel import make_mesh
+    n_devices = jax.device_count()
+    shards = args.num_partitions or n_devices
+    if args.backend == "single" or (args.backend == "auto" and shards <= 1):
+        return None, 1
+    if shards > n_devices:
+        raise SystemExit(
+            f"--num-partitions {shards} exceeds {n_devices} available devices"
+        )
+    devices = np.asarray(jax.devices()[:shards])
+    return make_mesh(dp=shards, devices=devices), shards
+
+
+def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
+                      eval_fn=None, checkpoint_fn=None, tokens_per_batch=None):
+    from .train.loop import train_loop
+
+    total = args.num_steps or args.epochs * steps_per_epoch
+    # --resume restores state.step; train only the REMAINING budget
+    total = max(total - int(state.step), 0)
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
+    try:
+        state = train_loop(
+            state,
+            train_step,
+            batches,
+            num_steps=total,
+            log_every=args.log_every,
+            logger=logger,
+            eval_fn=eval_fn,
+            eval_every=args.eval_every,
+            checkpoint_fn=checkpoint_fn,
+            checkpoint_every=args.checkpoint_every,
+            tokens_per_batch=tokens_per_batch,
+        )
+    finally:
+        if args.profile_dir:
+            jax.profiler.stop_trace()
+    return state
+
+
+def _run_lm(args, logger) -> int:
+    from .data import get_dataset, lm_batch_stream, lm_epoch_batches
+    from .models import LMConfig, init_lm, lm_loss
+    from .train import make_optimizer, make_train_step, make_eval_step
+    from .train.loop import evaluate, init_train_state
+    from .parallel import make_dp_train_step, make_dp_eval_step, shard_batch
+
+    data = get_dataset(args.dataset, args.data_path)
+    if data["synthetic"]:
+        logger.log({"note": f"dataset {args.dataset}: no files at --data-path, using synthetic stand-in"})
+    vocab = data["vocab"]
+    cfg = LMConfig(
+        vocab_size=len(vocab),
+        hidden_size=args.hidden_units,
+        num_layers=args.num_layers,
+        dropout=args.dropout,
+        tie_embeddings=args.tie_embeddings,
+        compute_dtype=args.compute_dtype,
+        remat_chunk=args.remat_chunk,
+        scan_unroll=args.scan_unroll,
+    )
+
+    stateful = args.stateful
+
+    if stateful:
+
+        def loss_fn(params, batch, dropout_rng, carries):
+            return lm_loss(
+                params, batch, cfg, carries=carries,
+                dropout_rng=dropout_rng,
+                deterministic=dropout_rng is None or cfg.dropout == 0.0,
+            )
+
+    else:
+
+        def loss_fn(params, batch, dropout_rng):
+            return lm_loss(
+                params, batch, cfg,
+                dropout_rng=dropout_rng,
+                deterministic=dropout_rng is None or cfg.dropout == 0.0,
+            )
+
+    key = jax.random.PRNGKey(args.seed)
+    kparams, krng = jax.random.split(key)
+    params = init_lm(kparams, cfg)
+    optimizer = make_optimizer(
+        args.optimizer, args.learning_rate,
+        momentum=args.momentum, clip_norm=args.clip_norm,
+    )
+    from .models.lstm_lm import init_carries
+    carries0 = init_carries(cfg, args.batch_size) if stateful else None
+    state = init_train_state(params, optimizer, krng, carries=carries0)
+
+    mesh, shards = _select_backend(args)
+    if args.batch_size % max(shards, 1) != 0:
+        raise SystemExit(f"--batch-size {args.batch_size} not divisible by {shards} partitions")
+
+    checkpoint_fn = resume_state = None
+    if args.checkpoint_dir:
+        from .train.checkpoint import Checkpointer
+        ckpt = Checkpointer(args.checkpoint_dir)
+        if args.resume:
+            resume_state = ckpt.restore_latest(state)
+        checkpoint_fn = ckpt.save
+    if resume_state is not None:
+        state = resume_state
+        logger.log({"note": f"resumed at step {int(state.step)}"})
+
+    train_tokens, valid_tokens = data["train"], data["valid"]
+    steps_per_epoch = max((len(train_tokens) - 1) // (args.batch_size * args.seq_len), 1)
+    batches = lm_batch_stream(train_tokens, args.batch_size, args.seq_len)
+
+    if mesh is None:
+        train_step = make_train_step(loss_fn, optimizer, stateful=stateful)
+        eval_step = make_eval_step(loss_fn, stateful=stateful)
+    else:
+        train_step = make_dp_train_step(loss_fn, optimizer, mesh, stateful=stateful)
+        eval_step = make_dp_eval_step(loss_fn, mesh, stateful=stateful)
+        from .parallel.data_parallel import replicate
+        state = state._replace(
+            params=replicate(state.params, mesh),
+            opt_state=replicate(state.opt_state, mesh),
+            carries=shard_batch(state.carries, mesh) if stateful else None,
+        )
+        batches = (shard_batch(b, mesh) for b in batches)
+
+    # The valid split can be smaller than one training-size window; evaluate
+    # with the largest batch that fits (multiple of the shard count).
+    eval_bs = min(args.batch_size, max((len(valid_tokens) - 1) // args.seq_len, 0))
+    eval_bs -= eval_bs % max(shards, 1)
+
+    def eval_fn(params):
+        if eval_bs <= 0:
+            return {"eval_skipped": 1}
+        ev = lm_epoch_batches(valid_tokens, eval_bs, args.seq_len)
+        ev_carries = init_carries(cfg, eval_bs) if stateful else None
+        if mesh is not None:
+            ev = (shard_batch(b, mesh) for b in ev)
+            if stateful:
+                ev_carries = shard_batch(ev_carries, mesh)
+        return evaluate(eval_step, params, ev, carries=ev_carries)
+
+    logger.log({
+        "note": "start", "dataset": args.dataset, "vocab": len(vocab),
+        "devices": jax.device_count(), "partitions": shards,
+        "steps_per_epoch": steps_per_epoch, "backend": "dp" if mesh is not None else "single",
+    })
+    state = _make_logged_loop(
+        args, state, train_step, batches, steps_per_epoch, logger,
+        eval_fn=eval_fn if args.eval_every else None,
+        checkpoint_fn=checkpoint_fn,
+        tokens_per_batch=args.batch_size * args.seq_len,
+    )
+    final = eval_fn(state.params)
+    logger.log({"step": int(state.step), **final, "note": "final"})
+    return 0
+
+
+def _run_classifier(args, logger) -> int:
+    from .tasks.classification import run_classifier
+    return run_classifier(args, logger)
+
+
+def _run_forecaster(args, logger) -> int:
+    from .tasks.forecasting import run_forecaster
+    return run_forecaster(args, logger)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,14 @@
+from .corpus import Vocab, build_char_vocab, build_word_vocab, load_text
+from .batching import lm_batch_stream, lm_epoch_batches, padded_batches
+from .datasets import get_dataset
+
+__all__ = [
+    "Vocab",
+    "build_char_vocab",
+    "build_word_vocab",
+    "load_text",
+    "lm_batch_stream",
+    "lm_epoch_batches",
+    "padded_batches",
+    "get_dataset",
+]

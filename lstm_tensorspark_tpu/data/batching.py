@@ -1,0 +1,107 @@
+"""Batching: token streams → fixed [B, T] windows; padded/bucketed batches.
+
+Reference parity: SURVEY.md §2 "Data pipeline" — the reference partitions an
+RDD of (seq, label) pairs; each worker iterates its shard. Here batching is
+host-side numpy producing static-shape arrays (XLA requirement), and the
+device dimension is added by the parallel backend, not the data layer.
+
+LM batching is the standard contiguous scheme: the token stream is split into
+``batch_size`` parallel streams so that window t's final recurrent state can
+seed window t+1 (stateful truncated BPTT — opt-in via the training loop's
+``stateful`` mode / the CLI ``--stateful`` flag) — the reference's
+fixed-unroll truncated-BPTT equivalent (SURVEY.md §5 "Long-context" row).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+def lm_windows(tokens: np.ndarray, batch_size: int, seq_len: int):
+    """Arrange a token stream [N] into contiguous per-row streams.
+
+    Returns ``(streams, shifted, n_windows)``: ``streams`` [B, n_windows*T]
+    holds the inputs, ``shifted`` the same array offset by one token (the
+    targets), so window w slices columns [w*T, (w+1)*T) of both."""
+    n_windows = (len(tokens) - 1) // (batch_size * seq_len)
+    if n_windows < 1:
+        raise ValueError(
+            f"corpus too small: {len(tokens)} tokens for B={batch_size} T={seq_len}"
+        )
+    usable = n_windows * batch_size * seq_len
+    streams = tokens[:usable].reshape(batch_size, n_windows * seq_len)
+    # targets need one extra token per stream: shift within the stream and
+    # borrow the next token for the last position
+    extra = tokens[1 : usable + 1].reshape(batch_size, n_windows * seq_len)
+    return streams, extra, n_windows
+
+
+def lm_epoch_batches(
+    tokens: np.ndarray, batch_size: int, seq_len: int
+) -> Iterator[dict]:
+    """One epoch of contiguous LM windows: {"inputs","targets"} each [B,T]."""
+    streams, shifted, n_windows = lm_windows(tokens, batch_size, seq_len)
+    for w in range(n_windows):
+        s = w * seq_len
+        yield {
+            "inputs": streams[:, s : s + seq_len],
+            "targets": shifted[:, s : s + seq_len],
+        }
+
+
+def lm_batch_stream(
+    tokens: np.ndarray,
+    batch_size: int,
+    seq_len: int,
+    *,
+    num_epochs: int | None = None,
+) -> Iterator[dict]:
+    """Repeat epochs (forever if num_epochs is None)."""
+    epoch = 0
+    while num_epochs is None or epoch < num_epochs:
+        yield from lm_epoch_batches(tokens, batch_size, seq_len)
+        epoch += 1
+
+
+def padded_batches(
+    sequences: list[np.ndarray],
+    labels: np.ndarray,
+    batch_size: int,
+    max_len: int,
+    *,
+    bucket: bool = True,
+    shuffle_seed: int | None = None,
+    drop_remainder: bool = True,
+) -> Iterator[dict]:
+    """Variable-length classification batches: pad to max_len, emit lengths.
+
+    ``bucket=True`` sorts by length first so co-batched sequences have similar
+    lengths (minimal padding waste — SURVEY.md §7 "padding waste vs
+    recompilation tradeoff": one static shape, bucketing only reorders).
+    Yields {"tokens" [B,L], "lengths" [B], "labels" [B], "valid" [B]}.
+    With ``drop_remainder=False`` the last short batch is padded with
+    all-zero filler rows marked ``valid=False`` (lengths 0) so metric
+    consumers can weight rows instead of double-counting examples.
+    """
+    order = np.arange(len(sequences))
+    if shuffle_seed is not None:
+        np.random.RandomState(shuffle_seed).shuffle(order)
+    if bucket:
+        order = order[np.argsort([len(sequences[i]) for i in order], kind="stable")]
+    for start in range(0, len(order), batch_size):
+        idx = order[start : start + batch_size]
+        if len(idx) < batch_size and drop_remainder:
+            break
+        toks = np.zeros((batch_size, max_len), np.int32)
+        lens = np.zeros((batch_size,), np.int32)
+        labs = np.zeros((batch_size,), np.int32)
+        valid = np.zeros((batch_size,), bool)
+        for row, i in enumerate(idx):
+            seq = sequences[i][:max_len]
+            toks[row, : len(seq)] = seq
+            lens[row] = len(seq)
+            labs[row] = labels[i]
+            valid[row] = True
+        yield {"tokens": toks, "lengths": lens, "labels": labs, "valid": valid}

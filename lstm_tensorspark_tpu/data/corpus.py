@@ -1,0 +1,108 @@
+"""Corpus loading and vocabularies.
+
+Reference parity: SURVEY.md §2 "Data pipeline" [P][I] — the reference loads a
+text corpus into an RDD and tokenizes/vectorizes into (seq, label) pairs.
+Here loading is host-side numpy (the RDD partitioning job is replaced by
+device sharding in parallel/), with char- and word-level vocabularies.
+
+No-network environment (SURVEY.md §7): real corpora (PTB/WikiText/IMDB)
+cannot be downloaded, so every loader falls back to a deterministic synthetic
+stand-in with the same interface; pointing ``data_path`` at real files uses
+them unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+# Seed paragraph for the synthetic corpus generator: public-domain text
+# (Lincoln, Gettysburg Address) — gives the Markov chain English-like
+# structure so a language model has something learnable to fit.
+_SEED_TEXT = """
+four score and seven years ago our fathers brought forth on this continent a
+new nation conceived in liberty and dedicated to the proposition that all men
+are created equal now we are engaged in a great civil war testing whether that
+nation or any nation so conceived and so dedicated can long endure we are met
+on a great battle field of that war we have come to dedicate a portion of that
+field as a final resting place for those who here gave their lives that that
+nation might live it is altogether fitting and proper that we should do this
+but in a larger sense we can not dedicate we can not consecrate we can not
+hallow this ground the brave men living and dead who struggled here have
+consecrated it far above our poor power to add or detract the world will
+little note nor long remember what we say here but it can never forget what
+they did here it is for us the living rather to be dedicated here to the
+unfinished work which they who fought here have thus far so nobly advanced
+"""
+
+
+class Vocab:
+    """Token ↔ id mapping. Reserved id 0 = <pad>, id 1 = <unk>."""
+
+    PAD, UNK = 0, 1
+
+    def __init__(self, tokens: list[str], *, reserve_special: bool = True):
+        specials = ["<pad>", "<unk>"] if reserve_special else []
+        self.itos = specials + [t for t in tokens if t not in ("<pad>", "<unk>")]
+        self.stoi = {t: i for i, t in enumerate(self.itos)}
+
+    def __len__(self) -> int:
+        return len(self.itos)
+
+    def encode(self, tokens) -> np.ndarray:
+        unk = self.stoi.get("<unk>", 0)
+        return np.asarray([self.stoi.get(t, unk) for t in tokens], dtype=np.int32)
+
+    def decode(self, ids) -> list[str]:
+        return [self.itos[int(i)] for i in ids]
+
+
+def build_char_vocab(text: str) -> Vocab:
+    return Vocab(sorted(set(text)))
+
+
+def build_word_vocab(text: str, max_size: int | None = None) -> Vocab:
+    from collections import Counter
+
+    counts = Counter(text.split())
+    most = counts.most_common(max_size - 2 if max_size else None)
+    return Vocab([w for w, _ in most])
+
+
+def load_text(path: str) -> str:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return f.read()
+
+
+def synthetic_text(n_tokens: int, seed: int = 0) -> str:
+    """Deterministic English-like word stream via a bigram Markov chain over
+    the embedded seed paragraph."""
+    words = _SEED_TEXT.split()
+    successors: dict[str, list[str]] = {}
+    for a, b in zip(words[:-1], words[1:]):
+        successors.setdefault(a, []).append(b)
+    rng = np.random.RandomState(seed)
+    out = [words[0]]
+    for _ in range(n_tokens - 1):
+        nxt = successors.get(out[-1])
+        if not nxt:
+            nxt = words
+        out.append(nxt[rng.randint(len(nxt))])
+    return " ".join(out)
+
+
+def resolve_split_files(data_path: str, basenames: list[str]) -> dict[str, str] | None:
+    """Find train/valid/test files under data_path matching any of the
+    conventional naming schemes; None if absent."""
+    if not data_path or not os.path.isdir(data_path):
+        return None
+    for pattern in ("{b}.{s}.txt", "{s}.txt", "{b}.{s}.tokens"):
+        for b in basenames:
+            files = {
+                s: os.path.join(data_path, pattern.format(b=b, s=s))
+                for s in ("train", "valid", "test")
+            }
+            if all(os.path.isfile(p) for p in files.values()):
+                return files
+    return None

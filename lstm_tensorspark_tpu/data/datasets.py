@@ -1,0 +1,163 @@
+"""Dataset registry for the five baseline configs (BASELINE.md).
+
+Each entry returns real data when files exist under ``data_path``, otherwise
+a deterministic synthetic stand-in with identical interface — required by the
+no-network environment (SURVEY.md §7 "Hard parts").
+
+Returned dict: {"train","valid","test"} token arrays (LM) or
+(sequences, labels) tuples (classification) or float arrays (forecasting),
+plus "vocab" where applicable and "synthetic": bool.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .corpus import (
+    Vocab,
+    build_char_vocab,
+    build_word_vocab,
+    load_text,
+    resolve_split_files,
+    synthetic_text,
+)
+
+
+def _lm_dataset(
+    data_path: str | None,
+    basenames: list[str],
+    level: str,
+    *,
+    synthetic_tokens: int,
+    max_vocab: int | None = None,
+    seed: int = 0,
+):
+    files = resolve_split_files(data_path or "", basenames)
+    synthetic = files is None
+    if synthetic:
+        texts = {
+            "train": synthetic_text(synthetic_tokens, seed),
+            "valid": synthetic_text(synthetic_tokens // 10, seed + 1),
+            "test": synthetic_text(synthetic_tokens // 10, seed + 2),
+        }
+    else:
+        texts = {s: load_text(p) for s, p in files.items()}
+
+    if level == "char":
+        vocab = build_char_vocab(texts["train"])
+        tokenize = list
+    else:
+        vocab = build_word_vocab(texts["train"], max_vocab)
+        tokenize = str.split
+
+    out = {s: vocab.encode(tokenize(t)) for s, t in texts.items()}
+    out["vocab"] = vocab
+    out["synthetic"] = synthetic
+    return out
+
+
+def ptb_char(data_path=None, **kw):
+    """BASELINE.md config 1: Penn Treebank char-level."""
+    return _lm_dataset(
+        data_path, ["ptb", "ptb.char"], "char", synthetic_tokens=200_000, **kw
+    )
+
+
+def wikitext2_word(data_path=None, **kw):
+    """BASELINE.md config 3: WikiText-2 word-level."""
+    return _lm_dataset(
+        data_path, ["wiki", "wikitext-2"], "word",
+        synthetic_tokens=400_000, max_vocab=33_278, **kw
+    )
+
+
+def wikitext103_word(data_path=None, **kw):
+    """BASELINE.md config 5: WikiText-103 word-level (synthetic stand-in is
+    deliberately larger)."""
+    return _lm_dataset(
+        data_path, ["wiki", "wikitext-103"], "word",
+        synthetic_tokens=2_000_000, max_vocab=50_000, **kw
+    )
+
+
+def imdb(data_path=None, *, num_examples: int = 2000, max_len: int = 400, seed: int = 0):
+    """BASELINE.md config 2: binary sentiment over variable-length sequences.
+
+    Synthetic stand-in: two word distributions shifted by class, lengths
+    drawn log-uniform in [20, max_len] — learnable by a bi-LSTM, label
+    balance exact.
+    """
+    del data_path  # no standard offline layout; synthetic only for now
+    rng = np.random.RandomState(seed)
+    text = synthetic_text(50_000, seed)
+    vocab = build_word_vocab(text)
+    V = len(vocab)
+    pos_words = np.arange(2, V, 2)
+    neg_words = np.arange(3, V, 2)
+    sequences, labels = [], []
+    for i in range(num_examples):
+        label = i % 2
+        length = int(np.exp(rng.uniform(np.log(20), np.log(max_len))))
+        base = pos_words if label else neg_words
+        mix = rng.rand(length) < 0.7  # 70% class-specific, 30% shared noise
+        seq = np.where(
+            mix, base[rng.randint(len(base), size=length)],
+            rng.randint(2, V, size=length),
+        ).astype(np.int32)
+        sequences.append(seq)
+        labels.append(label)
+    labels = np.asarray(labels, np.int32)
+    n_train = int(num_examples * 0.8)
+    n_valid = int(num_examples * 0.1)
+    return {
+        "train": (sequences[:n_train], labels[:n_train]),
+        "valid": (sequences[n_train : n_train + n_valid], labels[n_train : n_train + n_valid]),
+        "test": (sequences[n_train + n_valid :], labels[n_train + n_valid :]),
+        "vocab": vocab,
+        "num_classes": 2,
+        "max_len": max_len,
+        "synthetic": True,
+    }
+
+
+def uci_electricity(data_path=None, *, num_series: int = 8, length: int = 10_000, seed: int = 0):
+    """BASELINE.md config 4: multivariate forecasting. Synthetic stand-in:
+    mixtures of sinusoids (daily/weekly periods) + AR(1) noise, one column
+    per 'customer', normalised per-series."""
+    del data_path
+    rng = np.random.RandomState(seed)
+    t = np.arange(length, dtype=np.float32)
+    series = []
+    for i in range(num_series):
+        daily = np.sin(2 * np.pi * t / 24 + rng.uniform(0, 6.28))
+        weekly = 0.5 * np.sin(2 * np.pi * t / (24 * 7) + rng.uniform(0, 6.28))
+        noise = np.zeros(length, np.float32)
+        for k in range(1, length):
+            noise[k] = 0.8 * noise[k - 1] + 0.1 * rng.randn()
+        s = (1 + 0.3 * i) * daily + weekly + noise
+        series.append((s - s.mean()) / (s.std() + 1e-6))
+    data = np.stack(series, axis=1).astype(np.float32)  # [length, num_series]
+    n_train = int(length * 0.8)
+    n_valid = int(length * 0.1)
+    return {
+        "train": data[:n_train],
+        "valid": data[n_train : n_train + n_valid],
+        "test": data[n_train + n_valid :],
+        "num_features": num_series,
+        "synthetic": True,
+    }
+
+
+DATASETS = {
+    "ptb_char": ptb_char,
+    "wikitext2": wikitext2_word,
+    "wikitext103": wikitext103_word,
+    "imdb": imdb,
+    "uci_electricity": uci_electricity,
+}
+
+
+def get_dataset(name: str, data_path: str | None = None, **kw):
+    if name not in DATASETS:
+        raise ValueError(f"unknown dataset {name!r}; have {sorted(DATASETS)}")
+    return DATASETS[name](data_path, **kw)

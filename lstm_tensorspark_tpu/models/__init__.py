@@ -1,0 +1,3 @@
+from .lstm_lm import LMConfig, init_lm, lm_forward, lm_loss
+
+__all__ = ["LMConfig", "init_lm", "lm_forward", "lm_loss"]

@@ -1,0 +1,22 @@
+from .lstm_cell import (
+    LSTMParams,
+    init_lstm_params,
+    fuse_params,
+    lstm_step,
+    lstm_step_unfused,
+)
+from .scan import lstm_scan, stacked_lstm_scan
+from .masking import sequence_mask, masked_mean, reverse_sequences
+
+__all__ = [
+    "LSTMParams",
+    "init_lstm_params",
+    "fuse_params",
+    "lstm_step",
+    "lstm_step_unfused",
+    "lstm_scan",
+    "stacked_lstm_scan",
+    "sequence_mask",
+    "masked_mean",
+    "reverse_sequences",
+]

@@ -1,0 +1,39 @@
+"""Variable-length sequence utilities (SURVEY.md §7 "Hard parts": bucketing +
+padding + masked loss under XLA's static shapes).
+
+The reference handles only fixed unroll lengths within one worker
+(SURVEY.md §5 "Long-context" row); variable-length batches (IMDB seq-400
+config, BASELINE.md config 2) are new capability and need masking throughout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sequence_mask(lengths: jax.Array, maxlen: int) -> jax.Array:
+    """Bool mask [B, maxlen]: True where position < length."""
+    return jnp.arange(maxlen)[None, :] < lengths[:, None]
+
+
+def masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
+    """Mean of x over True mask positions (mask broadcast against x)."""
+    mask = mask.astype(x.dtype)
+    total = jnp.sum(x * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / count
+
+
+def reverse_sequences(x: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Reverse each row's first ``length`` elements, leaving padding in place.
+
+    x: [B, T, ...], lengths: [B]. Used to feed the backward direction of a
+    bi-LSTM when not using the mask-freeze reversed scan.
+    """
+    T = x.shape[1]
+    t = jnp.arange(T)[None, :]
+    src = jnp.where(t < lengths[:, None], lengths[:, None] - 1 - t, t)
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1
+    )

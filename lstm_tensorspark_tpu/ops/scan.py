@@ -1,0 +1,131 @@
+"""Sequence unrolling of the LSTM cell with `jax.lax.scan`.
+
+Reference parity: SURVEY.md §3.2 — the reference unrolls the recurrence in a
+Python ``for t in 1..T`` loop re-executed per batch through TF ``session.run``.
+TPU-native replacement: the recurrence is a `lax.scan`, traced once and
+compiled by XLA into a single on-device loop (static shapes, no per-step host
+round-trips).
+
+Long-sequence memory (SURVEY.md §7 "Hard parts"): BPTT through T steps stores
+O(T) activations; ``remat_chunk`` wraps fixed-size chunks of the scan in
+`jax.checkpoint`, storing only O(T/chunk) boundary carries and recomputing
+inside chunks during the backward pass — the scan-with-remat crux kernel.
+
+Variable-length sequences (SURVEY.md §7): a boolean ``mask`` freezes the carry
+at padded steps, so the final (h, c) is each sequence's state at its true end,
+and reversed scans over right-padded batches stay correct.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .lstm_cell import LSTMParams, fuse_params, lstm_step, zero_carry
+
+
+def lstm_scan(
+    params: LSTMParams,
+    xs: jax.Array,
+    carry: tuple[jax.Array, jax.Array] | None = None,
+    *,
+    mask: jax.Array | None = None,
+    reverse: bool = False,
+    remat_chunk: int | None = None,
+    compute_dtype=None,
+    unroll: int = 1,
+):
+    """Run the LSTM over a batch of sequences.
+
+    Args:
+      params: per-gate `LSTMParams` (fused once here, outside the scan).
+      xs: inputs ``[B, T, D]`` (batch-major).
+      carry: optional initial ``(h, c)`` each ``[B, H]``; zeros if None.
+      mask: optional bool ``[B, T]``; False steps leave the carry unchanged.
+      reverse: scan right-to-left (for the backward direction of a bi-LSTM).
+      remat_chunk: if set, chunk size for `jax.checkpoint` rematerialisation
+        (T must be divisible by it).
+      compute_dtype: e.g. ``jnp.bfloat16`` for the matmuls; cell state and
+        accumulation stay float32.
+      unroll: `lax.scan` unroll factor (amortises loop overhead on TPU).
+
+    Returns:
+      ``((h_T, c_T), ys)`` with ``ys`` ``[B, T, H]`` (hidden state per step).
+    """
+    B, T, _ = xs.shape
+    fused = fuse_params(params, compute_dtype=compute_dtype)
+    if carry is None:
+        carry = zero_carry(B, params.hidden_size)
+
+    xs_t = jnp.moveaxis(xs, 0, 1)  # [T, B, D] — scan runs over the leading axis
+
+    def step(c, inp):
+        if mask is None:
+            new_carry, y = lstm_step(fused, c, inp)
+        else:
+            x, m = inp
+            (h_new, c_new), _ = lstm_step(fused, c, x)
+            h = jnp.where(m, h_new, c[0])
+            cc = jnp.where(m, c_new, c[1])
+            new_carry, y = (h, cc), h
+        return new_carry, y
+
+    if mask is None:
+        inputs = xs_t
+    else:
+        inputs = (xs_t, jnp.moveaxis(mask, 0, 1)[..., None])
+
+    if remat_chunk is None:
+        final, ys = lax.scan(step, carry, inputs, reverse=reverse, unroll=unroll)
+    else:
+        if T % remat_chunk != 0:
+            raise ValueError(f"T={T} not divisible by remat_chunk={remat_chunk}")
+        n_chunks = T // remat_chunk
+
+        def chunk_fn(c, chunk_inputs):
+            return lax.scan(step, c, chunk_inputs, reverse=reverse, unroll=unroll)
+
+        chunk_fn = jax.checkpoint(chunk_fn, prevent_cse=False)
+        chunked = jax.tree.map(
+            lambda a: a.reshape(n_chunks, remat_chunk, *a.shape[1:]), inputs
+        )
+        final, ys = lax.scan(chunk_fn, carry, chunked, reverse=reverse)
+        ys = ys.reshape(T, B, ys.shape[-1])
+
+    return final, jnp.moveaxis(ys, 0, 1)
+
+
+def stacked_lstm_scan(
+    layer_params: Sequence[LSTMParams],
+    xs: jax.Array,
+    carries: Sequence[tuple[jax.Array, jax.Array]] | None = None,
+    *,
+    mask: jax.Array | None = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: jax.Array | None = None,
+    deterministic: bool = True,
+    **scan_kwargs,
+):
+    """Stack LSTM layers over the same time axis (SURVEY.md §2 "Multi-layer").
+
+    Inter-layer dropout is applied to the full ``[B, T, H]`` output between
+    layers (not on the recurrent path). Returns (list of per-layer final
+    carries, top-layer outputs ``[B, T, H]``).
+    """
+    ys = xs
+    finals = []
+    n = len(layer_params)
+    for idx, p in enumerate(layer_params):
+        c0 = None if carries is None else carries[idx]
+        final, ys = lstm_scan(p, ys, c0, mask=mask, **scan_kwargs)
+        finals.append(final)
+        if idx < n - 1 and dropout_rate > 0.0 and not deterministic:
+            if dropout_rng is None:
+                raise ValueError("dropout_rng required when deterministic=False")
+            dropout_rng, sub = jax.random.split(dropout_rng)
+            keep = jax.random.bernoulli(sub, 1.0 - dropout_rate, ys.shape)
+            ys = jnp.where(keep, ys / (1.0 - dropout_rate), 0.0)
+    return finals, ys

@@ -1,0 +1,11 @@
+from .mesh import make_mesh, local_device_count, distributed_init
+from .data_parallel import make_dp_train_step, make_dp_eval_step, shard_batch
+
+__all__ = [
+    "make_mesh",
+    "local_device_count",
+    "distributed_init",
+    "make_dp_train_step",
+    "make_dp_eval_step",
+    "shard_batch",
+]

@@ -1,0 +1,152 @@
+"""Data-parallel training over the device mesh — the north-star replacement
+for the reference's Spark backend (BASELINE.json north_star; SURVEY.md §3.3).
+
+Mapping, component by component:
+  Spark ``sc.broadcast(weights)``      → params replicated on-device (no
+                                         per-step broadcast exists at all)
+  ``rdd.mapPartitions(train_partition)`` → the same per-shard step body
+                                         running under `shard_map` on every
+                                         device's batch shard
+  ``treeAggregate`` grad tree-reduce   → `lax.psum` (ICI all-reduce); being
+                                         an all-reduce, every device gets the
+                                         averaged grads, which also deletes
+                                         the re-broadcast (SURVEY.md §3.3)
+  driver-side ``params -= lr*grad``    → optimizer update runs replicated
+                                         on-device inside the same XLA program
+
+The entire reference round (3 process boundaries, 2 network serializations)
+compiles to ONE jitted program per step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..train.loop import TrainState
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "data"):
+    """Place a host batch with its leading dim sharded over ``axis``
+    (replicated over the other mesh axes)."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+
+
+def replicate(tree, mesh: Mesh):
+    """Fully-replicated placement — the reference's broadcast, done once."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
+
+
+def make_dp_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    axis: str = "data",
+    jit: bool = True,
+    donate: bool | None = None,
+    stateful: bool = False,
+):
+    """Build the data-parallel train step.
+
+    ``loss_fn(params, batch, dropout_rng) -> (loss, aux)`` — the identical
+    per-shard body used single-chip (SURVEY.md §3.2's train_partition), so
+    single-device and DP runs are the same program modulo the psum.
+
+    With ``stateful=True`` the loss_fn also takes/returns recurrent carries
+    (see train/loop.py); carries live sharded over the data axis — each
+    shard's stream keeps its own recurrent state, exactly like a Spark
+    partition's worker-local state.
+    """
+
+    from ..train.loop import step_body
+
+    def per_shard_step(state: TrainState, batch):
+        return step_body(
+            loss_fn,
+            optimizer,
+            state,
+            batch,
+            stateful=stateful,
+            # distinct dropout per shard, common everything else
+            rng_transform=lambda sub: jax.random.fold_in(
+                sub, jax.lax.axis_index(axis)
+            ),
+            # treeAggregate + broadcast, collapsed into one ICI all-reduce:
+            reduce_fn=lambda grads, loss: (
+                jax.lax.pmean(grads, axis),
+                jax.lax.pmean(loss, axis),
+            ),
+        )
+
+    state_spec = TrainState(
+        step=P(), params=P(), opt_state=P(), rng=P(),
+        carries=P(axis) if stateful else P(),
+    )
+    sharded = shard_map(
+        per_shard_step,
+        mesh=mesh,
+        in_specs=(state_spec, P(axis)),
+        out_specs=(state_spec, P()),
+        check_vma=False,
+    )
+    if jit:
+        from ..train.loop import _donation_supported
+
+        if donate is None:
+            donate = _donation_supported()
+        sharded = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return sharded
+
+
+def make_dp_eval_step(
+    loss_fn: Callable,
+    mesh: Mesh,
+    *,
+    axis: str = "data",
+    jit: bool = True,
+    stateful: bool = False,
+):
+    from ..train.loop import call_loss
+
+    if stateful:
+
+        def per_shard_eval(params, batch, carries):
+            loss, aux = call_loss(loss_fn, params, batch, None, carries, stateful=True)
+            return {"loss": jax.lax.pmean(loss, axis)}, aux["carries"]
+
+        sharded = shard_map(
+            per_shard_eval,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=(P(), P(axis)),
+            check_vma=False,
+        )
+    else:
+
+        def per_shard_eval(params, batch):
+            loss, _ = loss_fn(params, batch, None)
+            return {"loss": jax.lax.pmean(loss, axis)}
+
+        sharded = shard_map(
+            per_shard_eval,
+            mesh=mesh,
+            in_specs=(P(), P(axis)),
+            out_specs=P(),
+            check_vma=False,
+        )
+    if jit:
+        sharded = jax.jit(sharded)
+    return sharded

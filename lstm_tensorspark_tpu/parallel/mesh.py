@@ -1,0 +1,70 @@
+"""Device mesh construction and multi-host initialization.
+
+Reference parity: SURVEY.md §2 L3 — the reference's cluster layer is Apache
+Spark (JVM, Py4J, netty RPC, cluster manager). TPU-native replacement: a
+`jax.sharding.Mesh` over the ICI torus with named axes, XLA emitting the
+collectives; the control plane is `jax.distributed.initialize` (one process
+per host), replacing Spark master/executor scheduling (SURVEY.md §2 native
+table, "Cluster scheduling/launch" row).
+
+Axis convention (used across parallel/):
+  "data"  — data parallel (the reference's RDD partitions [D])
+  "model" — tensor parallel over the hidden/gate dimension (new capability)
+  "seq"   — sequence/context parallel over time chunks (new capability)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+AXES = ("data", "model", "seq")
+
+
+def local_device_count() -> int:
+    return jax.device_count()
+
+
+def make_mesh(
+    dp: int | None = None,
+    tp: int = 1,
+    sp: int = 1,
+    *,
+    devices=None,
+) -> Mesh:
+    """Build a ("data", "model", "seq") mesh.
+
+    ``dp=None`` absorbs all remaining devices into the data axis — the moral
+    equivalent of the reference's default partition count. XLA maps the mesh
+    onto the physical ICI topology; for multi-slice/DCN deployments put the
+    slowest-varying axis ("data") across slices so psum rides ICI within a
+    slice (scaling-book recipe; SURVEY.md §5 comm-backend row).
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = devices.size
+    if dp is None:
+        if n % (tp * sp) != 0:
+            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
+        dp = n // (tp * sp)
+    if dp * tp * sp != n:
+        raise ValueError(f"dp*tp*sp={dp * tp * sp} != device count {n}")
+    return Mesh(devices.reshape(dp, tp, sp), AXES)
+
+
+def distributed_init(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host control plane (SURVEY.md §7 step 4). No-op when single
+    process (the common local case); on a pod slice each host calls this
+    before touching devices."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

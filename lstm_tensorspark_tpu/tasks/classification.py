@@ -1,0 +1,14 @@
+"""IMDB-style bi-LSTM classification task (BASELINE.md config 2).
+
+Placeholder entrypoint — the bidirectional classifier model lands with the
+model-families milestone; until then fail fast with a clear message instead
+of an import error.
+"""
+
+
+def run_classifier(args, logger) -> int:
+    raise SystemExit(
+        "--dataset imdb: the bi-LSTM classification task is not wired into the "
+        "CLI yet (model families milestone); the imdb dataset builder and "
+        "masking/batching utilities are available as a library."
+    )

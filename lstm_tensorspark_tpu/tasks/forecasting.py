@@ -1,0 +1,14 @@
+"""UCI-Electricity seq2seq forecasting task (BASELINE.md config 4).
+
+Placeholder entrypoint — the encoder-decoder model lands with the
+model-families milestone; until then fail fast with a clear message instead
+of an import error.
+"""
+
+
+def run_forecaster(args, logger) -> int:
+    raise SystemExit(
+        "--dataset uci_electricity: the seq2seq forecasting task is not wired "
+        "into the CLI yet (model families milestone); the uci_electricity "
+        "dataset builder is available as a library."
+    )

@@ -1,0 +1,10 @@
+from .optimizer import make_optimizer
+from .loop import TrainState, make_train_step, make_eval_step, train_loop
+
+__all__ = [
+    "make_optimizer",
+    "TrainState",
+    "make_train_step",
+    "make_eval_step",
+    "train_loop",
+]

@@ -1,0 +1,216 @@
+"""Single-program training loop: jitted train step + host-side epoch driver.
+
+Reference parity: SURVEY.md §3.1 — the reference's outer hot loop is
+broadcast(params) → mapPartitions(train_partition) → treeAggregate(grads) →
+driver update, with full param/grad serialization over TCP each round. Here
+the whole round is ONE jitted XLA program: forward, BPTT (jax.grad), and the
+optimizer update run on-device; the host only sees scalar metrics. Under the
+data-parallel backend (parallel/data_parallel.py) the same step body runs
+under shard_map with a psum in place of treeAggregate (SURVEY.md §3.3).
+
+Buffer donation (`donate_argnums=0`) reuses the parameter/optimizer memory
+across steps — the rebuilt equivalent of "weights live on-device, zero host
+round-trips per step" (SURVEY.md §2 native-capability table).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class TrainState(NamedTuple):
+    step: jax.Array  # scalar int32
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+    # Recurrent state carried across contiguous windows (stateful truncated
+    # BPTT). None for stateless training; per-layer (h, c) otherwise.
+    carries: Any = None
+
+
+def init_train_state(params, optimizer, rng, *, carries=None) -> TrainState:
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=optimizer.init(params),
+        rng=rng,
+        carries=carries,
+    )
+
+
+import os
+
+
+def _donation_supported() -> bool:
+    # Buffer donation is a memory optimisation (in-place param/opt-state
+    # update). The tunneled TPU backend in this environment rejects donated
+    # buffers on real train steps with an opaque INVALID_ARGUMENT *and*
+    # poisons the process afterwards, so it cannot be probed-and-recovered
+    # in-process. Default off; set LSTM_TSP_DONATE=1 on platforms with
+    # working donation (standard TPU/GPU/CPU runtimes).
+    return os.environ.get("LSTM_TSP_DONATE", "0") == "1"
+
+
+def call_loss(loss_fn, params, batch, rng, carries, *, stateful: bool):
+    """Uniform invocation of the (stateless|stateful) loss_fn signature."""
+    if stateful:
+        return loss_fn(params, batch, rng, carries)
+    return loss_fn(params, batch, rng)
+
+
+def step_body(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    state: TrainState,
+    batch,
+    *,
+    stateful: bool = False,
+    rng_transform: Callable | None = None,
+    reduce_fn: Callable | None = None,
+):
+    """The ONE train-step body shared by the single-chip and data-parallel
+    paths (keeps them provably identical — test_dp.py's loss-parity relies on
+    it). ``rng_transform`` perturbs the per-step dropout key (DP folds in the
+    shard index); ``reduce_fn(grads, loss)`` inserts the cross-shard mean
+    (DP: lax.pmean — the treeAggregate replacement)."""
+    rng, sub = jax.random.split(state.rng)
+    if rng_transform is not None:
+        sub = rng_transform(sub)
+    (loss, aux), grads = jax.value_and_grad(
+        lambda p: call_loss(loss_fn, p, batch, sub, state.carries, stateful=stateful),
+        has_aux=True,
+    )(state.params)
+    carries = jax.lax.stop_gradient(aux["carries"]) if stateful else state.carries
+    if reduce_fn is not None:
+        grads, loss = reduce_fn(grads, loss)
+    updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+    return TrainState(state.step + 1, params, opt_state, rng, carries), metrics
+
+
+def make_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    *,
+    jit: bool = True,
+    donate: bool | None = None,
+    stateful: bool = False,
+):
+    """Build the jitted step.
+
+    Stateless (default): ``loss_fn(params, batch, dropout_rng) -> (loss, aux)``.
+    Stateful TBPTT (``stateful=True``): ``loss_fn(params, batch, dropout_rng,
+    carries) -> (loss, aux)`` with ``aux["carries"]`` the final recurrent
+    state; it is gradient-stopped and fed to the next window (truncated BPTT
+    over the contiguous stream — SURVEY.md §5 "Long-context" row).
+    """
+
+    def train_step(state: TrainState, batch):
+        return step_body(loss_fn, optimizer, state, batch, stateful=stateful)
+
+    if jit:
+        if donate is None:
+            donate = _donation_supported()
+        train_step = jax.jit(train_step, donate_argnums=(0,) if donate else ())
+    return train_step
+
+
+def make_eval_step(loss_fn: Callable, *, jit: bool = True, stateful: bool = False):
+    """Forward-only step (SURVEY.md §3.4): loss, no grads, no update.
+
+    Stateful variant returns ``({"loss": ...}, carries)`` so evaluation can
+    carry recurrent state across contiguous windows."""
+
+    if stateful:
+
+        def eval_step(params, batch, carries):
+            loss, aux = loss_fn(params, batch, None, carries)
+            return {"loss": loss}, aux["carries"]
+
+    else:
+
+        def eval_step(params, batch):
+            loss, aux = loss_fn(params, batch, None)
+            return {"loss": loss}
+
+    if jit:
+        eval_step = jax.jit(eval_step)
+    return eval_step
+
+
+def evaluate(
+    eval_step, params, batches: Iterable, *, carries=None
+) -> dict[str, float]:
+    """Mean loss + perplexity over batches. Pass ``carries`` (with a stateful
+    eval_step) to thread recurrent state through the contiguous stream."""
+    stateful = carries is not None
+    total, n = 0.0, 0
+    for batch in batches:
+        if stateful:
+            m, carries = eval_step(params, batch, carries)
+        else:
+            m = eval_step(params, batch)
+        total += float(m["loss"])
+        n += 1
+    loss = total / max(n, 1)
+    return {"eval_loss": loss, "eval_ppl": float(jnp.exp(jnp.minimum(loss, 30.0)))}
+
+
+def train_loop(
+    state: TrainState,
+    train_step: Callable,
+    batches: Iterable,
+    *,
+    num_steps: int | None = None,
+    log_every: int = 50,
+    logger=None,
+    eval_fn: Callable[[Any], dict] | None = None,
+    eval_every: int = 0,
+    checkpoint_fn: Callable[[TrainState], None] | None = None,
+    checkpoint_every: int = 0,
+    tokens_per_batch: int | None = None,
+) -> TrainState:
+    """Drive the jitted step over a batch iterator, logging scalar metrics.
+
+    The only host↔device traffic per logged step is the scalar metric fetch
+    (and even that is amortised over ``log_every`` async-dispatched steps).
+    """
+    t0 = time.perf_counter()
+    window_start = t0
+    last_metrics = None
+    for i, batch in enumerate(batches):
+        if num_steps is not None and i >= num_steps:
+            break
+        state, metrics = train_step(state, batch)
+        last_metrics = metrics
+        step = i + 1
+        if log_every and step % log_every == 0:
+            loss = float(metrics["loss"])  # sync point
+            now = time.perf_counter()
+            dt = now - window_start
+            window_start = now
+            record = {
+                "step": int(state.step),
+                "loss": loss,
+                "grad_norm": float(metrics["grad_norm"]),
+                "steps_per_sec": log_every / dt,
+            }
+            if tokens_per_batch:
+                record["tokens_per_sec"] = tokens_per_batch * log_every / dt
+            if logger is not None:
+                logger.log(record)
+        if eval_fn is not None and eval_every and step % eval_every == 0:
+            ev = eval_fn(state.params)
+            if logger is not None:
+                logger.log({"step": int(state.step), **ev})
+        if checkpoint_fn is not None and checkpoint_every and step % checkpoint_every == 0:
+            checkpoint_fn(state)
+    if last_metrics is not None:
+        jax.block_until_ready(last_metrics["loss"])
+    return state

@@ -1,0 +1,37 @@
+"""Structured metrics: stdout + JSONL file.
+
+Reference parity: SURVEY.md §5 "Metrics / logging" — the reference prints
+per-epoch loss to driver stdout and leans on the Spark UI; structured metrics
+are new capability (jsonl lines consumable by any downstream tooling).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+class MetricsLogger:
+    def __init__(self, jsonl_path: str | None = None, stream=None, quiet: bool = False):
+        self.jsonl_path = jsonl_path
+        self.stream = stream or sys.stdout
+        self.quiet = quiet
+        self._fh = open(jsonl_path, "a") if jsonl_path else None
+        self._t0 = time.time()
+
+    def log(self, record: dict) -> None:
+        record = {"t": round(time.time() - self._t0, 3), **record}
+        if self._fh:
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+        if not self.quiet:
+            parts = " ".join(
+                f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in record.items()
+            )
+            print(parts, file=self.stream, flush=True)
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()

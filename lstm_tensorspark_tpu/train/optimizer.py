@@ -1,0 +1,56 @@
+"""Optimizers via optax.
+
+Reference parity: SURVEY.md §2 "Optimizer / update rule" [D][I] — the
+reference applies plain SGD on the driver after gradient averaging
+(``params -= lr * avg_grad``). SGD is therefore the default; momentum/adam
+and gradient clipping are capability extensions (BASELINE.md configs 2–5
+train poorly without them).
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def make_optimizer(
+    name: str = "sgd",
+    learning_rate: float = 1.0,
+    *,
+    momentum: float = 0.0,
+    clip_norm: float | None = None,
+    weight_decay: float = 0.0,
+    warmup_steps: int = 0,
+    decay_steps: int | None = None,
+) -> optax.GradientTransformation:
+    """Build an optax chain: [clip] -> optimizer [-> wd] with optional
+    linear-warmup cosine-decay schedule."""
+    if decay_steps is not None or warmup_steps > 0:
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0 if warmup_steps > 0 else learning_rate,
+            peak_value=learning_rate,
+            warmup_steps=max(warmup_steps, 1),
+            decay_steps=max(decay_steps or warmup_steps + 1, warmup_steps + 1),
+            end_value=learning_rate * 0.1,
+        )
+    else:
+        schedule = learning_rate
+
+    name = name.lower()
+    if name == "sgd":
+        opt = optax.sgd(schedule, momentum=momentum if momentum > 0 else None)
+    elif name == "momentum":
+        opt = optax.sgd(schedule, momentum=momentum or 0.9)
+    elif name == "adam":
+        opt = optax.adam(schedule)
+    elif name == "adamw":
+        opt = optax.adamw(schedule, weight_decay=weight_decay)
+    elif name == "rmsprop":
+        opt = optax.rmsprop(schedule)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+
+    chain = []
+    if clip_norm is not None:
+        chain.append(optax.clip_by_global_norm(clip_norm))
+    chain.append(opt)
+    return optax.chain(*chain)

@@ -1,0 +1,9 @@
+#!/usr/bin/env python
+"""Reference-parity entrypoint (SURVEY.md §1 L5: single main script at repo
+root). Where the reference ran ``spark-submit main.py --flags``, this runs the
+same CLI surface on the TPU mesh: ``python main.py --flags``."""
+
+from lstm_tensorspark_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,25 @@
+"""Test fixtures: run everything on a virtual 8-device CPU mesh.
+
+The moral equivalent of the reference's Spark ``local[N]`` story
+(SURVEY.md §4): distributed topology simulated on one host. Must set env
+before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize force-registers the TPU ("axon") platform
+# and overrides JAX_PLATFORMS; push the config back to CPU-only so the 8
+# virtual devices take effect.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+assert jax.device_count() == 8, jax.devices()

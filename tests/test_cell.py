@@ -1,0 +1,145 @@
+"""Unit numerics for the hand-rolled cell (SURVEY.md §4 test pyramid):
+fused vs unfused parity, flax.linen.LSTMCell oracle, grad vs finite
+differences."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lstm_tensorspark_tpu.ops import (
+    init_lstm_params,
+    fuse_params,
+    lstm_step,
+    lstm_step_unfused,
+    lstm_scan,
+)
+from lstm_tensorspark_tpu.ops.lstm_cell import zero_carry
+
+B, D, H, T = 4, 6, 8, 10
+
+
+@pytest.fixture
+def params():
+    return init_lstm_params(jax.random.PRNGKey(0), D, H)
+
+
+def test_shapes(params):
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+    carry = zero_carry(B, H)
+    (h, c), y = lstm_step(fuse_params(params), carry, x)
+    assert h.shape == (B, H) and c.shape == (B, H) and y.shape == (B, H)
+
+
+def test_fused_matches_unfused(params):
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+    h0 = jax.random.normal(jax.random.PRNGKey(2), (B, H))
+    c0 = jax.random.normal(jax.random.PRNGKey(3), (B, H))
+    (h1, c1), _ = lstm_step(fuse_params(params), (h0, c0), x)
+    (h2, c2), _ = lstm_step_unfused(params, (h0, c0), x)
+    np.testing.assert_allclose(h1, h2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(c1, c2, rtol=1e-5, atol=1e-5)
+
+
+def test_forget_bias(params):
+    assert np.allclose(params.b_f, 1.0)
+    assert np.allclose(params.b_i, 0.0)
+
+
+def test_flax_oracle(params):
+    """Copy our per-gate params into flax.linen.LSTMCell and compare a step."""
+    import flax.linen as nn
+
+    cell = nn.LSTMCell(features=H)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+    h0 = jax.random.normal(jax.random.PRNGKey(2), (B, H))
+    c0 = jax.random.normal(jax.random.PRNGKey(3), (B, H))
+    variables = cell.init(jax.random.PRNGKey(4), (c0, h0), x)
+
+    # flax gates: i=ii/hi, f=if/hf, g=ig/hg, o=io/ho; bias lives on h-dense.
+    fp = {"params": {}}
+    for gate in "ifgo":
+        W = getattr(params, f"W_{gate}")
+        U = getattr(params, f"U_{gate}")
+        b = getattr(params, f"b_{gate}")
+        fp["params"][f"i{gate}"] = {"kernel": W}
+        fp["params"][f"h{gate}"] = {"kernel": U, "bias": b}
+    jax.tree.map(  # structural check against the real flax param tree
+        lambda a, b_: None, variables["params"], fp["params"]
+    )
+
+    (c1f, h1f), yf = cell.apply(fp, (c0, h0), x)
+    (h1, c1), y = lstm_step(fuse_params(params), (h0, c0), x)
+    np.testing.assert_allclose(h1, h1f, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(c1, c1f, rtol=1e-5, atol=1e-5)
+
+
+def test_scan_matches_python_loop(params):
+    xs = jax.random.normal(jax.random.PRNGKey(1), (B, T, D))
+    (h, c), ys = lstm_scan(params, xs)
+    carry = zero_carry(B, H)
+    fused = fuse_params(params)
+    outs = []
+    for t in range(T):
+        carry, y = lstm_step(fused, carry, xs[:, t])
+        outs.append(y)
+    np.testing.assert_allclose(h, carry[0], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(ys, jnp.stack(outs, axis=1), rtol=1e-5, atol=1e-5)
+
+
+def test_grads_finite_differences(params):
+    from jax.test_util import check_grads
+
+    xs = jax.random.normal(jax.random.PRNGKey(1), (2, 5, D))
+
+    def loss(p, xs):
+        (h, _), ys = lstm_scan(p, xs)
+        return jnp.sum(h**2) + jnp.mean(ys**2)
+
+    check_grads(loss, (params, xs), order=1, modes=["rev"], atol=1e-2, rtol=1e-2)
+
+
+def test_remat_matches_plain(params):
+    xs = jax.random.normal(jax.random.PRNGKey(1), (B, 12, D))
+
+    def loss(p, chunk):
+        (h, _), ys = lstm_scan(p, xs, remat_chunk=chunk)
+        return jnp.mean(ys**2) + jnp.sum(h)
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(p, None))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(p, 4))(params)
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6), g0, g1
+    )
+
+
+def test_mask_freezes_carry(params):
+    xs = jax.random.normal(jax.random.PRNGKey(1), (2, 6, D))
+    lengths = jnp.array([4, 6])
+    from lstm_tensorspark_tpu.ops import sequence_mask
+
+    mask = sequence_mask(lengths, 6)
+    (h, c), ys = lstm_scan(params, xs, mask=mask)
+    # row 0's final state must equal the state after scanning only 4 steps
+    (h4, c4), _ = lstm_scan(params, xs[:1, :4])
+    np.testing.assert_allclose(h[0], h4[0], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(c[0], c4[0], rtol=1e-5, atol=1e-5)
+    # outputs after the end hold the frozen state
+    np.testing.assert_allclose(ys[0, 3], ys[0, 5], rtol=1e-5, atol=1e-5)
+
+
+def test_reverse_scan(params):
+    xs = jax.random.normal(jax.random.PRNGKey(1), (B, T, D))
+    (h, _), ys = lstm_scan(params, xs, reverse=True)
+    (h2, _), ys2 = lstm_scan(params, xs[:, ::-1])
+    np.testing.assert_allclose(h, h2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(ys, ys2[:, ::-1], rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_compute_close(params):
+    xs = jax.random.normal(jax.random.PRNGKey(1), (B, T, D))
+    (h32, _), _ = lstm_scan(params, xs)
+    (hbf, _), _ = lstm_scan(params, xs, compute_dtype=jnp.bfloat16)
+    assert hbf.dtype == jnp.float32  # accumulation/state stay f32
+    np.testing.assert_allclose(h32, hbf, rtol=0.1, atol=0.05)

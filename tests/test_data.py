@@ -1,0 +1,79 @@
+"""Data-layer tests: vocab, LM windowing (target shift), padded batches,
+dataset registry (SURVEY.md §4 test pyramid)."""
+
+import numpy as np
+
+from lstm_tensorspark_tpu.data import (
+    build_char_vocab,
+    build_word_vocab,
+    get_dataset,
+    lm_epoch_batches,
+    padded_batches,
+)
+from lstm_tensorspark_tpu.data.corpus import synthetic_text
+
+
+def test_char_vocab_roundtrip():
+    text = "hello world"
+    v = build_char_vocab(text)
+    ids = v.encode(list(text))
+    assert "".join(v.decode(ids)) == text
+    assert v.encode(["@"])[0] == v.stoi["<unk>"]
+
+
+def test_word_vocab_max_size():
+    v = build_word_vocab("a a a b b c", max_size=4)
+    assert len(v) == 4  # pad, unk, a, b
+    assert v.encode(["c"])[0] == v.UNK
+
+
+def test_lm_windows_shift():
+    tokens = np.arange(100, dtype=np.int32)
+    batches = list(lm_epoch_batches(tokens, batch_size=2, seq_len=8))
+    assert len(batches) >= 2
+    b = batches[0]
+    assert b["inputs"].shape == (2, 8)
+    np.testing.assert_array_equal(b["targets"], b["inputs"] + 1)
+    # window t+1 continues where window t left off (stateful contiguity)
+    np.testing.assert_array_equal(
+        batches[1]["inputs"][:, 0], batches[0]["inputs"][:, -1] + 1
+    )
+
+
+def test_padded_batches():
+    seqs = [np.arange(1, n + 1, dtype=np.int32) for n in (3, 7, 5, 9, 2, 6)]
+    labels = np.array([0, 1, 0, 1, 0, 1], np.int32)
+    out = list(padded_batches(seqs, labels, batch_size=2, max_len=8))
+    assert len(out) == 3
+    for b in out:
+        assert b["tokens"].shape == (2, 8)
+        for row in range(2):
+            L = b["lengths"][row]
+            assert (b["tokens"][row, :L] > 0).all()
+            assert (b["tokens"][row, L:] == 0).all()
+    # bucketing: lengths within a batch are adjacent in sorted order
+    all_lens = [tuple(b["lengths"]) for b in out]
+    flat = [l for pair in all_lens for l in pair]
+    assert flat == sorted(flat)
+    # drop_remainder=False pads with invalid filler rows, never duplicates
+    out2 = list(padded_batches(seqs, labels, batch_size=4, max_len=8,
+                               drop_remainder=False))
+    assert len(out2) == 2
+    last = out2[-1]
+    assert last["valid"].sum() == 2 and (last["lengths"][~last["valid"]] == 0).all()
+
+
+def test_synthetic_text_deterministic():
+    assert synthetic_text(500, seed=3) == synthetic_text(500, seed=3)
+    assert synthetic_text(500, seed=3) != synthetic_text(500, seed=4)
+
+
+def test_dataset_registry():
+    d = get_dataset("ptb_char")
+    assert d["synthetic"] and d["train"].dtype == np.int32
+    assert len(d["vocab"]) < 100  # char-level
+    d2 = get_dataset("imdb", num_examples=50)
+    seqs, labels = d2["train"]
+    assert len(seqs) == 40 and set(labels) == {0, 1}
+    d3 = get_dataset("uci_electricity", length=1000)
+    assert d3["train"].shape[1] == d3["num_features"]

@@ -1,0 +1,91 @@
+"""Integration tests (SURVEY.md §4): tiny-corpus overfit reaching low loss in
+seconds; CLI end-to-end through the DP backend; checkpoint resume."""
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from lstm_tensorspark_tpu.data import lm_batch_stream
+from lstm_tensorspark_tpu.models import LMConfig, init_lm, lm_loss
+from lstm_tensorspark_tpu.train import make_optimizer, make_train_step
+from lstm_tensorspark_tpu.train.loop import init_train_state, train_loop
+
+
+def test_overfit_tiny_corpus():
+    """A 1-layer LSTM must drive next-char loss well below the unigram
+    entropy on a tiny repeating corpus — end-to-end learning signal check."""
+    text = "abcdefgh" * 200
+    vocab = sorted(set(text))
+    tokens = np.asarray([vocab.index(c) for c in text], np.int32)
+    cfg = LMConfig(vocab_size=len(vocab), hidden_size=32)
+
+    def loss_fn(params, batch, rng):
+        return lm_loss(params, batch, cfg)
+
+    opt = make_optimizer("adam", 1e-2)
+    params = init_lm(jax.random.PRNGKey(0), cfg)
+    state = init_train_state(params, opt, jax.random.PRNGKey(1))
+    step = make_train_step(loss_fn, opt)
+
+    batches = lm_batch_stream(tokens, batch_size=4, seq_len=16)
+    first = None
+    for i, b in enumerate(batches):
+        state, m = step(state, b)
+        if first is None:
+            first = float(m["loss"])
+        if i >= 150:
+            break
+    final = float(m["loss"])
+    assert first > 1.5  # ~log(8) at init
+    assert final < 0.1, f"failed to overfit: {final}"
+
+
+def test_cli_end_to_end_dp(tmp_path):
+    """Full CLI run on the 8-device CPU mesh: DP backend, metrics JSONL,
+    checkpointing."""
+    from lstm_tensorspark_tpu.cli import main
+
+    jsonl = tmp_path / "metrics.jsonl"
+    ckpt = tmp_path / "ckpt"
+    rc = main([
+        "--dataset", "ptb_char",
+        "--hidden-units", "32",
+        "--batch-size", "16",
+        "--seq-len", "16",
+        "--num-steps", "12",
+        "--log-every", "4",
+        "--learning-rate", "0.5",
+        "--compute-dtype", "float32",
+        "--jsonl", str(jsonl),
+        "--checkpoint-dir", str(ckpt),
+        "--checkpoint-every", "10",
+    ])
+    assert rc == 0
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    start = next(r for r in records if r.get("note") == "start")
+    assert start["backend"] == "dp" and start["partitions"] == 8
+    losses = [r["loss"] for r in records if "loss" in r]
+    assert losses and all(np.isfinite(losses))
+    assert any(r.get("note") == "final" and "eval_ppl" in r for r in records)
+    assert os.path.exists(ckpt / "step_10.msgpack")
+
+
+def test_cli_resume(tmp_path):
+    from lstm_tensorspark_tpu.cli import main
+
+    common = [
+        "--dataset", "ptb_char", "--hidden-units", "16",
+        "--batch-size", "8", "--seq-len", "8", "--log-every", "0",
+        "--compute-dtype", "float32",
+        "--checkpoint-dir", str(tmp_path / "ck"), "--checkpoint-every", "5",
+        "--jsonl", str(tmp_path / "m.jsonl"),
+    ]
+    assert main(common + ["--num-steps", "5"]) == 0
+    # --num-steps is the TOTAL budget: resuming at 5 with budget 8 runs 3 more
+    assert main(common + ["--num-steps", "8", "--resume"]) == 0
+    records = [json.loads(l) for l in (tmp_path / "m.jsonl").read_text().splitlines()]
+    assert any("resumed at step 5" in str(r.get("note", "")) for r in records)
+    finals = [r for r in records if r.get("note") == "final"]
+    assert finals[-1]["step"] == 8
