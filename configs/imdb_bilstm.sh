@@ -1,0 +1,7 @@
+#!/bin/sh
+# BASELINE config 2: IMDB bi-LSTM hidden=256 seq-len=400
+exec python main.py --dataset imdb --hidden-units 256 --num-layers 1 \
+  --batch-size 32 --seq-len 400 --epochs 3 --optimizer adam \
+  --learning-rate 1e-3 --clip-norm 1.0 --dropout 0.2 \
+  --compute-dtype bfloat16 --remat-chunk 50 --eval-every 200 \
+  ${DATA:+--data-path "$DATA"} "$@"

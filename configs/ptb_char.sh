@@ -1,0 +1,5 @@
+#!/bin/sh
+# BASELINE config 1: PTB char-LSTM 1x128 (single chip)
+exec python main.py --dataset ptb_char --hidden-units 128 --num-layers 1 \
+  --batch-size 64 --seq-len 64 --epochs 5 --learning-rate 0.5 --stateful \
+  --compute-dtype bfloat16 --eval-every 500 ${DATA:+--data-path "$DATA"} "$@"

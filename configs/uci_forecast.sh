@@ -1,0 +1,6 @@
+#!/bin/sh
+# BASELINE config 4: UCI-Electricity seq2seq forecaster (168h context -> 24h)
+exec python main.py --dataset uci_electricity --hidden-units 128 --num-layers 1 \
+  --batch-size 64 --seq-len 168 --epochs 5 --optimizer adam --learning-rate 1e-3 \
+  --clip-norm 1.0 --compute-dtype bfloat16 --eval-every 200 \
+  ${DATA:+--data-path "$DATA"} "$@"

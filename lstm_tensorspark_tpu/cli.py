@@ -36,7 +36,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dataset", type=str, default="ptb_char",
                    choices=["ptb_char", "wikitext2", "wikitext103", "imdb", "uci_electricity"])
     p.add_argument("--batch-size", type=int, default=32, help="global batch size")
-    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="window/context length (defaults: LM 64, imdb 400, uci 168)")
     p.add_argument("--optimizer", type=str, default="sgd",
                    choices=["sgd", "momentum", "adam", "adamw", "rmsprop"])
     p.add_argument("--momentum", type=float, default=0.0)
@@ -107,6 +108,68 @@ def _select_backend(args):
     return make_mesh(dp=shards, devices=devices), shards
 
 
+def _setup_training(
+    args,
+    logger,
+    *,
+    loss_fn,
+    params,
+    optimizer,
+    rng,
+    stateful: bool = False,
+    carries0=None,
+):
+    """Shared orchestration for every task runner: backend selection,
+    divisibility check, checkpoint wiring (restore BEFORE device placement),
+    replication onto the mesh, and batch-stream sharding.
+
+    Returns (state, train_step, mesh, shards, wrap_stream, checkpoint_fn).
+    """
+    from .parallel import make_dp_train_step, shard_batch
+    from .parallel.data_parallel import replicate
+    from .train import make_train_step
+    from .train.loop import init_train_state
+
+    mesh, shards = _select_backend(args)
+    if args.batch_size % max(shards, 1) != 0:
+        raise SystemExit(
+            f"--batch-size {args.batch_size} not divisible by {shards} partitions"
+        )
+
+    state = init_train_state(params, optimizer, rng, carries=carries0)
+
+    checkpoint_fn = None
+    if args.checkpoint_dir:
+        from .train.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(args.checkpoint_dir)
+        if args.resume:
+            restored = ckpt.restore_latest(state)
+            if restored is not None:
+                state = restored
+                logger.log({"note": f"resumed at step {int(state.step)}"})
+        checkpoint_fn = ckpt.save
+
+    if mesh is None:
+        train_step = make_train_step(loss_fn, optimizer, stateful=stateful)
+
+        def wrap_stream(it):
+            return it
+
+    else:
+        train_step = make_dp_train_step(loss_fn, optimizer, mesh, stateful=stateful)
+        state = state._replace(
+            params=replicate(state.params, mesh),
+            opt_state=replicate(state.opt_state, mesh),
+            carries=shard_batch(state.carries, mesh) if stateful else None,
+        )
+
+        def wrap_stream(it):
+            return (shard_batch(b, mesh) for b in it)
+
+    return state, train_step, mesh, shards, wrap_stream, checkpoint_fn
+
+
 def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
                       eval_fn=None, checkpoint_fn=None, tokens_per_batch=None):
     from .train.loop import train_loop
@@ -139,10 +202,11 @@ def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
 def _run_lm(args, logger) -> int:
     from .data import get_dataset, lm_batch_stream, lm_epoch_batches
     from .models import LMConfig, init_lm, lm_loss
-    from .train import make_optimizer, make_train_step, make_eval_step
-    from .train.loop import evaluate, init_train_state
-    from .parallel import make_dp_train_step, make_dp_eval_step, shard_batch
+    from .train import make_optimizer, make_eval_step
+    from .train.loop import evaluate
+    from .parallel import make_dp_eval_step, shard_batch
 
+    seq_len = args.seq_len or 64
     data = get_dataset(args.dataset, args.data_path)
     if data["synthetic"]:
         logger.log({"note": f"dataset {args.dataset}: no files at --data-path, using synthetic stand-in"})
@@ -187,50 +251,31 @@ def _run_lm(args, logger) -> int:
     )
     from .models.lstm_lm import init_carries
     carries0 = init_carries(cfg, args.batch_size) if stateful else None
-    state = init_train_state(params, optimizer, krng, carries=carries0)
 
-    mesh, shards = _select_backend(args)
-    if args.batch_size % max(shards, 1) != 0:
-        raise SystemExit(f"--batch-size {args.batch_size} not divisible by {shards} partitions")
-
-    checkpoint_fn = resume_state = None
-    if args.checkpoint_dir:
-        from .train.checkpoint import Checkpointer
-        ckpt = Checkpointer(args.checkpoint_dir)
-        if args.resume:
-            resume_state = ckpt.restore_latest(state)
-        checkpoint_fn = ckpt.save
-    if resume_state is not None:
-        state = resume_state
-        logger.log({"note": f"resumed at step {int(state.step)}"})
+    state, train_step, mesh, shards, wrap_stream, checkpoint_fn = _setup_training(
+        args, logger,
+        loss_fn=loss_fn, params=params, optimizer=optimizer, rng=krng,
+        stateful=stateful, carries0=carries0,
+    )
 
     train_tokens, valid_tokens = data["train"], data["valid"]
-    steps_per_epoch = max((len(train_tokens) - 1) // (args.batch_size * args.seq_len), 1)
-    batches = lm_batch_stream(train_tokens, args.batch_size, args.seq_len)
+    steps_per_epoch = max((len(train_tokens) - 1) // (args.batch_size * seq_len), 1)
+    batches = wrap_stream(lm_batch_stream(train_tokens, args.batch_size, seq_len))
 
     if mesh is None:
-        train_step = make_train_step(loss_fn, optimizer, stateful=stateful)
         eval_step = make_eval_step(loss_fn, stateful=stateful)
     else:
-        train_step = make_dp_train_step(loss_fn, optimizer, mesh, stateful=stateful)
         eval_step = make_dp_eval_step(loss_fn, mesh, stateful=stateful)
-        from .parallel.data_parallel import replicate
-        state = state._replace(
-            params=replicate(state.params, mesh),
-            opt_state=replicate(state.opt_state, mesh),
-            carries=shard_batch(state.carries, mesh) if stateful else None,
-        )
-        batches = (shard_batch(b, mesh) for b in batches)
 
     # The valid split can be smaller than one training-size window; evaluate
     # with the largest batch that fits (multiple of the shard count).
-    eval_bs = min(args.batch_size, max((len(valid_tokens) - 1) // args.seq_len, 0))
+    eval_bs = min(args.batch_size, max((len(valid_tokens) - 1) // seq_len, 0))
     eval_bs -= eval_bs % max(shards, 1)
 
     def eval_fn(params):
         if eval_bs <= 0:
             return {"eval_skipped": 1}
-        ev = lm_epoch_batches(valid_tokens, eval_bs, args.seq_len)
+        ev = lm_epoch_batches(valid_tokens, eval_bs, seq_len)
         ev_carries = init_carries(cfg, eval_bs) if stateful else None
         if mesh is not None:
             ev = (shard_batch(b, mesh) for b in ev)
@@ -247,7 +292,7 @@ def _run_lm(args, logger) -> int:
         args, state, train_step, batches, steps_per_epoch, logger,
         eval_fn=eval_fn if args.eval_every else None,
         checkpoint_fn=checkpoint_fn,
-        tokens_per_batch=args.batch_size * args.seq_len,
+        tokens_per_batch=args.batch_size * seq_len,
     )
     final = eval_fn(state.params)
     logger.log({"step": int(state.step), **final, "note": "final"})

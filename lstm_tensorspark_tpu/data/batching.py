@@ -105,3 +105,49 @@ def padded_batches(
             labs[row] = labels[i]
             valid[row] = True
         yield {"tokens": toks, "lengths": lens, "labels": labs, "valid": valid}
+
+
+def forecast_windows(
+    series: np.ndarray,
+    context_len: int,
+    horizon: int,
+    batch_size: int,
+    *,
+    shuffle_seed: int | None = None,
+    drop_remainder: bool = True,
+) -> Iterator[dict]:
+    """Slide (context, horizon) windows over a [N, F] series and batch them.
+
+    Yields {"context" [B, context_len, F], "targets" [B, horizon, F],
+    "valid" [B]}. With ``drop_remainder=False`` the last short batch keeps
+    the static shape by repeating its final window as filler, marked
+    ``valid=False`` — weight metrics by ``valid``; no window is ever
+    double-counted as valid.
+    """
+    N = len(series)
+    starts = np.arange(0, N - context_len - horizon + 1)
+    if len(starts) == 0:
+        raise ValueError(
+            f"series length {N} < context {context_len} + horizon {horizon}"
+        )
+    if shuffle_seed is not None:
+        np.random.RandomState(shuffle_seed).shuffle(starts)
+    for b0 in range(0, len(starts), batch_size):
+        idx = starts[b0 : b0 + batch_size]
+        valid = np.ones((batch_size,), bool)
+        if len(idx) < batch_size:
+            if drop_remainder:
+                break
+            valid[len(idx):] = False
+            idx = np.concatenate(
+                [idx, np.repeat(idx[-1:], batch_size - len(idx))]
+            )
+        ctx = np.stack([series[i : i + context_len] for i in idx])
+        tgt = np.stack(
+            [series[i + context_len : i + context_len + horizon] for i in idx]
+        )
+        yield {
+            "context": ctx.astype(np.float32),
+            "targets": tgt.astype(np.float32),
+            "valid": valid,
+        }

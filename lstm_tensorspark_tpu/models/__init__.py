@@ -1,3 +1,28 @@
 from .lstm_lm import LMConfig, init_lm, lm_forward, lm_loss
+from .classifier import (
+    ClassifierConfig,
+    init_classifier,
+    classifier_forward,
+    classifier_loss,
+)
+from .seq2seq import (
+    Seq2SeqConfig,
+    init_seq2seq,
+    seq2seq_loss,
+    forecast,
+)
 
-__all__ = ["LMConfig", "init_lm", "lm_forward", "lm_loss"]
+__all__ = [
+    "LMConfig",
+    "init_lm",
+    "lm_forward",
+    "lm_loss",
+    "ClassifierConfig",
+    "init_classifier",
+    "classifier_forward",
+    "classifier_loss",
+    "Seq2SeqConfig",
+    "init_seq2seq",
+    "seq2seq_loss",
+    "forecast",
+]

@@ -1,0 +1,125 @@
+"""Bidirectional LSTM sequence classifier (BASELINE.md config 2: IMDB
+sentiment, hidden=256, seq-len=400).
+
+Reference parity: the reference's network wrapper supports a classification
+head (SURVEY.md §2 "Multi-layer / network wrapper", §6 capability envelope:
+"uni/bi-directional ... classification + LM heads, variable-length
+batching"). Bi-direction and masking are capability extensions the baseline
+configs demand.
+
+Design: each bi-layer runs the SAME `lstm_scan` twice — forward, and
+reverse=True with the carry-freeze mask (correct over right-padded batches:
+the reversed scan walks padding first with a frozen zero carry, so its final
+state is the state at t=0 over the valid prefix). Outputs concat to [B,T,2H].
+The classifier head consumes the concat of both directions' final states.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.lstm_cell import init_lstm_params
+from ..ops.masking import dropout, sequence_mask
+from ..ops.scan import lstm_scan
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassifierConfig:
+    vocab_size: int
+    num_classes: int = 2
+    hidden_size: int = 256
+    num_layers: int = 1
+    embed_size: int | None = None
+    dropout: float = 0.0
+    compute_dtype: str = "float32"
+    remat_chunk: int | None = None
+
+    @property
+    def embed(self) -> int:
+        return self.embed_size or self.hidden_size
+
+    @property
+    def cdtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+
+def init_classifier(key: jax.Array, cfg: ClassifierConfig):
+    keys = jax.random.split(key, 2 * cfg.num_layers + 2)
+    embedding = (
+        jax.random.normal(keys[0], (cfg.vocab_size, cfg.embed)) * 0.02
+    ).astype(jnp.float32)
+    fwd, bwd = [], []
+    for i in range(cfg.num_layers):
+        in_size = cfg.embed if i == 0 else 2 * cfg.hidden_size
+        fwd.append(init_lstm_params(keys[1 + 2 * i], in_size, cfg.hidden_size))
+        bwd.append(init_lstm_params(keys[2 + 2 * i], in_size, cfg.hidden_size))
+    head = {
+        "kernel": jax.nn.initializers.glorot_uniform()(
+            keys[-1], (2 * cfg.hidden_size, cfg.num_classes), jnp.float32
+        ),
+        "bias": jnp.zeros((cfg.num_classes,), jnp.float32),
+    }
+    return {"embedding": embedding, "fwd": fwd, "bwd": bwd, "head": head}
+
+
+def classifier_forward(
+    params,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    cfg: ClassifierConfig,
+    *,
+    dropout_rng: jax.Array | None = None,
+    deterministic: bool = True,
+):
+    """tokens [B,T] int32, lengths [B] → logits [B, num_classes]."""
+    cdtype = None if cfg.cdtype == jnp.float32 else cfg.cdtype
+    mask = sequence_mask(lengths, tokens.shape[1])
+    xs = jnp.take(params["embedding"], tokens, axis=0)
+    h_fwd = h_bwd = None
+    for i, (pf, pb) in enumerate(zip(params["fwd"], params["bwd"])):
+        (h_fwd, _), ys_f = lstm_scan(
+            pf, xs, mask=mask, compute_dtype=cdtype, remat_chunk=cfg.remat_chunk
+        )
+        (h_bwd, _), ys_b = lstm_scan(
+            pb, xs, mask=mask, reverse=True, compute_dtype=cdtype,
+            remat_chunk=cfg.remat_chunk,
+        )
+        xs = jnp.concatenate([ys_f, ys_b], axis=-1)
+        if i < cfg.num_layers - 1 and cfg.dropout > 0.0 and not deterministic:
+            dropout_rng, xs = dropout(dropout_rng, cfg.dropout, xs)
+    final = jnp.concatenate([h_fwd, h_bwd], axis=-1)  # [B, 2H]
+    if cfg.dropout > 0.0 and not deterministic:
+        dropout_rng, final = dropout(dropout_rng, cfg.dropout, final)
+    head = params["head"]
+    return (
+        jnp.dot(final.astype(head["kernel"].dtype), head["kernel"],
+                preferred_element_type=jnp.float32)
+        + head["bias"]
+    )
+
+
+def classifier_loss(
+    params,
+    batch,
+    cfg: ClassifierConfig,
+    *,
+    dropout_rng=None,
+    deterministic: bool = True,
+):
+    """batch: {"tokens" [B,T], "lengths" [B], "labels" [B], "valid" [B]}.
+    Mean softmax cross-entropy over valid rows; aux carries accuracy."""
+    logits = classifier_forward(
+        params, batch["tokens"], batch["lengths"], cfg,
+        dropout_rng=dropout_rng, deterministic=deterministic,
+    )
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1)[:, 0]
+    w = batch.get("valid")
+    w = jnp.ones_like(nll) if w is None else w.astype(nll.dtype)
+    denom = jnp.maximum(w.sum(), 1.0)
+    loss = (nll * w).sum() / denom
+    acc = ((jnp.argmax(logits, axis=-1) == batch["labels"]) * w).sum() / denom
+    return loss, {"loss": loss, "accuracy": acc}

@@ -1,0 +1,127 @@
+"""Seq2seq encoder-decoder LSTM forecaster (BASELINE.md config 4:
+UCI-Electricity multivariate forecasting).
+
+Reference parity: part of the driver-defined capability envelope
+(SURVEY.md §6: "seq2seq" row); the reference itself ships only one task, so
+this is new capability built from the same cell/scan primitives.
+
+Encoder: stacked LSTM over the context window; its final per-layer (h, c)
+carries initialize the decoder stack. Decoder: teacher-forced `lstm_scan`
+during training (one compiled scan over the horizon — MXU-friendly), and an
+autoregressive `lax.scan` feeding back its own projections for inference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.lstm_cell import fuse_params, init_lstm_params, lstm_step
+from ..ops.scan import lstm_scan, stacked_lstm_scan
+
+
+@dataclasses.dataclass(frozen=True)
+class Seq2SeqConfig:
+    num_features: int
+    hidden_size: int = 128
+    num_layers: int = 1
+    horizon: int = 24
+    compute_dtype: str = "float32"
+    remat_chunk: int | None = None
+
+    @property
+    def cdtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+
+def init_seq2seq(key: jax.Array, cfg: Seq2SeqConfig):
+    keys = jax.random.split(key, 2 * cfg.num_layers + 1)
+    enc, dec = [], []
+    for i in range(cfg.num_layers):
+        enc_in = cfg.num_features if i == 0 else cfg.hidden_size
+        dec_in = cfg.num_features if i == 0 else cfg.hidden_size
+        enc.append(init_lstm_params(keys[2 * i], enc_in, cfg.hidden_size))
+        dec.append(init_lstm_params(keys[2 * i + 1], dec_in, cfg.hidden_size))
+    proj = {
+        "kernel": jax.nn.initializers.glorot_uniform()(
+            keys[-1], (cfg.hidden_size, cfg.num_features), jnp.float32
+        ),
+        "bias": jnp.zeros((cfg.num_features,), jnp.float32),
+    }
+    return {"encoder": enc, "decoder": dec, "proj": proj}
+
+
+def _project(proj, h):
+    return (
+        jnp.dot(h.astype(proj["kernel"].dtype), proj["kernel"],
+                preferred_element_type=jnp.float32)
+        + proj["bias"]
+    )
+
+
+def encode(params, context: jax.Array, cfg: Seq2SeqConfig):
+    """context [B, T, F] → per-layer final carries for the decoder."""
+    cdtype = None if cfg.cdtype == jnp.float32 else cfg.cdtype
+    carries, _ = stacked_lstm_scan(
+        params["encoder"], context,
+        compute_dtype=cdtype, remat_chunk=cfg.remat_chunk,
+    )
+    return carries
+
+
+def decode_teacher_forced(params, carries, decoder_inputs, cfg: Seq2SeqConfig):
+    """Training decode: decoder_inputs [B, H, F] (last context step + shifted
+    targets) → predictions [B, H, F]. One compiled scan per layer."""
+    cdtype = None if cfg.cdtype == jnp.float32 else cfg.cdtype
+    ys = decoder_inputs
+    # no remat on the decoder: the horizon is short (remat_chunk targets the
+    # long encoder context and generally does not divide the horizon)
+    for p, c0 in zip(params["decoder"], carries):
+        _, ys = lstm_scan(p, ys, c0, compute_dtype=cdtype)
+    return _project(params["proj"], ys)
+
+
+def decode_autoregressive(params, carries, first_input, cfg: Seq2SeqConfig):
+    """Inference decode: feed back own projections for ``horizon`` steps.
+    first_input [B, F] (the last observed step). Returns [B, horizon, F]."""
+    cdtype = None if cfg.cdtype == jnp.float32 else cfg.cdtype
+    fused = [fuse_params(p, compute_dtype=cdtype) for p in params["decoder"]]
+
+    def step(carry, _):
+        layer_carries, x = carry
+        new_carries = []
+        h = x
+        for f, c in zip(fused, layer_carries):
+            c_new, h = lstm_step(f, c, h)
+            new_carries.append(c_new)
+        y = _project(params["proj"], h)
+        return (new_carries, y), y
+
+    (_, _), ys = lax.scan(step, (carries, first_input), None, length=cfg.horizon)
+    return jnp.moveaxis(ys, 0, 1)
+
+
+def seq2seq_loss(params, batch, cfg: Seq2SeqConfig, *, dropout_rng=None,
+                 deterministic: bool = True):
+    """batch: {"context" [B,T,F], "targets" [B,H,F]}. Teacher-forced MSE.
+
+    Decoder input at step t is the previous ground-truth step (context's last
+    step at t=0) — the standard teacher-forcing scheme.
+    """
+    del dropout_rng, deterministic
+    carries = encode(params, batch["context"], cfg)
+    last = batch["context"][:, -1:, :]
+    dec_in = jnp.concatenate([last, batch["targets"][:, :-1, :]], axis=1)
+    preds = decode_teacher_forced(params, carries, dec_in, cfg)
+    err = (preds - batch["targets"]) ** 2
+    loss = jnp.mean(err)
+    return loss, {"loss": loss, "mae": jnp.mean(jnp.abs(preds - batch["targets"]))}
+
+
+def forecast(params, context: jax.Array, cfg: Seq2SeqConfig):
+    """Free-running forecast: [B,T,F] → [B,horizon,F]."""
+    carries = encode(params, context, cfg)
+    return decode_autoregressive(params, carries, context[:, -1, :], cfg)

@@ -25,6 +25,15 @@ def masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
     return total / count
 
 
+def dropout(rng: jax.Array, rate: float, x: jax.Array):
+    """Inverted dropout. Returns (next_rng, dropped_x); identity at rate 0."""
+    if rate <= 0.0:
+        return rng, x
+    rng, sub = jax.random.split(rng)
+    keep = jax.random.bernoulli(sub, 1.0 - rate, x.shape)
+    return rng, jnp.where(keep, x / (1.0 - rate), 0.0)
+
+
 def reverse_sequences(x: jax.Array, lengths: jax.Array) -> jax.Array:
     """Reverse each row's first ``length`` elements, leaving padding in place.
 

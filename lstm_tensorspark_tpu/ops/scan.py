@@ -125,7 +125,7 @@ def stacked_lstm_scan(
         if idx < n - 1 and dropout_rate > 0.0 and not deterministic:
             if dropout_rng is None:
                 raise ValueError("dropout_rng required when deterministic=False")
-            dropout_rng, sub = jax.random.split(dropout_rng)
-            keep = jax.random.bernoulli(sub, 1.0 - dropout_rate, ys.shape)
-            ys = jnp.where(keep, ys / (1.0 - dropout_rate), 0.0)
+            from .masking import dropout
+
+            dropout_rng, ys = dropout(dropout_rng, dropout_rate, ys)
     return finals, ys

@@ -1,5 +1,12 @@
 from .mesh import make_mesh, local_device_count, distributed_init
 from .data_parallel import make_dp_train_step, make_dp_eval_step, shard_batch
+from .sequence_parallel import sp_lstm_scan
+from .tensor_parallel import (
+    lm_param_specs,
+    make_tp_train_step,
+    place_lm_params,
+)
+from .train_step import make_sharded_lm_train_step
 
 __all__ = [
     "make_mesh",
@@ -8,4 +15,9 @@ __all__ = [
     "make_dp_train_step",
     "make_dp_eval_step",
     "shard_batch",
+    "sp_lstm_scan",
+    "lm_param_specs",
+    "make_tp_train_step",
+    "place_lm_params",
+    "make_sharded_lm_train_step",
 ]

@@ -1,0 +1,139 @@
+"""Sequence/context parallelism for the LSTM recurrence.
+
+The reference has NO long-context story beyond a fixed unroll inside one
+worker (SURVEY.md §5 "Long-context / sequence parallelism": none). This
+module is new first-class capability: the time axis is sharded over the
+"seq" mesh axis, so each device stores only T/S of the activations for BPTT
+— the memory scaling that makes very long sequences trainable (the LSTM
+analogue of ring-attention's motivation; attention itself is n/a to this
+architecture).
+
+An LSTM is sequential in T, so the chunks form a dependency chain: device s
+needs device s-1's final (h, c). The schedule is a classic WAVEFRONT:
+
+  tick 0: dev0 scans microbatch 0 | others idle
+  tick 1: dev1 scans mb 0 (carry from dev0) | dev0 scans mb 1 | ...
+  ...
+
+with the carry handed right one hop per tick via `lax.ppermute` (ICI
+neighbor traffic only — 2*b*H floats per tick). With M microbatches,
+utilization is M/(M+S-1): M=1 gives pure memory scaling; M >= S recovers
+throughput (pipeline full).
+
+Under `shard_map`, `lax.cond` on a per-device predicate compiles to a real
+branch (not a select), so idle ticks cost no scan compute. Autodiff reverses
+the wavefront (ppermute transposes to the opposite ring), giving BPTT with
+the same memory scaling.
+
+``uniform=True`` replaces the cond with where-masking: every device executes
+every tick (same wall-clock — the pipeline bubble just burns compute instead
+of idling). REQUIRED whenever the scan body contains collectives the devices
+must hit in lockstep — e.g. composing with tensor parallelism on an auto
+"model" axis, where GSPMD inserts all-gathers inside the scan: divergent
+branches would deadlock the rendezvous.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.lstm_cell import LSTMParams, fuse_params, lstm_step, zero_carry
+
+
+def sp_lstm_scan(
+    params: LSTMParams,
+    xs_local: jax.Array,
+    *,
+    axis: str = "seq",
+    microbatches: int = 1,
+    compute_dtype=None,
+    remat_chunk: int | None = None,
+    unroll: int = 1,
+    uniform: bool = False,
+) -> jax.Array:
+    """Wavefront LSTM scan over a sequence-sharded batch.
+
+    MUST be called inside a `shard_map` program whose mesh has ``axis``.
+    ``xs_local`` is this device's time-chunk ``[B, C, D]`` (C = T/S).
+    Returns the local outputs ``ys`` ``[B, C, H]`` (hidden per local step).
+    Zero initial carry (sequence starts on device 0).
+    """
+    S = lax.axis_size(axis)
+    s = lax.axis_index(axis)
+    B, C, _ = xs_local.shape
+    M = microbatches
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    b = B // M
+    H = params.hidden_size
+    fused = fuse_params(params, compute_dtype=compute_dtype)
+
+    def chunk_scan(carry, x_chunk):
+        """One microbatch's pass over the local chunk: [b, C, D] -> [b, C, H]."""
+        xs_t = jnp.moveaxis(x_chunk, 0, 1)  # [C, b, D]
+
+        def step(c, x):
+            return lstm_step(fused, c, x)
+
+        if remat_chunk is not None:
+            if C % remat_chunk != 0:
+                raise ValueError(f"C={C} not divisible by remat_chunk={remat_chunk}")
+
+            def inner(c, xs_chunk):
+                return lax.scan(step, c, xs_chunk, unroll=unroll)
+
+            inner = jax.checkpoint(inner, prevent_cse=False)
+            chunked = xs_t.reshape(C // remat_chunk, remat_chunk, b, -1)
+            new_carry, ys = lax.scan(inner, carry, chunked)
+            ys = ys.reshape(C, b, H)
+        else:
+            new_carry, ys = lax.scan(step, carry, xs_t, unroll=unroll)
+        return new_carry, jnp.moveaxis(ys, 0, 1)  # [b, C, H]
+
+    xs_m = xs_local.reshape(M, b, C, -1)
+    ys_buf = jnp.zeros((M, b, C, H), jnp.float32)
+    zc = zero_carry(b, H)
+    # carry_in: the carry for the microbatch this device processes next tick
+    carry_in = zc
+    right = [(i, i + 1) for i in range(S - 1)]  # linear chain, no wraparound
+
+    for t in range(M + S - 1):
+        m = t - s  # which microbatch this device works on at tick t
+        active = jnp.logical_and(m >= 0, m < M)
+        m_c = jnp.clip(m, 0, M - 1)
+        x_m = lax.dynamic_index_in_dim(xs_m, m_c, axis=0, keepdims=False)
+
+        if uniform:
+            # collective-safe: all devices scan every tick, results masked
+            scanned_carry, ys = chunk_scan(carry_in, x_m)
+            carry_out = jax.tree.map(
+                lambda new, old: jnp.where(active, new, old),
+                scanned_carry, carry_in,
+            )
+            updated = lax.dynamic_update_index_in_dim(ys_buf, ys, m_c, axis=0)
+            ys_buf = jnp.where(active, updated, ys_buf)
+        else:
+
+            def do_scan(carry, x):
+                return chunk_scan(carry, x)
+
+            def skip(carry, x):
+                return carry, jnp.zeros((b, C, H), jnp.float32)
+
+            carry_out, ys = lax.cond(active, do_scan, skip, carry_in, x_m)
+            ys_buf = lax.cond(
+                active,
+                lambda buf, y: lax.dynamic_update_index_in_dim(buf, y, m_c, axis=0),
+                lambda buf, y: buf,
+                ys_buf, ys,
+            )
+        # hand the finished microbatch's carry to the right neighbor
+        received = lax.ppermute(carry_out, axis, right)
+        # device 0 always starts each microbatch from zero carry
+        carry_in = jax.tree.map(
+            lambda r, z: jnp.where(s == 0, z, r), received, zc
+        )
+
+    return ys_buf.reshape(B, C, H)

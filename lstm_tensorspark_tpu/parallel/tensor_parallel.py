@@ -1,0 +1,109 @@
+"""Tensor parallelism for the LSTM: gate/hidden dimensions sharded over the
+"model" mesh axis.
+
+Not in the reference (SURVEY.md §2 parallelism inventory: TP "no"); new
+capability. Design is compiler-first (the pjit/GSPMD recipe: annotate
+shardings, let XLA insert the collectives — PAPERS.md "Scalable Training of
+Language Models using JAX pjit and TPUv4" describes the approach): every
+gate kernel is column-sharded ``[D, H/P]``, recurrent kernels ``[H, H/P]``,
+the LM head row-sharded ``[H/P, V]``. XLA then emits the per-step all-gather
+of h (column-parallel matmul) and the logits psum (row-parallel matmul) plus
+the correct gradient reductions — no hand-written collective can drift out
+of sync with the backward pass.
+
+This composes with data parallelism on the same mesh: batch over "data",
+params over "model", both handled by GSPMD from the same annotations.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.lstm_cell import LSTMParams
+from ..train.loop import TrainState, step_body
+
+
+def lstm_param_specs(tp_axis: str = "model") -> LSTMParams:
+    """PartitionSpecs for one cell: gate output dim sharded over tp_axis."""
+    col = P(None, tp_axis)  # W [D, H/P], U [H, H/P]
+    vec = P(tp_axis)  # b [H/P]
+    return LSTMParams(
+        W_i=col, W_f=col, W_g=col, W_o=col,
+        U_i=col, U_f=col, U_g=col, U_o=col,
+        b_i=vec, b_f=vec, b_g=vec, b_o=vec,
+    )
+
+
+def lm_param_specs(params, tp_axis: str = "model"):
+    """PartitionSpec pytree for the LM param dict (models/lstm_lm.py):
+    embedding replicated, cells column-sharded, head row-sharded."""
+    specs = {
+        "embedding": P(),
+        "layers": [lstm_param_specs(tp_axis) for _ in params["layers"]],
+    }
+    head = {"bias": P()}
+    if "kernel" in params["head"]:
+        head["kernel"] = P(tp_axis, None)  # [H/P, V] row-parallel
+    specs["head"] = head
+    return specs
+
+
+def place_lm_params(params, mesh: Mesh, tp_axis: str = "model"):
+    """Device_put the LM params with TP shardings on ``mesh``."""
+    specs = lm_param_specs(params, tp_axis)
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, jax.Array) or x is None,
+    )
+
+
+def make_tp_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    params_template,
+    *,
+    dp_axis: str = "data",
+    tp_axis: str = "model",
+    stateful: bool = False,
+    donate: bool | None = None,
+):
+    """Compiler-sharded (GSPMD) train step: TP via param shardings, DP via
+    batch sharding — no shard_map, no manual collectives.
+
+    ``params_template`` provides the pytree structure for the sharding
+    annotations. The batch's leading dim is sharded over ``dp_axis``; XLA
+    derives every collective (h all-gather per step, logits psum, grad
+    reductions) from the annotations.
+    """
+    param_specs = lm_param_specs(params_template, tp_axis)
+    state_shardings = TrainState(
+        step=NamedSharding(mesh, P()),
+        params=jax.tree.map(
+            lambda s: NamedSharding(mesh, s), param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+        # opt_state stays unconstrained: XLA propagates the params' shardings
+        # onto the matching optimizer-state leaves
+        opt_state=None,
+        rng=NamedSharding(mesh, P()),
+        carries=NamedSharding(mesh, P(dp_axis)) if stateful else None,
+    )
+
+    def train_step(state: TrainState, batch):
+        return step_body(loss_fn, optimizer, state, batch, stateful=stateful)
+
+    from ..train.loop import _donation_supported
+
+    if donate is None:
+        donate = _donation_supported()
+    return jax.jit(
+        train_step,
+        in_shardings=(state_shardings, NamedSharding(mesh, P(dp_axis))),
+        donate_argnums=(0,) if donate else (),
+    )

@@ -1,0 +1,139 @@
+"""Combined 3D-parallel LM train step: DP x TP x SP on one mesh.
+
+Axis responsibilities (mesh.py convention):
+  "data"  — batch sharding, grads pmean'd (the reference's only strategy [D])
+  "seq"   — time-chunk sharding via the wavefront scan (sequence parallel)
+  "model" — gate/hidden sharding (tensor parallel)
+
+Hybrid manual/auto sharding: `shard_map` is MANUAL over {"data","seq"} (the
+wavefront's ppermute needs explicit neighbor collectives the compiler cannot
+infer), while "model" stays an AUTO axis — inside the body all hidden-dim
+tensors remain global and GSPMD shards them from the jit-level param
+annotations (tensor_parallel.lm_param_specs), deriving the h all-gather,
+logits psum and gradient reductions automatically.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..models.lstm_lm import LMConfig
+from ..train.loop import TrainState, step_body
+from .sequence_parallel import sp_lstm_scan
+from .tensor_parallel import lm_param_specs
+
+
+def sp_lm_loss(params, batch, cfg: LMConfig, *, seq_axis: str = "seq",
+               microbatches: int = 1):
+    """LM loss over a sequence-sharded batch (called inside shard_map).
+
+    batch: {"inputs","targets"} each [b_local, C] (B sharded over "data",
+    T over "seq"). Stacked layers each run the wavefront scan; layer
+    boundaries need NO communication (chunks stay resident). Deterministic
+    (no dropout) — SP training targets long-context configs where remat,
+    not dropout, is the lever.
+    """
+    xs = jnp.take(params["embedding"], batch["inputs"], axis=0)
+    for layer in params["layers"]:
+        xs = sp_lstm_scan(
+            layer, xs,
+            axis=seq_axis,
+            microbatches=microbatches,
+            compute_dtype=None if cfg.cdtype == jnp.float32 else cfg.cdtype,
+            remat_chunk=cfg.remat_chunk,
+            unroll=cfg.scan_unroll,
+            # "model" is an auto axis here: GSPMD inserts TP collectives
+            # inside the scan, so ticks must execute in lockstep
+            uniform=True,
+        )
+    head = params["head"]
+    kernel = params["embedding"].T if cfg.tie_embeddings else head["kernel"]
+    logits = (
+        jnp.dot(xs.astype(kernel.dtype), kernel,
+                preferred_element_type=jnp.float32)
+        + head["bias"]
+    )
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)[..., 0]
+    loss = jnp.mean(nll)  # local mean; caller pmeans over data+seq
+    return loss, {"loss": loss}
+
+
+def make_sharded_lm_train_step(
+    cfg: LMConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    params_template,
+    *,
+    microbatches: int = 1,
+    donate: bool | None = None,
+):
+    """Build the DP x TP x SP train step. Batch: {"inputs","targets"} [B, T]
+    with B % (data axis) == 0 and T % (seq axis) == 0."""
+
+    manual = {"data", "seq"}
+
+    def loss_fn(params, batch, rng):
+        del rng
+        return sp_lm_loss(params, batch, cfg, microbatches=microbatches)
+
+    def body(state: TrainState, batch):
+        return step_body(
+            loss_fn, optimizer, state, batch,
+            rng_transform=lambda sub: jax.random.fold_in(
+                sub,
+                jax.lax.axis_index("data") * jax.lax.axis_size("seq")
+                + jax.lax.axis_index("seq"),
+            ),
+            reduce_fn=lambda grads, loss: (
+                jax.lax.pmean(grads, ("data", "seq")),
+                jax.lax.pmean(loss, ("data", "seq")),
+            ),
+        )
+
+    state_spec = TrainState(step=P(), params=P(), opt_state=P(), rng=P(), carries=P())
+    batch_spec = {"inputs": P("data", "seq"), "targets": P("data", "seq")}
+    sharded = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(state_spec, batch_spec),
+        out_specs=(state_spec, P()),
+        axis_names=manual,
+        check_vma=False,
+    )
+
+    # TP placement happens at the jit level (auto axis "model").
+    param_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        lm_param_specs(params_template),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    state_shardings = TrainState(
+        step=NamedSharding(mesh, P()),
+        params=param_shardings,
+        opt_state=None,  # propagated from params by XLA
+        rng=NamedSharding(mesh, P()),
+        carries=None,
+    )
+    batch_shardings = {
+        "inputs": NamedSharding(mesh, P("data", "seq")),
+        "targets": NamedSharding(mesh, P("data", "seq")),
+    }
+
+    from ..train.loop import _donation_supported
+
+    if donate is None:
+        donate = _donation_supported()
+    return jax.jit(
+        sharded,
+        in_shardings=(state_shardings, batch_shardings),
+        donate_argnums=(0,) if donate else (),
+    )

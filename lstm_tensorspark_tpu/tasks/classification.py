@@ -1,14 +1,109 @@
-"""IMDB-style bi-LSTM classification task (BASELINE.md config 2).
+"""IMDB-style bi-LSTM classification task runner (BASELINE.md config 2).
 
-Placeholder entrypoint — the bidirectional classifier model lands with the
-model-families milestone; until then fail fast with a clear message instead
-of an import error.
+Wires the bi-LSTM classifier (models/classifier.py) into the CLI: epochs of
+bucketed padded batches, single-chip or data-parallel training (via the
+shared cli._setup_training orchestration, including checkpoint/resume),
+accuracy eval. Evaluation runs single-device on the small held-out split
+(params are replicated, so any device's copy works).
 """
+
+from __future__ import annotations
+
+import jax
 
 
 def run_classifier(args, logger) -> int:
-    raise SystemExit(
-        "--dataset imdb: the bi-LSTM classification task is not wired into the "
-        "CLI yet (model families milestone); the imdb dataset builder and "
-        "masking/batching utilities are available as a library."
+    from ..cli import _make_logged_loop, _setup_training
+    from ..data import get_dataset, padded_batches
+    from ..models.classifier import ClassifierConfig, classifier_loss, init_classifier
+    from ..train import make_optimizer
+
+    if args.stateful:
+        raise SystemExit(
+            "--stateful applies to contiguous-stream LM training only "
+            "(classification examples are independent)"
+        )
+    max_len = args.seq_len or 400  # config-2 default
+    data = get_dataset("imdb", args.data_path, max_len=max_len)
+    if data["synthetic"]:
+        logger.log({"note": "dataset imdb: using synthetic stand-in"})
+    vocab = data["vocab"]
+    cfg = ClassifierConfig(
+        vocab_size=len(vocab),
+        num_classes=data["num_classes"],
+        hidden_size=args.hidden_units,
+        num_layers=args.num_layers,
+        dropout=args.dropout,
+        compute_dtype=args.compute_dtype,
+        remat_chunk=args.remat_chunk,
     )
+
+    def loss_fn(params, batch, dropout_rng):
+        return classifier_loss(
+            params, batch, cfg,
+            dropout_rng=dropout_rng,
+            deterministic=dropout_rng is None or cfg.dropout == 0.0,
+        )
+
+    key = jax.random.PRNGKey(args.seed)
+    kp, kr = jax.random.split(key)
+    params = init_classifier(kp, cfg)
+    optimizer = make_optimizer(
+        args.optimizer, args.learning_rate,
+        momentum=args.momentum, clip_norm=args.clip_norm,
+    )
+
+    state, train_step, mesh, shards, wrap_stream, checkpoint_fn = _setup_training(
+        args, logger, loss_fn=loss_fn, params=params, optimizer=optimizer, rng=kr,
+    )
+
+    train_seqs, train_labels = data["train"]
+    valid_seqs, valid_labels = data["valid"]
+    if len(train_seqs) < args.batch_size:
+        raise SystemExit(
+            f"train set too small: {len(train_seqs)} examples < batch {args.batch_size}"
+        )
+    steps_per_epoch = max(len(train_seqs) // args.batch_size, 1)
+
+    def batches():
+        epoch = 0
+        while True:
+            yield from padded_batches(
+                train_seqs, train_labels, args.batch_size, max_len,
+                shuffle_seed=args.seed + epoch,
+            )
+            epoch += 1
+
+    stream = wrap_stream(batches())
+    eval_step = jax.jit(lambda p, b: classifier_loss(p, b, cfg)[1])
+
+    def eval_fn(params):
+        if not valid_seqs:
+            return {"eval_skipped": 1}
+        tot_w = tot_loss = tot_acc = 0.0
+        eval_bs = min(args.batch_size, len(valid_seqs))
+        for b in padded_batches(valid_seqs, valid_labels, eval_bs, max_len,
+                                drop_remainder=False):
+            m = eval_step(params, b)
+            w = float(b["valid"].sum())
+            tot_loss += float(m["loss"]) * w
+            tot_acc += float(m["accuracy"]) * w
+            tot_w += w
+        tot_w = max(tot_w, 1.0)
+        return {"eval_loss": tot_loss / tot_w, "eval_accuracy": tot_acc / tot_w}
+
+    logger.log({
+        "note": "start", "dataset": "imdb", "vocab": len(vocab),
+        "max_len": max_len, "devices": jax.device_count(), "partitions": shards,
+        "steps_per_epoch": steps_per_epoch,
+        "backend": "dp" if mesh is not None else "single",
+    })
+    state = _make_logged_loop(
+        args, state, train_step, stream, steps_per_epoch, logger,
+        eval_fn=eval_fn if args.eval_every else None,
+        checkpoint_fn=checkpoint_fn,
+        tokens_per_batch=args.batch_size * max_len,
+    )
+    final = eval_fn(jax.device_get(state.params))
+    logger.log({"step": int(state.step), **final, "note": "final"})
+    return 0

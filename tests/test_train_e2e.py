@@ -89,3 +89,37 @@ def test_cli_resume(tmp_path):
     assert any("resumed at step 5" in str(r.get("note", "")) for r in records)
     finals = [r for r in records if r.get("note") == "final"]
     assert finals[-1]["step"] == 8
+
+
+def test_cli_classifier_dp(tmp_path):
+    from lstm_tensorspark_tpu.cli import main
+
+    jsonl = tmp_path / "c.jsonl"
+    rc = main([
+        "--dataset", "imdb", "--hidden-units", "16", "--batch-size", "16",
+        "--seq-len", "32", "--num-steps", "6", "--log-every", "3",
+        "--optimizer", "adam", "--learning-rate", "1e-3",
+        "--compute-dtype", "float32", "--jsonl", str(jsonl),
+    ])
+    assert rc == 0
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    start = next(r for r in records if r.get("note") == "start")
+    assert start["backend"] == "dp"
+    final = next(r for r in records if r.get("note") == "final")
+    assert "eval_accuracy" in final and np.isfinite(final["eval_loss"])
+
+
+def test_cli_forecaster_dp(tmp_path):
+    from lstm_tensorspark_tpu.cli import main
+
+    jsonl = tmp_path / "f.jsonl"
+    rc = main([
+        "--dataset", "uci_electricity", "--hidden-units", "16",
+        "--batch-size", "16", "--seq-len", "48", "--num-steps", "6",
+        "--log-every", "3", "--optimizer", "adam", "--learning-rate", "1e-3",
+        "--compute-dtype", "float32", "--jsonl", str(jsonl),
+    ])
+    assert rc == 0
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    final = next(r for r in records if r.get("note") == "final")
+    assert np.isfinite(final["eval_mse"])
