@@ -49,6 +49,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat-chunk", type=int, default=None,
                    help="jax.checkpoint chunk size over time (long sequences)")
     p.add_argument("--scan-unroll", type=int, default=1)
+    p.add_argument("--use-pallas", action="store_true",
+                   help="fused Pallas recurrence kernel (TPU, B%%8==0, H%%128==0)")
     p.add_argument("--stateful", action="store_true",
                    help="stateful truncated BPTT: carry recurrent state across contiguous windows")
     p.add_argument("--num-steps", type=int, default=None,
@@ -220,6 +222,7 @@ def _run_lm(args, logger) -> int:
         compute_dtype=args.compute_dtype,
         remat_chunk=args.remat_chunk,
         scan_unroll=args.scan_unroll,
+        use_pallas=args.use_pallas,
     )
 
     stateful = args.stateful

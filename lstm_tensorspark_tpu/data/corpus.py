@@ -54,6 +54,19 @@ class Vocab:
         unk = self.stoi.get("<unk>", 0)
         return np.asarray([self.stoi.get(t, unk) for t in tokens], dtype=np.int32)
 
+    def encode_text(self, text: str, level: str) -> np.ndarray:
+        """Encode raw text at "char" or "word" level — native C++ fast path
+        (data/native.py) with pure-Python fallback."""
+        from . import native
+
+        unk = self.stoi.get("<unk>", 0)
+        if level == "char":
+            return native.encode_chars(text, self.stoi, unk)
+        n_special = sum(1 for t in self.itos if t in ("<pad>", "<unk>"))
+        return native.encode_words(
+            text, self.itos[n_special:], self.stoi, unk, id_base=n_special
+        )
+
     def decode(self, ids) -> list[str]:
         return [self.itos[int(i)] for i in ids]
 

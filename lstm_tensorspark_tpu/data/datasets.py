@@ -45,12 +45,10 @@ def _lm_dataset(
 
     if level == "char":
         vocab = build_char_vocab(texts["train"])
-        tokenize = list
     else:
         vocab = build_word_vocab(texts["train"], max_vocab)
-        tokenize = str.split
 
-    out = {s: vocab.encode(tokenize(t)) for s, t in texts.items()}
+    out = {s: vocab.encode_text(t, level) for s, t in texts.items()}
     out["vocab"] = vocab
     out["synthetic"] = synthetic
     return out

@@ -1,0 +1,125 @@
+"""ctypes bindings for the native data-pipeline kernels (native/fastdata.cpp)
+with transparent pure-Python fallback.
+
+The .so is built on demand via the checked-in Makefile (g++ is part of the
+toolchain); if the build or load fails, every entry point falls back to the
+numpy/Python implementation with identical results — the native path is a
+host-side throughput optimization, never a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SO_PATH = os.path.join(_NATIVE_DIR, "libfastdata.so")
+
+_lib = None
+_load_attempted = False
+
+
+def _load():
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("LSTM_TSP_NO_NATIVE") == "1":
+        return None
+    try:
+        src = os.path.join(_NATIVE_DIR, "fastdata.cpp")
+        stale = not os.path.exists(_SO_PATH) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)
+        )
+        if stale:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "-sB"],
+                check=True, capture_output=True, timeout=120,
+            )
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.encode_bytes.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.count_words.restype = ctypes.c_int64
+        lib.count_words.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.encode_words.restype = ctypes.c_int64
+        lib.encode_words.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def encode_chars(text: str, stoi: dict[str, int], unk_id: int) -> np.ndarray:
+    """Char-level encoding. Only ASCII vocabularies take the native path
+    (byte-level table); others fall back."""
+    lib = _load()
+    # The byte table only matches Python-level chars when text is pure ASCII
+    # (1 byte == 1 char); multi-byte UTF-8 would change lengths and ids.
+    # multi-char stoi entries (<pad>/<unk> specials) never appear in raw text.
+    chars = {c: i for c, i in stoi.items() if len(c) == 1}
+    if (
+        lib is not None
+        and text.isascii()
+        and all(ord(c) < 128 for c in chars)
+    ):
+        data = text.encode("ascii")
+        table = np.full(256, unk_id, np.int32)
+        for ch, idx in chars.items():
+            table[ord(ch)] = idx
+        out = np.empty(len(data), np.int32)
+        lib.encode_bytes(
+            data, len(data),
+            table.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return out
+    return np.asarray([stoi.get(c, unk_id) for c in text], np.int32)
+
+
+def _ascii_splittable(text: str) -> bool:
+    """True when str.split() and the C tokenizer agree: pure-ASCII text
+    (the C side matches Python's ASCII whitespace set exactly)."""
+    return text.isascii()
+
+
+def encode_words(
+    text: str, itos: list[str], stoi: dict[str, int],
+    unk_id: int, id_base: int = 0,
+) -> np.ndarray:
+    """Word-level encoding of a whitespace-tokenized text.
+
+    itos: words in id order STARTING at id_base (specials excluded when
+    id_base covers them)."""
+    lib = _load()
+    if lib is not None and _ascii_splittable(text):
+        data = text.encode("ascii")
+        vocab_buf = b"\0".join(w.encode("utf-8") for w in itos) + b"\0"
+        n_words = lib.count_words(data, len(data))
+        out = np.empty(max(n_words, 1), np.int32)
+        written = lib.encode_words(
+            data, len(data), vocab_buf, len(vocab_buf), len(itos),
+            id_base, unk_id,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(out),
+        )
+        return out[:written]
+    return np.asarray(
+        [stoi.get(w, unk_id) for w in text.split()], np.int32
+    )

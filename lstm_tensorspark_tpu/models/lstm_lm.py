@@ -32,6 +32,9 @@ class LMConfig:
     compute_dtype: str = "float32"  # "bfloat16" for MXU-friendly matmuls
     remat_chunk: int | None = None
     scan_unroll: int = 1
+    # fused Pallas recurrence kernel (ops/pallas_lstm.py) when shapes/platform
+    # allow; falls back to lax.scan per layer otherwise
+    use_pallas: bool = False
 
     @property
     def embed(self) -> int:
@@ -93,6 +96,7 @@ def lm_forward(
         compute_dtype=None if cdtype == jnp.float32 else cdtype,
         remat_chunk=cfg.remat_chunk,
         unroll=cfg.scan_unroll,
+        use_pallas=cfg.use_pallas,
     )
     head = params["head"]
     kernel = params["embedding"].T if cfg.tie_embeddings else head["kernel"]

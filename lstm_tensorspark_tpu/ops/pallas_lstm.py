@@ -1,0 +1,175 @@
+"""Fused Pallas TPU kernel for the LSTM recurrence.
+
+Motivation (SURVEY.md §2 native-capability table: "optional Pallas kernel
+for the fused cell if XLA fusion is insufficient"): under `lax.scan` XLA
+executes T small programs, each round-tripping h/c and the gate activations
+through HBM. This kernel runs the WHOLE sequence in one `pallas_call`:
+
+- the input projection ``X @ W + b`` for all T steps is hoisted OUT of the
+  recurrence into one large MXU matmul (XLA does this part best);
+- the serial part — ``z_t = Xproj_t + h @ U``, gates, state update — runs
+  over a sequential grid of T steps with h and c RESIDENT IN VMEM scratch
+  (TPU grids execute in order, so scratch carries state between steps);
+- per step the kernel touches HBM only for its Xproj block (streamed in)
+  and its ys block (streamed out): 2*B*H + B*4H floats instead of the
+  scan's intermediates.
+
+Training support: `pallas_lstm_scan` carries a custom VJP whose backward
+re-runs the pure-jax scan under `jax.vjp` (full-recompute, remat-style) —
+gradients are exactly the reference implementation's, and the fast kernel
+needs no hand-written backward.
+
+Tiling constraints (pallas_guide.md): last dim 128 lanes; float32 sublane 8.
+`supported()` gates on B % 8 == 0 and H % 128 == 0; callers fall back to
+`lstm_scan` otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .lstm_cell import LSTMParams, fuse_params
+from .scan import lstm_scan
+
+
+def supported(batch: int, hidden: int, platform: str | None = None) -> bool:
+    """Can the fused kernel run these shapes on this platform?"""
+    if platform is None:
+        platform = jax.default_backend()
+    return platform == "tpu" and batch % 8 == 0 and hidden % 128 == 0
+
+
+def _lstm_kernel(xproj_ref, u_ref, h0_ref, c0_ref, ys_ref, hT_ref, cT_ref,
+                 h_scr, c_scr, *, hidden: int):
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    z = xproj_ref[0] + jnp.dot(
+        h_scr[:].astype(u_ref.dtype), u_ref[:], preferred_element_type=jnp.float32
+    )
+    H = hidden
+    i = jax.nn.sigmoid(z[:, :H])
+    f = jax.nn.sigmoid(z[:, H : 2 * H])
+    g = jnp.tanh(z[:, 2 * H : 3 * H])
+    o = jax.nn.sigmoid(z[:, 3 * H :])
+    c = f * c_scr[:] + i * g
+    h = o * jnp.tanh(c)
+    h_scr[:] = h
+    c_scr[:] = c
+    ys_ref[0] = h
+
+    @pl.when(t == T - 1)
+    def _():
+        hT_ref[:] = h
+        cT_ref[:] = c
+
+
+def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False):
+    """xs [B,T,D] -> (ys [B,T,H], hT, cT). fused: FusedLSTMParams."""
+    B, T, _ = xs.shape
+    H = fused.hidden_size
+    dtype = fused.kernel.dtype
+    # one big MXU matmul for every step's input projection
+    xproj = (
+        jnp.einsum(
+            "btd,dk->btk", xs.astype(dtype), fused.kernel,
+            preferred_element_type=jnp.float32,
+        )
+        + fused.bias
+    )  # [B, T, 4H] f32
+    xproj = jnp.moveaxis(xproj, 0, 1)  # [T, B, 4H]
+
+    kernel = functools.partial(_lstm_kernel, hidden=H)
+    ys, hT, cT = pl.pallas_call(
+        kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, 4 * H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # U resident
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # h0
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # c0
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xproj, fused.recurrent, h0.astype(jnp.float32), c0.astype(jnp.float32))
+    return jnp.moveaxis(ys, 0, 1), hT, cT
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _scan_core(params, xs, h0, c0, compute_dtype, interpret):
+    fused = fuse_params(params, compute_dtype=compute_dtype)
+    ys, hT, cT = _pallas_forward(fused, xs, h0, c0, interpret=interpret)
+    return ys, hT, cT
+
+
+def _reference(params, xs, h0, c0, compute_dtype):
+    (hT, cT), ys = lstm_scan(params, xs, (h0, c0), compute_dtype=compute_dtype)
+    return ys, hT, cT
+
+
+def _scan_core_fwd(params, xs, h0, c0, compute_dtype, interpret):
+    out = _scan_core(params, xs, h0, c0, compute_dtype, interpret)
+    return out, (params, xs, h0, c0)
+
+
+def _scan_core_bwd(compute_dtype, interpret, residuals, cotangents):
+    # Remat-style backward: recompute the forward with the pure-jax scan and
+    # pull gradients through it — bit-exact with the reference BPTT.
+    params, xs, h0, c0 = residuals
+    _, vjp = jax.vjp(
+        lambda p, x, h, c: _reference(p, x, h, c, compute_dtype),
+        params, xs, h0, c0,
+    )
+    return vjp(cotangents)
+
+
+_scan_core.defvjp(_scan_core_fwd, _scan_core_bwd)
+
+
+def pallas_lstm_scan(
+    params: LSTMParams,
+    xs: jax.Array,
+    carry: tuple[jax.Array, jax.Array] | None = None,
+    *,
+    compute_dtype=None,
+    interpret: bool = False,
+):
+    """Drop-in fused-kernel variant of `lstm_scan` (no mask/reverse support;
+    long-T remat is unnecessary — backward already full-recomputes).
+
+    Returns ``((hT, cT), ys)`` like `lstm_scan`.
+    """
+    B, _, _ = xs.shape
+    H = params.hidden_size
+    if carry is None:
+        h0 = jnp.zeros((B, H), jnp.float32)
+        c0 = jnp.zeros((B, H), jnp.float32)
+    else:
+        h0, c0 = carry
+    ys, hT, cT = _scan_core(params, xs, h0, c0, compute_dtype, interpret)
+    return (hT, cT), ys

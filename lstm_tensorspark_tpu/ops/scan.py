@@ -115,12 +115,24 @@ def stacked_lstm_scan(
     layers (not on the recurrent path). Returns (list of per-layer final
     carries, top-layer outputs ``[B, T, H]``).
     """
+    use_pallas = scan_kwargs.pop("use_pallas", False)
     ys = xs
     finals = []
     n = len(layer_params)
     for idx, p in enumerate(layer_params):
         c0 = None if carries is None else carries[idx]
-        final, ys = lstm_scan(p, ys, c0, mask=mask, **scan_kwargs)
+        took_pallas = False
+        if use_pallas and mask is None and not scan_kwargs.get("reverse", False):
+            from .pallas_lstm import pallas_lstm_scan, supported
+
+            if supported(ys.shape[0], p.hidden_size):
+                final, ys = pallas_lstm_scan(
+                    p, ys, c0,
+                    compute_dtype=scan_kwargs.get("compute_dtype"),
+                )
+                took_pallas = True
+        if not took_pallas:
+            final, ys = lstm_scan(p, ys, c0, mask=mask, **scan_kwargs)
         finals.append(final)
         if idx < n - 1 and dropout_rate > 0.0 and not deterministic:
             if dropout_rng is None:

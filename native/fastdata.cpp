@@ -1,0 +1,74 @@
+// Native data-pipeline kernels: corpus tokenization and encoding.
+//
+// Reference parity: SURVEY.md §2 "Data pipeline" — the reference leans on
+// Spark/JVM (netty, executors) for corpus handling; its native capability is
+// dependency-provided. Here the host-side hot loops (byte->id mapping, word
+// tokenization against a vocabulary) are C++ behind ctypes, with a pure
+// Python fallback (data/native.py). Device-side work stays in XLA.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC fastdata.cpp)
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+
+static inline bool is_ws(char c) {
+  // Python str.split() whitespace for ASCII text: \t\n\v\f\r space and
+  // the \x1c-\x1f separators (all satisfy str.isspace()).
+  const unsigned char u = static_cast<unsigned char>(c);
+  return u == ' ' || u == '\t' || u == '\n' || u == '\r' || u == '\f' ||
+         u == '\v' || (u >= 0x1c && u <= 0x1f);
+}
+
+extern "C" {
+
+// Map each byte through a 256-entry table -> int32 ids (char-level encoding).
+void encode_bytes(const uint8_t* text, int64_t n, const int32_t* table,
+                  int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = table[text[i]];
+}
+
+// Count ASCII-whitespace-separated tokens.
+int64_t count_words(const char* text, int64_t n) {
+  int64_t count = 0;
+  bool in_tok = false;
+  for (int64_t i = 0; i < n; ++i) {
+    const bool ws = is_ws(text[i]);
+    if (!ws && !in_tok) ++count;
+    in_tok = !ws;
+  }
+  return count;
+}
+
+// Encode whitespace-separated words against a vocabulary.
+// vocab_buf: '\0'-joined words in id order (ids are positions + id_base).
+// Unknown words map to unk_id. Returns number of tokens written (<= out_cap).
+int64_t encode_words(const char* text, int64_t n, const char* vocab_buf,
+                     int64_t vocab_len, int32_t n_vocab, int32_t id_base,
+                     int32_t unk_id, int32_t* out, int64_t out_cap) {
+  std::unordered_map<std::string, int32_t> vocab;
+  vocab.reserve(static_cast<size_t>(n_vocab) * 2);
+  {
+    int64_t pos = 0;
+    for (int32_t id = 0; id < n_vocab && pos < vocab_len; ++id) {
+      const char* w = vocab_buf + pos;
+      const size_t len = strnlen(w, vocab_len - pos);
+      vocab.emplace(std::string(w, len), id + id_base);
+      pos += static_cast<int64_t>(len) + 1;
+    }
+  }
+  int64_t written = 0;
+  int64_t i = 0;
+  while (i < n && written < out_cap) {
+    while (i < n && is_ws(text[i])) ++i;
+    if (i >= n) break;
+    const int64_t start = i;
+    while (i < n && !is_ws(text[i])) ++i;
+    const auto it = vocab.find(std::string(text + start, i - start));
+    out[written++] = it == vocab.end() ? unk_id : it->second;
+  }
+  return written;
+}
+
+}  // extern "C"

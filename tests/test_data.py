@@ -77,3 +77,57 @@ def test_dataset_registry():
     assert len(seqs) == 40 and set(labels) == {0, 1}
     d3 = get_dataset("uci_electricity", length=1000)
     assert d3["train"].shape[1] == d3["num_features"]
+
+
+def test_native_encode_parity():
+    """Native C++ encoders must match the pure-Python paths exactly (and the
+    suite still passes if the .so is unavailable — fallback is automatic)."""
+    import os
+
+    from lstm_tensorspark_tpu.data import native
+    from lstm_tensorspark_tpu.data.corpus import synthetic_text
+
+    text = synthetic_text(2000, seed=7)
+    cv = build_char_vocab(text)
+    want_c = np.asarray([cv.stoi.get(c, 1) for c in text], np.int32)
+    np.testing.assert_array_equal(cv.encode_text(text, "char"), want_c)
+
+    wv = build_word_vocab(text)
+    want_w = np.asarray([wv.stoi.get(w, 1) for w in text.split()], np.int32)
+    got_w = wv.encode_text(text + " zzznotinvocab", "word")
+    np.testing.assert_array_equal(got_w[:-1], want_w)
+    assert got_w[-1] == wv.stoi["<unk>"]
+
+    # forced-fallback parity
+    os.environ["LSTM_TSP_NO_NATIVE"] = "1"
+    try:
+        native._load_attempted = False
+        native._lib = None
+        np.testing.assert_array_equal(cv.encode_text(text, "char"), want_c)
+        np.testing.assert_array_equal(wv.encode_text(text, "word"), want_w)
+    finally:
+        del os.environ["LSTM_TSP_NO_NATIVE"]
+        native._load_attempted = False
+        native._lib = None
+
+
+def test_native_non_ascii_falls_back():
+    """Non-ASCII text must take the Python path and stay correct."""
+    text = "café au lait café   x"  # é + non-breaking space
+    cv = build_char_vocab(text)
+    got = cv.encode_text(text, "char")
+    want = np.asarray([cv.stoi.get(c, 1) for c in text], np.int32)
+    np.testing.assert_array_equal(got, want)
+    wv = build_word_vocab(text)
+    got_w = wv.encode_text(text, "word")
+    want_w = np.asarray([wv.stoi.get(w, 1) for w in text.split()], np.int32)
+    np.testing.assert_array_equal(got_w, want_w)
+
+
+def test_native_control_char_whitespace_parity():
+    """ASCII control separators \\x1c-\\x1f split identically in C and Python."""
+    text = "alpha\x1cbeta\x1d gamma\x1ealpha\x1fbeta alpha"
+    wv = build_word_vocab(text)
+    got = wv.encode_text(text, "word")
+    want = np.asarray([wv.stoi.get(w, 1) for w in text.split()], np.int32)
+    np.testing.assert_array_equal(got, want)
