@@ -64,7 +64,7 @@ class Vocab:
             return native.encode_chars(text, self.stoi, unk)
         n_special = sum(1 for t in self.itos if t in ("<pad>", "<unk>"))
         return native.encode_words(
-            text, self.itos[n_special:], self.stoi, unk, id_base=n_special
+            text, self.itos[n_special:], unk, id_base=n_special
         )
 
     def decode(self, ids) -> list[str]:

@@ -101,15 +101,21 @@ def _ascii_splittable(text: str) -> bool:
 
 
 def encode_words(
-    text: str, itos: list[str], stoi: dict[str, int],
-    unk_id: int, id_base: int = 0,
+    text: str, itos: list[str], unk_id: int, id_base: int = 0
 ) -> np.ndarray:
     """Word-level encoding of a whitespace-tokenized text.
 
     itos: words in id order STARTING at id_base (specials excluded when
-    id_base covers them)."""
+    id_base covers them). Tokens not in itos — including literal special
+    strings like "<pad>" appearing in raw text — map to unk_id on BOTH
+    paths (reserved ids are never reachable from raw text)."""
     lib = _load()
-    if lib is not None and _ascii_splittable(text):
+    if (
+        lib is not None
+        and _ascii_splittable(text)
+        # a NUL inside a vocab token would corrupt the \0-delimited buffer
+        and all("\0" not in w for w in itos)
+    ):
         data = text.encode("ascii")
         vocab_buf = b"\0".join(w.encode("utf-8") for w in itos) + b"\0"
         n_words = lib.count_words(data, len(data))
@@ -120,6 +126,7 @@ def encode_words(
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(out),
         )
         return out[:written]
+    lookup = {w: id_base + i for i, w in enumerate(itos)}
     return np.asarray(
-        [stoi.get(w, unk_id) for w in text.split()], np.int32
+        [lookup.get(w, unk_id) for w in text.split()], np.int32
     )

@@ -37,11 +37,37 @@ from .lstm_cell import LSTMParams, fuse_params
 from .scan import lstm_scan
 
 
-def supported(batch: int, hidden: int, platform: str | None = None) -> bool:
-    """Can the fused kernel run these shapes on this platform?"""
+_VMEM_BUDGET = 12 * 2**20  # bytes; conservative vs ~16 MiB/core
+
+
+def supported(
+    batch: int,
+    hidden: int,
+    platform: str | None = None,
+    *,
+    param_dtype_bytes: int = 4,
+) -> bool:
+    """Can the fused kernel run these shapes on this platform?
+
+    Besides tiling divisibility, checks VMEM feasibility: the kernel keeps
+    the recurrent matrix U (H, 4H) plus h/c state, carry in/out blocks and
+    the streamed xproj/ys blocks resident in VMEM. Shapes that would blow
+    the budget (e.g. H=1024 f32: U alone is 16 MiB) fall back to lstm_scan
+    instead of failing Mosaic compilation.
+    """
     if platform is None:
         platform = jax.default_backend()
-    return platform == "tpu" and batch % 8 == 0 and hidden % 128 == 0
+    resident = (
+        4 * hidden * hidden * param_dtype_bytes  # U (H, 4H)
+        + batch * 4 * hidden * 4  # xproj block, f32
+        + 7 * batch * hidden * 4  # ys block + h0/c0/hT/cT + h/c scratch, f32
+    )
+    return (
+        platform == "tpu"
+        and batch % 8 == 0
+        and hidden % 128 == 0
+        and resident <= _VMEM_BUDGET
+    )
 
 
 def _lstm_kernel(xproj_ref, u_ref, h0_ref, c0_ref, ys_ref, hT_ref, cT_ref,
@@ -120,29 +146,34 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False):
     return jnp.moveaxis(ys, 0, 1), hT, cT
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _scan_core(params, xs, h0, c0, compute_dtype, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _scan_core(params, xs, h0, c0, compute_dtype, interpret, remat_chunk):
     fused = fuse_params(params, compute_dtype=compute_dtype)
     ys, hT, cT = _pallas_forward(fused, xs, h0, c0, interpret=interpret)
     return ys, hT, cT
 
 
-def _reference(params, xs, h0, c0, compute_dtype):
-    (hT, cT), ys = lstm_scan(params, xs, (h0, c0), compute_dtype=compute_dtype)
+def _reference(params, xs, h0, c0, compute_dtype, remat_chunk):
+    (hT, cT), ys = lstm_scan(
+        params, xs, (h0, c0),
+        compute_dtype=compute_dtype, remat_chunk=remat_chunk,
+    )
     return ys, hT, cT
 
 
-def _scan_core_fwd(params, xs, h0, c0, compute_dtype, interpret):
-    out = _scan_core(params, xs, h0, c0, compute_dtype, interpret)
+def _scan_core_fwd(params, xs, h0, c0, compute_dtype, interpret, remat_chunk):
+    out = _scan_core(params, xs, h0, c0, compute_dtype, interpret, remat_chunk)
     return out, (params, xs, h0, c0)
 
 
-def _scan_core_bwd(compute_dtype, interpret, residuals, cotangents):
+def _scan_core_bwd(compute_dtype, interpret, remat_chunk, residuals, cotangents):
     # Remat-style backward: recompute the forward with the pure-jax scan and
     # pull gradients through it — bit-exact with the reference BPTT.
+    # remat_chunk bounds the recompute's own residual memory to O(T/chunk)
+    # carries, so --use-pallas composes with --remat-chunk on long sequences.
     params, xs, h0, c0 = residuals
     _, vjp = jax.vjp(
-        lambda p, x, h, c: _reference(p, x, h, c, compute_dtype),
+        lambda p, x, h, c: _reference(p, x, h, c, compute_dtype, remat_chunk),
         params, xs, h0, c0,
     )
     return vjp(cotangents)
@@ -157,12 +188,13 @@ def pallas_lstm_scan(
     carry: tuple[jax.Array, jax.Array] | None = None,
     *,
     compute_dtype=None,
+    remat_chunk: int | None = None,
     interpret: bool = False,
 ):
-    """Drop-in fused-kernel variant of `lstm_scan` (no mask/reverse support;
-    long-T remat is unnecessary — backward already full-recomputes).
+    """Drop-in fused-kernel variant of `lstm_scan` (no mask/reverse support).
 
-    Returns ``((hT, cT), ys)`` like `lstm_scan`.
+    ``remat_chunk`` applies to the backward's recompute scan, bounding its
+    residual memory exactly as in `lstm_scan`. Returns ``((hT, cT), ys)``.
     """
     B, _, _ = xs.shape
     H = params.hidden_size
@@ -171,5 +203,6 @@ def pallas_lstm_scan(
         c0 = jnp.zeros((B, H), jnp.float32)
     else:
         h0, c0 = carry
-    ys, hT, cT = _scan_core(params, xs, h0, c0, compute_dtype, interpret)
+    ys, hT, cT = _scan_core(params, xs, h0, c0, compute_dtype, interpret,
+                            remat_chunk)
     return (hT, cT), ys

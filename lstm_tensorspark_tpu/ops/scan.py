@@ -125,10 +125,13 @@ def stacked_lstm_scan(
         if use_pallas and mask is None and not scan_kwargs.get("reverse", False):
             from .pallas_lstm import pallas_lstm_scan, supported
 
-            if supported(ys.shape[0], p.hidden_size):
+            cdtype = scan_kwargs.get("compute_dtype")
+            pbytes = 2 if cdtype == jnp.bfloat16 else 4
+            if supported(ys.shape[0], p.hidden_size, param_dtype_bytes=pbytes):
                 final, ys = pallas_lstm_scan(
                     p, ys, c0,
-                    compute_dtype=scan_kwargs.get("compute_dtype"),
+                    compute_dtype=cdtype,
+                    remat_chunk=scan_kwargs.get("remat_chunk"),
                 )
                 took_pallas = True
         if not took_pallas:

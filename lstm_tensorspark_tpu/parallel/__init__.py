@@ -6,9 +6,19 @@ from .tensor_parallel import (
     make_tp_train_step,
     place_lm_params,
 )
+from .pipeline_parallel import (
+    make_pp_lm_train_step,
+    place_pp_lm_params,
+    stack_lm_params,
+    unstack_lm_params,
+)
 from .train_step import make_sharded_lm_train_step
 
 __all__ = [
+    "make_pp_lm_train_step",
+    "place_pp_lm_params",
+    "stack_lm_params",
+    "unstack_lm_params",
     "make_mesh",
     "local_device_count",
     "distributed_init",

@@ -11,6 +11,10 @@ Axis convention (used across parallel/):
   "data"  — data parallel (the reference's RDD partitions [D])
   "model" — tensor parallel over the hidden/gate dimension (new capability)
   "seq"   — sequence/context parallel over time chunks (new capability)
+  "pipe"  — pipeline parallel over stacked layers (new capability)
+
+(Expert parallelism has no axis: the architecture has no MoE layers —
+SURVEY.md §2 strategy inventory marks EP "n/a".)
 """
 
 from __future__ import annotations
@@ -20,7 +24,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-AXES = ("data", "model", "seq")
+AXES = ("data", "model", "seq", "pipe")
 
 
 def local_device_count() -> int:
@@ -31,10 +35,11 @@ def make_mesh(
     dp: int | None = None,
     tp: int = 1,
     sp: int = 1,
+    pp: int = 1,
     *,
     devices=None,
 ) -> Mesh:
-    """Build a ("data", "model", "seq") mesh.
+    """Build a ("data", "model", "seq", "pipe") mesh.
 
     ``dp=None`` absorbs all remaining devices into the data axis — the moral
     equivalent of the reference's default partition count. XLA maps the mesh
@@ -45,12 +50,12 @@ def make_mesh(
     devices = np.asarray(devices if devices is not None else jax.devices())
     n = devices.size
     if dp is None:
-        if n % (tp * sp) != 0:
-            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
-        dp = n // (tp * sp)
-    if dp * tp * sp != n:
-        raise ValueError(f"dp*tp*sp={dp * tp * sp} != device count {n}")
-    return Mesh(devices.reshape(dp, tp, sp), AXES)
+        if n % (tp * sp * pp) != 0:
+            raise ValueError(f"{n} devices not divisible by tp*sp*pp={tp * sp * pp}")
+        dp = n // (tp * sp * pp)
+    if dp * tp * sp * pp != n:
+        raise ValueError(f"dp*tp*sp*pp={dp * tp * sp * pp} != device count {n}")
+    return Mesh(devices.reshape(dp, tp, sp, pp), AXES)
 
 
 def distributed_init(

@@ -1,0 +1,260 @@
+"""Pipeline parallelism for stacked LSTM layers over the "pipe" mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2 strategy inventory:
+"not required for parity") — this is new first-class capability, built on
+the same wavefront machinery as sequence parallelism (DESIGN.md notes the
+wavefront is PP's natural substrate).
+
+Layout: the L stacked layers are split into S = |pipe| stages of L/S layers
+each; stage s owns layers [s*L/S, (s+1)*L/S). Layer parameters (and their
+optimizer state) are *sharded* over "pipe" — each device stores only its
+stage's weights, the point of PP. Embedding and head are replicated; only
+stage 0 reads the embedding and only stage S-1 applies the head, so their
+gradients are nonzero on exactly one stage and shard_map's transpose psums
+them back to consistency.
+
+Schedule: GPipe-style wavefront over M microbatches — at tick t, stage s
+processes microbatch m = t - s and hands its activations [b, T, H] one hop
+right via `lax.ppermute` (ICI neighbor traffic only). Utilization is
+M/(M+S-1): the (S-1)-tick fill/drain bubble amortises away as M grows.
+`lax.cond` on the per-device active predicate skips real compute during
+bubble ticks (safe here: no collectives inside a stage's scan).
+
+Autodiff: `jax.grad` through the shard_map reverses the wavefront
+(ppermute transposes to the opposite ring), giving pipelined BPTT with the
+same schedule in reverse. The train step does grad/update at the jit level —
+shard_map's transpose inserts the psums for replicated inputs, and GSPMD
+propagates the P("pipe") param sharding to the optimizer state, so each
+stage's Adam moments etc. also live only on that stage.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..models.lstm_lm import LMConfig
+from ..ops.lstm_cell import LSTMParams, fuse_params, zero_carry
+from ..ops.scan import lstm_scan
+from ..train.loop import TrainState, step_body
+
+
+def stack_layers(layers: list[LSTMParams]) -> LSTMParams:
+    """Stack per-layer params into one LSTMParams of [L, ...] arrays so the
+    layer axis can be sharded over "pipe". Requires uniform input size
+    (embed_size == hidden_size), or the stack would be ragged."""
+    sizes = {p.input_size for p in layers}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"pipeline parallelism needs uniform layer input sizes, got {sizes} "
+            "(set embed_size == hidden_size)"
+        )
+    return jax.tree.map(lambda *a: jnp.stack(a), *layers)
+
+
+def unstack_layers(stacked: LSTMParams) -> list[LSTMParams]:
+    L = stacked.W_i.shape[0]
+    return [jax.tree.map(lambda a: a[j], stacked) for j in range(L)]
+
+
+def stack_lm_params(params):
+    """LM params with the per-layer list replaced by a stacked pytree."""
+    return {**params, "layers": stack_layers(params["layers"])}
+
+
+def unstack_lm_params(params):
+    return {**params, "layers": unstack_layers(params["layers"])}
+
+
+def pp_lm_param_specs(params_stacked):
+    """PartitionSpecs: stacked layers sharded over "pipe", rest replicated."""
+    specs = {
+        k: jax.tree.map(lambda _: P(), v)
+        for k, v in params_stacked.items()
+        if k != "layers"
+    }
+    specs["layers"] = jax.tree.map(lambda _: P("pipe"), params_stacked["layers"])
+    return specs
+
+
+def place_pp_lm_params(params_stacked, mesh: Mesh):
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        params_stacked,
+        pp_lm_param_specs(params_stacked),
+    )
+
+
+def pp_lm_loss(
+    params,
+    batch,
+    cfg: LMConfig,
+    *,
+    microbatches: int = 1,
+    pipe_axis: str = "pipe",
+    data_axis: str = "data",
+):
+    """Global-mean LM loss under the pipeline wavefront.
+
+    MUST run inside shard_map, manual over {pipe_axis, data_axis}. ``params``
+    is the local view: layers [L/S, ...] (this stage's slice), embedding and
+    head full. ``batch`` is this data-shard's {"inputs","targets"} [B_local,
+    T], replicated over "pipe". Returns the already-reduced global scalar.
+    """
+    S = lax.axis_size(pipe_axis)
+    s = lax.axis_index(pipe_axis)
+    M = microbatches
+    inputs, targets = batch["inputs"], batch["targets"]
+    B, T = inputs.shape
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    b = B // M
+    H = cfg.hidden_size
+    if cfg.embed != H:
+        raise ValueError("pipeline parallelism requires embed_size == hidden_size")
+
+    embedding = params["embedding"]
+    head = params["head"]
+    kernel = embedding.T if cfg.tie_embeddings else head["kernel"]
+    local_layers = unstack_layers(params["layers"])
+    cdtype = None if cfg.cdtype == jnp.float32 else cfg.cdtype
+
+    inputs_m = inputs.reshape(M, b, T)
+    targets_m = targets.reshape(M, b, T)
+
+    def run_stage(src):
+        ys = src
+        for layer in local_layers:
+            _, ys = lstm_scan(
+                layer, ys,
+                compute_dtype=cdtype,
+                remat_chunk=cfg.remat_chunk,
+                unroll=cfg.scan_unroll,
+            )
+        return ys
+
+    def mb_loss(ys, tgt):
+        logits = (
+            jnp.dot(ys.astype(kernel.dtype), kernel,
+                    preferred_element_type=jnp.float32)
+            + head["bias"]
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    x_in = jnp.zeros((b, T, H), jnp.float32)
+    loss_acc = jnp.zeros((), jnp.float32)
+    right = [(i, i + 1) for i in range(S - 1)]  # linear chain, no wraparound
+    is_last = s == S - 1
+
+    for t in range(M + S - 1):
+        m = t - s  # microbatch this stage works on at tick t
+        active = jnp.logical_and(m >= 0, m < M)
+        m_c = jnp.clip(m, 0, M - 1)
+        tok = lax.dynamic_index_in_dim(inputs_m, m_c, axis=0, keepdims=False)
+        tgt = lax.dynamic_index_in_dim(targets_m, m_c, axis=0, keepdims=False)
+        # stage 0 sources from the embedding; later stages from the left
+        # neighbor's activations. where() zeroes the embedding gradient on
+        # stages > 0, so the psum'd embedding grad is exactly stage 0's.
+        emb_x = jnp.take(embedding, tok, axis=0).astype(jnp.float32)
+        src = jnp.where(s == 0, emb_x, x_in)
+        ys = lax.cond(
+            active,
+            run_stage,
+            lambda x: jnp.zeros((b, T, H), jnp.float32),
+            src,
+        )
+        loss_acc = loss_acc + lax.cond(
+            jnp.logical_and(active, is_last),
+            mb_loss,
+            lambda ys, tgt: jnp.zeros((), jnp.float32),
+            ys, tgt,
+        )
+        if S > 1:
+            x_in = lax.ppermute(ys, pipe_axis, right)
+
+    loss = lax.psum(loss_acc, pipe_axis) / M  # only the last stage contributed
+    return lax.pmean(loss, data_axis)
+
+
+def make_pp_lm_train_step(
+    cfg: LMConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    params_stacked,
+    *,
+    microbatches: int | None = None,
+    donate: bool | None = None,
+):
+    """Build the DP x PP train step on stacked params.
+
+    Batch: {"inputs","targets"} [B, T], B % (data axis * microbatches) == 0.
+    ``microbatches`` defaults to the pipe size (pipeline full at steady
+    state). Grad/update happen at the jit level: shard_map's transpose
+    produces correct grads (psum'd for replicated embedding/head, local for
+    the stage-sharded layers), and jit propagates P("pipe") to opt state.
+    """
+    S = mesh.shape["pipe"]
+    L = params_stacked["layers"].W_i.shape[0]
+    if L % S != 0:
+        raise ValueError(f"{L} layers not divisible by {S} pipeline stages")
+    if cfg.dropout > 0.0:
+        raise ValueError(
+            "pipeline-parallel training is deterministic (no inter-layer "
+            "dropout support); set dropout=0"
+        )
+    if microbatches is None:
+        microbatches = max(S, 1)
+
+    param_specs = pp_lm_param_specs(params_stacked)
+    batch_spec = {"inputs": P("data"), "targets": P("data")}
+    loss_shard = shard_map(
+        lambda p, bt: pp_lm_loss(p, bt, cfg, microbatches=microbatches),
+        mesh=mesh,
+        in_specs=(param_specs, batch_spec),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def loss_fn(params, batch, rng):
+        del rng
+        loss = loss_shard(params, batch)
+        return loss, {"loss": loss}
+
+    def step(state: TrainState, batch):
+        return step_body(loss_fn, optimizer, state, batch)
+
+    param_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    state_shardings = TrainState(
+        step=NamedSharding(mesh, P()),
+        params=param_shardings,
+        opt_state=None,  # propagated from params by XLA
+        rng=NamedSharding(mesh, P()),
+        carries=None,
+    )
+    batch_shardings = {
+        "inputs": NamedSharding(mesh, P("data")),
+        "targets": NamedSharding(mesh, P("data")),
+    }
+
+    from ..train.loop import _donation_supported
+
+    if donate is None:
+        donate = _donation_supported()
+    return jax.jit(
+        step,
+        in_shardings=(state_shardings, batch_shardings),
+        donate_argnums=(0,) if donate else (),
+    )

@@ -79,6 +79,12 @@ def make_sharded_lm_train_step(
     """Build the DP x TP x SP train step. Batch: {"inputs","targets"} [B, T]
     with B % (data axis) == 0 and T % (seq axis) == 0."""
 
+    if cfg.dropout > 0.0:
+        raise ValueError(
+            "sequence-parallel training is deterministic (no inter-layer "
+            "dropout support); set dropout=0"
+        )
+
     manual = {"data", "seq"}
 
     def loss_fn(params, batch, rng):

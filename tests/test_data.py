@@ -131,3 +131,38 @@ def test_native_control_char_whitespace_parity():
     got = wv.encode_text(text, "word")
     want = np.asarray([wv.stoi.get(w, 1) for w in text.split()], np.int32)
     np.testing.assert_array_equal(got, want)
+
+
+def test_literal_special_token_maps_to_unk():
+    """A literal '<pad>'/'<unk>' string in raw text maps to unk on BOTH the
+    native and fallback word paths (reserved ids unreachable from text)."""
+    import os
+
+    from lstm_tensorspark_tpu.data import native
+
+    text = "alpha beta alpha <pad> <unk> beta"
+    wv = build_word_vocab("alpha beta alpha beta")
+    got_native = wv.encode_text(text, "word")
+    os.environ["LSTM_TSP_NO_NATIVE"] = "1"
+    try:
+        native._load_attempted = False
+        native._lib = None
+        got_py = wv.encode_text(text, "word")
+    finally:
+        del os.environ["LSTM_TSP_NO_NATIVE"]
+        native._load_attempted = False
+        native._lib = None
+    np.testing.assert_array_equal(got_native, got_py)
+    unk = wv.stoi["<unk>"]
+    np.testing.assert_array_equal(got_py[3:5], [unk, unk])
+
+
+def test_nul_in_vocab_token_falls_back():
+    """A NUL byte inside a vocab token would corrupt the native encoder's
+    \\0-delimited vocab buffer; such vocabs must take the Python path."""
+    text = "a\x00b plain a\x00b word"
+    assert text.isascii() and len(text.split()) == 4
+    wv = build_word_vocab(text)
+    got = wv.encode_text(text, "word")
+    want = np.asarray([wv.stoi.get(w, 1) for w in text.split()], np.int32)
+    np.testing.assert_array_equal(got, want)

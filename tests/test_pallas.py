@@ -69,3 +69,31 @@ def test_stacked_scan_fallback_on_cpu():
     finals, ys = stacked_lstm_scan([params], xs, use_pallas=True)
     _, ys2 = lstm_scan(params, xs)
     np.testing.assert_allclose(ys, ys2, rtol=1e-6)
+
+
+def test_supported_vmem_bound():
+    """Shapes whose resident VMEM footprint exceeds the budget must fall
+    back instead of failing Mosaic compilation (H=1024 f32: U is 16 MiB)."""
+    assert not supported(8, 1024, platform="tpu")
+    assert supported(8, 1024, platform="tpu", param_dtype_bytes=2)  # bf16 U
+    assert supported(8, 512, platform="tpu")
+
+
+def test_grad_parity_with_remat_chunk():
+    """remat_chunk threads through the custom VJP's recompute unchanged."""
+    params, xs = _setup()
+
+    def loss_p(p):
+        return jnp.mean(
+            pallas_lstm_scan(p, xs, remat_chunk=5, interpret=True)[1] ** 2
+        )
+
+    def loss_r(p):
+        return jnp.mean(lstm_scan(p, xs)[1] ** 2)
+
+    g1 = jax.grad(loss_p)(params)
+    g2 = jax.grad(loss_r)(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        g1, g2,
+    )
