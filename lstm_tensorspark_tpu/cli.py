@@ -65,6 +65,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", type=str, default=None, help="jax.profiler trace output dir")
     p.add_argument("--backend", type=str, default="auto", choices=["auto", "single", "dp"],
                    help="auto: dp when >1 device/partition")
+    # --- advanced parallelism (LM task; new capability beyond the reference) ---
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="'model' mesh axis size: gate/hidden dims sharded (GSPMD)")
+    p.add_argument("--seq-parallel", type=int, default=1,
+                   help="'seq' mesh axis size: wavefront sequence parallelism")
+    p.add_argument("--pipeline-stages", type=int, default=1,
+                   help="'pipe' mesh axis size: GPipe pipeline over stacked layers")
+    p.add_argument("--microbatches", type=int, default=None,
+                   help="wavefront microbatches for --seq-parallel/--pipeline-stages")
     # --- multi-host control plane (SURVEY.md §7 step 4) ---
     p.add_argument("--coordinator", type=str, default=None)
     p.add_argument("--num-processes", type=int, default=None)
@@ -140,17 +149,9 @@ def _setup_training(
 
     state = init_train_state(params, optimizer, rng, carries=carries0)
 
-    checkpoint_fn = None
-    if args.checkpoint_dir:
-        from .train.checkpoint import Checkpointer
-
-        ckpt = Checkpointer(args.checkpoint_dir)
-        if args.resume:
-            restored = ckpt.restore_latest(state)
-            if restored is not None:
-                state = restored
-                logger.log({"note": f"resumed at step {int(state.step)}"})
-        checkpoint_fn = ckpt.save
+    restored, checkpoint_fn = _wire_checkpoint(args, logger, lambda: state)
+    if restored is not None:
+        state = restored
 
     if mesh is None:
         train_step = make_train_step(loss_fn, optimizer, stateful=stateful)
@@ -170,6 +171,25 @@ def _setup_training(
             return (shard_batch(b, mesh) for b in it)
 
     return state, train_step, mesh, shards, wrap_stream, checkpoint_fn
+
+
+def _wire_checkpoint(args, logger, template_fn):
+    """Shared checkpoint/resume wiring. ``template_fn()`` produces the
+    restore template lazily — only called when a checkpoint actually exists,
+    so fresh --resume runs on sharded state skip the host gather.
+
+    Returns (restored_state_or_None, checkpoint_fn_or_None)."""
+    if not args.checkpoint_dir:
+        return None, None
+    from .train.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(args.checkpoint_dir)
+    restored = None
+    if args.resume and ckpt.has_checkpoint():
+        restored = ckpt.restore_latest(template_fn())
+        if restored is not None:
+            logger.log({"note": f"resumed at step {int(restored.step)}"})
+    return restored, ckpt.save
 
 
 def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
@@ -224,6 +244,9 @@ def _run_lm(args, logger) -> int:
         scan_unroll=args.scan_unroll,
         use_pallas=args.use_pallas,
     )
+
+    if max(args.tensor_parallel, args.seq_parallel, args.pipeline_stages) > 1:
+        return _run_lm_advanced(args, logger, cfg, data, seq_len)
 
     stateful = args.stateful
 
@@ -290,6 +313,123 @@ def _run_lm(args, logger) -> int:
         "note": "start", "dataset": args.dataset, "vocab": len(vocab),
         "devices": jax.device_count(), "partitions": shards,
         "steps_per_epoch": steps_per_epoch, "backend": "dp" if mesh is not None else "single",
+    })
+    state = _make_logged_loop(
+        args, state, train_step, batches, steps_per_epoch, logger,
+        eval_fn=eval_fn if args.eval_every else None,
+        checkpoint_fn=checkpoint_fn,
+        tokens_per_batch=args.batch_size * seq_len,
+    )
+    final = eval_fn(state.params)
+    logger.log({"step": int(state.step), **final, "note": "final"})
+    return 0
+
+
+def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
+    """LM training under tensor/sequence/pipeline parallelism (± DP) on an
+    explicit 4-axis mesh — the CLI surface for the strategies beyond the
+    reference's data-parallel-only scope (DESIGN.md parallelism table).
+
+    Eval pulls params to host (unstacking pipeline shards) and runs the
+    standard single-program eval step — eval is infrequent, the gather is
+    one param-sized fetch.
+    """
+    from .data import lm_batch_stream, lm_epoch_batches
+    from .models import init_lm, lm_loss
+    from .parallel import (
+        make_mesh,
+        make_pp_lm_train_step,
+        make_sharded_lm_train_step,
+        place_pp_lm_params,
+        stack_lm_params,
+        unstack_lm_params,
+    )
+    from .parallel.tensor_parallel import place_lm_params
+    from .train import make_eval_step, make_optimizer
+    from .train.loop import evaluate, init_train_state
+
+    tp, sp, pp = args.tensor_parallel, args.seq_parallel, args.pipeline_stages
+    if args.stateful:
+        raise SystemExit("--stateful is not supported with --tensor-parallel/"
+                         "--seq-parallel/--pipeline-stages")
+    if args.dropout > 0:
+        raise SystemExit("--dropout is not supported with --tensor-parallel/"
+                         "--seq-parallel/--pipeline-stages")
+    if pp > 1 and (tp > 1 or sp > 1):
+        raise SystemExit("--pipeline-stages cannot combine with "
+                         "--tensor-parallel/--seq-parallel")
+    if args.use_pallas:
+        raise SystemExit("--use-pallas is not supported with --tensor-parallel/"
+                         "--seq-parallel/--pipeline-stages (the wavefront "
+                         "losses use lax.scan)")
+    if args.microbatches is not None and args.microbatches < 1:
+        raise SystemExit(f"--microbatches must be >= 1, got {args.microbatches}")
+    n = jax.device_count()
+    dp = args.num_partitions or max(n // (tp * sp * pp), 1)
+    total = dp * tp * sp * pp
+    if total > n:
+        raise SystemExit(f"mesh dp*tp*sp*pp={total} exceeds {n} devices")
+    if tp > 1 and args.hidden_units % tp != 0:
+        raise SystemExit(f"--hidden-units {args.hidden_units} not divisible by "
+                         f"--tensor-parallel {tp}")
+    if seq_len % max(sp, 1) != 0:
+        raise SystemExit(f"--seq-len {seq_len} not divisible by --seq-parallel {sp}")
+    mb = args.microbatches if args.microbatches is not None else (pp if pp > 1 else 1)
+    if args.batch_size % (dp * mb) != 0:
+        raise SystemExit(f"--batch-size {args.batch_size} not divisible by "
+                         f"dp*microbatches = {dp}*{mb}")
+    mesh = make_mesh(dp=dp, tp=tp, sp=sp, pp=pp,
+                     devices=np.asarray(jax.devices()[:total]))
+
+    optimizer = make_optimizer(
+        args.optimizer, args.learning_rate,
+        momentum=args.momentum, clip_norm=args.clip_norm,
+    )
+    params = init_lm(jax.random.PRNGKey(args.seed), cfg)
+    if pp > 1:
+        stacked = stack_lm_params(params)
+        train_step = make_pp_lm_train_step(
+            cfg, optimizer, mesh, stacked, microbatches=mb
+        )
+        placed = place_pp_lm_params(stacked, mesh)
+    else:
+        train_step = make_sharded_lm_train_step(
+            cfg, optimizer, mesh, params, microbatches=mb
+        )
+        placed = place_lm_params(params, mesh)
+    state = init_train_state(placed, optimizer, jax.random.PRNGKey(args.seed + 1))
+
+    restored, checkpoint_fn = _wire_checkpoint(
+        args, logger, lambda: jax.device_get(state)
+    )
+    if restored is not None:
+        state = restored
+
+    def eval_loss_fn(p, b, r):
+        return lm_loss(p, b, cfg)
+
+    eval_step = make_eval_step(eval_loss_fn)
+    valid_tokens = data["valid"]
+    eval_bs = min(args.batch_size, max((len(valid_tokens) - 1) // seq_len, 0))
+
+    def eval_fn(params_dev):
+        if eval_bs <= 0:
+            return {"eval_skipped": 1}
+        params_host = jax.device_get(params_dev)
+        if pp > 1:
+            params_host = unstack_lm_params(params_host)
+        ev = lm_epoch_batches(valid_tokens, eval_bs, seq_len)
+        return evaluate(eval_step, params_host, ev)
+
+    train_tokens = data["train"]
+    steps_per_epoch = max((len(train_tokens) - 1) // (args.batch_size * seq_len), 1)
+    batches = lm_batch_stream(train_tokens, args.batch_size, seq_len)
+
+    logger.log({
+        "note": "start", "dataset": args.dataset, "vocab": cfg.vocab_size,
+        "devices": n, "mesh": {"dp": dp, "tp": tp, "sp": sp, "pp": pp},
+        "microbatches": mb, "steps_per_epoch": steps_per_epoch,
+        "backend": "pp" if pp > 1 else "tp/sp",
     })
     state = _make_logged_loop(
         args, state, train_step, batches, steps_per_epoch, logger,

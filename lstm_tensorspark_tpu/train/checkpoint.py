@@ -46,6 +46,9 @@ class Checkpointer:
             os.remove(old)
         return path
 
+    def has_checkpoint(self) -> bool:
+        return bool(self._paths())
+
     def restore_latest(self, template):
         """Restore newest checkpoint into the structure of ``template``
         (same model/optimizer config); None if no checkpoint exists."""

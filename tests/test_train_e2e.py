@@ -123,3 +123,62 @@ def test_cli_forecaster_dp(tmp_path):
     records = [json.loads(l) for l in jsonl.read_text().splitlines()]
     final = next(r for r in records if r.get("note") == "final")
     assert np.isfinite(final["eval_mse"])
+
+
+def test_cli_tp_sp(tmp_path):
+    """CLI with --tensor-parallel/--seq-parallel on the 8-device mesh."""
+    from lstm_tensorspark_tpu.cli import main
+
+    jsonl = tmp_path / "m.jsonl"
+    rc = main([
+        "--dataset", "ptb_char",
+        "--hidden-units", "32",
+        "--batch-size", "16",
+        "--seq-len", "16",
+        "--num-steps", "6",
+        "--log-every", "3",
+        "--learning-rate", "0.5",
+        "--compute-dtype", "float32",
+        "--tensor-parallel", "2",
+        "--seq-parallel", "2",
+        "--eval-every", "6",
+        "--jsonl", str(jsonl),
+    ])
+    assert rc == 0
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    start = next(r for r in records if r.get("note") == "start")
+    assert start["mesh"] == {"dp": 2, "tp": 2, "sp": 2, "pp": 1}
+    losses = [r["loss"] for r in records if "loss" in r]
+    assert losses and all(np.isfinite(losses))
+    assert any(r.get("note") == "final" and "eval_ppl" in r for r in records)
+
+
+def test_cli_pipeline(tmp_path):
+    """CLI with --pipeline-stages (DP x PP) incl. checkpoint + resume of the
+    stage-sharded state."""
+    from lstm_tensorspark_tpu.cli import main
+
+    jsonl = tmp_path / "m.jsonl"
+    ckpt = tmp_path / "ckpt"
+    common = [
+        "--dataset", "ptb_char",
+        "--hidden-units", "32",
+        "--num-layers", "2",
+        "--batch-size", "16",
+        "--seq-len", "16",
+        "--log-every", "3",
+        "--learning-rate", "0.5",
+        "--compute-dtype", "float32",
+        "--pipeline-stages", "2",
+        "--jsonl", str(jsonl),
+        "--checkpoint-dir", str(ckpt),
+        "--checkpoint-every", "3",
+    ]
+    assert main(common + ["--num-steps", "3"]) == 0
+    assert main(common + ["--num-steps", "6", "--resume"]) == 0
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    start = next(r for r in records if r.get("note") == "start")
+    assert start["mesh"]["pp"] == 2 and start["backend"] == "pp"
+    assert any("resumed at step 3" in str(r.get("note", "")) for r in records)
+    finals = [r for r in records if r.get("note") == "final"]
+    assert finals and all(np.isfinite(f["eval_ppl"]) for f in finals)
