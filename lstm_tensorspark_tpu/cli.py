@@ -53,6 +53,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fused Pallas recurrence kernel (TPU, B%%8==0, H%%128==0)")
     p.add_argument("--stateful", action="store_true",
                    help="stateful truncated BPTT: carry recurrent state across contiguous windows")
+    p.add_argument("--steps-per-call", type=int, default=1,
+                   help="K optimizer steps per host dispatch (lax.scan over K "
+                        "staged batches — amortises dispatch for small models; "
+                        "log/eval/checkpoint cadences then count K-step calls)")
+    p.add_argument("--prefetch", type=int, default=0,
+                   help="device-prefetch depth for the input feed (0 = off; "
+                        "background-thread device_put can hurt on tunneled/"
+                        "shared backends — measure before enabling)")
     p.add_argument("--num-steps", type=int, default=None,
                    help="total step budget for the job, resume-inclusive (overrides epochs)")
     p.add_argument("--eval-every", type=int, default=0)
@@ -136,9 +144,14 @@ def _setup_training(
 
     Returns (state, train_step, mesh, shards, wrap_stream, checkpoint_fn).
     """
+    from .data import prefetch_to_device, stacked_batches
     from .parallel import make_dp_train_step, shard_batch
     from .parallel.data_parallel import replicate
-    from .train import make_train_step
+    from .train import (
+        make_dp_multi_train_step,
+        make_multi_train_step,
+        make_train_step,
+    )
     from .train.loop import init_train_state
 
     mesh, shards = _select_backend(args)
@@ -146,6 +159,10 @@ def _setup_training(
         raise SystemExit(
             f"--batch-size {args.batch_size} not divisible by {shards} partitions"
         )
+    k = getattr(args, "steps_per_call", 1)
+    k = 1 if k is None else k
+    if k < 1:
+        raise SystemExit(f"--steps-per-call must be >= 1, got {k}")
 
     state = init_train_state(params, optimizer, rng, carries=carries0)
 
@@ -153,22 +170,46 @@ def _setup_training(
     if restored is not None:
         state = restored
 
+    depth = getattr(args, "prefetch", 0) or 0
+
     if mesh is None:
-        train_step = make_train_step(loss_fn, optimizer, stateful=stateful)
+        if k > 1:
+            train_step = make_multi_train_step(loss_fn, optimizer, stateful=stateful)
+        else:
+            train_step = make_train_step(loss_fn, optimizer, stateful=stateful)
 
         def wrap_stream(it):
+            if k > 1:
+                it = stacked_batches(it, k)
+            if depth > 0:
+                it = prefetch_to_device(it, depth)
             return it
 
     else:
-        train_step = make_dp_train_step(loss_fn, optimizer, mesh, stateful=stateful)
+        if k > 1:
+            train_step = make_dp_multi_train_step(
+                loss_fn, optimizer, mesh, stateful=stateful
+            )
+        else:
+            train_step = make_dp_train_step(
+                loss_fn, optimizer, mesh, stateful=stateful
+            )
         state = state._replace(
             params=replicate(state.params, mesh),
             opt_state=replicate(state.opt_state, mesh),
             carries=shard_batch(state.carries, mesh) if stateful else None,
         )
 
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         def wrap_stream(it):
-            return (shard_batch(b, mesh) for b in it)
+            dim = 1 if k > 1 else 0
+            if k > 1:
+                it = stacked_batches(it, k)
+            if depth > 0:
+                sharding = NamedSharding(mesh, P(*([None] * dim), "data"))
+                return prefetch_to_device(it, depth, sharding=sharding)
+            return (shard_batch(b, mesh, dim=dim) for b in it)
 
     return state, train_step, mesh, shards, wrap_stream, checkpoint_fn
 
@@ -199,6 +240,12 @@ def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
     total = args.num_steps or args.epochs * steps_per_epoch
     # --resume restores state.step; train only the REMAINING budget
     total = max(total - int(state.step), 0)
+    k = getattr(args, "steps_per_call", 1)
+    k = 1 if k is None or k < 1 else k
+    if k > 1:
+        # each loop iteration is one K-step dispatch; round up so the step
+        # budget is never undershot
+        total = -(-total // k)
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
     try:
@@ -214,6 +261,7 @@ def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
             checkpoint_fn=checkpoint_fn,
             checkpoint_every=args.checkpoint_every,
             tokens_per_batch=tokens_per_batch,
+            steps_per_call=k,
         )
     finally:
         if args.profile_dir:
@@ -349,6 +397,13 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     from .train.loop import evaluate, init_train_state
 
     tp, sp, pp = args.tensor_parallel, args.seq_parallel, args.pipeline_stages
+    if getattr(args, "steps_per_call", 1) > 1:
+        raise SystemExit("--steps-per-call is not supported with "
+                         "--tensor-parallel/--seq-parallel/--pipeline-stages")
+    if getattr(args, "prefetch", 0) > 0:
+        raise SystemExit("--prefetch is not supported with "
+                         "--tensor-parallel/--seq-parallel/--pipeline-stages "
+                         "(these steps place their own shardings)")
     if args.stateful:
         raise SystemExit("--stateful is not supported with --tensor-parallel/"
                          "--seq-parallel/--pipeline-stages")

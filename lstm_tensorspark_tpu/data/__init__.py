@@ -1,6 +1,12 @@
 from .corpus import Vocab, build_char_vocab, build_word_vocab, load_text
-from .batching import lm_batch_stream, lm_epoch_batches, padded_batches
+from .batching import (
+    lm_batch_stream,
+    lm_epoch_batches,
+    padded_batches,
+    stacked_batches,
+)
 from .datasets import get_dataset
+from .prefetch import prefetch_to_device
 
 __all__ = [
     "Vocab",
@@ -10,5 +16,7 @@ __all__ = [
     "lm_batch_stream",
     "lm_epoch_batches",
     "padded_batches",
+    "stacked_batches",
     "get_dataset",
+    "prefetch_to_device",
 ]

@@ -65,6 +65,21 @@ def lm_batch_stream(
         epoch += 1
 
 
+def stacked_batches(batches: Iterator[dict], k: int) -> Iterator[dict]:
+    """Group k consecutive batches into one [k, ...]-leading pytree — the
+    host-side feed for the K-steps-per-dispatch train step
+    (train/multistep.py). Order is preserved, so contiguous LM streams stay
+    contiguous across the stack (stateful TBPTT keeps working). A trailing
+    group smaller than k is dropped (it would force a second XLA
+    compilation for one partial call)."""
+    group: list[dict] = []
+    for b in batches:
+        group.append(b)
+        if len(group) == k:
+            yield {key: np.stack([g[key] for g in group]) for key in group[0]}
+            group = []
+
+
 def padded_batches(
     sequences: list[np.ndarray],
     labels: np.ndarray,

@@ -1,0 +1,71 @@
+"""Device prefetch: overlap host batch preparation with device compute.
+
+The reference's input path is Spark's lazily-materialised RDD iterator inside
+each executor (SURVEY.md §3.2) — batch prep and compute are serialized per
+worker. Here the host thread stacks/transfers the NEXT batch while the device
+runs the CURRENT step: `jax.device_put` is async, so keeping a small window
+of in-flight transfers ahead of the compute stream hides host time entirely
+(double/triple buffering). With a sharding, the put lands shards directly on
+their devices — this is also the DP feed path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import jax
+
+
+def prefetch_to_device(batches: Iterator, size: int = 2, *, sharding=None) -> Iterator:
+    """Yield batches already transferred to device, ``size`` ahead.
+
+    A daemon thread pulls from ``batches`` (host numpy work — stacking,
+    tokenization — happens there, off the dispatch thread) and device_puts
+    into a bounded queue. ``sharding`` (e.g. NamedSharding(mesh, P("data")))
+    places each leaf; None uses the default device.
+    """
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    q: queue.Queue = queue.Queue(maxsize=size)
+    END = object()
+    stop = threading.Event()  # consumer-gone signal: unpin HBM + exit thread
+
+    def put(x):
+        if sharding is None:
+            return jax.device_put(x)
+        return jax.tree.map(lambda a: jax.device_put(a, sharding), x)
+
+    def producer():
+        try:
+            for b in batches:
+                if stop.is_set():
+                    return
+                q.put(put(b))
+        except Exception as e:  # surface in the consumer, not the thread
+            if not stop.is_set():
+                q.put(e)
+            return
+        q.put(END)
+
+    threading.Thread(target=producer, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+    finally:
+        # Abandoned mid-stream (train_loop breaking at num_steps is the
+        # normal case): tell the producer to quit and drain the queue so a
+        # blocked q.put unblocks — otherwise the thread pins size+1
+        # device-resident batches for the rest of the process.
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass

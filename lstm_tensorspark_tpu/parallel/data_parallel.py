@@ -36,10 +36,11 @@ except ImportError:  # pragma: no cover
 from ..train.loop import TrainState
 
 
-def shard_batch(batch, mesh: Mesh, axis: str = "data"):
-    """Place a host batch with its leading dim sharded over ``axis``
-    (replicated over the other mesh axes)."""
-    sharding = NamedSharding(mesh, P(axis))
+def shard_batch(batch, mesh: Mesh, axis: str = "data", *, dim: int = 0):
+    """Place a host batch with dim ``dim`` sharded over ``axis`` (replicated
+    over the other mesh axes). ``dim=1`` is the K-steps-per-call layout
+    [K, B, ...] where B is the sharded batch axis (train/multistep.py)."""
+    sharding = NamedSharding(mesh, P(*([None] * dim), axis))
     return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
 
 

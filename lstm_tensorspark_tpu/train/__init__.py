@@ -1,5 +1,6 @@
 from .optimizer import make_optimizer
 from .loop import TrainState, make_train_step, make_eval_step, train_loop
+from .multistep import make_multi_train_step, make_dp_multi_train_step
 
 __all__ = [
     "make_optimizer",
@@ -7,4 +8,6 @@ __all__ = [
     "make_train_step",
     "make_eval_step",
     "train_loop",
+    "make_multi_train_step",
+    "make_dp_multi_train_step",
 ]

@@ -175,11 +175,17 @@ def train_loop(
     checkpoint_fn: Callable[[TrainState], None] | None = None,
     checkpoint_every: int = 0,
     tokens_per_batch: int | None = None,
+    steps_per_call: int = 1,
 ) -> TrainState:
     """Drive the jitted step over a batch iterator, logging scalar metrics.
 
     The only host↔device traffic per logged step is the scalar metric fetch
     (and even that is amortised over ``log_every`` async-dispatched steps).
+
+    With ``steps_per_call=K`` (the multi-step path, train/multistep.py) each
+    iteration is one K-step dispatch: ``num_steps``/``log_every``/
+    ``eval_every``/``checkpoint_every`` count CALLS, and throughput metrics
+    are scaled by K to stay in optimizer-steps/tokens per second.
     """
     t0 = time.perf_counter()
     window_start = t0
@@ -199,10 +205,12 @@ def train_loop(
                 "step": int(state.step),
                 "loss": loss,
                 "grad_norm": float(metrics["grad_norm"]),
-                "steps_per_sec": log_every / dt,
+                "steps_per_sec": log_every * steps_per_call / dt,
             }
             if tokens_per_batch:
-                record["tokens_per_sec"] = tokens_per_batch * log_every / dt
+                record["tokens_per_sec"] = (
+                    tokens_per_batch * log_every * steps_per_call / dt
+                )
             if logger is not None:
                 logger.log(record)
         if eval_fn is not None and eval_every and step % eval_every == 0:

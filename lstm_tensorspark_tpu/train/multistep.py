@@ -1,0 +1,128 @@
+"""Multi-step training: K optimizer steps per host dispatch via `lax.scan`.
+
+The single-step path (train/loop.py) already collapses the reference's whole
+round — broadcast, mapPartitions, treeAggregate, update (SURVEY.md §3.1) —
+into one XLA program, leaving host→device dispatch as the only per-step host
+cost. For small models that dispatch dominates: the PTB config's step is
+~25µs of TPU compute but ~150µs of dispatch over this environment's tunneled
+chip. This module removes it the TPU-native way: stage K batches on device
+([K, ...] leading axis) and `lax.scan` the SAME step body K times inside one
+jitted call, so the host pays one dispatch per K steps.
+
+This is the moral opposite of the reference's design point: Spark pays
+per-round *network serialization*; single-step jit pays per-step *dispatch*;
+multi-step amortises even that. The step body is shared verbatim with the
+single-step and DP paths (step_body), so the K-step program is provably K
+iterations of the same update — tests/test_multistep.py asserts bit-level
+parity against K sequential single steps.
+
+Metrics: ``loss`` is the mean over the K steps (the natural logging quantity
+for a K-step window), ``loss_last``/``grad_norm`` are the final step's.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from .loop import TrainState, _donation_supported, step_body
+
+
+def _scan_steps(loss_fn, optimizer, state, batches, *, stateful, rng_transform=None,
+                reduce_fn=None):
+    """scan step_body over the leading [K] axis of ``batches``."""
+
+    def body(s, b):
+        s2, m = step_body(
+            loss_fn, optimizer, s, b, stateful=stateful,
+            rng_transform=rng_transform, reduce_fn=reduce_fn,
+        )
+        return s2, m
+
+    state, ms = jax.lax.scan(body, state, batches)
+    metrics = {
+        "loss": jnp.mean(ms["loss"]),
+        "loss_last": ms["loss"][-1],
+        "grad_norm": ms["grad_norm"][-1],
+    }
+    return state, metrics
+
+
+def make_multi_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    *,
+    jit: bool = True,
+    donate: bool | None = None,
+    stateful: bool = False,
+):
+    """Single-chip K-steps-per-call train step.
+
+    ``multi_step(state, batches)`` where ``batches`` is the usual batch pytree
+    with an extra leading K axis (see data.batching.stacked_batches). K is
+    read from the array shapes — one compilation per distinct K.
+    """
+
+    def multi_step(state: TrainState, batches):
+        return _scan_steps(loss_fn, optimizer, state, batches, stateful=stateful)
+
+    if jit:
+        if donate is None:
+            donate = _donation_supported()
+        multi_step = jax.jit(multi_step, donate_argnums=(0,) if donate else ())
+    return multi_step
+
+
+def make_dp_multi_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    axis: str = "data",
+    jit: bool = True,
+    donate: bool | None = None,
+    stateful: bool = False,
+):
+    """Data-parallel K-steps-per-call: the DP per-shard body (rng fold-in +
+    pmean grad all-reduce — parallel/data_parallel.py) scanned K times inside
+    the shard_map, so the ICI all-reduce happens every step but the host
+    dispatch only once per K. ``batches`` leading axes are [K, B, ...] with B
+    sharded over the data axis (spec ``P(None, axis)``)."""
+
+    def per_shard_multi(state: TrainState, batches):
+        return _scan_steps(
+            loss_fn, optimizer, state, batches, stateful=stateful,
+            rng_transform=lambda sub: jax.random.fold_in(
+                sub, jax.lax.axis_index(axis)
+            ),
+            reduce_fn=lambda grads, loss: (
+                jax.lax.pmean(grads, axis),
+                jax.lax.pmean(loss, axis),
+            ),
+        )
+
+    state_spec = TrainState(
+        step=P(), params=P(), opt_state=P(), rng=P(),
+        carries=P(axis) if stateful else P(),
+    )
+    sharded = shard_map(
+        per_shard_multi,
+        mesh=mesh,
+        in_specs=(state_spec, P(None, axis)),
+        out_specs=(state_spec, P()),
+        check_vma=False,
+    )
+    if jit:
+        if donate is None:
+            donate = _donation_supported()
+        sharded = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return sharded
