@@ -42,6 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["sgd", "momentum", "adam", "adamw", "rmsprop"])
     p.add_argument("--momentum", type=float, default=0.0)
     p.add_argument("--clip-norm", type=float, default=None)
+    p.add_argument("--weight-decay", type=float, default=0.0, help="adamw only")
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="linear LR warmup steps (enables warmup-cosine schedule)")
+    p.add_argument("--decay-steps", type=int, default=None,
+                   help="cosine decay horizon in steps (enables the schedule)")
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--tie-embeddings", action="store_true")
     p.add_argument("--compute-dtype", type=str, default="bfloat16",
@@ -53,6 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fused Pallas recurrence kernel (TPU, B%%8==0, H%%128==0)")
     p.add_argument("--stateful", action="store_true",
                    help="stateful truncated BPTT: carry recurrent state across contiguous windows")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="gradient-accumulation microbatches per optimizer step "
+                        "(splits the per-shard batch; activation memory drops "
+                        "to one microbatch's worth)")
     p.add_argument("--steps-per-call", type=int, default=1,
                    help="K optimizer steps per host dispatch (lax.scan over K "
                         "staged batches — amortises dispatch for small models; "
@@ -61,6 +70,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device-prefetch depth for the input feed (0 = off; "
                         "background-thread device_put can hurt on tunneled/"
                         "shared backends — measure before enabling)")
+    # --- inference / generation (LM tasks) ---
+    p.add_argument("--generate-tokens", type=int, default=0,
+                   help="after training, sample N continuation tokens from the LM")
+    p.add_argument("--prompt", type=str, default=None,
+                   help="generation prompt text (defaults to the corpus start)")
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--greedy", action="store_true", help="argmax decoding")
     p.add_argument("--num-steps", type=int, default=None,
                    help="total step budget for the job, resume-inclusive (overrides epochs)")
     p.add_argument("--eval-every", type=int, default=0)
@@ -91,6 +108,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.top_k is not None and args.top_k < 1:
+        raise SystemExit(f"--top-k must be >= 1, got {args.top_k}")
 
     from .parallel import distributed_init
     distributed_init(args.coordinator, args.num_processes, args.process_id)
@@ -106,6 +125,21 @@ def main(argv=None) -> int:
         rc = _run_forecaster(args, logger)
     logger.close()
     return rc
+
+
+def make_cli_optimizer(args):
+    """The one optimizer constructor for every task runner — full flag
+    surface (optimizer family, momentum, clipping, weight decay, warmup/
+    cosine schedule)."""
+    from .train import make_optimizer
+
+    return make_optimizer(
+        args.optimizer, args.learning_rate,
+        momentum=args.momentum, clip_norm=args.clip_norm,
+        weight_decay=getattr(args, "weight_decay", 0.0),
+        warmup_steps=getattr(args, "warmup_steps", 0),
+        decay_steps=getattr(args, "decay_steps", None),
+    )
 
 
 def _select_backend(args):
@@ -163,6 +197,18 @@ def _setup_training(
     k = 1 if k is None else k
     if k < 1:
         raise SystemExit(f"--steps-per-call must be >= 1, got {k}")
+    accum = getattr(args, "grad_accum", 1) or 1
+    if accum < 1:
+        raise SystemExit(f"--grad-accum must be >= 1, got {accum}")
+    if accum > 1:
+        if stateful:
+            raise SystemExit("--grad-accum is not supported with --stateful "
+                             "(recurrent carries do not microbatch)")
+        per_shard = args.batch_size // max(shards, 1)
+        if per_shard % accum != 0:
+            raise SystemExit(
+                f"per-shard batch {per_shard} not divisible by --grad-accum {accum}"
+            )
 
     state = init_train_state(params, optimizer, rng, carries=carries0)
 
@@ -174,9 +220,13 @@ def _setup_training(
 
     if mesh is None:
         if k > 1:
-            train_step = make_multi_train_step(loss_fn, optimizer, stateful=stateful)
+            train_step = make_multi_train_step(
+                loss_fn, optimizer, stateful=stateful, grad_accum=accum
+            )
         else:
-            train_step = make_train_step(loss_fn, optimizer, stateful=stateful)
+            train_step = make_train_step(
+                loss_fn, optimizer, stateful=stateful, grad_accum=accum
+            )
 
         def wrap_stream(it):
             if k > 1:
@@ -188,11 +238,11 @@ def _setup_training(
     else:
         if k > 1:
             train_step = make_dp_multi_train_step(
-                loss_fn, optimizer, mesh, stateful=stateful
+                loss_fn, optimizer, mesh, stateful=stateful, grad_accum=accum
             )
         else:
             train_step = make_dp_train_step(
-                loss_fn, optimizer, mesh, stateful=stateful
+                loss_fn, optimizer, mesh, stateful=stateful, grad_accum=accum
             )
         state = state._replace(
             params=replicate(state.params, mesh),
@@ -319,10 +369,7 @@ def _run_lm(args, logger) -> int:
     key = jax.random.PRNGKey(args.seed)
     kparams, krng = jax.random.split(key)
     params = init_lm(kparams, cfg)
-    optimizer = make_optimizer(
-        args.optimizer, args.learning_rate,
-        momentum=args.momentum, clip_norm=args.clip_norm,
-    )
+    optimizer = make_cli_optimizer(args)
     from .models.lstm_lm import init_carries
     carries0 = init_carries(cfg, args.batch_size) if stateful else None
 
@@ -370,7 +417,42 @@ def _run_lm(args, logger) -> int:
     )
     final = eval_fn(state.params)
     logger.log({"step": int(state.step), **final, "note": "final"})
+    if args.generate_tokens > 0:
+        _generate_text(args, logger, cfg, data, jax.device_get(state.params))
     return 0
+
+
+def _generate_text(args, logger, cfg, data, params_host) -> None:
+    """Post-training sampling (models/generate.py): encode the prompt, run
+    the jitted prefill+decode program, print/log the decoded continuation."""
+    from .models import make_generate_fn
+
+    level = "char" if args.dataset == "ptb_char" else "word"
+    vocab = data["vocab"]
+    if args.prompt:
+        prompt_ids = vocab.encode_text(args.prompt, level)
+        if prompt_ids.size == 0:
+            prompt_ids = np.asarray(data["train"][:8], np.int32)
+    else:
+        prompt_ids = np.asarray(data["train"][:32], np.int32)
+    gen = make_generate_fn(
+        cfg,
+        max_new_tokens=args.generate_tokens,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        greedy=args.greedy,
+    )
+    rng = jax.random.PRNGKey(args.seed + 17)
+    out = np.asarray(gen(params_host, prompt_ids[None, :], rng))[0]
+    sep = "" if level == "char" else " "
+    prompt_txt = sep.join(vocab.decode(prompt_ids))
+    cont_txt = sep.join(vocab.decode(out[prompt_ids.size:]))
+    logger.log({
+        "note": "generate", "prompt": prompt_txt, "continuation": cont_txt,
+        "temperature": args.temperature, "top_k": args.top_k,
+        "greedy": bool(args.greedy),
+    })
+    print(f"--- prompt ---\n{prompt_txt}\n--- continuation ---\n{cont_txt}")
 
 
 def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
@@ -400,6 +482,10 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     if getattr(args, "steps_per_call", 1) > 1:
         raise SystemExit("--steps-per-call is not supported with "
                          "--tensor-parallel/--seq-parallel/--pipeline-stages")
+    if getattr(args, "grad_accum", 1) > 1:
+        raise SystemExit("--grad-accum is not supported with --tensor-parallel/"
+                         "--seq-parallel/--pipeline-stages (use --microbatches "
+                         "for the wavefront schedules)")
     if getattr(args, "prefetch", 0) > 0:
         raise SystemExit("--prefetch is not supported with "
                          "--tensor-parallel/--seq-parallel/--pipeline-stages "
@@ -436,10 +522,7 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     mesh = make_mesh(dp=dp, tp=tp, sp=sp, pp=pp,
                      devices=np.asarray(jax.devices()[:total]))
 
-    optimizer = make_optimizer(
-        args.optimizer, args.learning_rate,
-        momentum=args.momentum, clip_norm=args.clip_norm,
-    )
+    optimizer = make_cli_optimizer(args)
     params = init_lm(jax.random.PRNGKey(args.seed), cfg)
     if pp > 1:
         stacked = stack_lm_params(params)
@@ -494,6 +577,11 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     )
     final = eval_fn(state.params)
     logger.log({"step": int(state.step), **final, "note": "final"})
+    if args.generate_tokens > 0:
+        params_host = jax.device_get(state.params)
+        if pp > 1:
+            params_host = unstack_lm_params(params_host)
+        _generate_text(args, logger, cfg, data, params_host)
     return 0
 
 

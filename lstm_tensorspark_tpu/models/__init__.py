@@ -1,4 +1,5 @@
 from .lstm_lm import LMConfig, init_lm, lm_forward, lm_loss
+from .generate import generate, make_generate_fn, sample_logits
 from .classifier import (
     ClassifierConfig,
     init_classifier,
@@ -17,6 +18,9 @@ __all__ = [
     "init_lm",
     "lm_forward",
     "lm_loss",
+    "generate",
+    "make_generate_fn",
+    "sample_logits",
     "ClassifierConfig",
     "init_classifier",
     "classifier_forward",

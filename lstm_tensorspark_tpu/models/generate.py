@@ -1,0 +1,138 @@
+"""Autoregressive text generation for the LSTM LM.
+
+Reference parity: SURVEY.md §2 "Eval / inference" [P] — the reference's
+inference surface is a forward-only predict path. For a language model the
+natural predict operation is sampling continuations; this module supplies it
+TPU-natively: one jitted program containing the prompt prefill (batched
+`lm_forward` over [B, T0]) and the decode loop (`lax.scan` over new tokens,
+recurrent carries threaded on-device). No per-token host round-trips — the
+host sees only the final [B, T0 + N] token array.
+
+Sampling modes (all static at trace time): greedy argmax, temperature
+scaling, top-k truncation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.lstm_cell import fuse_params, lstm_step
+from .lstm_lm import LMConfig, init_carries, lm_forward
+
+
+def sample_logits(
+    rng: jax.Array,
+    logits: jax.Array,
+    *,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    greedy: bool = False,
+) -> jax.Array:
+    """Sample token ids [B] from logits [B, V]."""
+    logits = logits.astype(jnp.float32)
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if temperature != 1.0:
+        logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def _fuse_layers(params, cfg: LMConfig):
+    """Fuse every layer's gate matrices ONCE (outside the decode scan) — per
+    lstm_cell.py's contract that fusing happens once per forward pass."""
+    cdtype = None if cfg.cdtype == jnp.float32 else cfg.cdtype
+    return [fuse_params(layer, compute_dtype=cdtype) for layer in params["layers"]]
+
+
+def _decode_one(params, fused_layers, cfg: LMConfig, carries, token: jax.Array):
+    """One decode step: token [B] int32 → (logits [B, V], new carries).
+
+    Shares the exact cell math with training (`lstm_step` on fused kernels) —
+    the decode path cannot drift from the train path.
+    """
+    x = jnp.take(params["embedding"], token, axis=0)
+    new_carries = []
+    for fused, carry in zip(fused_layers, carries):
+        carry, x = lstm_step(fused, carry, x)
+        new_carries.append(carry)
+    head = params["head"]
+    kernel = params["embedding"].T if cfg.tie_embeddings else head["kernel"]
+    logits = (
+        jnp.dot(x.astype(kernel.dtype), kernel, preferred_element_type=jnp.float32)
+        + head["bias"]
+    )
+    return logits, new_carries
+
+
+def generate(
+    params,
+    prompt: jax.Array,
+    cfg: LMConfig,
+    rng: jax.Array,
+    *,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    greedy: bool = False,
+) -> jax.Array:
+    """Generate continuations: prompt [B, T0] int32 → [B, T0 + N] int32.
+
+    Pure function of (params, prompt, rng) — jit with static
+    (cfg, max_new_tokens, temperature, top_k, greedy) via
+    :func:`make_generate_fn`.
+    """
+    B = prompt.shape[0]
+    logits, carries = lm_forward(
+        params, prompt, cfg, carries=init_carries(cfg, B)
+    )
+    rng, sub = jax.random.split(rng)
+    token = sample_logits(
+        sub, logits[:, -1, :], temperature=temperature, top_k=top_k, greedy=greedy
+    )
+
+    fused_layers = _fuse_layers(params, cfg)
+
+    def step(carry, _):
+        rng, token, carries = carry
+        logits, carries = _decode_one(params, fused_layers, cfg, carries, token)
+        rng, sub = jax.random.split(rng)
+        nxt = sample_logits(
+            sub, logits, temperature=temperature, top_k=top_k, greedy=greedy
+        )
+        return (rng, nxt, carries), token
+
+    if max_new_tokens > 1:
+        (_, last, _), toks = lax.scan(
+            step, (rng, token, carries), None, length=max_new_tokens - 1
+        )
+        new = jnp.concatenate([jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+    else:
+        new = token[:, None]
+    return jnp.concatenate([prompt, new], axis=1)
+
+
+def make_generate_fn(
+    cfg: LMConfig,
+    *,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    greedy: bool = False,
+):
+    """Jitted generate: fn(params, prompt [B, T0], rng) -> [B, T0 + N]."""
+
+    def fn(params, prompt, rng):
+        return generate(
+            params, prompt, cfg, rng,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, greedy=greedy,
+        )
+
+    return jax.jit(fn)

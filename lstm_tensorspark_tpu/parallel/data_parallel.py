@@ -59,6 +59,7 @@ def make_dp_train_step(
     jit: bool = True,
     donate: bool | None = None,
     stateful: bool = False,
+    grad_accum: int = 1,
 ):
     """Build the data-parallel train step.
 
@@ -81,6 +82,7 @@ def make_dp_train_step(
             state,
             batch,
             stateful=stateful,
+            grad_accum=grad_accum,
             # distinct dropout per shard, common everything else
             rng_transform=lambda sub: jax.random.fold_in(
                 sub, jax.lax.axis_index(axis)

@@ -44,10 +44,8 @@ def run_forecaster(args, logger) -> int:
     key = jax.random.PRNGKey(args.seed)
     kp, kr = jax.random.split(key)
     params = init_seq2seq(kp, cfg)
-    optimizer = make_optimizer(
-        args.optimizer, args.learning_rate,
-        momentum=args.momentum, clip_norm=args.clip_norm,
-    )
+    from ..cli import make_cli_optimizer
+    optimizer = make_cli_optimizer(args)
 
     state, train_step, mesh, shards, wrap_stream, checkpoint_fn = _setup_training(
         args, logger, loss_fn=loss_fn, params=params, optimizer=optimizer, rng=kr,

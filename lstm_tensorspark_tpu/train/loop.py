@@ -63,6 +63,40 @@ def call_loss(loss_fn, params, batch, rng, carries, *, stateful: bool):
     return loss_fn(params, batch, rng)
 
 
+def accumulate_grads(loss_fn, params, batch, rng, *, grad_accum: int):
+    """Microbatched gradient accumulation: split the (per-shard) batch into
+    ``grad_accum`` equal microbatches along the leading axis and `lax.scan`
+    value_and_grad over them, keeping a running mean of grads and loss.
+
+    Peak activation memory drops to one microbatch's worth (the BPTT
+    activations of [B/N, T] instead of [B, T]) at the cost of N sequential
+    grad passes — the standard large-model trade. Equal microbatch sizes make
+    the mean-of-means exactly the full-batch mean, so the update is
+    numerically the full-batch update (tests/test_grad_accum.py)."""
+    micro = jax.tree.map(
+        lambda a: a.reshape(grad_accum, a.shape[0] // grad_accum, *a.shape[1:]),
+        batch,
+    )
+
+    def body(acc, inp):
+        i, mb = inp
+        (loss, _), grads = jax.value_and_grad(
+            lambda p: call_loss(
+                loss_fn, p, mb, jax.random.fold_in(rng, i), None, stateful=False
+            ),
+            has_aux=True,
+        )(params)
+        g_acc, l_acc = acc
+        g_acc = jax.tree.map(lambda a, b: a + b / grad_accum, g_acc, grads)
+        return (g_acc, l_acc + loss / grad_accum), None
+
+    zero = jax.tree.map(jnp.zeros_like, params)
+    (grads, loss), _ = jax.lax.scan(
+        body, (zero, jnp.zeros((), jnp.float32)), (jnp.arange(grad_accum), micro)
+    )
+    return loss, grads
+
+
 def step_body(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,
@@ -72,20 +106,33 @@ def step_body(
     stateful: bool = False,
     rng_transform: Callable | None = None,
     reduce_fn: Callable | None = None,
+    grad_accum: int = 1,
 ):
     """The ONE train-step body shared by the single-chip and data-parallel
     paths (keeps them provably identical — test_dp.py's loss-parity relies on
     it). ``rng_transform`` perturbs the per-step dropout key (DP folds in the
     shard index); ``reduce_fn(grads, loss)`` inserts the cross-shard mean
-    (DP: lax.pmean — the treeAggregate replacement)."""
+    (DP: lax.pmean — the treeAggregate replacement); ``grad_accum > 1``
+    microbatches the gradient computation (stateless losses only — recurrent
+    carries are batch-aligned and do not split)."""
     rng, sub = jax.random.split(state.rng)
     if rng_transform is not None:
         sub = rng_transform(sub)
-    (loss, aux), grads = jax.value_and_grad(
-        lambda p: call_loss(loss_fn, p, batch, sub, state.carries, stateful=stateful),
-        has_aux=True,
-    )(state.params)
-    carries = jax.lax.stop_gradient(aux["carries"]) if stateful else state.carries
+    if grad_accum > 1:
+        if stateful:
+            raise ValueError("grad_accum is not supported with stateful TBPTT")
+        loss, grads = accumulate_grads(
+            loss_fn, state.params, batch, sub, grad_accum=grad_accum
+        )
+        carries = state.carries
+    else:
+        (loss, aux), grads = jax.value_and_grad(
+            lambda p: call_loss(
+                loss_fn, p, batch, sub, state.carries, stateful=stateful
+            ),
+            has_aux=True,
+        )(state.params)
+        carries = jax.lax.stop_gradient(aux["carries"]) if stateful else state.carries
     if reduce_fn is not None:
         grads, loss = reduce_fn(grads, loss)
     updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
@@ -101,6 +148,7 @@ def make_train_step(
     jit: bool = True,
     donate: bool | None = None,
     stateful: bool = False,
+    grad_accum: int = 1,
 ):
     """Build the jitted step.
 
@@ -112,7 +160,10 @@ def make_train_step(
     """
 
     def train_step(state: TrainState, batch):
-        return step_body(loss_fn, optimizer, state, batch, stateful=stateful)
+        return step_body(
+            loss_fn, optimizer, state, batch,
+            stateful=stateful, grad_accum=grad_accum,
+        )
 
     if jit:
         if donate is None:

@@ -38,13 +38,14 @@ from .loop import TrainState, _donation_supported, step_body
 
 
 def _scan_steps(loss_fn, optimizer, state, batches, *, stateful, rng_transform=None,
-                reduce_fn=None):
+                reduce_fn=None, grad_accum=1):
     """scan step_body over the leading [K] axis of ``batches``."""
 
     def body(s, b):
         s2, m = step_body(
             loss_fn, optimizer, s, b, stateful=stateful,
             rng_transform=rng_transform, reduce_fn=reduce_fn,
+            grad_accum=grad_accum,
         )
         return s2, m
 
@@ -64,6 +65,7 @@ def make_multi_train_step(
     jit: bool = True,
     donate: bool | None = None,
     stateful: bool = False,
+    grad_accum: int = 1,
 ):
     """Single-chip K-steps-per-call train step.
 
@@ -73,7 +75,10 @@ def make_multi_train_step(
     """
 
     def multi_step(state: TrainState, batches):
-        return _scan_steps(loss_fn, optimizer, state, batches, stateful=stateful)
+        return _scan_steps(
+            loss_fn, optimizer, state, batches,
+            stateful=stateful, grad_accum=grad_accum,
+        )
 
     if jit:
         if donate is None:
@@ -91,6 +96,7 @@ def make_dp_multi_train_step(
     jit: bool = True,
     donate: bool | None = None,
     stateful: bool = False,
+    grad_accum: int = 1,
 ):
     """Data-parallel K-steps-per-call: the DP per-shard body (rng fold-in +
     pmean grad all-reduce — parallel/data_parallel.py) scanned K times inside
@@ -101,6 +107,7 @@ def make_dp_multi_train_step(
     def per_shard_multi(state: TrainState, batches):
         return _scan_steps(
             loss_fn, optimizer, state, batches, stateful=stateful,
+            grad_accum=grad_accum,
             rng_transform=lambda sub: jax.random.fold_in(
                 sub, jax.lax.axis_index(axis)
             ),

@@ -24,13 +24,22 @@ def make_optimizer(
 ) -> optax.GradientTransformation:
     """Build an optax chain: [clip] -> optimizer [-> wd] with optional
     linear-warmup cosine-decay schedule."""
-    if decay_steps is not None or warmup_steps > 0:
+    if decay_steps is not None:
         schedule = optax.warmup_cosine_decay_schedule(
             init_value=0.0 if warmup_steps > 0 else learning_rate,
             peak_value=learning_rate,
             warmup_steps=max(warmup_steps, 1),
-            decay_steps=max(decay_steps or warmup_steps + 1, warmup_steps + 1),
+            decay_steps=max(decay_steps, warmup_steps + 1),
             end_value=learning_rate * 0.1,
+        )
+    elif warmup_steps > 0:
+        # Warmup with no decay horizon: ramp to peak, then HOLD at peak.
+        schedule = optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, learning_rate, warmup_steps),
+                optax.constant_schedule(learning_rate),
+            ],
+            [warmup_steps],
         )
     else:
         schedule = learning_rate
