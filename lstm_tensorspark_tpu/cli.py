@@ -70,6 +70,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device-prefetch depth for the input feed (0 = off; "
                         "background-thread device_put can hurt on tunneled/"
                         "shared backends — measure before enabling)")
+    p.add_argument("--device-data", action="store_true",
+                   help="stage the LM corpus in device HBM once and slice "
+                        "[B,T] windows on-device (per-dispatch host traffic: "
+                        "one scalar) — the cached-RDD equivalent; corpus must "
+                        "fit HBM; LM datasets only")
     # --- inference / generation (LM tasks) ---
     p.add_argument("--generate-tokens", type=int, default=0,
                    help="after training, sample N continuation tokens from the LM")
@@ -119,6 +124,11 @@ def main(argv=None) -> int:
 
     if args.dataset in ("ptb_char", "wikitext2", "wikitext103"):
         rc = _run_lm(args, logger)
+    elif args.device_data or args.generate_tokens > 0:
+        raise SystemExit(
+            "--device-data/--generate-tokens apply to the LM datasets only "
+            f"(got --dataset {args.dataset})"
+        )
     elif args.dataset == "imdb":
         rc = _run_classifier(args, logger)
     else:
@@ -209,6 +219,10 @@ def _setup_training(
             raise SystemExit(
                 f"per-shard batch {per_shard} not divisible by --grad-accum {accum}"
             )
+    # write the normalized values back so later branches (e.g. --device-data)
+    # reuse THIS validation instead of re-deriving their own
+    args.steps_per_call = k
+    args.grad_accum = accum
 
     state = init_train_state(params, optimizer, rng, carries=carries0)
 
@@ -381,7 +395,32 @@ def _run_lm(args, logger) -> int:
 
     train_tokens, valid_tokens = data["train"], data["valid"]
     steps_per_epoch = max((len(train_tokens) - 1) // (args.batch_size * seq_len), 1)
-    batches = wrap_stream(lm_batch_stream(train_tokens, args.batch_size, seq_len))
+    if args.device_data:
+        if args.prefetch:
+            raise SystemExit("--device-data has no host feed; drop --prefetch")
+        from .data import stage_lm_data, window_index_stream
+        from .train import (
+            make_device_dp_lm_train_step,
+            make_device_lm_train_step,
+        )
+
+        # values below were normalized+validated by _setup_training
+        k = args.steps_per_call
+        ddata = stage_lm_data(train_tokens, args.batch_size, seq_len, mesh=mesh)
+        if mesh is None:
+            dstep = make_device_lm_train_step(
+                loss_fn, optimizer, ddata, steps_per_call=k,
+                stateful=stateful, grad_accum=args.grad_accum,
+            )
+        else:
+            dstep = make_device_dp_lm_train_step(
+                loss_fn, optimizer, ddata, mesh, steps_per_call=k,
+                stateful=stateful, grad_accum=args.grad_accum,
+            )
+        train_step = lambda state, w0: dstep(state, ddata.arrays, w0)  # noqa: E731
+        batches = window_index_stream(ddata, k)
+    else:
+        batches = wrap_stream(lm_batch_stream(train_tokens, args.batch_size, seq_len))
 
     if mesh is None:
         eval_step = make_eval_step(loss_fn, stateful=stateful)
@@ -486,6 +525,10 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
         raise SystemExit("--grad-accum is not supported with --tensor-parallel/"
                          "--seq-parallel/--pipeline-stages (use --microbatches "
                          "for the wavefront schedules)")
+    if getattr(args, "device_data", False):
+        raise SystemExit("--device-data is not supported with --tensor-parallel/"
+                         "--seq-parallel/--pipeline-stages (these steps place "
+                         "their own shardings)")
     if getattr(args, "prefetch", 0) > 0:
         raise SystemExit("--prefetch is not supported with "
                          "--tensor-parallel/--seq-parallel/--pipeline-stages "

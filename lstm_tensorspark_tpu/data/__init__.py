@@ -6,6 +6,12 @@ from .batching import (
     stacked_batches,
 )
 from .datasets import get_dataset
+from .device_dataset import (
+    DeviceLMData,
+    stage_lm_data,
+    slice_window,
+    window_index_stream,
+)
 from .prefetch import prefetch_to_device
 
 __all__ = [
@@ -18,5 +24,9 @@ __all__ = [
     "padded_batches",
     "stacked_batches",
     "get_dataset",
+    "DeviceLMData",
+    "stage_lm_data",
+    "slice_window",
+    "window_index_stream",
     "prefetch_to_device",
 ]

@@ -1,0 +1,101 @@
+"""Device-resident LM dataset: corpus staged in HBM, windows sliced on-device.
+
+Reference parity + the TPU-native upgrade: Spark caches the RDD in executor
+memory, so per-round the reference moves only params/grads — the *data* stays
+resident with the workers (SURVEY.md §3.1). The host-fed JAX path regressed
+that: every K-step dispatch shipped [K, B, T] token windows over PCIe/tunnel,
+which measures as ~13x the step's actual compute time on this environment's
+tunneled chip. This module restores the reference's data-locality property
+the TPU way:
+
+- the contiguous per-row token streams (`data.batching.lm_windows` layout:
+  [B, n_windows*T] inputs + shifted targets) are `device_put` ONCE;
+- the train step takes a scalar window index and `lax.dynamic_slice`s the
+  [B, T] batch inside the jitted program (one slice per step of the K-step
+  scan) — per-dispatch host traffic is one int32 scalar;
+- under data parallelism the streams shard over the "data" mesh axis with
+  `P("data", None)` — each chip holds only its batch rows, exactly like a
+  Spark partition's cached shard; slicing is along time, so no collective
+  is ever needed for the feed.
+
+Stream order is identical to `lm_epoch_batches`, so stateful TBPTT carries
+stay aligned and host-fed vs device-resident runs are bit-identical
+(tests/test_device_data.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .batching import lm_windows
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceLMData:
+    """HBM-staged LM corpus + static window geometry.
+
+    ``arrays`` is a pytree of device arrays passed explicitly through jit
+    (never closed over: closure constants can be baked into the executable,
+    which would duplicate a large corpus into every compiled program).
+    """
+
+    arrays: dict  # {"streams": [B, n_windows*T], "shifted": same} int32
+    batch_size: int
+    seq_len: int
+    n_windows: int
+
+    @property
+    def tokens_per_window(self) -> int:
+        return self.batch_size * self.seq_len
+
+
+def stage_lm_data(
+    tokens: np.ndarray,
+    batch_size: int,
+    seq_len: int,
+    *,
+    mesh: Mesh | None = None,
+    axis: str = "data",
+) -> DeviceLMData:
+    """Build the [B, n_windows*T] streams host-side (pure reshape) and place
+    them on device — batch rows sharded over ``axis`` when a mesh is given,
+    single default device otherwise."""
+    streams, shifted, n_windows = lm_windows(tokens, batch_size, seq_len)
+    streams = np.ascontiguousarray(streams)
+    shifted = np.ascontiguousarray(shifted)
+    if mesh is not None:
+        sharding = NamedSharding(mesh, P(axis, None))
+        put = lambda a: jax.device_put(a, sharding)
+    else:
+        put = jax.device_put
+    return DeviceLMData(
+        arrays={"streams": put(streams), "shifted": put(shifted)},
+        batch_size=batch_size,
+        seq_len=seq_len,
+        n_windows=n_windows,
+    )
+
+
+def slice_window(arrays: dict, w: jax.Array, seq_len: int) -> dict:
+    """Traced: window index (scalar int32) → {"inputs","targets"} [B, T]."""
+    s = w * seq_len
+    return {
+        "inputs": lax.dynamic_slice_in_dim(arrays["streams"], s, seq_len, axis=1),
+        "targets": lax.dynamic_slice_in_dim(arrays["shifted"], s, seq_len, axis=1),
+    }
+
+
+def window_index_stream(data: DeviceLMData, steps_per_call: int):
+    """Host-side iterator of starting window indices, one per K-step dispatch
+    (the entire per-call feed). Wraps around epochs forever, matching
+    `lm_batch_stream`'s ordering."""
+    w = 0
+    while True:
+        yield np.int32(w)
+        w = (w + steps_per_call) % data.n_windows

@@ -14,6 +14,8 @@ scaling, top-k truncation.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -89,6 +91,10 @@ def generate(
     :func:`make_generate_fn`.
     """
     B = prompt.shape[0]
+    # Inference needs no rematerialisation: remat_chunk is a training-memory
+    # device and would reject prompt lengths not divisible by the chunk.
+    if cfg.remat_chunk is not None:
+        cfg = dataclasses.replace(cfg, remat_chunk=None)
     logits, carries = lm_forward(
         params, prompt, cfg, carries=init_carries(cfg, B)
     )

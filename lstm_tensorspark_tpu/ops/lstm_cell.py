@@ -135,6 +135,31 @@ def lstm_step(
     return (h_new, c_new), h_new
 
 
+def lstm_step_hoisted(
+    fused: FusedLSTMParams,
+    carry: tuple[jax.Array, jax.Array],
+    zx: jax.Array,
+) -> tuple[tuple[jax.Array, jax.Array], jax.Array]:
+    """Recurrence step on a PRE-PROJECTED input: ``zx = x @ kernel + bias``
+    [B, 4H] float32, computed for all T steps in one MXU matmul before the
+    scan (ops/scan.py). Leaves only the unavoidable sequential work —
+    ``h @ recurrent`` + gate nonlinearities — inside the loop, halving the
+    per-iteration matmul count (the standard cuDNN-style LSTM split)."""
+    h, c = carry
+    dtype = fused.recurrent.dtype
+    z = zx + jnp.dot(
+        h.astype(dtype), fused.recurrent, preferred_element_type=jnp.float32
+    )
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return (h_new, c_new), h_new
+
+
 def lstm_step_unfused(
     params: LSTMParams,
     carry: tuple[jax.Array, jax.Array],

@@ -59,8 +59,8 @@ def supported(
         platform = jax.default_backend()
     resident = (
         4 * hidden * hidden * param_dtype_bytes  # U (H, 4H)
-        + batch * 4 * hidden * 4  # xproj block, f32
-        + 7 * batch * hidden * 4  # ys block + h0/c0/hT/cT + h/c scratch, f32
+        + 8 * batch * 4 * hidden * 4  # xproj block (worst-case chunk=8), f32
+        + (8 + 6) * batch * hidden * 4  # ys block + h0/c0/hT/cT + h/c scratch
     )
     return (
         platform == "tpu"
@@ -71,7 +71,7 @@ def supported(
 
 
 def _lstm_kernel(xproj_ref, u_ref, h0_ref, c0_ref, ys_ref, hT_ref, cT_ref,
-                 h_scr, c_scr, *, hidden: int):
+                 h_scr, c_scr, *, hidden: int, chunk: int):
     t = pl.program_id(0)
     T = pl.num_programs(0)
 
@@ -80,24 +80,38 @@ def _lstm_kernel(xproj_ref, u_ref, h0_ref, c0_ref, ys_ref, hT_ref, cT_ref,
         h_scr[:] = h0_ref[:]
         c_scr[:] = c0_ref[:]
 
-    z = xproj_ref[0] + jnp.dot(
-        h_scr[:].astype(u_ref.dtype), u_ref[:], preferred_element_type=jnp.float32
-    )
     H = hidden
-    i = jax.nn.sigmoid(z[:, :H])
-    f = jax.nn.sigmoid(z[:, H : 2 * H])
-    g = jnp.tanh(z[:, 2 * H : 3 * H])
-    o = jax.nn.sigmoid(z[:, 3 * H :])
-    c = f * c_scr[:] + i * g
-    h = o * jnp.tanh(c)
+    h = h_scr[:]
+    c = c_scr[:]
+    # ``chunk`` sequential time-steps per grid step (python-unrolled): the
+    # per-grid-step overhead (block index bookkeeping, DMA setup) amortises
+    # over the chunk while h/c stay in registers/VMEM between sub-steps.
+    for s in range(chunk):
+        z = xproj_ref[s] + jnp.dot(
+            h.astype(u_ref.dtype), u_ref[:], preferred_element_type=jnp.float32
+        )
+        i = jax.nn.sigmoid(z[:, :H])
+        f = jax.nn.sigmoid(z[:, H : 2 * H])
+        g = jnp.tanh(z[:, 2 * H : 3 * H])
+        o = jax.nn.sigmoid(z[:, 3 * H :])
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        ys_ref[s] = h
     h_scr[:] = h
     c_scr[:] = c
-    ys_ref[0] = h
 
     @pl.when(t == T - 1)
     def _():
         hT_ref[:] = h
         cT_ref[:] = c
+
+
+def _time_chunk(T: int) -> int:
+    """Largest chunk (≤8) dividing T — python-unrolled inside the kernel."""
+    for c in (8, 4, 2):
+        if T % c == 0:
+            return c
+    return 1
 
 
 def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False):
@@ -114,20 +128,21 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False):
         + fused.bias
     )  # [B, T, 4H] f32
     xproj = jnp.moveaxis(xproj, 0, 1)  # [T, B, 4H]
+    C = _time_chunk(T)
 
-    kernel = functools.partial(_lstm_kernel, hidden=H)
+    kernel = functools.partial(_lstm_kernel, hidden=H, chunk=C)
     ys, hT, cT = pl.pallas_call(
         kernel,
-        grid=(T,),
+        grid=(T // C,),
         in_specs=[
-            pl.BlockSpec((1, B, 4 * H), lambda t: (t, 0, 0),
+            pl.BlockSpec((C, B, 4 * H), lambda t: (t, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),  # U resident
             pl.BlockSpec(memory_space=pltpu.VMEM),  # h0
             pl.BlockSpec(memory_space=pltpu.VMEM),  # c0
         ],
         out_specs=[
-            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0),
+            pl.BlockSpec((C, B, H), lambda t: (t, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -146,34 +161,41 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False):
     return jnp.moveaxis(ys, 0, 1), hT, cT
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _scan_core(params, xs, h0, c0, compute_dtype, interpret, remat_chunk):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _scan_core(params, xs, h0, c0, compute_dtype, interpret, remat_chunk,
+               unroll):
     fused = fuse_params(params, compute_dtype=compute_dtype)
     ys, hT, cT = _pallas_forward(fused, xs, h0, c0, interpret=interpret)
     return ys, hT, cT
 
 
-def _reference(params, xs, h0, c0, compute_dtype, remat_chunk):
+def _reference(params, xs, h0, c0, compute_dtype, remat_chunk, unroll):
     (hT, cT), ys = lstm_scan(
         params, xs, (h0, c0),
-        compute_dtype=compute_dtype, remat_chunk=remat_chunk,
+        compute_dtype=compute_dtype, remat_chunk=remat_chunk, unroll=unroll,
     )
     return ys, hT, cT
 
 
-def _scan_core_fwd(params, xs, h0, c0, compute_dtype, interpret, remat_chunk):
-    out = _scan_core(params, xs, h0, c0, compute_dtype, interpret, remat_chunk)
+def _scan_core_fwd(params, xs, h0, c0, compute_dtype, interpret, remat_chunk,
+                   unroll):
+    out = _scan_core(
+        params, xs, h0, c0, compute_dtype, interpret, remat_chunk, unroll
+    )
     return out, (params, xs, h0, c0)
 
 
-def _scan_core_bwd(compute_dtype, interpret, remat_chunk, residuals, cotangents):
+def _scan_core_bwd(compute_dtype, interpret, remat_chunk, unroll, residuals,
+                   cotangents):
     # Remat-style backward: recompute the forward with the pure-jax scan and
     # pull gradients through it — bit-exact with the reference BPTT.
     # remat_chunk bounds the recompute's own residual memory to O(T/chunk)
     # carries, so --use-pallas composes with --remat-chunk on long sequences.
     params, xs, h0, c0 = residuals
     _, vjp = jax.vjp(
-        lambda p, x, h, c: _reference(p, x, h, c, compute_dtype, remat_chunk),
+        lambda p, x, h, c: _reference(
+            p, x, h, c, compute_dtype, remat_chunk, unroll
+        ),
         params, xs, h0, c0,
     )
     return vjp(cotangents)
@@ -189,12 +211,14 @@ def pallas_lstm_scan(
     *,
     compute_dtype=None,
     remat_chunk: int | None = None,
+    unroll: int = 1,
     interpret: bool = False,
 ):
     """Drop-in fused-kernel variant of `lstm_scan` (no mask/reverse support).
 
-    ``remat_chunk`` applies to the backward's recompute scan, bounding its
-    residual memory exactly as in `lstm_scan`. Returns ``((hT, cT), ys)``.
+    ``remat_chunk``/``unroll`` apply to the backward's recompute scan,
+    bounding its residual memory / loop overhead exactly as in `lstm_scan`.
+    Returns ``((hT, cT), ys)``.
     """
     B, _, _ = xs.shape
     H = params.hidden_size
@@ -204,5 +228,5 @@ def pallas_lstm_scan(
     else:
         h0, c0 = carry
     ys, hT, cT = _scan_core(params, xs, h0, c0, compute_dtype, interpret,
-                            remat_chunk)
+                            remat_chunk, unroll)
     return (hT, cT), ys

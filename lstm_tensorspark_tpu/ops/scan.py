@@ -24,7 +24,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .lstm_cell import LSTMParams, fuse_params, lstm_step, zero_carry
+from .lstm_cell import (
+    LSTMParams,
+    fuse_params,
+    lstm_step_hoisted,
+    zero_carry,
+)
 
 
 def lstm_scan(
@@ -62,33 +67,53 @@ def lstm_scan(
 
     xs_t = jnp.moveaxis(xs, 0, 1)  # [T, B, D] — scan runs over the leading axis
 
+    def project(x_td):
+        # Input projection for a whole [t, B, D] block in ONE MXU matmul —
+        # hoisted out of the scan so the sequential loop only carries the
+        # unavoidable h @ recurrent (cuDNN-style split). float32 out.
+        z = jnp.dot(
+            x_td.astype(fused.kernel.dtype),
+            fused.kernel,
+            preferred_element_type=jnp.float32,
+        )
+        return z + fused.bias
+
     def step(c, inp):
         if mask is None:
-            new_carry, y = lstm_step(fused, c, inp)
+            new_carry, y = lstm_step_hoisted(fused, c, inp)
         else:
-            x, m = inp
-            (h_new, c_new), _ = lstm_step(fused, c, x)
+            zx, m = inp
+            (h_new, c_new), _ = lstm_step_hoisted(fused, c, zx)
             h = jnp.where(m, h_new, c[0])
             cc = jnp.where(m, c_new, c[1])
             new_carry, y = (h, cc), h
         return new_carry, y
 
-    if mask is None:
-        inputs = xs_t
-    else:
-        inputs = (xs_t, jnp.moveaxis(mask, 0, 1)[..., None])
+    def with_mask(zx_t):
+        if mask is None:
+            return zx_t
+        return (zx_t, jnp.moveaxis(mask, 0, 1)[..., None])
 
     if remat_chunk is None:
-        final, ys = lax.scan(step, carry, inputs, reverse=reverse, unroll=unroll)
+        final, ys = lax.scan(
+            step, carry, with_mask(project(xs_t)), reverse=reverse, unroll=unroll
+        )
     else:
         if T % remat_chunk != 0:
             raise ValueError(f"T={T} not divisible by remat_chunk={remat_chunk}")
         n_chunks = T // remat_chunk
 
         def chunk_fn(c, chunk_inputs):
-            return lax.scan(step, c, chunk_inputs, reverse=reverse, unroll=unroll)
+            # project per chunk, INSIDE the checkpoint: the [chunk, B, 4H]
+            # activations are rematerialised, not stored — keeps the remat
+            # memory bound at O(T/chunk) carries.
+            x_td, m = chunk_inputs if mask is not None else (chunk_inputs, None)
+            zx = project(x_td)
+            inp = zx if m is None else (zx, m)
+            return lax.scan(step, c, inp, reverse=reverse, unroll=unroll)
 
         chunk_fn = jax.checkpoint(chunk_fn, prevent_cse=False)
+        inputs = xs_t if mask is None else (xs_t, jnp.moveaxis(mask, 0, 1)[..., None])
         chunked = jax.tree.map(
             lambda a: a.reshape(n_chunks, remat_chunk, *a.shape[1:]), inputs
         )
@@ -132,6 +157,7 @@ def stacked_lstm_scan(
                     p, ys, c0,
                     compute_dtype=cdtype,
                     remat_chunk=scan_kwargs.get("remat_chunk"),
+                    unroll=scan_kwargs.get("unroll", 1),
                 )
                 took_pallas = True
         if not took_pallas:

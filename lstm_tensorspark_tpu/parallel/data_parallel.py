@@ -50,6 +50,12 @@ def replicate(tree, mesh: Mesh):
     return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
 
 
+# Re-exported from train.loop (their dependency-free home): the per-shard
+# rng fold-in and the pmean gradient reduction shared by every DP step
+# builder here, in train/multistep.py and train/device_step.py.
+from ..train.loop import dp_reduce_fn, dp_rng_transform  # noqa: E402,F401
+
+
 def make_dp_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,
@@ -83,15 +89,9 @@ def make_dp_train_step(
             batch,
             stateful=stateful,
             grad_accum=grad_accum,
-            # distinct dropout per shard, common everything else
-            rng_transform=lambda sub: jax.random.fold_in(
-                sub, jax.lax.axis_index(axis)
-            ),
+            rng_transform=dp_rng_transform(axis),
             # treeAggregate + broadcast, collapsed into one ICI all-reduce:
-            reduce_fn=lambda grads, loss: (
-                jax.lax.pmean(grads, axis),
-                jax.lax.pmean(loss, axis),
-            ),
+            reduce_fn=dp_reduce_fn(axis),
         )
 
     state_spec = TrainState(

@@ -1,0 +1,128 @@
+"""Train steps over the device-resident LM dataset (data/device_dataset.py).
+
+The step takes (state, staged arrays, scalar window index) and runs K
+optimizer steps, slicing each [B, T] window out of HBM inside the scan —
+host→device traffic per dispatch is ONE int32. Combines the K-steps-per-call
+dispatch amortisation (train/multistep.py) with the reference's cached-RDD
+data locality (SURVEY.md §3.1: executors iterate their *resident* shard).
+
+The scan body is the shared `step_body`, so semantics are identical to the
+host-fed paths — tests/test_device_data.py asserts bit-level parity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..data.device_dataset import DeviceLMData, slice_window
+from .loop import (
+    TrainState,
+    _donation_supported,
+    dp_reduce_fn,
+    dp_rng_transform,
+    step_body,
+    summarize_scan_metrics,
+)
+
+
+def _scan_windows(loss_fn, optimizer, state, arrays, w0, *, seq_len, n_windows,
+                  steps_per_call, stateful, grad_accum, rng_transform=None,
+                  reduce_fn=None):
+    def body(s, j):
+        batch = slice_window(arrays, lax.rem(w0 + j, n_windows), seq_len)
+        return step_body(
+            loss_fn, optimizer, s, batch, stateful=stateful,
+            grad_accum=grad_accum, rng_transform=rng_transform,
+            reduce_fn=reduce_fn,
+        )
+
+    state, ms = lax.scan(
+        body, state, jnp.arange(steps_per_call, dtype=jnp.int32)
+    )
+    return state, summarize_scan_metrics(ms)
+
+
+def make_device_lm_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    data: DeviceLMData,
+    *,
+    steps_per_call: int = 1,
+    stateful: bool = False,
+    grad_accum: int = 1,
+    jit: bool = True,
+    donate: bool | None = None,
+):
+    """Single-chip device-data step: ``step(state, data.arrays, w0)``."""
+
+    def step(state: TrainState, arrays, w0):
+        return _scan_windows(
+            loss_fn, optimizer, state, arrays, w0,
+            seq_len=data.seq_len, n_windows=data.n_windows,
+            steps_per_call=steps_per_call, stateful=stateful,
+            grad_accum=grad_accum,
+        )
+
+    if jit:
+        if donate is None:
+            donate = _donation_supported()
+        step = jax.jit(step, donate_argnums=(0,) if donate else ())
+    return step
+
+
+def make_device_dp_lm_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    data: DeviceLMData,
+    mesh: Mesh,
+    *,
+    axis: str = "data",
+    steps_per_call: int = 1,
+    stateful: bool = False,
+    grad_accum: int = 1,
+    jit: bool = True,
+    donate: bool | None = None,
+):
+    """Data-parallel device-data step: streams live sharded ``P(axis, None)``
+    (each chip's HBM holds only its batch rows — a cached RDD partition);
+    the window slice is along time, so the feed needs no collective; grads
+    pmean over the ICI mesh as always."""
+
+    def per_shard(state: TrainState, arrays, w0):
+        return _scan_windows(
+            loss_fn, optimizer, state, arrays, w0,
+            seq_len=data.seq_len, n_windows=data.n_windows,
+            steps_per_call=steps_per_call, stateful=stateful,
+            grad_accum=grad_accum,
+            rng_transform=dp_rng_transform(axis),
+            reduce_fn=dp_reduce_fn(axis),
+        )
+
+    state_spec = TrainState(
+        step=P(), params=P(), opt_state=P(), rng=P(),
+        carries=P(axis) if stateful else P(),
+    )
+    arrays_spec = {"streams": P(axis, None), "shifted": P(axis, None)}
+    sharded = shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(state_spec, arrays_spec, P()),
+        out_specs=(state_spec, P()),
+        check_vma=False,
+    )
+    if jit:
+        if donate is None:
+            donate = _donation_supported()
+        sharded = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return sharded

@@ -141,6 +141,37 @@ def step_body(
     return TrainState(state.step + 1, params, opt_state, rng, carries), metrics
 
 
+def dp_rng_transform(axis: str = "data"):
+    """Per-shard dropout-key perturbation: fold the shard index into the
+    step key (distinct dropout per shard, common everything else). The ONE
+    definition shared by every DP step builder (parallel/data_parallel.py,
+    multistep.py, device_step.py). Lives here — the dependency-free base
+    module — to avoid train↔parallel import cycles."""
+    return lambda sub: jax.random.fold_in(sub, jax.lax.axis_index(axis))
+
+
+def dp_reduce_fn(axis: str = "data"):
+    """The treeAggregate replacement: mean grads (and loss, for logging)
+    across shards with one ICI all-reduce. The ONE definition shared by
+    every DP step builder — change the gradient-reduction contract here."""
+    return lambda grads, loss: (
+        jax.lax.pmean(grads, axis),
+        jax.lax.pmean(loss, axis),
+    )
+
+
+def summarize_scan_metrics(ms) -> dict:
+    """Reduce per-step metrics stacked by a K-step `lax.scan` to the logging
+    contract shared by every multi-step path (multistep.py, device_step.py):
+    ``loss`` = mean over the K steps, ``loss_last``/``grad_norm`` = final
+    step's."""
+    return {
+        "loss": jnp.mean(ms["loss"]),
+        "loss_last": ms["loss"][-1],
+        "grad_norm": ms["grad_norm"][-1],
+    }
+
+
 def make_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,

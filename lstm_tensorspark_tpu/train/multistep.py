@@ -34,7 +34,14 @@ try:  # jax >= 0.4.35
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from .loop import TrainState, _donation_supported, step_body
+from .loop import (
+    TrainState,
+    _donation_supported,
+    dp_reduce_fn,
+    dp_rng_transform,
+    step_body,
+    summarize_scan_metrics,
+)
 
 
 def _scan_steps(loss_fn, optimizer, state, batches, *, stateful, rng_transform=None,
@@ -50,12 +57,7 @@ def _scan_steps(loss_fn, optimizer, state, batches, *, stateful, rng_transform=N
         return s2, m
 
     state, ms = jax.lax.scan(body, state, batches)
-    metrics = {
-        "loss": jnp.mean(ms["loss"]),
-        "loss_last": ms["loss"][-1],
-        "grad_norm": ms["grad_norm"][-1],
-    }
-    return state, metrics
+    return state, summarize_scan_metrics(ms)
 
 
 def make_multi_train_step(
@@ -108,13 +110,8 @@ def make_dp_multi_train_step(
         return _scan_steps(
             loss_fn, optimizer, state, batches, stateful=stateful,
             grad_accum=grad_accum,
-            rng_transform=lambda sub: jax.random.fold_in(
-                sub, jax.lax.axis_index(axis)
-            ),
-            reduce_fn=lambda grads, loss: (
-                jax.lax.pmean(grads, axis),
-                jax.lax.pmean(loss, axis),
-            ),
+            rng_transform=dp_rng_transform(axis),
+            reduce_fn=dp_reduce_fn(axis),
         )
 
     state_spec = TrainState(

@@ -81,6 +81,17 @@ def test_top_k_restricts_support():
     np.testing.assert_array_equal(out1, out2)
 
 
+def test_generate_ignores_remat_chunk():
+    """remat is a training-memory device; generation must accept prompt
+    lengths not divisible by the chunk (cfg override inside generate())."""
+    cfg = LMConfig(vocab_size=19, hidden_size=16, remat_chunk=16)
+    params = init_lm(jax.random.PRNGKey(0), cfg)
+    prompt = np.array([[1, 2, 3, 4, 5]], np.int32)  # T0=5, not % 16
+    gen = make_generate_fn(cfg, max_new_tokens=4, greedy=True)
+    out = np.asarray(gen(params, prompt, jax.random.PRNGKey(0)))
+    assert out.shape == (1, 9)
+
+
 def test_sample_logits_greedy_ignores_rng():
     logits = jnp.asarray(np.random.RandomState(0).randn(4, 11).astype(np.float32))
     a = sample_logits(jax.random.PRNGKey(0), logits, greedy=True)
