@@ -93,6 +93,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true", help="resume from latest checkpoint in --checkpoint-dir")
     p.add_argument("--profile-dir", type=str, default=None, help="jax.profiler trace output dir")
+    p.add_argument("--trace", type=str, default=None,
+                   help="host-side span trace output (Chrome trace-event "
+                        "JSON; device-side profiling is --profile-dir)")
     p.add_argument("--backend", type=str, default="auto", choices=["auto", "single", "dp"],
                    help="auto: dp when >1 device/partition")
     # --- advanced parallelism (LM task; new capability beyond the reference) ---
@@ -122,17 +125,32 @@ def main(argv=None) -> int:
     from .train.metrics import MetricsLogger
     logger = MetricsLogger(args.jsonl)
 
-    if args.dataset in ("ptb_char", "wikitext2", "wikitext103"):
-        rc = _run_lm(args, logger)
-    elif args.device_data or args.generate_tokens > 0:
-        raise SystemExit(
-            "--device-data/--generate-tokens apply to the LM datasets only "
-            f"(got --dataset {args.dataset})"
-        )
-    elif args.dataset == "imdb":
-        rc = _run_classifier(args, logger)
-    else:
-        rc = _run_forecaster(args, logger)
+    from .utils import Tracer, set_tracer
+    tracer = None
+    if args.trace:
+        tracer = Tracer()
+        set_tracer(tracer)
+
+    try:
+        if args.dataset in ("ptb_char", "wikitext2", "wikitext103"):
+            rc = _run_lm(args, logger)
+        elif args.device_data or args.generate_tokens > 0:
+            raise SystemExit(
+                "--device-data/--generate-tokens apply to the LM datasets only "
+                f"(got --dataset {args.dataset})"
+            )
+        elif args.dataset == "imdb":
+            rc = _run_classifier(args, logger)
+        else:
+            rc = _run_forecaster(args, logger)
+    finally:
+        if tracer is not None:
+            set_tracer(None)  # uninstall first: a failed save must not leak it
+            try:
+                tracer.save(args.trace)
+            except OSError as e:
+                # never mask the run's own outcome with a trace-write error
+                print(f"warning: could not write --trace file: {e}")
     logger.close()
     return rc
 
@@ -340,8 +358,11 @@ def _run_lm(args, logger) -> int:
     from .train.loop import evaluate
     from .parallel import make_dp_eval_step, shard_batch
 
+    from .utils import span
+
     seq_len = args.seq_len or 64
-    data = get_dataset(args.dataset, args.data_path)
+    with span("load_dataset", dataset=args.dataset):
+        data = get_dataset(args.dataset, args.data_path)
     if data["synthetic"]:
         logger.log({"note": f"dataset {args.dataset}: no files at --data-path, using synthetic stand-in"})
     vocab = data["vocab"]
@@ -382,16 +403,17 @@ def _run_lm(args, logger) -> int:
 
     key = jax.random.PRNGKey(args.seed)
     kparams, krng = jax.random.split(key)
-    params = init_lm(kparams, cfg)
-    optimizer = make_cli_optimizer(args)
-    from .models.lstm_lm import init_carries
-    carries0 = init_carries(cfg, args.batch_size) if stateful else None
+    with span("setup", hidden=cfg.hidden_size, layers=cfg.num_layers):
+        params = init_lm(kparams, cfg)
+        optimizer = make_cli_optimizer(args)
+        from .models.lstm_lm import init_carries
+        carries0 = init_carries(cfg, args.batch_size) if stateful else None
 
-    state, train_step, mesh, shards, wrap_stream, checkpoint_fn = _setup_training(
-        args, logger,
-        loss_fn=loss_fn, params=params, optimizer=optimizer, rng=krng,
-        stateful=stateful, carries0=carries0,
-    )
+        state, train_step, mesh, shards, wrap_stream, checkpoint_fn = _setup_training(
+            args, logger,
+            loss_fn=loss_fn, params=params, optimizer=optimizer, rng=krng,
+            stateful=stateful, carries0=carries0,
+        )
 
     train_tokens, valid_tokens = data["train"], data["valid"]
     steps_per_epoch = max((len(train_tokens) - 1) // (args.batch_size * seq_len), 1)
@@ -448,16 +470,19 @@ def _run_lm(args, logger) -> int:
         "devices": jax.device_count(), "partitions": shards,
         "steps_per_epoch": steps_per_epoch, "backend": "dp" if mesh is not None else "single",
     })
-    state = _make_logged_loop(
-        args, state, train_step, batches, steps_per_epoch, logger,
-        eval_fn=eval_fn if args.eval_every else None,
-        checkpoint_fn=checkpoint_fn,
-        tokens_per_batch=args.batch_size * seq_len,
-    )
-    final = eval_fn(state.params)
+    with span("train", steps_per_epoch=steps_per_epoch, backend="dp" if mesh is not None else "single"):
+        state = _make_logged_loop(
+            args, state, train_step, batches, steps_per_epoch, logger,
+            eval_fn=eval_fn if args.eval_every else None,
+            checkpoint_fn=checkpoint_fn,
+            tokens_per_batch=args.batch_size * seq_len,
+        )
+    with span("eval_final"):
+        final = eval_fn(state.params)
     logger.log({"step": int(state.step), **final, "note": "final"})
     if args.generate_tokens > 0:
-        _generate_text(args, logger, cfg, data, jax.device_get(state.params))
+        with span("generate", tokens=args.generate_tokens):
+            _generate_text(args, logger, cfg, data, jax.device_get(state.params))
     return 0
 
 

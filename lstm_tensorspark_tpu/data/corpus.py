@@ -76,11 +76,13 @@ def build_char_vocab(text: str) -> Vocab:
 
 
 def build_word_vocab(text: str, max_size: int | None = None) -> Vocab:
-    from collections import Counter
+    """Most-common-first word vocabulary — native C++ count+sort fast path
+    (data/native.py `most_common_words`) with Counter fallback, identical
+    ordering."""
+    from . import native
 
-    counts = Counter(text.split())
-    most = counts.most_common(max_size - 2 if max_size else None)
-    return Vocab([w for w, _ in most])
+    words = native.most_common_words(text, max_size - 2 if max_size else None)
+    return Vocab(words)
 
 
 def load_text(path: str) -> str:

@@ -57,6 +57,16 @@ def _load():
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
         ]
+        lib.vocab_build.restype = ctypes.c_void_p
+        lib.vocab_build.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.vocab_size.restype = ctypes.c_int64
+        lib.vocab_size.argtypes = [ctypes.c_void_p]
+        lib.vocab_words_bytes.restype = ctypes.c_int64
+        lib.vocab_words_bytes.argtypes = [ctypes.c_void_p]
+        lib.vocab_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.vocab_free.argtypes = [ctypes.c_void_p]
         _lib = lib
     except Exception:
         _lib = None
@@ -130,3 +140,35 @@ def encode_words(
     return np.asarray(
         [lookup.get(w, unk_id) for w in text.split()], np.int32
     )
+
+
+def most_common_words(text: str, max_size: int | None = None) -> list[str]:
+    """Whitespace-tokenized vocabulary in ``Counter.most_common`` order
+    (count desc, first-occurrence tie-break) — C++ hash-count+sort for ASCII
+    text, Python Counter fallback, identical results."""
+    if max_size is not None and max_size <= 0:
+        return []  # Counter.most_common(n <= 0) semantics on both paths
+    lib = _load()
+    # NUL gate: a token containing '\0' would corrupt the \0-joined words
+    # buffer returned from C++ (one counted word parsed back as two).
+    if lib is not None and _ascii_splittable(text) and "\0" not in text:
+        data = text.encode("ascii")
+        handle = lib.vocab_build(data, len(data))
+        try:
+            n = lib.vocab_size(handle)
+            nbytes = lib.vocab_words_bytes(handle)
+            words_buf = ctypes.create_string_buffer(max(nbytes, 1))
+            counts = np.empty(max(n, 1), np.int64)
+            lib.vocab_fill(
+                handle, words_buf,
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            )
+            words = words_buf.raw[: max(nbytes - 1, 0)].decode("ascii")
+            out = words.split("\0") if words else []
+        finally:
+            lib.vocab_free(handle)
+        return out[:max_size] if max_size is not None else out
+    from collections import Counter
+
+    most = Counter(text.split()).most_common(max_size)
+    return [w for w, _ in most]

@@ -35,15 +35,18 @@ class Checkpointer:
         return sorted(out)
 
     def save(self, state) -> str:
-        state = jax.device_get(state)
-        step = int(state.step)
-        path = os.path.join(self.directory, f"step_{step}.msgpack")
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(serialization.to_bytes(state))
-        os.replace(tmp, path)  # atomic: partial writes never count as a checkpoint
-        for _, old in self._paths()[: -self.keep]:
-            os.remove(old)
+        from ..utils import span
+
+        with span("checkpoint_save"):
+            state = jax.device_get(state)
+            step = int(state.step)
+            path = os.path.join(self.directory, f"step_{step}.msgpack")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(serialization.to_bytes(state))
+            os.replace(tmp, path)  # atomic: partial writes never count as a checkpoint
+            for _, old in self._paths()[: -self.keep]:
+                os.remove(old)
         return path
 
     def has_checkpoint(self) -> bool:

@@ -1,0 +1,3 @@
+from .tracing import Tracer, get_tracer, set_tracer, span, instant
+
+__all__ = ["Tracer", "get_tracer", "set_tracer", "span", "instant"]

@@ -1,0 +1,95 @@
+"""Host-side structured tracing: named spans → Chrome trace JSON.
+
+Reference parity: SURVEY.md §5 "Tracing / profiling" — the reference's only
+observability was the Spark web UI's per-stage/task timing, external to the
+repo. This module supplies the in-framework equivalent for the host side of
+a run (data load, compile, train loop, eval, checkpoint, generation), saved
+in the Chrome trace-event format (load in chrome://tracing or Perfetto).
+Device-side profiling is separate and richer: ``--profile-dir`` streams
+XLA/TPU traces via ``jax.profiler`` (see cli.py).
+
+Zero overhead when disabled: the module-level ``span``/``instant`` helpers
+no-op unless a Tracer is installed with ``set_tracer``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+
+class Tracer:
+    """Collects trace events; thread-safe appends; ``save`` writes the
+    Chrome trace-event JSON ({"traceEvents": [...]})."""
+
+    def __init__(self) -> None:
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args):
+        """Complete-event span ("ph": "X") around the with-block."""
+        ts = self._now_us()
+        try:
+            yield self
+        finally:
+            dur = self._now_us() - ts
+            ev = {"name": name, "ph": "X", "ts": ts, "dur": dur,
+                  "pid": os.getpid(), "tid": threading.get_ident() & 0xFFFF}
+            if args:
+                ev["args"] = args
+            with self._lock:
+                self._events.append(ev)
+
+    def instant(self, name: str, **args) -> None:
+        ev = {"name": name, "ph": "i", "ts": self._now_us(), "s": "g",
+              "pid": os.getpid(), "tid": threading.get_ident() & 0xFFFF}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def save(self, path: str) -> None:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with self._lock:
+            events = list(self._events)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+
+_tracer: Tracer | None = None
+
+
+def set_tracer(tracer: Tracer | None) -> None:
+    global _tracer
+    _tracer = tracer
+
+
+def get_tracer() -> Tracer | None:
+    return _tracer
+
+
+@contextlib.contextmanager
+def span(name: str, **args):
+    """Module-level span: records on the installed tracer, no-op otherwise."""
+    t = _tracer
+    if t is None:
+        yield None
+    else:
+        with t.span(name, **args):
+            yield t
+
+
+def instant(name: str, **args) -> None:
+    t = _tracer
+    if t is not None:
+        t.instant(name, **args)

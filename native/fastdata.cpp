@@ -8,10 +8,12 @@
 //
 // Build: make -C native   (g++ -O3 -shared -fPIC fastdata.cpp)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <string>
 #include <unordered_map>
+#include <vector>
 
 static inline bool is_ws(char c) {
   // Python str.split() whitespace for ASCII text: \t\n\v\f\r space and
@@ -70,5 +72,73 @@ int64_t encode_words(const char* text, int64_t n, const char* vocab_buf,
   }
   return written;
 }
+
+// ---- vocabulary building (frequency count + most-common ordering) ----
+//
+// Handle-based API: vocab_build tokenizes and counts; vocab_fill streams the
+// words (\0-joined, most-common-first with first-occurrence tie-break — the
+// exact order of Python collections.Counter.most_common) and their counts
+// into caller-allocated buffers; vocab_free releases the handle.
+
+struct VocabCount {
+  std::vector<std::string> words;   // most-common-first
+  std::vector<int64_t> counts;
+  int64_t words_bytes = 0;          // total \0-joined byte length
+};
+
+void* vocab_build(const char* text, int64_t n) {
+  struct Entry { int64_t count; int64_t first; };
+  std::unordered_map<std::string, Entry> counts;
+  counts.reserve(1 << 16);
+  int64_t i = 0, order = 0;
+  while (i < n) {
+    while (i < n && is_ws(text[i])) ++i;
+    if (i >= n) break;
+    const int64_t start = i;
+    while (i < n && !is_ws(text[i])) ++i;
+    auto [it, inserted] =
+        counts.try_emplace(std::string(text + start, i - start), Entry{0, order});
+    if (inserted) ++order;
+    ++it->second.count;
+  }
+  std::vector<std::pair<const std::string*, Entry>> items;
+  items.reserve(counts.size());
+  for (const auto& kv : counts) items.push_back({&kv.first, kv.second});
+  std::sort(items.begin(), items.end(), [](const auto& a, const auto& b) {
+    if (a.second.count != b.second.count) return a.second.count > b.second.count;
+    return a.second.first < b.second.first;  // Counter.most_common tie order
+  });
+  auto* out = new VocabCount();
+  out->words.reserve(items.size());
+  out->counts.reserve(items.size());
+  for (const auto& it : items) {
+    out->words.push_back(*it.first);
+    out->counts.push_back(it.second.count);
+    out->words_bytes += static_cast<int64_t>(it.first->size()) + 1;
+  }
+  return out;
+}
+
+int64_t vocab_size(const void* handle) {
+  return static_cast<int64_t>(static_cast<const VocabCount*>(handle)->words.size());
+}
+
+int64_t vocab_words_bytes(const void* handle) {
+  return static_cast<const VocabCount*>(handle)->words_bytes;
+}
+
+// words_buf must hold vocab_words_bytes(); counts_buf vocab_size() int64s.
+void vocab_fill(const void* handle, char* words_buf, int64_t* counts_buf) {
+  const auto* v = static_cast<const VocabCount*>(handle);
+  char* p = words_buf;
+  for (size_t i = 0; i < v->words.size(); ++i) {
+    std::memcpy(p, v->words[i].data(), v->words[i].size());
+    p += v->words[i].size();
+    *p++ = '\0';
+    counts_buf[i] = v->counts[i];
+  }
+}
+
+void vocab_free(void* handle) { delete static_cast<VocabCount*>(handle); }
 
 }  // extern "C"

@@ -166,3 +166,52 @@ def test_nul_in_vocab_token_falls_back():
     got = wv.encode_text(text, "word")
     want = np.asarray([wv.stoi.get(w, 1) for w in text.split()], np.int32)
     np.testing.assert_array_equal(got, want)
+
+
+def test_native_vocab_build_parity():
+    """C++ most_common_words must equal Counter.most_common exactly,
+    including count-tie ordering (first occurrence wins) and max_size."""
+    import os
+    from collections import Counter
+
+    from lstm_tensorspark_tpu.data import native
+    from lstm_tensorspark_tpu.data.corpus import synthetic_text
+
+    def oracle(text, max_size=None):
+        return [w for w, _ in Counter(text.split()).most_common(max_size)]
+
+    text = synthetic_text(20_000, seed=11)
+    assert native.most_common_words(text) == oracle(text)
+    assert native.most_common_words(text, 10) == oracle(text, 10)
+    # tie-heavy corpus: every word once, order = first occurrence
+    tie = "delta alpha charlie bravo"
+    assert native.most_common_words(tie) == oracle(tie)
+    # non-ASCII falls back, same result
+    assert native.most_common_words("café x café") == oracle("café x café")
+    # forced fallback parity
+    os.environ["LSTM_TSP_NO_NATIVE"] = "1"
+    try:
+        native._load_attempted = False
+        native._lib = None
+        assert native.most_common_words(text, 50) == oracle(text, 50)
+    finally:
+        del os.environ["LSTM_TSP_NO_NATIVE"]
+        native._load_attempted = False
+        native._lib = None
+
+
+def test_native_vocab_edge_cases():
+    """NUL-containing tokens and non-positive max_size must match the
+    Counter oracle on both paths (review regressions)."""
+    from collections import Counter
+
+    from lstm_tensorspark_tpu.data import native
+
+    def oracle(text, max_size=None):
+        return [w for w, _ in Counter(text.split()).most_common(max_size)]
+
+    nul = "a\0b a\0b x"
+    assert native.most_common_words(nul) == oracle(nul)  # ['a\0b', 'x']
+    assert native.most_common_words("aa bb aa cc", -1) == []
+    assert native.most_common_words("aa bb", 0) == []
+    assert build_word_vocab("aa bb aa", 1).itos == ["<pad>", "<unk>"]

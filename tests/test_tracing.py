@@ -1,0 +1,65 @@
+"""utils/tracing: span capture, Chrome trace JSON output, CLI --trace, and
+no-op behavior when disabled."""
+
+import json
+import time
+
+from lstm_tensorspark_tpu.utils import Tracer, get_tracer, instant, set_tracer, span
+
+
+def test_tracer_records_spans_and_saves(tmp_path):
+    t = Tracer()
+    with t.span("outer", phase="x"):
+        time.sleep(0.01)
+        with t.span("inner"):
+            pass
+    t.instant("marker", step=3)
+    path = tmp_path / "trace.json"
+    t.save(str(path))
+    doc = json.loads(path.read_text())
+    events = doc["traceEvents"]
+    names = [e["name"] for e in events]
+    assert set(names) == {"outer", "inner", "marker"}
+    outer = next(e for e in events if e["name"] == "outer")
+    inner = next(e for e in events if e["name"] == "inner")
+    assert outer["ph"] == "X" and outer["dur"] >= 10_000  # >= 10ms in us
+    assert outer["args"] == {"phase": "x"}
+    # inner nested within outer's interval
+    assert outer["ts"] <= inner["ts"] <= inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+
+
+def test_module_helpers_noop_when_disabled():
+    set_tracer(None)
+    assert get_tracer() is None
+    with span("nothing") as t:
+        assert t is None
+    instant("nothing")  # must not raise
+
+
+def test_module_helpers_record_when_installed(tmp_path):
+    t = Tracer()
+    set_tracer(t)
+    try:
+        with span("phase"):
+            instant("tick")
+    finally:
+        set_tracer(None)
+    path = tmp_path / "t.json"
+    t.save(str(path))
+    names = [e["name"] for e in json.loads(path.read_text())["traceEvents"]]
+    assert names.count("phase") == 1 and names.count("tick") == 1
+
+
+def test_cli_trace_end_to_end(tmp_path):
+    from lstm_tensorspark_tpu.cli import main
+
+    trace = tmp_path / "host_trace.json"
+    rc = main([
+        "--dataset", "ptb_char", "--hidden-units", "32", "--batch-size", "8",
+        "--num-steps", "2", "--log-every", "1", "--backend", "single",
+        "--trace", str(trace),
+    ])
+    assert rc == 0
+    names = {e["name"] for e in json.loads(trace.read_text())["traceEvents"]}
+    assert {"load_dataset", "setup", "train", "eval_final"} <= names
+    assert get_tracer() is None  # uninstalled after the run
