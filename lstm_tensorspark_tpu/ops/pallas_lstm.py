@@ -14,10 +14,17 @@ through HBM. This kernel runs the WHOLE sequence in one `pallas_call`:
   and its ys block (streamed out): 2*B*H + B*4H floats instead of the
   scan's intermediates.
 
-Training support: `pallas_lstm_scan` carries a custom VJP whose backward
-re-runs the pure-jax scan under `jax.vjp` (full-recompute, remat-style) —
-gradients are exactly the reference implementation's, and the fast kernel
-needs no hand-written backward.
+Training support: `pallas_lstm_scan` carries a custom VJP with TWO backward
+strategies:
+- default: a hand-written FUSED BPTT kernel (`_lstm_bwd_kernel`) — reverse
+  sequential grid with dh/dc carries and the dU accumulator resident in
+  VMEM, consuming the z/c trajectories the train-mode forward streams out.
+  Gate math recomputes from saved f32 z, but the two backward matmuls run
+  in the compute dtype, so bf16 grads agree with the scan reference only to
+  bf16 tolerance (not bit-exact);
+- fallback (when `remat_chunk` is set — memory priority — or the backward's
+  VMEM residents don't fit): re-run the pure-jax scan under `jax.vjp`
+  (full-recompute, remat-style), bit-exact with the reference BPTT.
 
 Tiling constraints (pallas_guide.md): last dim 128 lanes; float32 sublane 8.
 `supported()` gates on B % 8 == 0 and H % 128 == 0; callers fall back to
@@ -71,7 +78,14 @@ def supported(
 
 
 def _lstm_kernel(xproj_ref, u_ref, h0_ref, c0_ref, ys_ref, hT_ref, cT_ref,
-                 h_scr, c_scr, *, hidden: int, chunk: int):
+                 *rest, hidden: int, chunk: int, save_residuals: bool):
+    """Forward recurrence. With ``save_residuals`` the kernel additionally
+    streams out the gate pre-activations z_t and cell states c_t — the
+    residuals `_lstm_bwd_kernel` consumes (no recompute in the backward)."""
+    if save_residuals:
+        z_ref, cs_ref, h_scr, c_scr = rest
+    else:
+        h_scr, c_scr = rest
     t = pl.program_id(0)
     T = pl.num_programs(0)
 
@@ -90,6 +104,8 @@ def _lstm_kernel(xproj_ref, u_ref, h0_ref, c0_ref, ys_ref, hT_ref, cT_ref,
         z = xproj_ref[s] + jnp.dot(
             h.astype(u_ref.dtype), u_ref[:], preferred_element_type=jnp.float32
         )
+        if save_residuals:
+            z_ref[s] = z
         i = jax.nn.sigmoid(z[:, :H])
         f = jax.nn.sigmoid(z[:, H : 2 * H])
         g = jnp.tanh(z[:, 2 * H : 3 * H])
@@ -97,6 +113,8 @@ def _lstm_kernel(xproj_ref, u_ref, h0_ref, c0_ref, ys_ref, hT_ref, cT_ref,
         c = f * c + i * g
         h = o * jnp.tanh(c)
         ys_ref[s] = h
+        if save_residuals:
+            cs_ref[s] = c
     h_scr[:] = h
     c_scr[:] = c
 
@@ -114,8 +132,90 @@ def _time_chunk(T: int) -> int:
     return 1
 
 
-def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False):
-    """xs [B,T,D] -> (ys [B,T,H], hT, cT). fused: FusedLSTMParams."""
+def _bwd_supported(batch: int, hidden: int, param_dtype_bytes: int) -> bool:
+    """Can the FUSED backward kernel hold its residents in VMEM?
+
+    Residents: U^T (4H, H), the f32 dU accumulator (H, 4H) TWICE (scratch +
+    whole-array output block), dh/dc scratch, and the streamed per-chunk
+    blocks (z, dys, c, c_prev, h_prev in; dz out) — counted ×2 for the
+    pipeline's double-buffering. Falls back to the remat-recompute backward
+    otherwise — a memory/speed trade, never a capability loss."""
+    streamed = (
+        8 * batch * 4 * hidden * 4 * 2  # z in + dz out blocks (chunk<=8)
+        + 8 * batch * hidden * 4 * 4  # dys/c/c_prev/h_prev blocks
+    )
+    resident = (
+        4 * hidden * hidden * param_dtype_bytes  # U^T
+        + 2 * 4 * hidden * hidden * 4  # dU: f32 scratch + output block
+        + streamed * 2  # double-buffered pipelining
+        + 4 * batch * hidden * 4  # dh/dc scratch + dh0/dc0 out
+    )
+    return resident <= _VMEM_BUDGET
+
+
+def _lstm_bwd_kernel(z_ref, dys_ref, c_ref, cprev_ref, hprev_ref, ut_ref,
+                     dhT_ref, dcT_ref,
+                     dz_ref, du_ref, dh0_ref, dc0_ref,
+                     dh_scr, dc_scr, du_scr, *, hidden: int, chunk: int):
+    """Fused BPTT: reverse sequential grid; dh/dc carries and the dU
+    accumulator live in VMEM scratch across grid steps. Per time-step:
+    gate recompute from saved z (VPU), cotangent algebra (VPU), and two
+    MXU matmuls — dz @ U^T for the carry, h_prev^T @ dz into dU."""
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+    H = hidden
+
+    @pl.when(t == 0)
+    def _():
+        dh_scr[:] = dhT_ref[:]
+        dc_scr[:] = dcT_ref[:]
+        du_scr[:] = jnp.zeros_like(du_scr)
+
+    dh = dh_scr[:]
+    dc = dc_scr[:]
+    du = du_scr[:]
+    for s in range(chunk - 1, -1, -1):
+        z = z_ref[s]
+        i = jax.nn.sigmoid(z[:, :H])
+        f = jax.nn.sigmoid(z[:, H : 2 * H])
+        g = jnp.tanh(z[:, 2 * H : 3 * H])
+        o = jax.nn.sigmoid(z[:, 3 * H :])
+        c = c_ref[s]
+        c_prev = cprev_ref[s]
+        tc = jnp.tanh(c)
+        dh = dh + dys_ref[s]
+        dc = dc + dh * o * (1.0 - tc * tc)
+        do = dh * tc * o * (1.0 - o)
+        di = dc * g * i * (1.0 - i)
+        df = dc * c_prev * f * (1.0 - f)
+        dg = dc * i * (1.0 - g * g)
+        dz = jnp.concatenate([di, df, dg, do], axis=1)  # [B, 4H] f32
+        dz_ref[s] = dz
+        dz_c = dz.astype(ut_ref.dtype)
+        du = du + jax.lax.dot_general(
+            hprev_ref[s].astype(ut_ref.dtype), dz_c,
+            (((0,), (0,)), ((), ())),  # contract batch -> [H, 4H]
+            preferred_element_type=jnp.float32,
+        )
+        dh = jnp.dot(dz_c, ut_ref[:], preferred_element_type=jnp.float32)
+        dc = dc * f
+    dh_scr[:] = dh
+    dc_scr[:] = dc
+    du_scr[:] = du
+
+    @pl.when(t == T - 1)
+    def _():
+        dh0_ref[:] = dh
+        dc0_ref[:] = dc
+        du_ref[:] = du
+
+
+def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False,
+                    save_residuals: bool = False):
+    """xs [B,T,D] -> (ys [B,T,H], hT, cT[, z, cs]). fused: FusedLSTMParams.
+
+    ``save_residuals`` additionally returns the z/c trajectories ([T,B,...])
+    for the fused backward."""
     B, T, _ = xs.shape
     H = fused.hidden_size
     dtype = fused.kernel.dtype
@@ -130,8 +230,32 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False):
     xproj = jnp.moveaxis(xproj, 0, 1)  # [T, B, 4H]
     C = _time_chunk(T)
 
-    kernel = functools.partial(_lstm_kernel, hidden=H, chunk=C)
-    ys, hT, cT = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((C, B, H), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((T, B, H), jnp.float32),
+        jax.ShapeDtypeStruct((B, H), jnp.float32),
+        jax.ShapeDtypeStruct((B, H), jnp.float32),
+    ]
+    if save_residuals:
+        out_specs += [
+            pl.BlockSpec((C, B, 4 * H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, B, H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),
+        ]
+
+    kernel = functools.partial(
+        _lstm_kernel, hidden=H, chunk=C, save_residuals=save_residuals
+    )
+    out = pl.pallas_call(
         kernel,
         grid=(T // C,),
         in_specs=[
@@ -141,24 +265,95 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False):
             pl.BlockSpec(memory_space=pltpu.VMEM),  # h0
             pl.BlockSpec(memory_space=pltpu.VMEM),  # c0
         ],
-        out_specs=[
-            pl.BlockSpec((C, B, H), lambda t: (t, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T, B, H), jnp.float32),
-            jax.ShapeDtypeStruct((B, H), jnp.float32),
-            jax.ShapeDtypeStruct((B, H), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((B, H), jnp.float32),
             pltpu.VMEM((B, H), jnp.float32),
         ],
         interpret=interpret,
     )(xproj, fused.recurrent, h0.astype(jnp.float32), c0.astype(jnp.float32))
-    return jnp.moveaxis(ys, 0, 1), hT, cT
+    ys = jnp.moveaxis(out[0], 0, 1)
+    if save_residuals:
+        return ys, out[1], out[2], out[3], out[4]
+    return ys, out[1], out[2]
+
+
+def _pallas_backward(fused, params, xs, h0, c0, ys, z, cs, dys, dhT, dcT,
+                     *, interpret: bool = False):
+    """Fused BPTT via `_lstm_bwd_kernel` + two big MXU matmuls outside.
+
+    Returns per-gate grads in the LSTMParams structure plus (dxs, dh0, dc0).
+    """
+    B, T, _ = xs.shape
+    H = fused.hidden_size
+    dtype = fused.kernel.dtype
+    C = _time_chunk(T)
+
+    ys_t = jnp.moveaxis(ys, 0, 1)  # [T, B, H] f32
+    h_prev = jnp.concatenate([h0.astype(jnp.float32)[None], ys_t[:-1]], axis=0)
+    c_prev = jnp.concatenate([c0.astype(jnp.float32)[None], cs[:-1]], axis=0)
+    dys_t = jnp.moveaxis(dys.astype(jnp.float32), 0, 1)
+    u_t = fused.recurrent.T  # [4H, H], compute dtype
+
+    kernel = functools.partial(_lstm_bwd_kernel, hidden=H, chunk=C)
+    n = T // C
+    rev = lambda t: (n - 1 - t, 0, 0)  # reverse-time grid
+    dz, dU, dh0, dc0 = pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # z
+            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),      # dys
+            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),      # c
+            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),      # c_prev
+            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),      # h_prev
+            pl.BlockSpec(memory_space=pltpu.VMEM),                      # U^T
+            pl.BlockSpec(memory_space=pltpu.VMEM),                      # dhT
+            pl.BlockSpec(memory_space=pltpu.VMEM),                      # dcT
+        ],
+        out_specs=[
+            pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # dz
+            pl.BlockSpec(memory_space=pltpu.VMEM),                      # dU
+            pl.BlockSpec(memory_space=pltpu.VMEM),                      # dh0
+            pl.BlockSpec(memory_space=pltpu.VMEM),                      # dc0
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
+            jax.ShapeDtypeStruct((H, 4 * H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((H, 4 * H), jnp.float32),
+        ],
+        interpret=interpret,
+    )(z, dys_t, cs, c_prev, h_prev, u_t,
+      dhT.astype(jnp.float32), dcT.astype(jnp.float32))
+
+    # input-projection cotangents: one MXU matmul each (XLA's job)
+    xs_t = jnp.moveaxis(xs, 0, 1).astype(dtype)  # [T, B, D]
+    dz_c = dz.astype(dtype)
+    dW = jnp.einsum(
+        "tbd,tbk->dk", xs_t, dz_c, preferred_element_type=jnp.float32
+    )
+    db = jnp.sum(dz, axis=(0, 1))
+    dxs = jnp.moveaxis(
+        jnp.einsum(
+            "tbk,dk->tbd", dz_c, fused.kernel,
+            preferred_element_type=jnp.float32,
+        ),
+        0, 1,
+    ).astype(xs.dtype)
+
+    Ws = jnp.split(dW, 4, axis=1)
+    Us = jnp.split(dU, 4, axis=1)
+    bs = jnp.split(db, 4)
+    dparams = LSTMParams(*Ws, *Us, *bs)
+    dparams = jax.tree.map(lambda g, p: g.astype(p.dtype), dparams, params)
+    return dparams, dxs, dh0.astype(h0.dtype), dc0.astype(c0.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
@@ -179,19 +374,38 @@ def _reference(params, xs, h0, c0, compute_dtype, remat_chunk, unroll):
 
 def _scan_core_fwd(params, xs, h0, c0, compute_dtype, interpret, remat_chunk,
                    unroll):
+    fused = fuse_params(params, compute_dtype=compute_dtype)
+    pbytes = 2 if fused.kernel.dtype == jnp.bfloat16 else 4
+    # Fused Pallas backward when its residents fit VMEM and no remat was
+    # requested (remat_chunk is the memory-over-speed signal: the recompute
+    # backward stores O(T/chunk) carries, the fused one stores z/cs O(T)).
+    if remat_chunk is None and _bwd_supported(xs.shape[0], fused.hidden_size,
+                                              pbytes):
+        ys, hT, cT, z, cs = _pallas_forward(
+            fused, xs, h0, c0, interpret=interpret, save_residuals=True
+        )
+        return (ys, hT, cT), (params, xs, h0, c0, ys, z, cs)
     out = _scan_core(
         params, xs, h0, c0, compute_dtype, interpret, remat_chunk, unroll
     )
-    return out, (params, xs, h0, c0)
+    return out, (params, xs, h0, c0, None, None, None)
 
 
 def _scan_core_bwd(compute_dtype, interpret, remat_chunk, unroll, residuals,
                    cotangents):
+    params, xs, h0, c0, ys, z, cs = residuals
+    if z is not None:
+        # Fused Pallas BPTT (see _lstm_bwd_kernel).
+        fused = fuse_params(params, compute_dtype=compute_dtype)
+        dys, dhT, dcT = cotangents
+        return _pallas_backward(
+            fused, params, xs, h0, c0, ys, z, cs, dys, dhT, dcT,
+            interpret=interpret,
+        )
     # Remat-style backward: recompute the forward with the pure-jax scan and
     # pull gradients through it — bit-exact with the reference BPTT.
     # remat_chunk bounds the recompute's own residual memory to O(T/chunk)
     # carries, so --use-pallas composes with --remat-chunk on long sequences.
-    params, xs, h0, c0 = residuals
     _, vjp = jax.vjp(
         lambda p, x, h, c: _reference(
             p, x, h, c, compute_dtype, remat_chunk, unroll
@@ -216,9 +430,10 @@ def pallas_lstm_scan(
 ):
     """Drop-in fused-kernel variant of `lstm_scan` (no mask/reverse support).
 
-    ``remat_chunk``/``unroll`` apply to the backward's recompute scan,
-    bounding its residual memory / loop overhead exactly as in `lstm_scan`.
-    Returns ``((hT, cT), ys)``.
+    Backward strategy (module docstring): fused BPTT kernel by default;
+    setting ``remat_chunk`` selects the recompute backward (bounded residual
+    memory), where ``remat_chunk``/``unroll`` apply to its recompute scan
+    exactly as in `lstm_scan`. Returns ``((hT, cT), ys)``.
     """
     B, _, _ = xs.shape
     H = params.hidden_size
