@@ -1,0 +1,95 @@
+"""Crash supervisor: relaunch training until completion, resuming from the
+latest checkpoint.
+
+Reference parity: SURVEY.md §5 "Failure detection / elastic recovery" — the
+reference inherits lineage-based task retry from Spark (a failed partition's
+task is re-run automatically) but loses the whole run on a driver crash.
+XLA has no partition-retry equivalent (the step is one program), so the
+rebuild's fault story is checkpoint-restart; this module closes the loop by
+supervising the process the way Spark's driver supervises tasks:
+
+    python -m lstm_tensorspark_tpu.supervise --max-restarts 3 -- \
+        --dataset ptb_char --num-steps 10000 \
+        --checkpoint-dir ckpt --checkpoint-every 50
+
+The child is the normal CLI (same flags). On a nonzero exit the supervisor
+relaunches it with ``--resume`` injected, so the run continues from the
+last checkpoint; ``--num-steps`` is resume-inclusive (cli.py), so the total
+step budget holds across restarts. Exit code: the child's final exit code —
+0 on success, or the LAST failing child's code when restarts are exhausted
+(so callers can still distinguish failure classes, e.g. OOM kills).
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="lstm_tensorspark_tpu.supervise",
+        description="relaunch-on-crash wrapper around the training CLI",
+    )
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="restarts after the first attempt (default 3)")
+    p.add_argument("--restart-delay", type=float, default=1.0,
+                   help="seconds between attempts")
+    p.add_argument("cli_args", nargs=argparse.REMAINDER,
+                   help="-- followed by the training CLI flags")
+    return p
+
+
+def supervise(cli_args: list[str], *, max_restarts: int = 3,
+              restart_delay: float = 1.0, runner=None) -> int:
+    """Run the CLI (as a subprocess by default); relaunch with --resume on
+    failure. ``runner(argv) -> int`` is injectable for tests."""
+    if not any(a == "--checkpoint-dir" or a.startswith("--checkpoint-dir=")
+               for a in cli_args):
+        print("supervise: warning: no --checkpoint-dir — a crash will "
+              "restart from step 0", file=sys.stderr)
+    if runner is None:
+        def runner(argv):
+            return subprocess.run(
+                [sys.executable, "-m", "lstm_tensorspark_tpu.cli", *argv]
+            ).returncode
+
+    attempt = 0
+    while True:
+        argv = list(cli_args)
+        if attempt > 0 and "--resume" not in argv:
+            argv.append("--resume")
+        rc = runner(argv)
+        if rc == 0:
+            if attempt > 0:
+                print(f"supervise: succeeded after {attempt} restart(s)",
+                      file=sys.stderr)
+            return 0
+        if attempt >= max_restarts:
+            print(f"supervise: giving up after {attempt} restart(s) "
+                  f"(last exit code {rc})", file=sys.stderr)
+            return rc
+        attempt += 1
+        print(f"supervise: child exited {rc}; restart {attempt}/"
+              f"{max_restarts} in {restart_delay}s", file=sys.stderr)
+        time.sleep(restart_delay)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cli_args = args.cli_args
+    if cli_args and cli_args[0] == "--":
+        cli_args = cli_args[1:]
+    if not cli_args:
+        raise SystemExit("usage: ... supervise [--max-restarts N] -- <cli flags>")
+    return supervise(
+        cli_args,
+        max_restarts=args.max_restarts,
+        restart_delay=args.restart_delay,
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

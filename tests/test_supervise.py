@@ -1,0 +1,88 @@
+"""Supervisor (supervise.py): restart-with-resume semantics via an injected
+runner, plus a real crash-and-resume integration through the CLI."""
+
+import json
+
+from lstm_tensorspark_tpu.supervise import supervise
+
+
+def test_success_first_try_no_resume():
+    calls = []
+
+    def runner(argv):
+        calls.append(list(argv))
+        return 0
+
+    assert supervise(["--x"], runner=runner) == 0
+    assert calls == [["--x"]]
+
+
+def test_restart_injects_resume_then_succeeds():
+    calls = []
+
+    def runner(argv):
+        calls.append(list(argv))
+        return 1 if len(calls) < 3 else 0
+
+    rc = supervise(["--a", "--checkpoint-dir", "d"], max_restarts=5,
+                   restart_delay=0.0, runner=runner)
+    assert rc == 0
+    assert calls[0] == ["--a", "--checkpoint-dir", "d"]
+    assert calls[1] == ["--a", "--checkpoint-dir", "d", "--resume"]
+    assert calls[2] == calls[1]
+
+
+def test_gives_up_after_max_restarts():
+    calls = []
+
+    def runner(argv):
+        calls.append(argv)
+        return 7
+
+    rc = supervise(["--a"], max_restarts=2, restart_delay=0.0, runner=runner)
+    assert rc == 7  # the last failing child's exit code, not a sentinel
+    assert len(calls) == 3  # first attempt + 2 restarts
+
+
+def test_resume_not_duplicated():
+    calls = []
+
+    def runner(argv):
+        calls.append(list(argv))
+        return 1 if len(calls) < 2 else 0
+
+    supervise(["--resume"], max_restarts=2, restart_delay=0.0, runner=runner)
+    assert calls[1].count("--resume") == 1
+
+
+def test_crash_resume_integration(tmp_path):
+    """Real CLI child: first run checkpoints then 'crashes' (in-process
+    runner truncates the budget); the supervised rerun resumes from the
+    checkpoint and finishes the step budget exactly."""
+    from lstm_tensorspark_tpu.cli import main as cli_main
+
+    ckpt = tmp_path / "ckpt"
+    jsonl = tmp_path / "m.jsonl"
+    base = [
+        "--dataset", "ptb_char", "--hidden-units", "16", "--batch-size", "8",
+        "--backend", "single", "--num-steps", "6", "--log-every", "1",
+        "--checkpoint-dir", str(ckpt), "--checkpoint-every", "2",
+        "--jsonl", str(jsonl),
+    ]
+    attempts = []
+
+    def runner(argv):
+        attempts.append(list(argv))
+        if len(attempts) == 1:
+            # simulate a crash: run only part of the budget, then fail
+            cli_main([*argv[:argv.index("--num-steps")], "--num-steps", "4",
+                      *argv[argv.index("--num-steps") + 2:]])
+            return 1
+        return cli_main(argv)
+
+    rc = supervise(base, max_restarts=1, restart_delay=0.0, runner=runner)
+    assert rc == 0
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert any("resumed at step 4" in str(r.get("note", "")) for r in records)
+    finals = [r for r in records if r.get("note") == "final"]
+    assert finals[-1]["step"] == 6  # budget is resume-inclusive
