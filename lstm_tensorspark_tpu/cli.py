@@ -82,6 +82,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="generation prompt text (defaults to the corpus start)")
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None,
+                   help="nucleus sampling mass in (0, 1]")
     p.add_argument("--greedy", action="store_true", help="argmax decoding")
     p.add_argument("--num-steps", type=int, default=None,
                    help="total step budget for the job, resume-inclusive (overrides epochs)")
@@ -118,6 +120,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.top_k is not None and args.top_k < 1:
         raise SystemExit(f"--top-k must be >= 1, got {args.top_k}")
+    if args.top_p is not None and not 0.0 < args.top_p <= 1.0:
+        raise SystemExit(f"--top-p must be in (0, 1], got {args.top_p}")
 
     from .parallel import distributed_init
     distributed_init(args.coordinator, args.num_processes, args.process_id)
@@ -504,6 +508,7 @@ def _generate_text(args, logger, cfg, data, params_host) -> None:
         max_new_tokens=args.generate_tokens,
         temperature=args.temperature,
         top_k=args.top_k,
+        top_p=args.top_p,
         greedy=args.greedy,
     )
     rng = jax.random.PRNGKey(args.seed + 17)
@@ -514,7 +519,7 @@ def _generate_text(args, logger, cfg, data, params_host) -> None:
     logger.log({
         "note": "generate", "prompt": prompt_txt, "continuation": cont_txt,
         "temperature": args.temperature, "top_k": args.top_k,
-        "greedy": bool(args.greedy),
+        "top_p": args.top_p, "greedy": bool(args.greedy),
     })
     print(f"--- prompt ---\n{prompt_txt}\n--- continuation ---\n{cont_txt}")
 

@@ -9,7 +9,7 @@ recurrent carries threaded on-device). No per-token host round-trips — the
 host sees only the final [B, T0 + N] token array.
 
 Sampling modes (all static at trace time): greedy argmax, temperature
-scaling, top-k truncation.
+scaling, top-k truncation, top-p (nucleus) truncation.
 """
 
 from __future__ import annotations
@@ -30,9 +30,12 @@ def sample_logits(
     *,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     greedy: bool = False,
 ) -> jax.Array:
-    """Sample token ids [B] from logits [B, V]."""
+    """Sample token ids [B] from logits [B, V]. ``top_k`` and ``top_p``
+    (nucleus) truncation compose: k-truncation first, then the smallest
+    prefix of the remaining distribution whose mass reaches ``top_p``."""
     logits = logits.astype(jnp.float32)
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -40,9 +43,22 @@ def sample_logits(
         logits = logits / jnp.maximum(temperature, 1e-6)
     if top_k is not None and top_k < 1:
         raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if top_k is not None and top_k < logits.shape[-1]:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose EXCLUSIVE cumulative mass is < top_p (the
+        # highest-probability token always survives)
+        keep = (cum - probs) < top_p
+        cutoff = jnp.min(
+            jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -82,6 +98,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     greedy: bool = False,
 ) -> jax.Array:
     """Generate continuations: prompt [B, T0] int32 → [B, T0 + N] int32.
@@ -100,7 +117,8 @@ def generate(
     )
     rng, sub = jax.random.split(rng)
     token = sample_logits(
-        sub, logits[:, -1, :], temperature=temperature, top_k=top_k, greedy=greedy
+        sub, logits[:, -1, :], temperature=temperature, top_k=top_k,
+        top_p=top_p, greedy=greedy,
     )
 
     fused_layers = _fuse_layers(params, cfg)
@@ -110,7 +128,8 @@ def generate(
         logits, carries = _decode_one(params, fused_layers, cfg, carries, token)
         rng, sub = jax.random.split(rng)
         nxt = sample_logits(
-            sub, logits, temperature=temperature, top_k=top_k, greedy=greedy
+            sub, logits, temperature=temperature, top_k=top_k,
+            top_p=top_p, greedy=greedy,
         )
         return (rng, nxt, carries), token
 
@@ -130,6 +149,7 @@ def make_generate_fn(
     max_new_tokens: int,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     greedy: bool = False,
 ):
     """Jitted generate: fn(params, prompt [B, T0], rng) -> [B, T0 + N]."""
@@ -138,7 +158,7 @@ def make_generate_fn(
         return generate(
             params, prompt, cfg, rng,
             max_new_tokens=max_new_tokens,
-            temperature=temperature, top_k=top_k, greedy=greedy,
+            temperature=temperature, top_k=top_k, top_p=top_p, greedy=greedy,
         )
 
     return jax.jit(fn)

@@ -62,6 +62,8 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
         if attempt > 0 and "--resume" not in argv:
             argv.append("--resume")
         rc = runner(argv)
+        if rc is not None and rc < 0:
+            rc = 128 - rc  # signal death -> conventional 128+signum status
         if rc == 0:
             if attempt > 0:
                 print(f"supervise: succeeded after {attempt} restart(s)",

@@ -143,3 +143,26 @@ def test_bf16_compute_close(params):
     (hbf, _), _ = lstm_scan(params, xs, compute_dtype=jnp.bfloat16)
     assert hbf.dtype == jnp.float32  # accumulation/state stay f32
     np.testing.assert_allclose(h32, hbf, rtol=0.1, atol=0.05)
+
+
+def test_long_sequence_remat_chunk_grads():
+    """T=512 with remat chunking: grads finite and matching the no-remat
+    scan (the long-context crux path — SURVEY.md §7 'Hard parts')."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lstm_tensorspark_tpu.ops import init_lstm_params, lstm_scan
+
+    params = init_lstm_params(jax.random.PRNGKey(0), 8, 16)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (2, 512, 8))
+
+    def loss(p, chunk):
+        return jnp.mean(lstm_scan(p, xs, remat_chunk=chunk)[1] ** 2)
+
+    g_remat = jax.jit(jax.grad(lambda p: loss(p, 64)))(params)
+    g_full = jax.jit(jax.grad(lambda p: loss(p, None)))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-7),
+        g_remat, g_full,
+    )

@@ -119,3 +119,39 @@ def test_cli_generate_end_to_end(tmp_path):
     gen = [r for r in records if r.get("note") == "generate"]
     assert len(gen) == 1
     assert len(gen[0]["continuation"]) >= 16  # 16 chars (+ nothing dropped)
+
+
+def test_top_p_one_equals_plain_sampling():
+    cfg = LMConfig(vocab_size=21, hidden_size=12)
+    params = init_lm(jax.random.PRNGKey(5), cfg)
+    prompt = np.array([[1, 2]], np.int32)
+    a = make_generate_fn(cfg, max_new_tokens=10, top_p=1.0)
+    b = make_generate_fn(cfg, max_new_tokens=10)
+    np.testing.assert_array_equal(
+        np.asarray(a(params, prompt, jax.random.PRNGKey(3))),
+        np.asarray(b(params, prompt, jax.random.PRNGKey(3))),
+    )
+
+
+def test_tiny_top_p_equals_greedy():
+    """top_p→0 keeps only the argmax token regardless of temperature."""
+    cfg = LMConfig(vocab_size=21, hidden_size=12)
+    params = init_lm(jax.random.PRNGKey(5), cfg)
+    prompt = np.array([[1, 2]], np.int32)
+    a = make_generate_fn(cfg, max_new_tokens=10, top_p=1e-6, temperature=3.0)
+    b = make_generate_fn(cfg, max_new_tokens=10, greedy=True)
+    np.testing.assert_array_equal(
+        np.asarray(a(params, prompt, jax.random.PRNGKey(3))),
+        np.asarray(b(params, prompt, jax.random.PRNGKey(3))),
+    )
+
+
+def test_top_p_restricts_support():
+    """With a peaked distribution, top_p sampling never emits tokens outside
+    the nucleus."""
+    logits = jnp.asarray([[10.0, 9.5, 0.0, -1.0, -2.0]] * 4)
+    for key in range(20):
+        toks = np.asarray(
+            sample_logits(jax.random.PRNGKey(key), logits, top_p=0.9)
+        )
+        assert set(toks.tolist()) <= {0, 1}

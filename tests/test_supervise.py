@@ -86,3 +86,11 @@ def test_crash_resume_integration(tmp_path):
     assert any("resumed at step 4" in str(r.get("note", "")) for r in records)
     finals = [r for r in records if r.get("note") == "final"]
     assert finals[-1]["step"] == 6  # budget is resume-inclusive
+
+
+def test_signal_death_maps_to_128_plus_signum():
+    def runner(argv):
+        return -9  # subprocess convention for SIGKILL
+
+    rc = supervise(["--a"], max_restarts=0, restart_delay=0.0, runner=runner)
+    assert rc == 137  # 128 + 9
