@@ -71,10 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "background-thread device_put can hurt on tunneled/"
                         "shared backends — measure before enabling)")
     p.add_argument("--device-data", action="store_true",
-                   help="stage the LM corpus in device HBM once and slice "
-                        "[B,T] windows on-device (per-dispatch host traffic: "
-                        "one scalar) — the cached-RDD equivalent; corpus must "
-                        "fit HBM; LM datasets only")
+                   help="stage the dataset in device HBM once and build "
+                        "batches on-device (LM: window slices; imdb: row "
+                        "gather; uci: series windows) — per-dispatch host "
+                        "traffic shrinks to indices; the cached-RDD "
+                        "equivalent; dataset must fit HBM")
     # --- inference / generation (LM tasks) ---
     p.add_argument("--generate-tokens", type=int, default=0,
                    help="after training, sample N continuation tokens from the LM")
@@ -138,9 +139,9 @@ def main(argv=None) -> int:
     try:
         if args.dataset in ("ptb_char", "wikitext2", "wikitext103"):
             rc = _run_lm(args, logger)
-        elif args.device_data or args.generate_tokens > 0:
+        elif args.generate_tokens > 0:
             raise SystemExit(
-                "--device-data/--generate-tokens apply to the LM datasets only "
+                "--generate-tokens applies to the LM datasets only "
                 f"(got --dataset {args.dataset})"
             )
         elif args.dataset == "imdb":

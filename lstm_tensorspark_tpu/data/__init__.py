@@ -8,8 +8,14 @@ from .batching import (
 from .datasets import get_dataset
 from .device_dataset import (
     DeviceLMData,
+    DeviceExamples,
+    DeviceSeries,
     stage_lm_data,
+    stage_examples,
+    stage_series,
     slice_window,
+    slice_forecast_batch,
+    take_batch,
     window_index_stream,
 )
 from .prefetch import prefetch_to_device
@@ -25,8 +31,14 @@ __all__ = [
     "stacked_batches",
     "get_dataset",
     "DeviceLMData",
+    "DeviceExamples",
+    "DeviceSeries",
     "stage_lm_data",
+    "stage_examples",
+    "stage_series",
     "slice_window",
+    "slice_forecast_batch",
+    "take_batch",
     "window_index_stream",
     "prefetch_to_device",
 ]

@@ -80,6 +80,50 @@ def stacked_batches(batches: Iterator[dict], k: int) -> Iterator[dict]:
             group = []
 
 
+def example_order(
+    lengths: list[int],
+    *,
+    shuffle_seed: int | None = None,
+    bucket: bool = True,
+) -> np.ndarray:
+    """THE example ordering (shuffle, then stable length-bucket sort) shared
+    by the host-fed `padded_batches` and the device-resident gather path
+    (tasks/classification.py) — one source so the two can never diverge."""
+    order = np.arange(len(lengths))
+    if shuffle_seed is not None:
+        np.random.RandomState(shuffle_seed).shuffle(order)
+    if bucket:
+        order = order[np.argsort([lengths[i] for i in order], kind="stable")]
+    return order
+
+
+def forecast_starts(
+    n_windows: int, *, shuffle_seed: int | None = None
+) -> np.ndarray:
+    """THE forecast window-start ordering shared by `forecast_windows` and
+    the device-resident series path (tasks/forecasting.py)."""
+    starts = np.arange(0, n_windows)
+    if shuffle_seed is not None:
+        np.random.RandomState(shuffle_seed).shuffle(starts)
+    return starts
+
+
+def index_groups(order_fn, batch_size: int, steps_per_call: int) -> Iterator[np.ndarray]:
+    """Epochs of index batches packed into [K, B] dispatch groups — the
+    index-stream sibling of `stacked_batches`. ``order_fn(epoch)`` returns
+    that epoch's 1-D index order; full batches only (host-path parity),
+    partial K-groups carry over into the next epoch."""
+    epoch, group = 0, []
+    while True:
+        order = order_fn(epoch)
+        for b0 in range(0, len(order) - batch_size + 1, batch_size):
+            group.append(order[b0 : b0 + batch_size].astype(np.int32))
+            if len(group) == steps_per_call:
+                yield np.stack(group)
+                group = []
+        epoch += 1
+
+
 def padded_batches(
     sequences: list[np.ndarray],
     labels: np.ndarray,
@@ -100,11 +144,9 @@ def padded_batches(
     all-zero filler rows marked ``valid=False`` (lengths 0) so metric
     consumers can weight rows instead of double-counting examples.
     """
-    order = np.arange(len(sequences))
-    if shuffle_seed is not None:
-        np.random.RandomState(shuffle_seed).shuffle(order)
-    if bucket:
-        order = order[np.argsort([len(sequences[i]) for i in order], kind="stable")]
+    order = example_order(
+        [len(s) for s in sequences], shuffle_seed=shuffle_seed, bucket=bucket
+    )
     for start in range(0, len(order), batch_size):
         idx = order[start : start + batch_size]
         if len(idx) < batch_size and drop_remainder:
@@ -140,13 +182,12 @@ def forecast_windows(
     double-counted as valid.
     """
     N = len(series)
-    starts = np.arange(0, N - context_len - horizon + 1)
-    if len(starts) == 0:
+    n_windows = N - context_len - horizon + 1
+    if n_windows < 1:
         raise ValueError(
             f"series length {N} < context {context_len} + horizon {horizon}"
         )
-    if shuffle_seed is not None:
-        np.random.RandomState(shuffle_seed).shuffle(starts)
+    starts = forecast_starts(n_windows, shuffle_seed=shuffle_seed)
     for b0 in range(0, len(starts), batch_size):
         idx = starts[b0 : b0 + batch_size]
         valid = np.ones((batch_size,), bool)

@@ -55,6 +55,15 @@ class DeviceLMData:
         return self.batch_size * self.seq_len
 
 
+def _placer(mesh: Mesh | None, spec: P | None = None):
+    """One device_put closure for every stager: ``spec`` placement on the
+    mesh (replicated when spec is None/P()), default device otherwise."""
+    if mesh is None:
+        return lambda a: jax.device_put(np.ascontiguousarray(a))
+    sharding = NamedSharding(mesh, spec if spec is not None else P())
+    return lambda a: jax.device_put(np.ascontiguousarray(a), sharding)
+
+
 def stage_lm_data(
     tokens: np.ndarray,
     batch_size: int,
@@ -67,13 +76,7 @@ def stage_lm_data(
     them on device — batch rows sharded over ``axis`` when a mesh is given,
     single default device otherwise."""
     streams, shifted, n_windows = lm_windows(tokens, batch_size, seq_len)
-    streams = np.ascontiguousarray(streams)
-    shifted = np.ascontiguousarray(shifted)
-    if mesh is not None:
-        sharding = NamedSharding(mesh, P(axis, None))
-        put = lambda a: jax.device_put(a, sharding)
-    else:
-        put = jax.device_put
+    put = _placer(mesh, P(axis, None))
     return DeviceLMData(
         arrays={"streams": put(streams), "shifted": put(shifted)},
         batch_size=batch_size,
@@ -99,3 +102,87 @@ def window_index_stream(data: DeviceLMData, steps_per_call: int):
     while True:
         yield np.int32(w)
         w = (w + steps_per_call) % data.n_windows
+
+
+# ---- generic per-example staging (classification: BASELINE.md config 2) ----
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceExamples:
+    """HBM-staged fixed-shape example arrays ([N, ...] per key), batched
+    on-device by row gather. Arrays are placed REPLICATED (every shard can
+    gather any row); per-dispatch host traffic is the [K, B] index array."""
+
+    arrays: dict
+    num_examples: int
+
+
+def stage_examples(host_arrays: dict, *, mesh: Mesh | None = None) -> DeviceExamples:
+    n = next(iter(host_arrays.values())).shape[0]
+    for k, a in host_arrays.items():
+        if a.shape[0] != n:
+            raise ValueError(
+                f"leading dims differ: {k} has {a.shape[0]} rows, expected {n}"
+            )
+    put = _placer(mesh)
+    return DeviceExamples(
+        arrays={k: put(a) for k, a in host_arrays.items()}, num_examples=n
+    )
+
+
+def take_batch(arrays: dict, idx: jax.Array) -> dict:
+    """Traced: row indices [B] → batch {key: [B, ...]}."""
+    return {k: jnp.take(a, idx, axis=0) for k, a in arrays.items()}
+
+
+# ---- series staging (forecasting: BASELINE.md config 4) ----
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSeries:
+    """HBM-staged [N, F] time series; (context, horizon) windows are sliced
+    on-device from per-example start indices."""
+
+    arrays: dict  # {"series": [N, F]}
+    context_len: int
+    horizon: int
+    num_windows: int
+
+
+def stage_series(
+    series: np.ndarray, context_len: int, horizon: int,
+    *, mesh: Mesh | None = None,
+) -> DeviceSeries:
+    n_windows = len(series) - context_len - horizon + 1
+    if n_windows < 1:
+        raise ValueError(
+            f"series length {len(series)} < context {context_len} + horizon {horizon}"
+        )
+    put = _placer(mesh)
+    return DeviceSeries(
+        arrays={"series": put(series.astype(np.float32))},
+        context_len=context_len,
+        horizon=horizon,
+        num_windows=n_windows,
+    )
+
+
+def slice_forecast_batch(
+    arrays: dict, starts: jax.Array, context_len: int, horizon: int
+) -> dict:
+    """Traced: window starts [B] → {"context" [B,C,F], "targets" [B,H,F],
+    "valid" [B]} — the exact layout of `batching.forecast_windows`."""
+    series = arrays["series"]
+    F = series.shape[-1]
+
+    def one(s):
+        ctx = lax.dynamic_slice(series, (s, 0), (context_len, F))
+        tgt = lax.dynamic_slice(series, (s + context_len, 0), (horizon, F))
+        return ctx, tgt
+
+    ctx, tgt = jax.vmap(one)(starts)
+    return {
+        "context": ctx,
+        "targets": tgt,
+        "valid": jnp.ones(starts.shape[0], bool),
+    }

@@ -63,16 +63,67 @@ def run_classifier(args, logger) -> int:
         )
     steps_per_epoch = max(len(train_seqs) // args.batch_size, 1)
 
-    def batches():
-        epoch = 0
-        while True:
-            yield from padded_batches(
-                train_seqs, train_labels, args.batch_size, max_len,
-                shuffle_seed=args.seed + epoch,
-            )
-            epoch += 1
+    if getattr(args, "device_data", False):
+        # HBM-staged padded example matrix; batches gathered on-device by
+        # row indices in the same shuffle+bucket order as padded_batches.
+        import numpy as np
 
-    stream = wrap_stream(batches())
+        from ..data import stage_examples, take_batch
+        from ..train import make_device_dp_train_step, make_device_train_step
+
+        if args.prefetch:
+            raise SystemExit("--device-data has no host feed; drop --prefetch")
+        k = args.steps_per_call
+        N = len(train_seqs)
+        toks = np.zeros((N, max_len), np.int32)
+        lens = np.zeros((N,), np.int32)
+        for r, seq in enumerate(train_seqs):
+            seq = seq[:max_len]
+            toks[r, : len(seq)] = seq
+            lens[r] = len(seq)
+        staged = stage_examples(
+            {
+                "tokens": toks,
+                "lengths": lens,
+                "labels": np.asarray(train_labels, np.int32),
+                "valid": np.ones((N,), bool),
+            },
+            mesh=mesh,
+        )
+        if mesh is None:
+            dstep = make_device_train_step(
+                loss_fn, optimizer, take_batch, grad_accum=args.grad_accum
+            )
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            arrays_spec = {k2: P() for k2 in staged.arrays}
+            dstep = make_device_dp_train_step(
+                loss_fn, optimizer, take_batch, mesh, arrays_spec,
+                idx_spec=P(None, "data"), grad_accum=args.grad_accum,
+            )
+        train_step = lambda state, idxs: dstep(state, staged.arrays, idxs)  # noqa: E731
+
+        from ..data.batching import example_order, index_groups
+
+        lengths_all = [len(s) for s in train_seqs]
+        stream = index_groups(
+            lambda epoch: example_order(
+                lengths_all, shuffle_seed=args.seed + epoch
+            ),
+            args.batch_size, k,
+        )
+    else:
+        def batches():
+            epoch = 0
+            while True:
+                yield from padded_batches(
+                    train_seqs, train_labels, args.batch_size, max_len,
+                    shuffle_seed=args.seed + epoch,
+                )
+                epoch += 1
+
+        stream = wrap_stream(batches())
     eval_step = jax.jit(lambda p, b: classifier_loss(p, b, cfg)[1])
 
     def eval_fn(params):

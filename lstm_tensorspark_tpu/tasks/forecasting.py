@@ -59,16 +59,54 @@ def run_forecaster(args, logger) -> int:
         )
     steps_per_epoch = max(n_windows // args.batch_size, 1)
 
-    def batches():
-        epoch = 0
-        while True:
-            yield from forecast_windows(
-                train_series, context_len, horizon, args.batch_size,
-                shuffle_seed=args.seed + epoch,
-            )
-            epoch += 1
+    if getattr(args, "device_data", False):
+        # HBM-staged series; (context, horizon) windows sliced on-device from
+        # per-step start indices — same shuffled order as forecast_windows,
+        # so host-fed and device-resident runs see identical batches.
+        import functools
 
-    stream = wrap_stream(batches())
+        from ..data import slice_forecast_batch, stage_series
+        from ..train import make_device_dp_train_step, make_device_train_step
+
+        if args.prefetch:
+            raise SystemExit("--device-data has no host feed; drop --prefetch")
+        k = args.steps_per_call
+        staged = stage_series(train_series, context_len, horizon, mesh=mesh)
+        window_fn = functools.partial(
+            slice_forecast_batch, context_len=context_len, horizon=horizon
+        )
+        if mesh is None:
+            dstep = make_device_train_step(
+                loss_fn, optimizer, window_fn, grad_accum=args.grad_accum
+            )
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            dstep = make_device_dp_train_step(
+                loss_fn, optimizer, window_fn, mesh, {"series": P()},
+                idx_spec=P(None, "data"), grad_accum=args.grad_accum,
+            )
+        train_step = lambda state, idxs: dstep(state, staged.arrays, idxs)  # noqa: E731
+
+        from ..data.batching import forecast_starts, index_groups
+
+        stream = index_groups(
+            lambda epoch: forecast_starts(
+                staged.num_windows, shuffle_seed=args.seed + epoch
+            ),
+            args.batch_size, k,
+        )
+    else:
+        def batches():
+            epoch = 0
+            while True:
+                yield from forecast_windows(
+                    train_series, context_len, horizon, args.batch_size,
+                    shuffle_seed=args.seed + epoch,
+                )
+                epoch += 1
+
+        stream = wrap_stream(batches())
     fc = jax.jit(lambda p, ctx: forecast(p, ctx, cfg))
 
     def eval_fn(params):

@@ -2,6 +2,8 @@ from .optimizer import make_optimizer
 from .loop import TrainState, make_train_step, make_eval_step, train_loop
 from .multistep import make_multi_train_step, make_dp_multi_train_step
 from .device_step import (
+    make_device_train_step,
+    make_device_dp_train_step,
     make_device_lm_train_step,
     make_device_dp_lm_train_step,
 )
@@ -14,6 +16,8 @@ __all__ = [
     "train_loop",
     "make_multi_train_step",
     "make_dp_multi_train_step",
+    "make_device_train_step",
+    "make_device_dp_train_step",
     "make_device_lm_train_step",
     "make_device_dp_lm_train_step",
 ]

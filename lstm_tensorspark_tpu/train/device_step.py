@@ -1,10 +1,19 @@
-"""Train steps over the device-resident LM dataset (data/device_dataset.py).
+"""Train steps over device-resident datasets (data/device_dataset.py).
 
-The step takes (state, staged arrays, scalar window index) and runs K
-optimizer steps, slicing each [B, T] window out of HBM inside the scan —
-host→device traffic per dispatch is ONE int32. Combines the K-steps-per-call
-dispatch amortisation (train/multistep.py) with the reference's cached-RDD
-data locality (SURVEY.md §3.1: executors iterate their *resident* shard).
+The step takes (state, staged arrays, per-step index array) and runs K
+optimizer steps, materialising each batch out of HBM inside the scan — the
+per-dispatch host traffic is the tiny index array (one scalar for the LM's
+contiguous windows, [K, B] row ids for examples/series). Combines the
+K-steps-per-call dispatch amortisation (train/multistep.py) with the
+reference's cached-RDD data locality (SURVEY.md §3.1: executors iterate
+their *resident* shard).
+
+Three dataset shapes share ONE generic core (`make_device_train_step` /
+`make_device_dp_train_step`, parameterised by a traced ``window_fn``):
+  - LM contiguous windows (`slice_window`) — wrappers below keep the
+    scalar-w0 API used by the CLI and bench;
+  - per-example gather (`take_batch`) — classification;
+  - series windows (`slice_forecast_batch`) — forecasting.
 
 The scan body is the shared `step_body`, so semantics are identical to the
 host-fed paths — tests/test_device_data.py asserts bit-level parity.
@@ -36,21 +45,104 @@ from .loop import (
 )
 
 
-def _scan_windows(loss_fn, optimizer, state, arrays, w0, *, seq_len, n_windows,
-                  steps_per_call, stateful, grad_accum, rng_transform=None,
-                  reduce_fn=None):
-    def body(s, j):
-        batch = slice_window(arrays, lax.rem(w0 + j, n_windows), seq_len)
+def _scan_indexed(loss_fn, optimizer, state, arrays, idxs, *, window_fn,
+                  stateful, grad_accum, rng_transform=None, reduce_fn=None):
+    """lax.scan over the leading [K] axis of ``idxs``; each step builds its
+    batch with ``window_fn(arrays, idx)`` and runs the shared step_body."""
+
+    def body(s, idx):
         return step_body(
-            loss_fn, optimizer, s, batch, stateful=stateful,
+            loss_fn, optimizer, s, window_fn(arrays, idx), stateful=stateful,
             grad_accum=grad_accum, rng_transform=rng_transform,
             reduce_fn=reduce_fn,
         )
 
-    state, ms = lax.scan(
-        body, state, jnp.arange(steps_per_call, dtype=jnp.int32)
-    )
+    state, ms = lax.scan(body, state, idxs)
     return state, summarize_scan_metrics(ms)
+
+
+def make_device_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    window_fn: Callable,
+    *,
+    stateful: bool = False,
+    grad_accum: int = 1,
+    jit: bool = True,
+    donate: bool | None = None,
+):
+    """Generic single-chip device-data step: ``step(state, arrays, idxs)``
+    with ``idxs`` carrying a leading K axis (one entry per optimizer step)."""
+
+    def step(state: TrainState, arrays, idxs):
+        return _scan_indexed(
+            loss_fn, optimizer, state, arrays, idxs, window_fn=window_fn,
+            stateful=stateful, grad_accum=grad_accum,
+        )
+
+    if jit:
+        if donate is None:
+            donate = _donation_supported()
+        step = jax.jit(step, donate_argnums=(0,) if donate else ())
+    return step
+
+
+def make_device_dp_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    window_fn: Callable,
+    mesh: Mesh,
+    arrays_spec,
+    *,
+    idx_spec=P(),
+    axis: str = "data",
+    stateful: bool = False,
+    grad_accum: int = 1,
+    jit: bool = True,
+    donate: bool | None = None,
+):
+    """Generic data-parallel device-data step. ``arrays_spec`` gives the
+    staged arrays' shardings (LM streams shard their batch rows; example/
+    series arrays replicate); ``idx_spec`` the index array's (P() when every
+    shard uses the same indices, P(None, axis) to split a [K, B] batch of
+    row ids). Grads pmean over the ICI mesh as always."""
+
+    def per_shard(state: TrainState, arrays, idxs):
+        return _scan_indexed(
+            loss_fn, optimizer, state, arrays, idxs, window_fn=window_fn,
+            stateful=stateful, grad_accum=grad_accum,
+            rng_transform=dp_rng_transform(axis),
+            reduce_fn=dp_reduce_fn(axis),
+        )
+
+    state_spec = TrainState(
+        step=P(), params=P(), opt_state=P(), rng=P(),
+        carries=P(axis) if stateful else P(),
+    )
+    sharded = shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(state_spec, arrays_spec, idx_spec),
+        out_specs=(state_spec, P()),
+        check_vma=False,
+    )
+    if jit:
+        if donate is None:
+            donate = _donation_supported()
+        sharded = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return sharded
+
+
+# ---- LM wrappers: scalar-w0 per-dispatch API (window indices computed
+# ON-DEVICE from the traced scalar — per-dispatch host traffic really is
+# one int32) ----
+
+
+def _lm_window_idxs(w0, data: DeviceLMData, steps_per_call: int):
+    return lax.rem(
+        w0 + jnp.arange(steps_per_call, dtype=jnp.int32),
+        jnp.int32(data.n_windows),
+    )
 
 
 def make_device_lm_train_step(
@@ -64,14 +156,14 @@ def make_device_lm_train_step(
     jit: bool = True,
     donate: bool | None = None,
 ):
-    """Single-chip device-data step: ``step(state, data.arrays, w0)``."""
+    """Single-chip LM device-data step: ``step(state, data.arrays, w0)``."""
+    window_fn = lambda arrays, w: slice_window(arrays, w, data.seq_len)  # noqa: E731
 
     def step(state: TrainState, arrays, w0):
-        return _scan_windows(
-            loss_fn, optimizer, state, arrays, w0,
-            seq_len=data.seq_len, n_windows=data.n_windows,
-            steps_per_call=steps_per_call, stateful=stateful,
-            grad_accum=grad_accum,
+        return _scan_indexed(
+            loss_fn, optimizer, state, arrays,
+            _lm_window_idxs(w0, data, steps_per_call),
+            window_fn=window_fn, stateful=stateful, grad_accum=grad_accum,
         )
 
     if jit:
@@ -94,17 +186,17 @@ def make_device_dp_lm_train_step(
     jit: bool = True,
     donate: bool | None = None,
 ):
-    """Data-parallel device-data step: streams live sharded ``P(axis, None)``
-    (each chip's HBM holds only its batch rows — a cached RDD partition);
-    the window slice is along time, so the feed needs no collective; grads
-    pmean over the ICI mesh as always."""
+    """Data-parallel LM device-data step: streams live sharded
+    ``P(axis, None)`` (each chip's HBM holds only its batch rows — a cached
+    RDD partition); the window slice is along time, so the feed needs no
+    collective."""
+    window_fn = lambda arrays, w: slice_window(arrays, w, data.seq_len)  # noqa: E731
 
     def per_shard(state: TrainState, arrays, w0):
-        return _scan_windows(
-            loss_fn, optimizer, state, arrays, w0,
-            seq_len=data.seq_len, n_windows=data.n_windows,
-            steps_per_call=steps_per_call, stateful=stateful,
-            grad_accum=grad_accum,
+        return _scan_indexed(
+            loss_fn, optimizer, state, arrays,
+            _lm_window_idxs(w0, data, steps_per_call),
+            window_fn=window_fn, stateful=stateful, grad_accum=grad_accum,
             rng_transform=dp_rng_transform(axis),
             reduce_fn=dp_reduce_fn(axis),
         )
@@ -113,11 +205,11 @@ def make_device_dp_lm_train_step(
         step=P(), params=P(), opt_state=P(), rng=P(),
         carries=P(axis) if stateful else P(),
     )
-    arrays_spec = {"streams": P(axis, None), "shifted": P(axis, None)}
     sharded = shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(state_spec, arrays_spec, P()),
+        in_specs=(state_spec,
+                  {"streams": P(axis, None), "shifted": P(axis, None)}, P()),
         out_specs=(state_spec, P()),
         check_vma=False,
     )
