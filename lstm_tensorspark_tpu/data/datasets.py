@@ -11,6 +11,8 @@ plus "vocab" where applicable and "synthetic": bool.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from .corpus import (
@@ -78,14 +80,86 @@ def wikitext103_word(data_path=None, **kw):
     )
 
 
-def imdb(data_path=None, *, num_examples: int = 2000, max_len: int = 400, seed: int = 0):
+def _resolve_imdb_root(data_path: str | None) -> str | None:
+    """Locate the standard aclImdb directory layout: ``<root>/{train,test}/
+    {pos,neg}/*.txt``. Accepts the aclImdb dir itself or a parent containing
+    it; None when absent (synthetic fallback)."""
+    if not data_path or not os.path.isdir(data_path):
+        return None
+    for root in (data_path, os.path.join(data_path, "aclImdb")):
+        if all(
+            os.path.isdir(os.path.join(root, split, label))
+            for split in ("train", "test")
+            for label in ("pos", "neg")
+        ):
+            return root
+    return None
+
+
+def _read_imdb_split(root: str, split: str, max_examples: int | None = None):
+    """Read one aclImdb split into (texts, labels), deterministic order."""
+    texts, labels = [], []
+    for label_name, label in (("pos", 1), ("neg", 0)):
+        d = os.path.join(root, split, label_name)
+        names = [n for n in sorted(os.listdir(d)) if n.endswith(".txt")]
+        if max_examples is not None:
+            names = names[: max_examples // 2]
+        for name in names:
+            with open(os.path.join(d, name), encoding="utf-8",
+                      errors="replace") as f:
+                texts.append(f.read())
+            labels.append(label)
+    return texts, labels
+
+
+def _imdb_real(root: str, *, max_len: int, max_vocab: int = 25_000,
+               valid_frac: float = 0.1, max_examples: int | None = None,
+               seed: int = 0):
+    """aclImdb directory → the same dict interface as the synthetic path:
+    word-id sequences clipped to ``max_len``, labels, train-split vocab."""
+    train_texts, train_labels = _read_imdb_split(root, "train", max_examples)
+    test_texts, test_labels = _read_imdb_split(root, "test", max_examples)
+    vocab = build_word_vocab(" ".join(train_texts), max_vocab)
+
+    def encode(texts, labels):
+        seqs = [vocab.encode_text(t, "word")[:max_len] for t in texts]
+        return seqs, np.asarray(labels, np.int32)
+
+    # interleave pos/neg before the valid split so both splits stay balanced
+    order = np.random.RandomState(seed).permutation(len(train_texts))
+    train_texts = [train_texts[i] for i in order]
+    train_labels = [train_labels[i] for i in order]
+    n_valid = int(len(train_texts) * valid_frac)
+    seqs, labels = encode(train_texts, train_labels)
+    test_seqs, test_labels = encode(test_texts, test_labels)
+    return {
+        "train": (seqs[n_valid:], labels[n_valid:]),
+        "valid": (seqs[:n_valid], labels[:n_valid]),
+        "test": (test_seqs, test_labels),
+        "vocab": vocab,
+        "num_classes": 2,
+        "max_len": max_len,
+        "synthetic": False,
+    }
+
+
+def imdb(data_path=None, *, num_examples: int | None = None, max_len: int = 400, seed: int = 0):
     """BASELINE.md config 2: binary sentiment over variable-length sequences.
 
-    Synthetic stand-in: two word distributions shifted by class, lengths
-    drawn log-uniform in [20, max_len] — learnable by a bi-LSTM, label
-    balance exact.
+    Real data: point ``data_path`` at the aclImdb directory (or its parent) —
+    standard ``{train,test}/{pos,neg}/*.txt`` layout. Synthetic stand-in
+    otherwise: two word distributions shifted by class, lengths drawn
+    log-uniform in [20, max_len] — learnable by a bi-LSTM, label balance
+    exact.
+
+    ``num_examples`` bounds BOTH paths (per split, balanced); the default
+    loads everything real / 2000 synthetic.
     """
-    del data_path  # no standard offline layout; synthetic only for now
+    root = _resolve_imdb_root(data_path)
+    if root is not None:
+        return _imdb_real(root, max_len=max_len, seed=seed,
+                          max_examples=num_examples)
+    num_examples = num_examples or 2000
     rng = np.random.RandomState(seed)
     text = synthetic_text(50_000, seed)
     vocab = build_word_vocab(text)
@@ -118,11 +192,66 @@ def imdb(data_path=None, *, num_examples: int = 2000, max_len: int = 400, seed: 
     }
 
 
+def _resolve_uci_file(data_path: str | None) -> str | None:
+    """Locate the UCI ElectricityLoadDiagrams file (``LD2011_2014.txt``):
+    accepts the file itself or a directory containing it."""
+    if not data_path:
+        return None
+    if os.path.isfile(data_path):
+        return data_path
+    if os.path.isdir(data_path):
+        p = os.path.join(data_path, "LD2011_2014.txt")
+        if os.path.isfile(p):
+            return p
+    return None
+
+
+def _uci_real(path: str, *, num_series: int):
+    """Parse the UCI semicolon-separated CSV: first column is a timestamp,
+    remaining columns are per-customer loads with DECIMAL COMMAS (European
+    locale — the dataset's documented format). Keeps the first
+    ``num_series`` customer columns, per-series normalised, 80/10/10
+    time-ordered split — identical interface to the synthetic path."""
+    rows = []
+    with open(path, encoding="utf-8", errors="replace") as f:
+        header = f.readline()
+        ncols = header.count(";")
+        take = min(num_series, ncols) if ncols else num_series
+        for line in f:
+            parts = line.rstrip("\n").split(";")
+            if len(parts) < take + 1:
+                continue
+            rows.append(
+                [float(v.replace(",", ".") or 0.0) for v in parts[1 : take + 1]]
+            )
+    if not rows:
+        raise ValueError(
+            f"{path} does not look like the UCI LD2011_2014 format "
+            "(semicolon-separated, timestamp + per-customer columns)"
+        )
+    data = np.asarray(rows, np.float32)  # [length, take]
+    data = (data - data.mean(axis=0)) / (data.std(axis=0) + 1e-6)
+    n_train = int(len(data) * 0.8)
+    n_valid = int(len(data) * 0.1)
+    return {
+        "train": data[:n_train],
+        "valid": data[n_train : n_train + n_valid],
+        "test": data[n_train + n_valid :],
+        "num_features": data.shape[1],
+        "synthetic": False,
+    }
+
+
 def uci_electricity(data_path=None, *, num_series: int = 8, length: int = 10_000, seed: int = 0):
-    """BASELINE.md config 4: multivariate forecasting. Synthetic stand-in:
-    mixtures of sinusoids (daily/weekly periods) + AR(1) noise, one column
-    per 'customer', normalised per-series."""
-    del data_path
+    """BASELINE.md config 4: multivariate forecasting.
+
+    Real data: point ``data_path`` at ``LD2011_2014.txt`` (or a directory
+    containing it) — the UCI ElectricityLoadDiagrams20112014 CSV. Synthetic
+    stand-in otherwise: mixtures of sinusoids (daily/weekly periods) + AR(1)
+    noise, one column per 'customer', normalised per-series."""
+    uci_file = _resolve_uci_file(data_path)
+    if uci_file is not None:
+        return _uci_real(uci_file, num_series=num_series)
     rng = np.random.RandomState(seed)
     t = np.arange(length, dtype=np.float32)
     series = []

@@ -124,11 +124,21 @@ def make_dp_eval_step(
 ):
     from ..train.loop import call_loss
 
+    def _metrics(loss, aux):
+        # Mirror make_eval_step's token reporting so evaluate() token-weights
+        # DP eval identically to single-device eval. Shards are equal-shape,
+        # so pmean of per-shard per-token means is the exact batch mean; the
+        # batch's total token count is the psum of shard counts.
+        m = {"loss": jax.lax.pmean(loss, axis)}
+        if isinstance(aux, dict) and "tokens" in aux:
+            m["tokens"] = jax.lax.psum(aux["tokens"], axis)
+        return m
+
     if stateful:
 
         def per_shard_eval(params, batch, carries):
             loss, aux = call_loss(loss_fn, params, batch, None, carries, stateful=True)
-            return {"loss": jax.lax.pmean(loss, axis)}, aux["carries"]
+            return _metrics(loss, aux), aux["carries"]
 
         sharded = shard_map(
             per_shard_eval,
@@ -140,8 +150,8 @@ def make_dp_eval_step(
     else:
 
         def per_shard_eval(params, batch):
-            loss, _ = loss_fn(params, batch, None)
-            return {"loss": jax.lax.pmean(loss, axis)}
+            loss, aux = loss_fn(params, batch, None)
+            return _metrics(loss, aux)
 
         sharded = shard_map(
             per_shard_eval,

@@ -50,6 +50,7 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
                for a in cli_args):
         print("supervise: warning: no --checkpoint-dir — a crash will "
               "restart from step 0", file=sys.stderr)
+    subprocess_runner = runner is None
     if runner is None:
         def runner(argv):
             return subprocess.run(
@@ -61,7 +62,9 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
         argv = list(cli_args)
         if attempt > 0 and "--resume" not in argv:
             argv.append("--resume")
+        start = time.monotonic()
         rc = runner(argv)
+        lifetime = time.monotonic() - start
         if rc is not None and rc < 0:
             rc = 128 - rc  # signal death -> conventional 128+signum status
         if rc == 0:
@@ -69,6 +72,19 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
                 print(f"supervise: succeeded after {attempt} restart(s)",
                       file=sys.stderr)
             return 0
+        # Deterministic failures can never be fixed by a retry: argparse
+        # usage errors exit 2, and flag-validation SystemExits die within
+        # well under a second (before any training state exists). Retrying
+        # those burns the whole restart budget on a run that cannot succeed.
+        # The lifetime heuristic only applies to real child processes —
+        # injected test runners return instantly by construction — and never
+        # to signal deaths (rc >= 128): an early OOM-kill or preemption is
+        # exactly the transient class the supervisor exists to retry.
+        if rc == 2 or (subprocess_runner and rc is not None and 0 < rc < 128
+                       and lifetime < 1.0):
+            print(f"supervise: child failed deterministically (exit {rc} "
+                  f"after {lifetime:.2f}s) — not retrying", file=sys.stderr)
+            return rc
         if attempt >= max_restarts:
             print(f"supervise: giving up after {attempt} restart(s) "
                   f"(last exit code {rc})", file=sys.stderr)

@@ -209,17 +209,27 @@ def make_eval_step(loss_fn: Callable, *, jit: bool = True, stateful: bool = Fals
     Stateful variant returns ``({"loss": ...}, carries)`` so evaluation can
     carry recurrent state across contiguous windows."""
 
+    def _metrics(loss, aux):
+        # Token count for exact token-weighted averaging in evaluate();
+        # losses are per-token means, so the cross-batch mean must be
+        # weighted by tokens to stay exact under unequal batch sizes
+        # (dropped remainders, variable-length buckets).
+        m = {"loss": loss}
+        if isinstance(aux, dict) and "tokens" in aux:
+            m["tokens"] = aux["tokens"]
+        return m
+
     if stateful:
 
         def eval_step(params, batch, carries):
             loss, aux = loss_fn(params, batch, None, carries)
-            return {"loss": loss}, aux["carries"]
+            return _metrics(loss, aux), aux["carries"]
 
     else:
 
         def eval_step(params, batch):
             loss, aux = loss_fn(params, batch, None)
-            return {"loss": loss}
+            return _metrics(loss, aux)
 
     if jit:
         eval_step = jax.jit(eval_step)
@@ -229,18 +239,25 @@ def make_eval_step(loss_fn: Callable, *, jit: bool = True, stateful: bool = Fals
 def evaluate(
     eval_step, params, batches: Iterable, *, carries=None
 ) -> dict[str, float]:
-    """Mean loss + perplexity over batches. Pass ``carries`` (with a stateful
-    eval_step) to thread recurrent state through the contiguous stream."""
+    """Token-weighted mean loss + perplexity over batches. Pass ``carries``
+    (with a stateful eval_step) to thread recurrent state through the
+    contiguous stream.
+
+    Batch losses are weighted by their token count (when the loss aux
+    reports one) so perplexity is the exact corpus-level value under any
+    batching — equal-size batches, dropped remainders, or variable-length
+    buckets all give the same answer."""
     stateful = carries is not None
-    total, n = 0.0, 0
+    total, weight = 0.0, 0.0
     for batch in batches:
         if stateful:
             m, carries = eval_step(params, batch, carries)
         else:
             m = eval_step(params, batch)
-        total += float(m["loss"])
-        n += 1
-    loss = total / max(n, 1)
+        w = float(m["tokens"]) if "tokens" in m else 1.0
+        total += float(m["loss"]) * w
+        weight += w
+    loss = total / max(weight, 1.0)
     return {"eval_loss": loss, "eval_ppl": float(jnp.exp(jnp.minimum(loss, 30.0)))}
 
 

@@ -1,0 +1,36 @@
+"""Token-weighted evaluation (train/loop.py evaluate): batch losses are
+per-token means, so the cross-batch aggregate must weight by token count to
+be the exact corpus-level loss under unequal batch sizes."""
+
+import jax.numpy as jnp
+
+from lstm_tensorspark_tpu.train.loop import evaluate, make_eval_step
+
+
+def test_evaluate_weights_by_tokens():
+    # loss_fn reporting per-token mean loss + token count per batch
+    def loss_fn(params, batch, rng):
+        loss = jnp.asarray(batch["loss"], jnp.float32)
+        return loss, {"tokens": jnp.asarray(batch["tokens"], jnp.float32)}
+
+    step = make_eval_step(loss_fn, jit=False)
+    # batch A: 100 tokens at loss 1.0; batch B: 10 tokens at loss 11.0
+    batches = [
+        {"loss": 1.0, "tokens": 100.0},
+        {"loss": 11.0, "tokens": 10.0},
+    ]
+    out = evaluate(step, None, batches)
+    # exact corpus mean = (1.0*100 + 11.0*10) / 110, NOT (1.0+11.0)/2
+    expected = (1.0 * 100 + 11.0 * 10) / 110
+    assert abs(out["eval_loss"] - expected) < 1e-6
+
+
+def test_evaluate_unweighted_fallback():
+    """Losses without a token count average uniformly (legacy behavior)."""
+
+    def loss_fn(params, batch, rng):
+        return jnp.asarray(batch, jnp.float32), {}
+
+    step = make_eval_step(loss_fn, jit=False)
+    out = evaluate(step, None, [2.0, 4.0])
+    assert abs(out["eval_loss"] - 3.0) < 1e-6

@@ -88,6 +88,21 @@ def test_crash_resume_integration(tmp_path):
     assert finals[-1]["step"] == 6  # budget is resume-inclusive
 
 
+def test_usage_error_not_retried():
+    """Exit code 2 (argparse usage error) is deterministic — retrying burns
+    the restart budget on a run that can never succeed."""
+    calls = []
+
+    def runner(argv):
+        calls.append(argv)
+        return 2
+
+    rc = supervise(["--bogus"], max_restarts=5, restart_delay=0.0,
+                   runner=runner)
+    assert rc == 2
+    assert len(calls) == 1  # no retries
+
+
 def test_signal_death_maps_to_128_plus_signum():
     def runner(argv):
         return -9  # subprocess convention for SIGKILL
