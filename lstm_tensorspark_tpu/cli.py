@@ -55,7 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jax.checkpoint chunk size over time (long sequences)")
     p.add_argument("--scan-unroll", type=int, default=1)
     p.add_argument("--use-pallas", action="store_true",
-                   help="fused Pallas recurrence kernel (TPU, B%%8==0, H%%128==0)")
+                   help="fused Pallas recurrence kernel (TPU, B%%8==0; any H — "
+                        "padded/tiled internally). Its fused backward saves "
+                        "O(T) f32 activations in HBM; above ~4 GB (env "
+                        "LSTM_TSP_RESIDUAL_HBM_MB) or with --remat-chunk set "
+                        "it switches to the recompute backward instead")
     p.add_argument("--stateful", action="store_true",
                    help="stateful truncated BPTT: carry recurrent state across contiguous windows")
     p.add_argument("--grad-accum", type=int, default=1,

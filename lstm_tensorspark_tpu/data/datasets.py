@@ -230,9 +230,13 @@ def _uci_real(path: str, *, num_series: int):
             "(semicolon-separated, timestamp + per-customer columns)"
         )
     data = np.asarray(rows, np.float32)  # [length, take]
-    data = (data - data.mean(axis=0)) / (data.std(axis=0) + 1e-6)
     n_train = int(len(data) * 0.8)
     n_valid = int(len(data) * 0.1)
+    # normalise with TRAIN-split statistics only — using full-series stats
+    # would leak valid/test information into the scored data
+    mu = data[:n_train].mean(axis=0)
+    sd = data[:n_train].std(axis=0)
+    data = (data - mu) / (sd + 1e-6)
     return {
         "train": data[:n_train],
         "valid": data[n_train : n_train + n_valid],
@@ -262,10 +266,14 @@ def uci_electricity(data_path=None, *, num_series: int = 8, length: int = 10_000
         for k in range(1, length):
             noise[k] = 0.8 * noise[k - 1] + 0.1 * rng.randn()
         s = (1 + 0.3 * i) * daily + weekly + noise
-        series.append((s - s.mean()) / (s.std() + 1e-6))
+        series.append(s)
     data = np.stack(series, axis=1).astype(np.float32)  # [length, num_series]
     n_train = int(length * 0.8)
     n_valid = int(length * 0.1)
+    # train-split statistics only (no valid/test leakage), as in _uci_real
+    mu = data[:n_train].mean(axis=0)
+    sd = data[:n_train].std(axis=0)
+    data = (data - mu) / (sd + 1e-6)
     return {
         "train": data[:n_train],
         "valid": data[n_train : n_train + n_valid],

@@ -1,39 +1,62 @@
-"""Fused Pallas TPU kernel for the LSTM recurrence.
+"""Fused Pallas TPU kernels for the LSTM recurrence.
 
 Motivation (SURVEY.md §2 native-capability table: "optional Pallas kernel
 for the fused cell if XLA fusion is insufficient"): under `lax.scan` XLA
 executes T small programs, each round-tripping h/c and the gate activations
-through HBM. This kernel runs the WHOLE sequence in one `pallas_call`:
+through HBM. These kernels run the WHOLE sequence in one `pallas_call`:
 
 - the input projection ``X @ W + b`` for all T steps is hoisted OUT of the
   recurrence into one large MXU matmul (XLA does this part best);
 - the serial part — ``z_t = Xproj_t + h @ U``, gates, state update — runs
-  over a sequential grid of T steps with h and c RESIDENT IN VMEM scratch
-  (TPU grids execute in order, so scratch carries state between steps);
-- per step the kernel touches HBM only for its Xproj block (streamed in)
-  and its ys block (streamed out): 2*B*H + B*4H floats instead of the
-  scan's intermediates.
+  over a sequential grid with h and c RESIDENT IN VMEM scratch (TPU grids
+  execute in order, so scratch carries state between steps).
 
-Training support: `pallas_lstm_scan` carries a custom VJP with TWO backward
-strategies:
-- default: a hand-written FUSED BPTT kernel (`_lstm_bwd_kernel`) — reverse
-  sequential grid with dh/dc carries and the dU accumulator resident in
-  VMEM, consuming the z/c trajectories the train-mode forward streams out.
-  Gate math recomputes from saved f32 z, but the two backward matmuls run
-  in the compute dtype, so bf16 grads agree with the scan reference only to
-  bf16 tolerance (not bit-exact);
-- fallback (when `remat_chunk` is set — memory priority — or the backward's
-  VMEM residents don't fit): re-run the pure-jax scan under `jax.vjp`
-  (full-recompute, remat-style), bit-exact with the reference BPTT.
+Two kernel strategies, chosen by a single VMEM cost model (`_plan_fwd` /
+`_plan_bwd` — both gates derive from the same per-buffer accounting):
+
+- **resident** (small H): the recurrent matrix U lives in VMEM for the whole
+  sequence; the grid is time-chunked (``chunk`` steps python-unrolled per
+  grid step). Minimum HBM traffic.
+- **tiled** (big H, e.g. configs 3/5 at H=650/1024): U cannot fit VMEM, so
+  the grid is ``(T, K)`` with U streamed in K row-tiles per step and the
+  pre-gate activations accumulated f32 in a full-width VMEM scratch; h is
+  kept twice (tile-major for the matmul reads, full-width for the update).
+  U streams from HBM once per step — the same per-step U traffic `lax.scan`
+  pays — while still deleting the scan's h/c round-trips and per-step
+  dispatch overhead.
+
+Hidden sizes that are not lane-aligned (H % 128 != 0, e.g. 650) are
+zero-PADDED to the next multiple of 128 per gate block. Padding is exactly
+gradient-neutral: padded U/W columns and biases are zero, so padded
+pre-activations are z=0, padded gates are (i,f,o)=σ(0)=½, g=tanh(0)=0, and
+padded h/c lanes stay exactly 0 through the whole recurrence; all padded
+cotangents vanish identically (dz_pad = 0), so sliced gradients equal the
+unpadded ones. The pad/slice lives OUTSIDE the custom VJP, so JAX transposes
+it automatically.
+
+Training support: `pallas_lstm_scan` carries a custom VJP with THREE
+backward strategies:
+- **resident fused BPTT** (`_lstm_bwd_kernel`): reverse sequential grid with
+  dh/dc carries and the dU accumulator resident in VMEM, consuming the z/c
+  trajectories the train-mode forward streams out;
+- **tiled fused BPTT** (`_lstm_bwd_tiled_kernel`): the sequential kernel
+  computes only dz (streaming U^T in tiles for the dh carry); the weight
+  cotangents dU/dW/db and dxs are single large MXU matmuls OUTSIDE the
+  kernel (XLA's job — they contract over T·B at once);
+- **recompute fallback** (when `remat_chunk` is set — memory priority — or
+  the O(T) f32 residuals would exceed `_RESIDUAL_HBM_BUDGET`, or no fused
+  kernel fits): re-run the pure-jax scan under `jax.vjp` (remat-style),
+  bit-exact with the reference BPTT.
 
 Tiling constraints (pallas_guide.md): last dim 128 lanes; float32 sublane 8.
-`supported()` gates on B % 8 == 0 and H % 128 == 0; callers fall back to
+`supported()` gates on B % 8 == 0 plus the cost model; callers fall back to
 `lstm_scan` otherwise.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +68,103 @@ from .scan import lstm_scan
 
 
 _VMEM_BUDGET = 12 * 2**20  # bytes; conservative vs ~16 MiB/core
+_LANE = 128
+# The fused backward saves O(T) f32 residuals (z [T,B,4H] + cs [T,B,H]) in
+# HBM. Above this budget the recompute backward is selected instead — the
+# memory/speed trade ADVICE.md flagged, now an explicit heuristic
+# (override with LSTM_TSP_RESIDUAL_HBM_MB).
+_RESIDUAL_HBM_BUDGET = int(os.environ.get("LSTM_TSP_RESIDUAL_HBM_MB", 4096)) * 2**20
+
+
+def _pad_to_lane(h: int) -> int:
+    return h + (-h % _LANE)
+
+
+# ---------------------------------------------------------------------------
+# Unified VMEM cost model. Every supported()/strategy decision reads these
+# four functions; there is no second, implicit accounting (ADVICE.md #1).
+# Streamed blocks are counted ×2 for the pipeline's double-buffering.
+# ---------------------------------------------------------------------------
+
+
+def _resident_fwd_vmem(B: int, H: int, pbytes: int, save_residuals: bool) -> int:
+    c = 8  # worst-case time chunk (_time_chunk)
+    v = 4 * H * H * pbytes  # U resident
+    v += 2 * c * B * 4 * H * 4  # xproj blocks (double-buffered)
+    v += 2 * c * B * H * 4  # ys out blocks
+    v += 6 * B * H * 4  # h0/c0 in, hT/cT out, h/c scratch
+    if save_residuals:
+        v += 2 * c * B * 4 * H * 4  # z out blocks
+        v += 2 * c * B * H * 4  # cs out blocks
+    return v
+
+
+def _resident_bwd_vmem(B: int, H: int, pbytes: int) -> int:
+    streamed = (
+        8 * B * 4 * H * 4 * 2  # z in + dz out blocks (chunk<=8)
+        + 8 * B * H * 4 * 4  # dys/c/c_prev/h_prev blocks
+    )
+    return (
+        4 * H * H * pbytes  # U^T resident
+        + 2 * 4 * H * H * 4  # dU: f32 scratch + output block
+        + streamed * 2  # double-buffered pipelining
+        + 4 * B * H * 4  # dh/dc scratch + dh0/dc0 out
+    )
+
+
+def _tiled_fwd_vmem(B: int, H: int, pbytes: int, save_residuals: bool,
+                    htile: int) -> int:
+    v = 2 * htile * 4 * H * pbytes  # U row-tile (streamed every step)
+    v += 2 * B * 4 * H * 4  # xproj block
+    v += B * 4 * H * 4  # z accumulator scratch (f32)
+    v += 2 * B * H * 4  # h tiles scratch + c scratch
+    v += 2 * B * H * 4  # ys out block
+    v += 4 * B * H * 4  # h0/c0 in, hT/cT out
+    if save_residuals:
+        v += 2 * B * 4 * H * 4  # z out block
+        v += 2 * B * H * 4  # cs out block
+    return v
+
+
+def _tiled_bwd_vmem(B: int, H: int, pbytes: int, ttile: int) -> int:
+    v = 2 * ttile * H * pbytes  # U^T row-tile
+    v += 2 * B * 4 * H * 4  # z in block
+    v += 2 * 3 * B * H * 4  # dys/c/c_prev in blocks
+    v += 2 * B * 4 * H * 4  # dz out block
+    v += B * 4 * H * 4  # dz tiles scratch
+    v += 3 * B * H * 4  # dh/dc/dh-accumulator scratch
+    v += 4 * B * H * 4  # dhT/dcT in, dh0/dc0 out
+    return v
+
+
+def _plan_fwd(B: int, H: int, pbytes: int, *,
+              save_residuals: bool) -> tuple[str, int] | None:
+    """(strategy, htile) for the forward kernel at PADDED hidden size H,
+    or None when nothing fits. Prefers the resident kernel (least HBM
+    traffic), then the largest feasible U row-tile."""
+    if _resident_fwd_vmem(B, H, pbytes, save_residuals) <= _VMEM_BUDGET:
+        return ("resident", 0)
+    for htile in (512, 256, 128):
+        if H % htile == 0 and _tiled_fwd_vmem(
+                B, H, pbytes, save_residuals, htile) <= _VMEM_BUDGET:
+            return ("tiled", htile)
+    return None
+
+
+def _plan_bwd(B: int, H: int, pbytes: int) -> tuple[str, int] | None:
+    """(strategy, ttile) for the fused backward kernel, or None → recompute
+    fallback. ttile tiles U^T's leading (4H) dim."""
+    if _resident_bwd_vmem(B, H, pbytes) <= _VMEM_BUDGET:
+        return ("resident", 0)
+    for ttile in (1024, 512, 256, 128):
+        if (4 * H) % ttile == 0 and _tiled_bwd_vmem(
+                B, H, pbytes, ttile) <= _VMEM_BUDGET:
+            return ("tiled", ttile)
+    return None
+
+
+def _residual_bytes(T: int, B: int, H: int) -> int:
+    return T * B * 5 * H * 4  # z [T,B,4H] + cs [T,B,H], both f32
 
 
 def supported(
@@ -54,27 +174,28 @@ def supported(
     *,
     param_dtype_bytes: int = 4,
 ) -> bool:
-    """Can the fused kernel run these shapes on this platform?
+    """Can a fused kernel run these shapes on this platform?
 
-    Besides tiling divisibility, checks VMEM feasibility: the kernel keeps
-    the recurrent matrix U (H, 4H) plus h/c state, carry in/out blocks and
-    the streamed xproj/ys blocks resident in VMEM. Shapes that would blow
-    the budget (e.g. H=1024 f32: U alone is 16 MiB) fall back to lstm_scan
-    instead of failing Mosaic compilation.
+    Hidden sizes are padded to the 128-lane multiple internally, so any H is
+    lane-feasible; the gate is batch sublane alignment (B % 8) plus the VMEM
+    cost model (`_plan_fwd`) at the padded size — H=650/1024 now plan onto
+    the tiled kernel instead of falling back to lstm_scan.
     """
     if platform is None:
         platform = jax.default_backend()
-    resident = (
-        4 * hidden * hidden * param_dtype_bytes  # U (H, 4H)
-        + 8 * batch * 4 * hidden * 4  # xproj block (worst-case chunk=8), f32
-        + (8 + 6) * batch * hidden * 4  # ys block + h0/c0/hT/cT + h/c scratch
-    )
+    hp = _pad_to_lane(hidden)
     return (
         platform == "tpu"
         and batch % 8 == 0
-        and hidden % 128 == 0
-        and resident <= _VMEM_BUDGET
+        and hidden >= 1
+        and _plan_fwd(batch, hp, param_dtype_bytes,
+                      save_residuals=False) is not None
     )
+
+
+# ---------------------------------------------------------------------------
+# Resident kernels (U lives in VMEM; time-chunked grid)
+# ---------------------------------------------------------------------------
 
 
 def _lstm_kernel(xproj_ref, u_ref, h0_ref, c0_ref, ys_ref, hT_ref, cT_ref,
@@ -130,27 +251,6 @@ def _time_chunk(T: int) -> int:
         if T % c == 0:
             return c
     return 1
-
-
-def _bwd_supported(batch: int, hidden: int, param_dtype_bytes: int) -> bool:
-    """Can the FUSED backward kernel hold its residents in VMEM?
-
-    Residents: U^T (4H, H), the f32 dU accumulator (H, 4H) TWICE (scratch +
-    whole-array output block), dh/dc scratch, and the streamed per-chunk
-    blocks (z, dys, c, c_prev, h_prev in; dz out) — counted ×2 for the
-    pipeline's double-buffering. Falls back to the remat-recompute backward
-    otherwise — a memory/speed trade, never a capability loss."""
-    streamed = (
-        8 * batch * 4 * hidden * 4 * 2  # z in + dz out blocks (chunk<=8)
-        + 8 * batch * hidden * 4 * 4  # dys/c/c_prev/h_prev blocks
-    )
-    resident = (
-        4 * hidden * hidden * param_dtype_bytes  # U^T
-        + 2 * 4 * hidden * hidden * 4  # dU: f32 scratch + output block
-        + streamed * 2  # double-buffered pipelining
-        + 4 * batch * hidden * 4  # dh/dc scratch + dh0/dc0 out
-    )
-    return resident <= _VMEM_BUDGET
 
 
 def _lstm_bwd_kernel(z_ref, dys_ref, c_ref, cprev_ref, hprev_ref, ut_ref,
@@ -210,15 +310,146 @@ def _lstm_bwd_kernel(z_ref, dys_ref, c_ref, cprev_ref, hprev_ref, ut_ref,
         du_ref[:] = du
 
 
+# ---------------------------------------------------------------------------
+# Tiled kernels (U streamed in tiles; grid (T, K), chunk = 1)
+# ---------------------------------------------------------------------------
+
+
+def _lstm_tiled_kernel(xproj_ref, u_ref, h0_ref, c0_ref,
+                       ys_ref, hT_ref, cT_ref, *rest,
+                       hidden: int, htile: int, save_residuals: bool):
+    """Forward recurrence with U streamed in [htile, 4H] row-tiles.
+
+    Grid (T, K), K = H/htile, k fastest. Per (t, k): accumulate
+    ``z += h[:, k-tile] @ U[k-tile, :]`` into the full-width f32 z scratch;
+    at the last tile, apply the gates and advance h/c. h is kept twice —
+    tile-major ([K, B, htile] scratch, dynamically indexed by k for the
+    matmul) and rebuilt with static slices after each step."""
+    if save_residuals:
+        z_out_ref, cs_ref, h_tiles, c_scr, z_scr = rest
+    else:
+        h_tiles, c_scr, z_scr = rest
+    t = pl.program_id(0)
+    k = pl.program_id(1)
+    T = pl.num_programs(0)
+    K = pl.num_programs(1)
+    H = hidden
+
+    @pl.when((t == 0) & (k == 0))
+    def _():
+        for j in range(K):
+            h_tiles[j] = h0_ref[:, j * htile : (j + 1) * htile]
+        c_scr[:] = c0_ref[:]
+
+    @pl.when(k == 0)
+    def _():
+        z_scr[:] = xproj_ref[0]
+
+    z_scr[:] = z_scr[:] + jnp.dot(
+        h_tiles[k].astype(u_ref.dtype), u_ref[:],
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(k == K - 1)
+    def _():
+        z = z_scr[:]
+        i = jax.nn.sigmoid(z[:, :H])
+        f = jax.nn.sigmoid(z[:, H : 2 * H])
+        g = jnp.tanh(z[:, 2 * H : 3 * H])
+        o = jax.nn.sigmoid(z[:, 3 * H :])
+        c = f * c_scr[:] + i * g
+        h = o * jnp.tanh(c)
+        c_scr[:] = c
+        ys_ref[0] = h
+        if save_residuals:
+            z_out_ref[0] = z
+            cs_ref[0] = c
+        for j in range(K):
+            h_tiles[j] = h[:, j * htile : (j + 1) * htile]
+
+        @pl.when(t == T - 1)
+        def _():
+            hT_ref[:] = h
+            cT_ref[:] = c
+
+
+def _lstm_bwd_tiled_kernel(z_ref, dys_ref, c_ref, cprev_ref, ut_ref,
+                           dhT_ref, dcT_ref,
+                           dz_ref, dh0_ref, dc0_ref,
+                           dh_scr, dc_scr, dhacc_scr, dz_tiles,
+                           *, hidden: int, ttile: int):
+    """Tiled BPTT: computes ONLY the sequential part — dz_t and the dh/dc
+    carries — streaming U^T in [ttile, H] row-tiles for the carry matmul.
+    The weight cotangents (dU, dW, db) and dxs contract over all T·B outside
+    the kernel as single large MXU matmuls (`_pallas_backward`)."""
+    t = pl.program_id(0)
+    k = pl.program_id(1)
+    T = pl.num_programs(0)
+    K = pl.num_programs(1)
+    H = hidden
+
+    @pl.when((t == 0) & (k == 0))
+    def _():
+        dh_scr[:] = dhT_ref[:]
+        dc_scr[:] = dcT_ref[:]
+
+    @pl.when(k == 0)
+    def _():
+        z = z_ref[0]
+        i = jax.nn.sigmoid(z[:, :H])
+        f = jax.nn.sigmoid(z[:, H : 2 * H])
+        g = jnp.tanh(z[:, 2 * H : 3 * H])
+        o = jax.nn.sigmoid(z[:, 3 * H :])
+        c = c_ref[0]
+        tc = jnp.tanh(c)
+        dh = dh_scr[:] + dys_ref[0]
+        dc = dc_scr[:] + dh * o * (1.0 - tc * tc)
+        do = dh * tc * o * (1.0 - o)
+        di = dc * g * i * (1.0 - i)
+        df = dc * cprev_ref[0] * f * (1.0 - f)
+        dg = dc * i * (1.0 - g * g)
+        dz = jnp.concatenate([di, df, dg, do], axis=1)  # [B, 4H] f32
+        dz_ref[0] = dz
+        for j in range(K):
+            dz_tiles[j] = dz[:, j * ttile : (j + 1) * ttile]
+        dc_scr[:] = dc * f
+        dhacc_scr[:] = jnp.zeros_like(dhacc_scr)
+
+    dhacc_scr[:] = dhacc_scr[:] + jnp.dot(
+        dz_tiles[k].astype(ut_ref.dtype), ut_ref[:],
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(k == K - 1)
+    def _():
+        dh_scr[:] = dhacc_scr[:]
+
+        @pl.when(t == T - 1)
+        def _():
+            dh0_ref[:] = dhacc_scr[:]
+            dc0_ref[:] = dc_scr[:]
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers
+# ---------------------------------------------------------------------------
+
+
 def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False,
                     save_residuals: bool = False):
     """xs [B,T,D] -> (ys [B,T,H], hT, cT[, z, cs]). fused: FusedLSTMParams.
 
     ``save_residuals`` additionally returns the z/c trajectories ([T,B,...])
-    for the fused backward."""
+    for the fused backward. Strategy (resident vs tiled U) comes from the
+    shared cost model."""
     B, T, _ = xs.shape
     H = fused.hidden_size
     dtype = fused.kernel.dtype
+    pbytes = 2 if dtype == jnp.bfloat16 else 4
+    plan = _plan_fwd(B, H, pbytes, save_residuals=save_residuals)
+    if plan is None:  # callers gate via supported(); belt-and-braces
+        raise ValueError(f"no pallas forward plan for B={B}, H={H}")
+    strategy, htile = plan
     # one big MXU matmul for every step's input projection
     xproj = (
         jnp.einsum(
@@ -228,10 +459,11 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False,
         + fused.bias
     )  # [B, T, 4H] f32
     xproj = jnp.moveaxis(xproj, 0, 1)  # [T, B, 4H]
-    C = _time_chunk(T)
+    C = _time_chunk(T) if strategy == "resident" else 1
 
     out_specs = [
-        pl.BlockSpec((C, B, H), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((C, B, H), lambda t, *k: (t, 0, 0),
+                     memory_space=pltpu.VMEM),
         pl.BlockSpec(memory_space=pltpu.VMEM),
         pl.BlockSpec(memory_space=pltpu.VMEM),
     ]
@@ -242,9 +474,9 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False,
     ]
     if save_residuals:
         out_specs += [
-            pl.BlockSpec((C, B, 4 * H), lambda t: (t, 0, 0),
+            pl.BlockSpec((C, B, 4 * H), lambda t, *k: (t, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((C, B, H), lambda t: (t, 0, 0),
+            pl.BlockSpec((C, B, H), lambda t, *k: (t, 0, 0),
                          memory_space=pltpu.VMEM),
         ]
         out_shape += [
@@ -252,25 +484,45 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False,
             jax.ShapeDtypeStruct((T, B, H), jnp.float32),
         ]
 
-    kernel = functools.partial(
-        _lstm_kernel, hidden=H, chunk=C, save_residuals=save_residuals
-    )
+    xproj_spec = pl.BlockSpec((C, B, 4 * H), lambda t, *k: (t, 0, 0),
+                              memory_space=pltpu.VMEM)
+    if strategy == "resident":
+        kernel = functools.partial(
+            _lstm_kernel, hidden=H, chunk=C, save_residuals=save_residuals
+        )
+        grid = (T // C,)
+        u_spec = pl.BlockSpec(memory_space=pltpu.VMEM)  # U resident
+        scratch = [
+            pltpu.VMEM((B, H), jnp.float32),  # h
+            pltpu.VMEM((B, H), jnp.float32),  # c
+        ]
+    else:
+        K = H // htile
+        kernel = functools.partial(
+            _lstm_tiled_kernel, hidden=H, htile=htile,
+            save_residuals=save_residuals,
+        )
+        grid = (T, K)
+        u_spec = pl.BlockSpec((htile, 4 * H), lambda t, k: (k, 0),
+                              memory_space=pltpu.VMEM)  # U streamed
+        scratch = [
+            pltpu.VMEM((K, B, htile), jnp.float32),  # h, tile-major
+            pltpu.VMEM((B, H), jnp.float32),  # c
+            pltpu.VMEM((B, 4 * H), jnp.float32),  # z accumulator
+        ]
+
     out = pl.pallas_call(
         kernel,
-        grid=(T // C,),
+        grid=grid,
         in_specs=[
-            pl.BlockSpec((C, B, 4 * H), lambda t: (t, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),  # U resident
+            xproj_spec,
+            u_spec,
             pl.BlockSpec(memory_space=pltpu.VMEM),  # h0
             pl.BlockSpec(memory_space=pltpu.VMEM),  # c0
         ],
         out_specs=out_specs,
         out_shape=out_shape,
-        scratch_shapes=[
-            pltpu.VMEM((B, H), jnp.float32),
-            pltpu.VMEM((B, H), jnp.float32),
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(xproj, fused.recurrent, h0.astype(jnp.float32), c0.astype(jnp.float32))
     ys = jnp.moveaxis(out[0], 0, 1)
@@ -281,14 +533,19 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False,
 
 def _pallas_backward(fused, params, xs, h0, c0, ys, z, cs, dys, dhT, dcT,
                      *, interpret: bool = False):
-    """Fused BPTT via `_lstm_bwd_kernel` + two big MXU matmuls outside.
+    """Fused BPTT via `_lstm_bwd_kernel` / `_lstm_bwd_tiled_kernel` + big
+    MXU matmuls outside.
 
     Returns per-gate grads in the LSTMParams structure plus (dxs, dh0, dc0).
     """
     B, T, _ = xs.shape
     H = fused.hidden_size
     dtype = fused.kernel.dtype
-    C = _time_chunk(T)
+    pbytes = 2 if dtype == jnp.bfloat16 else 4
+    plan = _plan_bwd(B, H, pbytes)
+    if plan is None:
+        raise ValueError(f"no pallas backward plan for B={B}, H={H}")
+    strategy, ttile = plan
 
     ys_t = jnp.moveaxis(ys, 0, 1)  # [T, B, H] f32
     h_prev = jnp.concatenate([h0.astype(jnp.float32)[None], ys_t[:-1]], axis=0)
@@ -296,42 +553,87 @@ def _pallas_backward(fused, params, xs, h0, c0, ys, z, cs, dys, dhT, dcT,
     dys_t = jnp.moveaxis(dys.astype(jnp.float32), 0, 1)
     u_t = fused.recurrent.T  # [4H, H], compute dtype
 
-    kernel = functools.partial(_lstm_bwd_kernel, hidden=H, chunk=C)
-    n = T // C
-    rev = lambda t: (n - 1 - t, 0, 0)  # reverse-time grid
-    dz, dU, dh0, dc0 = pl.pallas_call(
-        kernel,
-        grid=(n,),
-        in_specs=[
-            pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # z
-            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),      # dys
-            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),      # c
-            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),      # c_prev
-            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),      # h_prev
-            pl.BlockSpec(memory_space=pltpu.VMEM),                      # U^T
-            pl.BlockSpec(memory_space=pltpu.VMEM),                      # dhT
-            pl.BlockSpec(memory_space=pltpu.VMEM),                      # dcT
-        ],
-        out_specs=[
-            pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # dz
-            pl.BlockSpec(memory_space=pltpu.VMEM),                      # dU
-            pl.BlockSpec(memory_space=pltpu.VMEM),                      # dh0
-            pl.BlockSpec(memory_space=pltpu.VMEM),                      # dc0
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
-            jax.ShapeDtypeStruct((H, 4 * H), jnp.float32),
-            jax.ShapeDtypeStruct((B, H), jnp.float32),
-            jax.ShapeDtypeStruct((B, H), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((B, H), jnp.float32),
-            pltpu.VMEM((B, H), jnp.float32),
-            pltpu.VMEM((H, 4 * H), jnp.float32),
-        ],
-        interpret=interpret,
-    )(z, dys_t, cs, c_prev, h_prev, u_t,
-      dhT.astype(jnp.float32), dcT.astype(jnp.float32))
+    if strategy == "resident":
+        C = _time_chunk(T)
+        n = T // C
+        rev = lambda t: (n - 1 - t, 0, 0)  # reverse-time grid
+        kernel = functools.partial(_lstm_bwd_kernel, hidden=H, chunk=C)
+        dz, dU, dh0, dc0 = pl.pallas_call(
+            kernel,
+            grid=(n,),
+            in_specs=[
+                pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # z
+                pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # dys
+                pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # c
+                pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # c_prev
+                pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # h_prev
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # U^T
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dhT
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dcT
+            ],
+            out_specs=[
+                pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # dz
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dU
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dh0
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dc0
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
+                jax.ShapeDtypeStruct((H, 4 * H), jnp.float32),
+                jax.ShapeDtypeStruct((B, H), jnp.float32),
+                jax.ShapeDtypeStruct((B, H), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((B, H), jnp.float32),
+                pltpu.VMEM((B, H), jnp.float32),
+                pltpu.VMEM((H, 4 * H), jnp.float32),
+            ],
+            interpret=interpret,
+        )(z, dys_t, cs, c_prev, h_prev, u_t,
+          dhT.astype(jnp.float32), dcT.astype(jnp.float32))
+    else:
+        K = 4 * H // ttile
+        rev1 = lambda t, k: (T - 1 - t, 0, 0)
+        kernel = functools.partial(_lstm_bwd_tiled_kernel, hidden=H,
+                                   ttile=ttile)
+        dz, dh0, dc0 = pl.pallas_call(
+            kernel,
+            grid=(T, K),
+            in_specs=[
+                pl.BlockSpec((1, B, 4 * H), rev1, memory_space=pltpu.VMEM),  # z
+                pl.BlockSpec((1, B, H), rev1, memory_space=pltpu.VMEM),  # dys
+                pl.BlockSpec((1, B, H), rev1, memory_space=pltpu.VMEM),  # c
+                pl.BlockSpec((1, B, H), rev1, memory_space=pltpu.VMEM),  # c_prev
+                pl.BlockSpec((ttile, H), lambda t, k: (k, 0),
+                             memory_space=pltpu.VMEM),                   # U^T tile
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dhT
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dcT
+            ],
+            out_specs=[
+                pl.BlockSpec((1, B, 4 * H), rev1, memory_space=pltpu.VMEM),  # dz
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dh0
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dc0
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
+                jax.ShapeDtypeStruct((B, H), jnp.float32),
+                jax.ShapeDtypeStruct((B, H), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((B, H), jnp.float32),          # dh carry
+                pltpu.VMEM((B, H), jnp.float32),          # dc carry
+                pltpu.VMEM((B, H), jnp.float32),          # dh accumulator
+                pltpu.VMEM((K, B, ttile), jnp.float32),   # dz, tile-major
+            ],
+            interpret=interpret,
+        )(z, dys_t, cs, c_prev, u_t,
+          dhT.astype(jnp.float32), dcT.astype(jnp.float32))
+        # dU contracts over all T·B at once — one large MXU matmul (the
+        # whole point of the tiled split: no VMEM-resident accumulator).
+        dU = jnp.einsum(
+            "tbh,tbk->hk", h_prev.astype(dtype), dz.astype(dtype),
+            preferred_element_type=jnp.float32,
+        )
 
     # input-projection cotangents: one MXU matmul each (XLA's job)
     xs_t = jnp.moveaxis(xs, 0, 1).astype(dtype)  # [T, B, D]
@@ -356,6 +658,11 @@ def _pallas_backward(fused, params, xs, h0, c0, ys, z, cs, dys, dhT, dcT,
     return dparams, dxs, dh0.astype(h0.dtype), dc0.astype(c0.dtype)
 
 
+# ---------------------------------------------------------------------------
+# custom-VJP core + public entry
+# ---------------------------------------------------------------------------
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _scan_core(params, xs, h0, c0, compute_dtype, interpret, remat_chunk,
                unroll):
@@ -375,12 +682,21 @@ def _reference(params, xs, h0, c0, compute_dtype, remat_chunk, unroll):
 def _scan_core_fwd(params, xs, h0, c0, compute_dtype, interpret, remat_chunk,
                    unroll):
     fused = fuse_params(params, compute_dtype=compute_dtype)
+    B, T, _ = xs.shape
+    H = fused.hidden_size
     pbytes = 2 if fused.kernel.dtype == jnp.bfloat16 else 4
-    # Fused Pallas backward when its residents fit VMEM and no remat was
-    # requested (remat_chunk is the memory-over-speed signal: the recompute
-    # backward stores O(T/chunk) carries, the fused one stores z/cs O(T)).
-    if remat_chunk is None and _bwd_supported(xs.shape[0], fused.hidden_size,
-                                              pbytes):
+    # Fused Pallas backward when (a) no remat was requested (remat_chunk is
+    # the memory-over-speed signal: the recompute backward stores O(T/chunk)
+    # carries, the fused one stores z/cs O(T)), (b) the O(T) f32 residuals
+    # fit the HBM heuristic budget, and (c) a backward kernel and a
+    # residual-saving forward both fit VMEM per the shared cost model.
+    use_fused_bwd = (
+        remat_chunk is None
+        and _residual_bytes(T, B, H) <= _RESIDUAL_HBM_BUDGET
+        and _plan_bwd(B, H, pbytes) is not None
+        and _plan_fwd(B, H, pbytes, save_residuals=True) is not None
+    )
+    if use_fused_bwd:
         ys, hT, cT, z, cs = _pallas_forward(
             fused, xs, h0, c0, interpret=interpret, save_residuals=True
         )
@@ -395,7 +711,7 @@ def _scan_core_bwd(compute_dtype, interpret, remat_chunk, unroll, residuals,
                    cotangents):
     params, xs, h0, c0, ys, z, cs = residuals
     if z is not None:
-        # Fused Pallas BPTT (see _lstm_bwd_kernel).
+        # Fused Pallas BPTT (see _lstm_bwd_kernel / _lstm_bwd_tiled_kernel).
         fused = fuse_params(params, compute_dtype=compute_dtype)
         dys, dhT, dcT = cotangents
         return _pallas_backward(
@@ -418,6 +734,20 @@ def _scan_core_bwd(compute_dtype, interpret, remat_chunk, unroll, residuals,
 _scan_core.defvjp(_scan_core_fwd, _scan_core_bwd)
 
 
+def _pad_params_lane(params: LSTMParams, hp: int) -> LSTMParams:
+    """Zero-pad every gate block from H to hp (lane alignment). Exactly
+    gradient-neutral — see the module docstring's padding analysis."""
+    pad = hp - params.hidden_size
+    pw = lambda a: jnp.pad(a, ((0, 0), (0, pad)))
+    pu = lambda a: jnp.pad(a, ((0, pad), (0, pad)))
+    pb = lambda a: jnp.pad(a, (0, pad))
+    return LSTMParams(
+        pw(params.W_i), pw(params.W_f), pw(params.W_g), pw(params.W_o),
+        pu(params.U_i), pu(params.U_f), pu(params.U_g), pu(params.U_o),
+        pb(params.b_i), pb(params.b_f), pb(params.b_g), pb(params.b_o),
+    )
+
+
 def pallas_lstm_scan(
     params: LSTMParams,
     xs: jax.Array,
@@ -434,14 +764,25 @@ def pallas_lstm_scan(
     setting ``remat_chunk`` selects the recompute backward (bounded residual
     memory), where ``remat_chunk``/``unroll`` apply to its recompute scan
     exactly as in `lstm_scan`. Returns ``((hT, cT), ys)``.
+
+    Hidden sizes off the 128-lane grid (e.g. 650) are padded internally;
+    the pad/slice sits outside the custom VJP, so gradients transpose
+    through it automatically and exactly.
     """
     B, _, _ = xs.shape
     H = params.hidden_size
+    hp = _pad_to_lane(H)
     if carry is None:
-        h0 = jnp.zeros((B, H), jnp.float32)
-        c0 = jnp.zeros((B, H), jnp.float32)
+        h0 = jnp.zeros((B, hp), jnp.float32)
+        c0 = jnp.zeros((B, hp), jnp.float32)
     else:
         h0, c0 = carry
-    ys, hT, cT = _scan_core(params, xs, h0, c0, compute_dtype, interpret,
+        if hp != H:
+            h0 = jnp.pad(h0, ((0, 0), (0, hp - H)))
+            c0 = jnp.pad(c0, ((0, 0), (0, hp - H)))
+    run_params = _pad_params_lane(params, hp) if hp != H else params
+    ys, hT, cT = _scan_core(run_params, xs, h0, c0, compute_dtype, interpret,
                             remat_chunk, unroll)
+    if hp != H:
+        ys, hT, cT = ys[..., :H], hT[:, :H], cT[:, :H]
     return (hT, cT), ys

@@ -264,10 +264,11 @@ def test_uci_real_loader(tmp_path):
     assert ds["train"].shape == (80, 2)
     assert ds["valid"].shape == (10, 2)
     assert ds["test"].shape == (10, 2)
-    # per-series normalisation
-    full = np.concatenate([ds["train"], ds["valid"], ds["test"]])
-    assert abs(full.mean()) < 1e-5 and abs(full.std() - 1.0) < 1e-2
+    # per-series normalisation uses TRAIN-split stats only (no test leakage)
+    assert abs(ds["train"].mean()) < 1e-5
+    assert abs(ds["train"].std() - 1.0) < 1e-2
     # decimal commas parsed: strictly increasing first column
+    full = np.concatenate([ds["train"], ds["valid"], ds["test"]])
     assert (np.diff(full[:, 0]) > 0).all()
     # the file path itself is accepted too
     ds2 = get_dataset("uci_electricity", str(f), num_series=2)

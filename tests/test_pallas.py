@@ -21,7 +21,9 @@ def test_supported_gating():
     assert not supported(B, H, platform="cpu")
     assert supported(8, 128, platform="tpu")
     assert not supported(7, 128, platform="tpu")  # sublane misalignment
-    assert not supported(8, 100, platform="tpu")  # lane misalignment
+    # lane misalignment is handled by internal padding now
+    assert supported(8, 100, platform="tpu")
+    assert supported(8, 650, platform="tpu")  # config 3, padded to 768
 
 
 def test_interpret_forward_parity():
@@ -72,11 +74,16 @@ def test_stacked_scan_fallback_on_cpu():
 
 
 def test_supported_vmem_bound():
-    """Shapes whose resident VMEM footprint exceeds the budget must fall
-    back instead of failing Mosaic compilation (H=1024 f32: U is 16 MiB)."""
-    assert not supported(8, 1024, platform="tpu")
-    assert supported(8, 1024, platform="tpu", param_dtype_bytes=2)  # bf16 U
+    """H=1024 f32 (resident U would be 16 MiB) now plans onto the TILED
+    kernel instead of falling back; gigantic B·H still gates to False."""
+    from lstm_tensorspark_tpu.ops.pallas_lstm import _plan_fwd
+
+    assert supported(8, 1024, platform="tpu")  # tiled (config 5)
+    assert _plan_fwd(8, 1024, 4, save_residuals=False)[0] == "tiled"
+    assert _plan_fwd(8, 512, 4, save_residuals=False)[0] == "resident"
     assert supported(8, 512, platform="tpu")
+    # a shape whose per-step blocks alone blow VMEM must still gate off
+    assert not supported(4096, 4096, platform="tpu")
 
 
 def test_grad_parity_with_remat_chunk():
@@ -160,4 +167,82 @@ def test_fused_backward_bf16_close_to_f32():
             rtol=0.1, atol=0.02,
         ),
         g1, g2,
+    )
+
+
+def test_tiled_forward_and_grad_parity_h1024():
+    """H=1024 f32 selects the TILED kernels (U streamed in row-tiles, dU
+    computed outside); forward and grads must match the scan reference."""
+    from lstm_tensorspark_tpu.ops.pallas_lstm import _plan_bwd, _plan_fwd
+
+    assert _plan_fwd(8, 1024, 4, save_residuals=True)[0] == "tiled"
+    assert _plan_bwd(8, 1024, 4)[0] == "tiled"
+    params = init_lstm_params(jax.random.PRNGKey(7), 32, 1024)
+    xs = jax.random.normal(jax.random.PRNGKey(8), (8, 4, 32))
+    (hT, cT), ys = pallas_lstm_scan(params, xs, interpret=True)
+    (hT2, cT2), ys2 = lstm_scan(params, xs)
+    np.testing.assert_allclose(ys, ys2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hT, hT2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cT, cT2, rtol=1e-5, atol=1e-5)
+
+    def lp(p, x):
+        return jnp.mean(pallas_lstm_scan(p, x, interpret=True)[1] ** 2)
+
+    def lr(p, x):
+        return jnp.mean(lstm_scan(p, x)[1] ** 2)
+
+    g1 = jax.grad(lp, argnums=(0, 1))(params, xs)
+    g2 = jax.grad(lr, argnums=(0, 1))(params, xs)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        g1, g2,
+    )
+
+
+def test_padded_h650_parity():
+    """H=650 (config 3) pads to 768 internally; forward AND grads must be
+    exact vs the unpadded scan (padding analysis: dz_pad = 0 identically)."""
+    params = init_lstm_params(jax.random.PRNGKey(9), 48, 650)
+    xs = jax.random.normal(jax.random.PRNGKey(10), (8, 6, 48))
+    h0 = jax.random.normal(jax.random.PRNGKey(11), (8, 650))
+    c0 = jax.random.normal(jax.random.PRNGKey(12), (8, 650))
+    (hT, cT), ys = pallas_lstm_scan(params, xs, (h0, c0), interpret=True)
+    (hT2, cT2), ys2 = lstm_scan(params, xs, (h0, c0))
+    assert ys.shape == ys2.shape == (8, 6, 650)
+    np.testing.assert_allclose(ys, ys2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hT, hT2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cT, cT2, rtol=1e-5, atol=1e-5)
+
+    def lp(p, h, c):
+        (hT, cT), ys = pallas_lstm_scan(p, xs, (h, c), interpret=True)
+        return jnp.mean(ys**2) + jnp.sum(hT * 0.3) + jnp.sum(cT * 0.1)
+
+    def lr(p, h, c):
+        (hT, cT), ys = lstm_scan(p, xs, (h, c))
+        return jnp.mean(ys**2) + jnp.sum(hT * 0.3) + jnp.sum(cT * 0.1)
+
+    g1 = jax.grad(lp, argnums=(0, 1, 2))(params, h0, c0)
+    g2 = jax.grad(lr, argnums=(0, 1, 2))(params, h0, c0)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        g1, g2,
+    )
+
+
+def test_residual_hbm_heuristic(monkeypatch):
+    """Residual bytes above the HBM budget select the recompute backward
+    (no z residuals saved) — ADVICE.md's memory-regression guard."""
+    import lstm_tensorspark_tpu.ops.pallas_lstm as pallas_mod
+
+    params, xs = _setup()
+    g_fused = jax.grad(
+        lambda p: jnp.mean(pallas_lstm_scan(p, xs, interpret=True)[1] ** 2)
+    )(params)
+    monkeypatch.setattr(pallas_mod, "_RESIDUAL_HBM_BUDGET", 1)  # force off
+    g_recompute = jax.grad(
+        lambda p: jnp.mean(pallas_lstm_scan(p, xs, interpret=True)[1] ** 2)
+    )(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        g_fused, g_recompute,
     )

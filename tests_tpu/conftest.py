@@ -1,0 +1,7 @@
+"""On-TPU test suite — runs on the real chip (NO platform forcing here,
+unlike tests/conftest.py which pins the 8-device CPU mesh).
+
+Run: ``python -m pytest tests_tpu/ -x -q`` on a machine with a TPU attached.
+Every module skips itself when no TPU is present, so this directory is safe
+to include in any environment.
+"""
