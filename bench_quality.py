@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Wall-clock-to-perplexity: the QUALITY half of the north star.
+
+``BASELINE.json:metric`` is "seq/sec/chip; wall-clock to reference
+perplexity" — this harness measures the second half for configs 1 and 3:
+train the IDENTICAL config (same synthetic corpus, same seed, same
+hyperparameters) on the TPU and on single-process CPU (the offline stand-in
+for the reference's Spark-CPU executors), log the eval-perplexity curve to
+JSONL, and record the first wall-clock time each run reaches each
+perplexity target.
+
+Outputs:
+- ``quality_curves/<config>_<platform>.jsonl`` — full metric curves (the
+  CLI's own JSONL: {"t": seconds, "step", "eval_ppl", ...});
+- ``BASELINE_MEASURED.json`` gains a "quality" section:
+  time-to-ppl per config/platform + the TPU speedup at the tightest target
+  both platforms reached.
+
+Timing honesty: "t" counts from process logger start (includes compile —
+the launch-to-quality number); "t_train" additionally subtracts the time of
+the first logged training record (post-compile steady-state). Both are
+reported. The tunneled-TPU async-queue caveat does not bite here: each eval
+fetches loss values to the host, a true barrier.
+
+Run: ``python bench_quality.py`` (TPU visible; CPU leg runs in a
+subprocess with the platform forced before any device query).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+CURVES = os.path.join(_DIR, "quality_curves")
+CACHE = os.path.join(_DIR, "BASELINE_MEASURED.json")
+
+# Perplexity targets scanned from loose to tight; the summary reports the
+# tightest one BOTH platforms reached inside the step budget.
+TARGETS = [12.0, 10.0, 8.0, 6.0, 5.0, 4.5, 4.0, 3.5, 3.0, 2.5, 2.0]
+
+CONFIGS = {
+    "config1_ptb_char": [
+        "--dataset", "ptb_char", "--hidden-units", "128", "--num-layers", "1",
+        "--batch-size", "64", "--seq-len", "64", "--learning-rate", "1.0",
+        "--num-steps", "800", "--log-every", "50", "--eval-every", "100",
+        "--backend", "single",
+    ],
+    "config3_wikitext2": [
+        "--dataset", "wikitext2", "--hidden-units", "650", "--num-layers", "2",
+        "--batch-size", "64", "--seq-len", "35", "--learning-rate", "1.0",
+        "--num-steps", "400", "--log-every", "25", "--eval-every", "50",
+        "--backend", "single",
+    ],
+}
+
+# Per-platform extras: each platform runs its FASTEST HONEST configuration
+# of the same model/data/optimizer. The tiny config-1 model is host-dispatch
+# bound on the tunneled TPU, so its TPU leg stages the corpus in HBM and
+# batches K steps per dispatch (identical optimizer trajectory —
+# tests/test_multistep.py proves K-step parity); the CPU leg is
+# compute-bound and gains nothing from dispatch batching, so it stays
+# per-step (also faithful to the reference's one-Spark-round-per-step).
+# NOTE: with --steps-per-call K, --log-every/--eval-every count CALLS
+# (train_loop contract), so the cadences below are rescaled by K=25;
+# --num-steps still counts optimizer steps.
+PLATFORM_EXTRA = {
+    ("config1_ptb_char", "tpu"): [
+        "--steps-per-call", "25", "--log-every", "2", "--eval-every", "4",
+    ],
+}
+
+
+def run_leg(name: str, platform: str) -> str:
+    """Run one training leg, return the JSONL path."""
+    os.makedirs(CURVES, exist_ok=True)
+    jsonl = os.path.join(CURVES, f"{name}_{platform}.jsonl")
+    if os.path.exists(jsonl):
+        os.remove(jsonl)
+    argv = CONFIGS[name] + PLATFORM_EXTRA.get((name, platform), []) + [
+        "--jsonl", jsonl]
+    if platform == "cpu":
+        code = (
+            "import sys, jax;"
+            "jax.config.update('jax_platforms','cpu');"
+            "from lstm_tensorspark_tpu.cli import main;"
+            f"sys.exit(main({argv!r}))"
+        )
+        proc = subprocess.run([sys.executable, "-c", code], cwd=_DIR,
+                              capture_output=True, text=True)
+    else:
+        proc = subprocess.run(
+            [sys.executable, "main.py", *argv], cwd=_DIR,
+            capture_output=True, text=True,
+        )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{name}/{platform} failed rc={proc.returncode}: "
+            f"{proc.stderr[-2000:]}"
+        )
+    return jsonl
+
+
+def time_to_targets(jsonl: str) -> dict:
+    """Scan the curve: first wall-clock at/below each perplexity target."""
+    evals = []
+    first_step_t = None
+    for line in open(jsonl):
+        r = json.loads(line)
+        if first_step_t is None and "loss" in r and "step" in r:
+            first_step_t = r["t"]
+        if "eval_ppl" in r:
+            evals.append((r["t"], r["eval_ppl"], r.get("step")))
+    out = {"targets": {}, "final_ppl": evals[-1][1] if evals else None,
+           "first_step_t": first_step_t}
+    for tgt in TARGETS:
+        hit = next((e for e in evals if e[1] <= tgt), None)
+        if hit:
+            out["targets"][str(tgt)] = {
+                "t": hit[0],
+                "t_train": round(hit[0] - (first_step_t or 0.0), 3),
+                "step": hit[2],
+            }
+    return out
+
+
+def main(only: list[str] | None = None) -> int:
+    # merge into any existing results so single-config reruns keep the rest
+    results = {}
+    if os.path.exists(CACHE):
+        with open(CACHE) as f:
+            results = json.load(f).get("quality", {}).get("results", {})
+    for name in (only or CONFIGS):
+        results[name] = {}
+        for platform in ("tpu", "cpu"):
+            print(f"[bench_quality] {name} on {platform} ...", flush=True)
+            jsonl = run_leg(name, platform)
+            results[name][platform] = time_to_targets(jsonl)
+
+        # tightest target both reached → the headline speedup
+        both = [
+            t for t in map(str, TARGETS)
+            if t in results[name]["tpu"]["targets"]
+            and t in results[name]["cpu"]["targets"]
+        ]
+        if both:
+            tight = both[-1]
+            tt = results[name]["tpu"]["targets"][tight]
+            tc = results[name]["cpu"]["targets"][tight]
+            results[name]["summary"] = {
+                "target_ppl": float(tight),
+                "tpu_seconds": tt["t"],
+                "cpu_seconds": tc["t"],
+                "speedup": round(tc["t"] / tt["t"], 2),
+                "tpu_seconds_train": tt["t_train"],
+                "cpu_seconds_train": tc["t_train"],
+                "speedup_train": round(
+                    tc["t_train"] / max(tt["t_train"], 1e-9), 2),
+            }
+            print(f"[bench_quality] {name}: ppl<={tight} "
+                  f"TPU {tt['t']:.1f}s vs CPU {tc['t']:.1f}s "
+                  f"({results[name]['summary']['speedup']}x; "
+                  f"post-compile {results[name]['summary']['speedup_train']}x)",
+                  flush=True)
+
+    cache = {}
+    if os.path.exists(CACHE):
+        with open(CACHE) as f:
+            cache = json.load(f)
+    cache["quality"] = {
+        "note": ("wall-clock to perplexity target, identical config+data+"
+                 "seed on TPU vs single-process CPU (Spark-CPU stand-in); "
+                 "t includes compile, t_train is post-compile"),
+        "results": results,
+    }
+    with open(CACHE, "w") as f:
+        json.dump(cache, f, indent=1)
+    print(json.dumps({"quality": {
+        n: r.get("summary", "no common target") for n, r in results.items()
+    }}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:] or None))

@@ -307,6 +307,55 @@ def _setup_training(
     return state, train_step, mesh, shards, wrap_stream, checkpoint_fn
 
 
+def _setup_tp_training(args, logger, *, loss_fn, params, optimizer, rng,
+                       specs_fn, hidden: int):
+    """Tensor-parallel (GSPMD dp×tp) setup for the classifier/forecaster
+    tasks — the compiler-first recipe: annotate param shardings, let XLA
+    insert the collectives. Returns the same tuple as _setup_training.
+    """
+    from .parallel import make_mesh
+    from .parallel.tensor_parallel import make_tp_train_step, place_params
+    from .train.loop import init_train_state
+
+    tp = args.tensor_parallel
+    if getattr(args, "steps_per_call", 1) and args.steps_per_call > 1:
+        raise SystemExit("--steps-per-call is not supported with --tensor-parallel")
+    if getattr(args, "grad_accum", 1) and args.grad_accum > 1:
+        raise SystemExit("--grad-accum is not supported with --tensor-parallel")
+    if getattr(args, "device_data", False):
+        raise SystemExit("--device-data is not supported with --tensor-parallel")
+    if getattr(args, "prefetch", 0):
+        raise SystemExit("--prefetch is not supported with --tensor-parallel")
+    if hidden % tp != 0:
+        raise SystemExit(f"--hidden-units {hidden} not divisible by "
+                         f"--tensor-parallel {tp}")
+    args.steps_per_call = 1
+    args.grad_accum = 1
+    n = jax.device_count()
+    dp = args.num_partitions or max(n // tp, 1)
+    if dp * tp > n:
+        raise SystemExit(f"mesh dp*tp={dp * tp} exceeds {n} devices")
+    if args.batch_size % dp != 0:
+        raise SystemExit(f"--batch-size {args.batch_size} not divisible by dp={dp}")
+    mesh = make_mesh(dp=dp, tp=tp, devices=np.asarray(jax.devices()[: dp * tp]))
+
+    state = init_train_state(params, optimizer, rng)
+    restored, checkpoint_fn = _wire_checkpoint(args, logger, lambda: state)
+    if restored is not None:
+        state = restored
+    specs = specs_fn(params)
+    # place params with their TP shardings; opt_state (possibly restored —
+    # re-initializing would lose momenta) is unconstrained in the step's
+    # in_shardings, so jit reshards it to match the params on first call
+    state = state._replace(params=place_params(state.params, specs, mesh))
+
+    train_step = make_tp_train_step(
+        loss_fn, optimizer, mesh, params, param_specs=specs
+    )
+    # jit's in_shardings place each host batch; the stream passes through
+    return state, train_step, mesh, dp, (lambda it: it), checkpoint_fn
+
+
 def _wire_checkpoint(args, logger, template_fn):
     """Shared checkpoint/resume wiring. ``template_fn()`` produces the
     restore template lazily — only called when a checkpoint actually exists,
@@ -573,12 +622,9 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     if args.stateful:
         raise SystemExit("--stateful is not supported with --tensor-parallel/"
                          "--seq-parallel/--pipeline-stages")
-    if args.dropout > 0:
-        raise SystemExit("--dropout is not supported with --tensor-parallel/"
-                         "--seq-parallel/--pipeline-stages")
-    if pp > 1 and (tp > 1 or sp > 1):
-        raise SystemExit("--pipeline-stages cannot combine with "
-                         "--tensor-parallel/--seq-parallel")
+    if pp > 1 and sp > 1:
+        raise SystemExit("--pipeline-stages cannot combine with --seq-parallel "
+                         "(both schedule the wavefront; tp composes with either)")
     if args.use_pallas:
         raise SystemExit("--use-pallas is not supported with --tensor-parallel/"
                          "--seq-parallel/--pipeline-stages (the wavefront "
@@ -607,9 +653,9 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     if pp > 1:
         stacked = stack_lm_params(params)
         train_step = make_pp_lm_train_step(
-            cfg, optimizer, mesh, stacked, microbatches=mb
+            cfg, optimizer, mesh, stacked, microbatches=mb, tp=tp > 1
         )
-        placed = place_pp_lm_params(stacked, mesh)
+        placed = place_pp_lm_params(stacked, mesh, tp=tp > 1)
     else:
         train_step = make_sharded_lm_train_step(
             cfg, optimizer, mesh, params, microbatches=mb

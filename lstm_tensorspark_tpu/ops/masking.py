@@ -25,13 +25,22 @@ def masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
     return total / count
 
 
+def dropout_with_key(key: jax.Array, rate: float, x: jax.Array) -> jax.Array:
+    """Inverted dropout with a caller-derived key (no split chain): the ONE
+    mask/scale implementation — the SP/PP parallel backends call this with
+    deterministically folded per-(shard, microbatch, layer) keys."""
+    if rate <= 0.0:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0)
+
+
 def dropout(rng: jax.Array, rate: float, x: jax.Array):
     """Inverted dropout. Returns (next_rng, dropped_x); identity at rate 0."""
     if rate <= 0.0:
         return rng, x
     rng, sub = jax.random.split(rng)
-    keep = jax.random.bernoulli(sub, 1.0 - rate, x.shape)
-    return rng, jnp.where(keep, x / (1.0 - rate), 0.0)
+    return rng, dropout_with_key(sub, rate, x)
 
 
 def reverse_sequences(x: jax.Array, lengths: jax.Array) -> jax.Array:

@@ -49,20 +49,43 @@ from ..train.loop import TrainState, step_body
 
 def stack_layers(layers: list[LSTMParams]) -> LSTMParams:
     """Stack per-layer params into one LSTMParams of [L, ...] arrays so the
-    layer axis can be sharded over "pipe". Requires uniform input size
-    (embed_size == hidden_size), or the stack would be ragged."""
-    sizes = {p.input_size for p in layers}
-    if len(sizes) != 1:
-        raise ValueError(
-            f"pipeline parallelism needs uniform layer input sizes, got {sizes} "
-            "(set embed_size == hidden_size)"
-        )
-    return jax.tree.map(lambda *a: jnp.stack(a), *layers)
+    layer axis can be sharded over "pipe".
+
+    Non-uniform input sizes (embed_size != hidden_size makes layer 0's W
+    rows differ) are zero-PADDED to the max input size. Padding is exact:
+    the padded W rows multiply zero-padded activations (pp_lm_loss pads its
+    inter-layer tensors), contribute nothing to the forward, and receive
+    identically-zero gradients (dW_pad = x_pad^T @ dz = 0), so they stay
+    zero under any optax transform."""
+    dmax = max(p.input_size for p in layers)
+
+    def pad_w(p: LSTMParams) -> LSTMParams:
+        pad = dmax - p.input_size
+        if pad == 0:
+            return p
+        pw = lambda a: jnp.pad(a, ((0, pad), (0, 0)))
+        return p._replace(W_i=pw(p.W_i), W_f=pw(p.W_f),
+                          W_g=pw(p.W_g), W_o=pw(p.W_o))
+
+    return jax.tree.map(lambda *a: jnp.stack(a), *[pad_w(p) for p in layers])
 
 
-def unstack_layers(stacked: LSTMParams) -> list[LSTMParams]:
+def unstack_layers(
+    stacked: LSTMParams, input_sizes: list[int] | None = None
+) -> list[LSTMParams]:
+    """Invert stack_layers; ``input_sizes`` slices each layer's W back to
+    its true row count (None = uniform stack, no slicing)."""
     L = stacked.W_i.shape[0]
-    return [jax.tree.map(lambda a: a[j], stacked) for j in range(L)]
+    layers = [jax.tree.map(lambda a: a[j], stacked) for j in range(L)]
+    if input_sizes is None:
+        return layers
+
+    def cut(p: LSTMParams, d: int) -> LSTMParams:
+        cw = lambda a: a[:d]
+        return p._replace(W_i=cw(p.W_i), W_f=cw(p.W_f),
+                          W_g=cw(p.W_g), W_o=cw(p.W_o))
+
+    return [cut(p, d) for p, d in zip(layers, input_sizes)]
 
 
 def stack_lm_params(params):
@@ -71,11 +94,19 @@ def stack_lm_params(params):
 
 
 def unstack_lm_params(params):
-    return {**params, "layers": unstack_layers(params["layers"])}
+    """Invert stack_lm_params, recovering the true per-layer W row counts
+    (layer 0: embed dim from the embedding table; rest: hidden)."""
+    embed = params["embedding"].shape[1]
+    hidden = params["layers"].U_i.shape[-1]
+    L = params["layers"].W_i.shape[0]
+    sizes = [embed] + [hidden] * (L - 1)
+    return {**params, "layers": unstack_layers(params["layers"], sizes)}
 
 
 def pp_lm_param_specs(params_stacked):
-    """PartitionSpecs: stacked layers sharded over "pipe", rest replicated."""
+    """shard_map in_specs: stacked layers sharded over "pipe" (the MANUAL
+    axis), everything else replicated. TP does not appear here — "model" is
+    an AUTO axis handled by GSPMD from the jit-level shardings below."""
     specs = {
         k: jax.tree.map(lambda _: P(), v)
         for k, v in params_stacked.items()
@@ -85,11 +116,31 @@ def pp_lm_param_specs(params_stacked):
     return specs
 
 
-def place_pp_lm_params(params_stacked, mesh: Mesh):
+def pp_lm_param_shardings(params_stacked, *, tp: bool = False):
+    """jit-level PartitionSpecs: layers over "pipe" and (with ``tp``) gate/
+    hidden dims over "model" — the hybrid manual-PP/auto-TP composition.
+    Stacked layer arrays are [L, D, 4H] (W), [L, H, 4H] (U), [L, 4H] (b)."""
+    model = "model" if tp else None
+    mat = P("pipe", None, model)
+    vec = P("pipe", model)
+    layer_specs = LSTMParams(
+        W_i=mat, W_f=mat, W_g=mat, W_o=mat,
+        U_i=mat, U_f=mat, U_g=mat, U_o=mat,
+        b_i=vec, b_f=vec, b_g=vec, b_o=vec,
+    )
+    specs = {"embedding": P(), "layers": layer_specs}
+    head = {"bias": P()}
+    if "kernel" in params_stacked["head"]:
+        head["kernel"] = P(model, None)  # [H/P, V] row-parallel
+    specs["head"] = head
+    return specs
+
+
+def place_pp_lm_params(params_stacked, mesh: Mesh, *, tp: bool = False):
     return jax.tree.map(
         lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
         params_stacked,
-        pp_lm_param_specs(params_stacked),
+        pp_lm_param_shardings(params_stacked, tp=tp),
     )
 
 
@@ -101,6 +152,8 @@ def pp_lm_loss(
     microbatches: int = 1,
     pipe_axis: str = "pipe",
     data_axis: str = "data",
+    dropout_rng: jax.Array | None = None,
+    uniform: bool = False,
 ):
     """Global-mean LM loss under the pipeline wavefront.
 
@@ -108,6 +161,21 @@ def pp_lm_loss(
     is the local view: layers [L/S, ...] (this stage's slice), embedding and
     head full. ``batch`` is this data-shard's {"inputs","targets"} [B_local,
     T], replicated over "pipe". Returns the already-reduced global scalar.
+
+    embed_size != hidden_size is handled by the stack_layers zero-padding:
+    every inter-layer/inter-stage tensor is carried at width
+    Dmax = max(embed, hidden) with exact zero lanes (see stack_layers).
+
+    With ``dropout_rng`` set and cfg.dropout > 0, inter-layer dropout
+    applies after every layer except the globally-last one, with masks
+    independent per (data shard, microbatch, layer) — the same fold-in
+    scheme the DP backend uses for per-shard dropout.
+
+    ``uniform=True`` (REQUIRED when "model" is an auto TP axis): every
+    stage computes every tick and bubble results are masked with where()
+    instead of skipped with lax.cond — GSPMD-inserted TP collectives must
+    execute in lockstep across devices, and divergent cond branches would
+    deadlock them (the same constraint as sp_lstm_scan's uniform mode).
     """
     S = lax.axis_size(pipe_axis)
     s = lax.axis_index(pipe_axis)
@@ -118,32 +186,52 @@ def pp_lm_loss(
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     b = B // M
     H = cfg.hidden_size
-    if cfg.embed != H:
-        raise ValueError("pipeline parallelism requires embed_size == hidden_size")
+    Dmax = max(cfg.embed, H)
 
     embedding = params["embedding"]
     head = params["head"]
     kernel = embedding.T if cfg.tie_embeddings else head["kernel"]
-    local_layers = unstack_layers(params["layers"])
+    local_layers = unstack_layers(params["layers"])  # padded widths kept
+    n_local = len(local_layers)
     cdtype = None if cfg.cdtype == jnp.float32 else cfg.cdtype
+    L_total = n_local * S
+    use_dropout = dropout_rng is not None and cfg.dropout > 0.0
+    if use_dropout:
+        # distinct masks per data shard; pipe/microbatch/layer fold below
+        dropout_rng = jax.random.fold_in(dropout_rng, lax.axis_index(data_axis))
 
     inputs_m = inputs.reshape(M, b, T)
     targets_m = targets.reshape(M, b, T)
 
-    def run_stage(src):
-        ys = src
-        for layer in local_layers:
+    def pad_d(x):
+        """[b, T, d] -> [b, T, Dmax] with exact zero lanes."""
+        d = x.shape[-1]
+        return x if d == Dmax else jnp.pad(x, ((0, 0), (0, 0), (0, Dmax - d)))
+
+    def run_stage(src, rng):
+        ys = src  # [b, T, Dmax]
+        for i, layer in enumerate(local_layers):
             _, ys = lstm_scan(
                 layer, ys,
                 compute_dtype=cdtype,
                 remat_chunk=cfg.remat_chunk,
                 unroll=cfg.scan_unroll,
             )
-        return ys
+            g = s * n_local + i  # global layer index (traced: s is an
+            # axis_index, so gate "not the last layer" with where, not if)
+            if use_dropout:
+                from ..ops.masking import dropout_with_key
+
+                dropped = dropout_with_key(
+                    jax.random.fold_in(rng, i), cfg.dropout, ys
+                )
+                ys = jnp.where(g == L_total - 1, ys, dropped)
+            ys = pad_d(ys)
+        return ys  # [b, T, Dmax]
 
     def mb_loss(ys, tgt):
         logits = (
-            jnp.dot(ys.astype(kernel.dtype), kernel,
+            jnp.dot(ys[..., :H].astype(kernel.dtype), kernel,
                     preferred_element_type=jnp.float32)
             + head["bias"]
         )
@@ -151,7 +239,7 @@ def pp_lm_loss(
         nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
         return jnp.mean(nll)
 
-    x_in = jnp.zeros((b, T, H), jnp.float32)
+    x_in = jnp.zeros((b, T, Dmax), jnp.float32)
     loss_acc = jnp.zeros((), jnp.float32)
     right = [(i, i + 1) for i in range(S - 1)]  # linear chain, no wraparound
     is_last = s == S - 1
@@ -165,20 +253,33 @@ def pp_lm_loss(
         # stage 0 sources from the embedding; later stages from the left
         # neighbor's activations. where() zeroes the embedding gradient on
         # stages > 0, so the psum'd embedding grad is exactly stage 0's.
-        emb_x = jnp.take(embedding, tok, axis=0).astype(jnp.float32)
+        emb_x = pad_d(jnp.take(embedding, tok, axis=0).astype(jnp.float32))
         src = jnp.where(s == 0, emb_x, x_in)
-        ys = lax.cond(
-            active,
-            run_stage,
-            lambda x: jnp.zeros((b, T, H), jnp.float32),
-            src,
+        rng_t = (
+            jax.random.fold_in(dropout_rng, m_c * S + s) if use_dropout
+            else jnp.zeros((2,), jnp.uint32)
         )
-        loss_acc = loss_acc + lax.cond(
-            jnp.logical_and(active, is_last),
-            mb_loss,
-            lambda ys, tgt: jnp.zeros((), jnp.float32),
-            ys, tgt,
-        )
+        if uniform:
+            # lockstep ticks: compute unconditionally, mask bubble results —
+            # auto-axis (TP) collectives inside the stage must not sit under
+            # divergent control flow
+            ys = jnp.where(active, run_stage(src, rng_t), 0.0)
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(active, is_last), mb_loss(ys, tgt), 0.0
+            )
+        else:
+            ys = lax.cond(
+                active,
+                run_stage,
+                lambda x, r: jnp.zeros((b, T, Dmax), jnp.float32),
+                src, rng_t,
+            )
+            loss_acc = loss_acc + lax.cond(
+                jnp.logical_and(active, is_last),
+                mb_loss,
+                lambda ys, tgt: jnp.zeros((), jnp.float32),
+                ys, tgt,
+            )
         if S > 1:
             x_in = lax.ppermute(ys, pipe_axis, right)
 
@@ -194,23 +295,31 @@ def make_pp_lm_train_step(
     *,
     microbatches: int | None = None,
     donate: bool | None = None,
+    tp: bool = False,
 ):
-    """Build the DP x PP train step on stacked params.
+    """Build the DP x PP (x TP with ``tp=True``) train step on stacked params.
 
     Batch: {"inputs","targets"} [B, T], B % (data axis * microbatches) == 0.
     ``microbatches`` defaults to the pipe size (pipeline full at steady
     state). Grad/update happen at the jit level: shard_map's transpose
     produces correct grads (psum'd for replicated embedding/head, local for
     the stage-sharded layers), and jit propagates P("pipe") to opt state.
+
+    TP composition is hybrid manual/auto (the train_step.py pattern): the
+    shard_map is MANUAL over {"pipe", "data"} only; "model" stays an AUTO
+    axis, so GSPMD shards the gate/hidden dims from the jit-level param
+    annotations and derives the TP collectives inside each stage's scan.
+    Inter-layer dropout (cfg.dropout > 0) uses per-(shard, microbatch,
+    layer) folded keys — see pp_lm_loss.
     """
     S = mesh.shape["pipe"]
     L = params_stacked["layers"].W_i.shape[0]
     if L % S != 0:
         raise ValueError(f"{L} layers not divisible by {S} pipeline stages")
-    if cfg.dropout > 0.0:
+    if tp and mesh.shape["model"] > 1 and cfg.hidden_size % mesh.shape["model"]:
         raise ValueError(
-            "pipeline-parallel training is deterministic (no inter-layer "
-            "dropout support); set dropout=0"
+            f"hidden {cfg.hidden_size} not divisible by model axis "
+            f"{mesh.shape['model']}"
         )
     if microbatches is None:
         microbatches = max(S, 1)
@@ -218,23 +327,27 @@ def make_pp_lm_train_step(
     param_specs = pp_lm_param_specs(params_stacked)
     batch_spec = {"inputs": P("data"), "targets": P("data")}
     loss_shard = shard_map(
-        lambda p, bt: pp_lm_loss(p, bt, cfg, microbatches=microbatches),
+        lambda p, bt, rng: pp_lm_loss(
+            p, bt, cfg, microbatches=microbatches, dropout_rng=rng,
+            uniform=tp,  # TP collectives need lockstep ticks
+        ),
         mesh=mesh,
-        in_specs=(param_specs, batch_spec),
+        in_specs=(param_specs, batch_spec, P()),
         out_specs=P(),
+        axis_names={"pipe", "data"},  # "model" stays auto (GSPMD TP)
         check_vma=False,
     )
 
     def loss_fn(params, batch, rng):
-        del rng
-        loss = loss_shard(params, batch)
+        loss = loss_shard(params, batch, rng)
         return loss, {"loss": loss}
 
     def step(state: TrainState, batch):
         return step_body(loss_fn, optimizer, state, batch)
 
     param_shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), param_specs,
+        lambda s: NamedSharding(mesh, s),
+        pp_lm_param_shardings(params_stacked, tp=tp),
         is_leaf=lambda x: isinstance(x, P),
     )
     state_shardings = TrainState(
@@ -256,5 +369,10 @@ def make_pp_lm_train_step(
     return jax.jit(
         step,
         in_shardings=(state_shardings, batch_shardings),
+        # pin the output state to the input shardings so steps CHAIN: with
+        # an auto "model" axis GSPMD may otherwise pick a different layout
+        # for e.g. the updated embedding, and the next call's in_shardings
+        # pin would reject the committed array
+        out_shardings=(state_shardings, None),
         donate_argnums=(0,) if donate else (),
     )

