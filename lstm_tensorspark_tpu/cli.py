@@ -585,12 +585,12 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     explicit 4-axis mesh — the CLI surface for the strategies beyond the
     reference's data-parallel-only scope (DESIGN.md parallelism table).
 
-    Eval pulls params to host (unstacking pipeline shards) and runs the
-    standard single-program eval step — eval is infrequent, the gather is
-    one param-sized fetch.
+    Eval runs SHARDED on the device-resident params (pp/tp/sp eval steps) —
+    no host gather; only post-training generation pulls params to host
+    (sequential small-batch decode).
     """
     from .data import lm_batch_stream, lm_epoch_batches
-    from .models import init_lm, lm_loss
+    from .models import init_lm
     from .parallel import (
         make_mesh,
         make_pp_lm_train_step,
@@ -600,7 +600,7 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
         unstack_lm_params,
     )
     from .parallel.tensor_parallel import place_lm_params
-    from .train import make_eval_step, make_optimizer
+    from .train import make_optimizer
     from .train.loop import evaluate, init_train_state
 
     tp, sp, pp = args.tensor_parallel, args.seq_parallel, args.pipeline_stages
@@ -669,21 +669,30 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     if restored is not None:
         state = restored
 
-    def eval_loss_fn(p, b, r):
-        return lm_loss(p, b, cfg)
+    # Sharded eval on the DEVICE-RESIDENT params — no host gather (the point
+    # of PP/TP is that one device need not hold the model); loss/token math
+    # runs under the same wavefront as training, deterministic.
+    if pp > 1:
+        from .parallel.pipeline_parallel import make_pp_lm_eval_step
 
-    eval_step = make_eval_step(eval_loss_fn)
+        eval_step = make_pp_lm_eval_step(
+            cfg, mesh, stacked, microbatches=mb, tp=tp > 1
+        )
+    else:
+        from .parallel.train_step import make_sharded_lm_eval_step
+
+        eval_step = make_sharded_lm_eval_step(cfg, mesh, params, microbatches=mb)
     valid_tokens = data["valid"]
     eval_bs = min(args.batch_size, max((len(valid_tokens) - 1) // seq_len, 0))
+    # the wavefront divisibility contracts hold for eval batches too
+    eval_quantum = dp * mb if pp > 1 else dp
+    eval_bs -= eval_bs % max(eval_quantum, 1)
 
     def eval_fn(params_dev):
         if eval_bs <= 0:
             return {"eval_skipped": 1}
-        params_host = jax.device_get(params_dev)
-        if pp > 1:
-            params_host = unstack_lm_params(params_host)
         ev = lm_epoch_batches(valid_tokens, eval_bs, seq_len)
-        return evaluate(eval_step, params_host, ev)
+        return evaluate(eval_step, params_dev, ev)
 
     train_tokens = data["train"]
     steps_per_epoch = max((len(train_tokens) - 1) // (args.batch_size * seq_len), 1)

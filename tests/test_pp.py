@@ -140,3 +140,24 @@ def test_pp_dropout_trains():
         assert np.isfinite(ls).all()
         losses[rate] = ls
     assert not np.allclose(losses[0.0], losses[0.5])  # masks took effect
+
+
+def test_pp_sharded_eval_matches_single_device():
+    """Sharded PP eval (no host gather) returns the same loss as the
+    single-device lm_loss on identical params, and reports global tokens."""
+    from lstm_tensorspark_tpu.models import lm_loss
+    from lstm_tensorspark_tpu.parallel.pipeline_parallel import (
+        make_pp_lm_eval_step,
+    )
+
+    cfg = LMConfig(vocab_size=V, hidden_size=H, num_layers=2, embed_size=8)
+    params = init_lm(jax.random.PRNGKey(10), cfg)
+    mesh = make_mesh(dp=2, tp=2, pp=2)
+    stacked = stack_lm_params(params)
+    placed = place_pp_lm_params(stacked, mesh, tp=True)
+    ev = make_pp_lm_eval_step(cfg, mesh, stacked, microbatches=2, tp=True)
+    b = _batches(1, seed=11)[0]
+    m = ev(placed, b)
+    want, _ = lm_loss(params, b, cfg)
+    np.testing.assert_allclose(float(m["loss"]), float(want), rtol=1e-5)
+    assert float(m["tokens"]) == B * T
