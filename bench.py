@@ -232,7 +232,8 @@ def measure_config(name: str, *, steps: int = 64, warmup: int = 8,
         )
 
         cfg = ClassifierConfig(vocab_size=c["V"], hidden_size=c["H"],
-                               num_layers=c["L"], compute_dtype="bfloat16")
+                               num_layers=c["L"], compute_dtype="bfloat16",
+                               use_pallas=PALLAS and jax.default_backend() == "tpu")
         params = init_classifier(jax.random.PRNGKey(0), cfg)
         loss_fn = lambda p, b, r: classifier_loss(p, b, cfg)  # noqa: E731
         fwd_flops_step = (
@@ -246,7 +247,8 @@ def measure_config(name: str, *, steps: int = 64, warmup: int = 8,
 
         cfg = Seq2SeqConfig(num_features=c["F"], hidden_size=c["H"],
                             num_layers=c["L"], horizon=c["horizon"],
-                            compute_dtype="bfloat16")
+                            compute_dtype="bfloat16",
+                            use_pallas=PALLAS and jax.default_backend() == "tpu")
         params = init_seq2seq(jax.random.PRNGKey(0), cfg)
         loss_fn = lambda p, b, r: seq2seq_loss(p, b, cfg)  # noqa: E731
         fwd_flops_step = _seq2seq_flops_per_seq(

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from ..ops.lstm_cell import init_lstm_params
 from ..ops.masking import dropout, sequence_mask
-from ..ops.scan import lstm_scan
+from ..ops.scan import auto_lstm_scan
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,6 +36,10 @@ class ClassifierConfig:
     dropout: float = 0.0
     compute_dtype: str = "float32"
     remat_chunk: int | None = None
+    # fused Pallas recurrence (ops/pallas_lstm.py) — covers the masked
+    # forward AND reversed scans of the bi-LSTM; falls back per-layer when
+    # shapes/platform don't fit the kernel's VMEM cost model
+    use_pallas: bool = False
 
     @property
     def embed(self) -> int:
@@ -80,12 +84,13 @@ def classifier_forward(
     xs = jnp.take(params["embedding"], tokens, axis=0)
     h_fwd = h_bwd = None
     for i, (pf, pb) in enumerate(zip(params["fwd"], params["bwd"])):
-        (h_fwd, _), ys_f = lstm_scan(
-            pf, xs, mask=mask, compute_dtype=cdtype, remat_chunk=cfg.remat_chunk
+        (h_fwd, _), ys_f = auto_lstm_scan(
+            pf, xs, mask=mask, compute_dtype=cdtype,
+            remat_chunk=cfg.remat_chunk, use_pallas=cfg.use_pallas,
         )
-        (h_bwd, _), ys_b = lstm_scan(
+        (h_bwd, _), ys_b = auto_lstm_scan(
             pb, xs, mask=mask, reverse=True, compute_dtype=cdtype,
-            remat_chunk=cfg.remat_chunk,
+            remat_chunk=cfg.remat_chunk, use_pallas=cfg.use_pallas,
         )
         xs = jnp.concatenate([ys_f, ys_b], axis=-1)
         if i < cfg.num_layers - 1 and cfg.dropout > 0.0 and not deterministic:

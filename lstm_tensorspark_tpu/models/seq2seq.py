@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.lstm_cell import fuse_params, init_lstm_params, lstm_step
-from ..ops.scan import lstm_scan, stacked_lstm_scan
+from ..ops.scan import auto_lstm_scan, stacked_lstm_scan
 
 
 @dataclasses.dataclass(frozen=True)
@@ -31,6 +31,10 @@ class Seq2SeqConfig:
     horizon: int = 24
     compute_dtype: str = "float32"
     remat_chunk: int | None = None
+    # fused Pallas recurrence for the encoder scan AND the teacher-forced
+    # decoder scan (the autoregressive inference decode stays a lax.scan —
+    # its per-step projection feedback cannot be hoisted into one kernel)
+    use_pallas: bool = False
 
     @property
     def cdtype(self):
@@ -68,6 +72,7 @@ def encode(params, context: jax.Array, cfg: Seq2SeqConfig):
     carries, _ = stacked_lstm_scan(
         params["encoder"], context,
         compute_dtype=cdtype, remat_chunk=cfg.remat_chunk,
+        use_pallas=cfg.use_pallas,
     )
     return carries
 
@@ -80,7 +85,8 @@ def decode_teacher_forced(params, carries, decoder_inputs, cfg: Seq2SeqConfig):
     # no remat on the decoder: the horizon is short (remat_chunk targets the
     # long encoder context and generally does not divide the horizon)
     for p, c0 in zip(params["decoder"], carries):
-        _, ys = lstm_scan(p, ys, c0, compute_dtype=cdtype)
+        _, ys = auto_lstm_scan(p, ys, c0, compute_dtype=cdtype,
+                               use_pallas=cfg.use_pallas)
     return _project(params["proj"], ys)
 
 

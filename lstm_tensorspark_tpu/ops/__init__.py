@@ -5,7 +5,7 @@ from .lstm_cell import (
     lstm_step,
     lstm_step_unfused,
 )
-from .scan import lstm_scan, stacked_lstm_scan
+from .scan import auto_lstm_scan, lstm_scan, stacked_lstm_scan
 from .masking import sequence_mask, masked_mean, reverse_sequences
 
 __all__ = [
@@ -14,6 +14,7 @@ __all__ = [
     "fuse_params",
     "lstm_step",
     "lstm_step_unfused",
+    "auto_lstm_scan",
     "lstm_scan",
     "stacked_lstm_scan",
     "sequence_mask",

@@ -34,11 +34,25 @@ cotangents vanish identically (dz_pad = 0), so sliced gradients equal the
 unpadded ones. The pad/slice lives OUTSIDE the custom VJP, so JAX transposes
 it automatically.
 
+Variable-length and bidirectional support (the bi-LSTM / seq2seq configs):
+
+- ``mask`` ([B, T] bool) freezes the carry at padded steps exactly as in
+  `lstm_scan`: the kernels stream a lane-broadcast f32 mask and blend
+  ``m*new + (1-m)*old`` into h and c. The backward applies the transposed
+  blend: the skipped cotangent ``(1-m)*dh`` bypasses the gate algebra into
+  the previous step.
+- ``reverse`` is implemented by flipping the time axis OUTSIDE the custom
+  VJP (inputs and mask in, outputs back), so the kernels always run
+  forward-in-time and autodiff transposes the flips for free. The flip is a
+  strided HBM read XLA fuses into the input projection.
+
 Training support: `pallas_lstm_scan` carries a custom VJP with THREE
 backward strategies:
 - **resident fused BPTT** (`_lstm_bwd_kernel`): reverse sequential grid with
   dh/dc carries and the dU accumulator resident in VMEM, consuming the z/c
-  trajectories the train-mode forward streams out;
+  trajectories the train-mode forward streams out; the cell state c_t is
+  RECOMPUTED from (z_t, c_{t-1}) in-kernel — bit-identical in f32 — so the
+  backward streams one fewer [T,B,H] tensor than a save-everything design;
 - **tiled fused BPTT** (`_lstm_bwd_tiled_kernel`): the sequential kernel
   computes only dz (streaming U^T in tiles for the dh carry); the weight
   cotangents dU/dW/db and dxs are single large MXU matmuls OUTSIDE the
@@ -87,23 +101,29 @@ def _pad_to_lane(h: int) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _resident_fwd_vmem(B: int, H: int, pbytes: int, save_residuals: bool) -> int:
+def _resident_fwd_vmem(B: int, H: int, pbytes: int, save_residuals: bool,
+                       has_mask: bool = False) -> int:
     c = 8  # worst-case time chunk (_time_chunk)
     v = 4 * H * H * pbytes  # U resident
     v += 2 * c * B * 4 * H * 4  # xproj blocks (double-buffered)
     v += 2 * c * B * H * 4  # ys out blocks
     v += 6 * B * H * 4  # h0/c0 in, hT/cT out, h/c scratch
+    if has_mask:
+        v += 2 * c * B * _LANE * 4  # mask blocks
     if save_residuals:
         v += 2 * c * B * 4 * H * 4  # z out blocks
         v += 2 * c * B * H * 4  # cs out blocks
     return v
 
 
-def _resident_bwd_vmem(B: int, H: int, pbytes: int) -> int:
+def _resident_bwd_vmem(B: int, H: int, pbytes: int,
+                       has_mask: bool = False) -> int:
     streamed = (
         8 * B * 4 * H * 4 * 2  # z in + dz out blocks (chunk<=8)
-        + 8 * B * H * 4 * 4  # dys/c/c_prev/h_prev blocks
+        + 8 * B * H * 4 * 3  # dys/c_prev/h_prev blocks (c_t recomputed)
     )
+    if has_mask:
+        streamed += 8 * B * _LANE * 4  # mask blocks
     return (
         4 * H * H * pbytes  # U^T resident
         + 2 * 4 * H * H * 4  # dU: f32 scratch + output block
@@ -113,52 +133,59 @@ def _resident_bwd_vmem(B: int, H: int, pbytes: int) -> int:
 
 
 def _tiled_fwd_vmem(B: int, H: int, pbytes: int, save_residuals: bool,
-                    htile: int) -> int:
+                    htile: int, has_mask: bool = False) -> int:
     v = 2 * htile * 4 * H * pbytes  # U row-tile (streamed every step)
     v += 2 * B * 4 * H * 4  # xproj block
     v += B * 4 * H * 4  # z accumulator scratch (f32)
     v += 2 * B * H * 4  # h tiles scratch + c scratch
     v += 2 * B * H * 4  # ys out block
     v += 4 * B * H * 4  # h0/c0 in, hT/cT out
+    if has_mask:
+        v += 2 * B * _LANE * 4  # mask block
     if save_residuals:
         v += 2 * B * 4 * H * 4  # z out block
         v += 2 * B * H * 4  # cs out block
     return v
 
 
-def _tiled_bwd_vmem(B: int, H: int, pbytes: int, ttile: int) -> int:
+def _tiled_bwd_vmem(B: int, H: int, pbytes: int, ttile: int,
+                    has_mask: bool = False) -> int:
     v = 2 * ttile * H * pbytes  # U^T row-tile
     v += 2 * B * 4 * H * 4  # z in block
-    v += 2 * 3 * B * H * 4  # dys/c/c_prev in blocks
+    v += 2 * 2 * B * H * 4  # dys/c_prev in blocks (c_t recomputed)
     v += 2 * B * 4 * H * 4  # dz out block
     v += B * 4 * H * 4  # dz tiles scratch
     v += 3 * B * H * 4  # dh/dc/dh-accumulator scratch
     v += 4 * B * H * 4  # dhT/dcT in, dh0/dc0 out
+    if has_mask:
+        v += 2 * B * _LANE * 4  # mask block
+        v += B * H * 4  # dh-skip scratch
     return v
 
 
-def _plan_fwd(B: int, H: int, pbytes: int, *,
-              save_residuals: bool) -> tuple[str, int] | None:
+def _plan_fwd(B: int, H: int, pbytes: int, *, save_residuals: bool,
+              has_mask: bool = False) -> tuple[str, int] | None:
     """(strategy, htile) for the forward kernel at PADDED hidden size H,
     or None when nothing fits. Prefers the resident kernel (least HBM
     traffic), then the largest feasible U row-tile."""
-    if _resident_fwd_vmem(B, H, pbytes, save_residuals) <= _VMEM_BUDGET:
+    if _resident_fwd_vmem(B, H, pbytes, save_residuals, has_mask) <= _VMEM_BUDGET:
         return ("resident", 0)
     for htile in (512, 256, 128):
         if H % htile == 0 and _tiled_fwd_vmem(
-                B, H, pbytes, save_residuals, htile) <= _VMEM_BUDGET:
+                B, H, pbytes, save_residuals, htile, has_mask) <= _VMEM_BUDGET:
             return ("tiled", htile)
     return None
 
 
-def _plan_bwd(B: int, H: int, pbytes: int) -> tuple[str, int] | None:
+def _plan_bwd(B: int, H: int, pbytes: int,
+              has_mask: bool = False) -> tuple[str, int] | None:
     """(strategy, ttile) for the fused backward kernel, or None → recompute
     fallback. ttile tiles U^T's leading (4H) dim."""
-    if _resident_bwd_vmem(B, H, pbytes) <= _VMEM_BUDGET:
+    if _resident_bwd_vmem(B, H, pbytes, has_mask) <= _VMEM_BUDGET:
         return ("resident", 0)
     for ttile in (1024, 512, 256, 128):
         if (4 * H) % ttile == 0 and _tiled_bwd_vmem(
-                B, H, pbytes, ttile) <= _VMEM_BUDGET:
+                B, H, pbytes, ttile, has_mask) <= _VMEM_BUDGET:
             return ("tiled", ttile)
     return None
 
@@ -173,13 +200,15 @@ def supported(
     platform: str | None = None,
     *,
     param_dtype_bytes: int = 4,
+    has_mask: bool = False,
 ) -> bool:
     """Can a fused kernel run these shapes on this platform?
 
     Hidden sizes are padded to the 128-lane multiple internally, so any H is
     lane-feasible; the gate is batch sublane alignment (B % 8) plus the VMEM
     cost model (`_plan_fwd`) at the padded size — H=650/1024 now plan onto
-    the tiled kernel instead of falling back to lstm_scan.
+    the tiled kernel instead of falling back to lstm_scan. ``has_mask``
+    accounts for the streamed mask operand of variable-length scans.
     """
     if platform is None:
         platform = jax.default_backend()
@@ -189,7 +218,7 @@ def supported(
         and batch % 8 == 0
         and hidden >= 1
         and _plan_fwd(batch, hp, param_dtype_bytes,
-                      save_residuals=False) is not None
+                      save_residuals=False, has_mask=has_mask) is not None
     )
 
 
@@ -198,11 +227,18 @@ def supported(
 # ---------------------------------------------------------------------------
 
 
-def _lstm_kernel(xproj_ref, u_ref, h0_ref, c0_ref, ys_ref, hT_ref, cT_ref,
-                 *rest, hidden: int, chunk: int, save_residuals: bool):
+def _lstm_kernel(*refs, hidden: int, chunk: int, save_residuals: bool,
+                 has_mask: bool):
     """Forward recurrence. With ``save_residuals`` the kernel additionally
     streams out the gate pre-activations z_t and cell states c_t — the
-    residuals `_lstm_bwd_kernel` consumes (no recompute in the backward)."""
+    residuals `_lstm_bwd_kernel` consumes (no recompute in the backward).
+    With ``has_mask`` a lane-broadcast f32 mask freezes h/c at padded
+    steps (carry blend ``m*new + (1-m)*old``, matching `lstm_scan`)."""
+    n_in = 4 + has_mask
+    xproj_ref, u_ref, h0_ref, c0_ref = refs[:4]
+    mask_ref = refs[4] if has_mask else None
+    ys_ref, hT_ref, cT_ref = refs[n_in:n_in + 3]
+    rest = refs[n_in + 3:]
     if save_residuals:
         z_ref, cs_ref, h_scr, c_scr = rest
     else:
@@ -231,8 +267,15 @@ def _lstm_kernel(xproj_ref, u_ref, h0_ref, c0_ref, ys_ref, hT_ref, cT_ref,
         f = jax.nn.sigmoid(z[:, H : 2 * H])
         g = jnp.tanh(z[:, 2 * H : 3 * H])
         o = jax.nn.sigmoid(z[:, 3 * H :])
-        c = f * c + i * g
-        h = o * jnp.tanh(c)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        if has_mask:
+            m = mask_ref[s][:, :1]  # [B, 1] f32, lane-broadcasts against H
+            c = m * c_new + (1.0 - m) * c
+            h = m * h_new + (1.0 - m) * h
+        else:
+            c = c_new
+            h = h_new
         ys_ref[s] = h
         if save_residuals:
             cs_ref[s] = c
@@ -253,14 +296,21 @@ def _time_chunk(T: int) -> int:
     return 1
 
 
-def _lstm_bwd_kernel(z_ref, dys_ref, c_ref, cprev_ref, hprev_ref, ut_ref,
-                     dhT_ref, dcT_ref,
-                     dz_ref, du_ref, dh0_ref, dc0_ref,
-                     dh_scr, dc_scr, du_scr, *, hidden: int, chunk: int):
+def _lstm_bwd_kernel(*refs, hidden: int, chunk: int, has_mask: bool):
     """Fused BPTT: reverse sequential grid; dh/dc carries and the dU
     accumulator live in VMEM scratch across grid steps. Per time-step:
-    gate recompute from saved z (VPU), cotangent algebra (VPU), and two
-    MXU matmuls — dz @ U^T for the carry, h_prev^T @ dz into dU."""
+    gate recompute from saved z (VPU), cell-state recompute
+    ``c_t = f*c_{t-1} + i*g`` (bit-identical f32 — saves streaming c_t),
+    cotangent algebra (VPU), and two MXU matmuls — dz @ U^T for the carry,
+    h_prev^T @ dz into dU. With ``has_mask`` the frozen fraction of the
+    incoming cotangents bypasses the gate algebra straight into the
+    previous step (the transpose of the forward's carry blend)."""
+    n_in = 7 + has_mask
+    z_ref, dys_ref, cprev_ref, hprev_ref = refs[:4]
+    mask_ref = refs[4] if has_mask else None
+    ut_ref, dhT_ref, dcT_ref = refs[4 + has_mask:n_in]
+    dz_ref, du_ref, dh0_ref, dc0_ref = refs[n_in:n_in + 4]
+    dh_scr, dc_scr, du_scr = refs[n_in + 4:]
     t = pl.program_id(0)
     T = pl.num_programs(0)
     H = hidden
@@ -280,15 +330,22 @@ def _lstm_bwd_kernel(z_ref, dys_ref, c_ref, cprev_ref, hprev_ref, ut_ref,
         f = jax.nn.sigmoid(z[:, H : 2 * H])
         g = jnp.tanh(z[:, 2 * H : 3 * H])
         o = jax.nn.sigmoid(z[:, 3 * H :])
-        c = c_ref[s]
         c_prev = cprev_ref[s]
-        tc = jnp.tanh(c)
-        dh = dh + dys_ref[s]
-        dc = dc + dh * o * (1.0 - tc * tc)
-        do = dh * tc * o * (1.0 - o)
-        di = dc * g * i * (1.0 - i)
-        df = dc * c_prev * f * (1.0 - f)
-        dg = dc * i * (1.0 - g * g)
+        tc = jnp.tanh(f * c_prev + i * g)  # tanh(c_new), recomputed
+        dh_tot = dh + dys_ref[s]
+        dc_in = dc  # incoming dc carry at step t (pre-mask split)
+        if has_mask:
+            m = mask_ref[s][:, :1]
+            dh_eff = m * dh_tot
+            dc_eff = m * dc_in
+        else:
+            dh_eff = dh_tot
+            dc_eff = dc_in
+        dc_new = dc_eff + dh_eff * o * (1.0 - tc * tc)
+        do = dh_eff * tc * o * (1.0 - o)
+        di = dc_new * g * i * (1.0 - i)
+        df = dc_new * c_prev * f * (1.0 - f)
+        dg = dc_new * i * (1.0 - g * g)
         dz = jnp.concatenate([di, df, dg, do], axis=1)  # [B, 4H] f32
         dz_ref[s] = dz
         dz_c = dz.astype(ut_ref.dtype)
@@ -298,7 +355,11 @@ def _lstm_bwd_kernel(z_ref, dys_ref, c_ref, cprev_ref, hprev_ref, ut_ref,
             preferred_element_type=jnp.float32,
         )
         dh = jnp.dot(dz_c, ut_ref[:], preferred_element_type=jnp.float32)
-        dc = dc * f
+        dc = dc_new * f
+        if has_mask:
+            # frozen fraction of the cotangents bypasses the gates
+            dh = dh + (1.0 - m) * dh_tot
+            dc = dc + (1.0 - m) * dc_in
     dh_scr[:] = dh
     dc_scr[:] = dc
     du_scr[:] = du
@@ -315,16 +376,22 @@ def _lstm_bwd_kernel(z_ref, dys_ref, c_ref, cprev_ref, hprev_ref, ut_ref,
 # ---------------------------------------------------------------------------
 
 
-def _lstm_tiled_kernel(xproj_ref, u_ref, h0_ref, c0_ref,
-                       ys_ref, hT_ref, cT_ref, *rest,
-                       hidden: int, htile: int, save_residuals: bool):
+def _lstm_tiled_kernel(*refs, hidden: int, htile: int, save_residuals: bool,
+                       has_mask: bool):
     """Forward recurrence with U streamed in [htile, 4H] row-tiles.
 
     Grid (T, K), K = H/htile, k fastest. Per (t, k): accumulate
     ``z += h[:, k-tile] @ U[k-tile, :]`` into the full-width f32 z scratch;
     at the last tile, apply the gates and advance h/c. h is kept twice —
     tile-major ([K, B, htile] scratch, dynamically indexed by k for the
-    matmul) and rebuilt with static slices after each step."""
+    matmul) and rebuilt with static slices after each step. With
+    ``has_mask`` the previous full-width h is reassembled from the tiles
+    for the carry blend."""
+    n_in = 4 + has_mask
+    xproj_ref, u_ref, h0_ref, c0_ref = refs[:4]
+    mask_ref = refs[4] if has_mask else None
+    ys_ref, hT_ref, cT_ref = refs[n_in:n_in + 3]
+    rest = refs[n_in + 3:]
     if save_residuals:
         z_out_ref, cs_ref, h_tiles, c_scr, z_scr = rest
     else:
@@ -357,8 +424,18 @@ def _lstm_tiled_kernel(xproj_ref, u_ref, h0_ref, c0_ref,
         f = jax.nn.sigmoid(z[:, H : 2 * H])
         g = jnp.tanh(z[:, 2 * H : 3 * H])
         o = jax.nn.sigmoid(z[:, 3 * H :])
-        c = f * c_scr[:] + i * g
-        h = o * jnp.tanh(c)
+        c_new = f * c_scr[:] + i * g
+        h_new = o * jnp.tanh(c_new)
+        if has_mask:
+            m = mask_ref[0][:, :1]
+            h_prev = jnp.concatenate(
+                [h_tiles[j] for j in range(K)], axis=1
+            )  # previous step's full-width h
+            c = m * c_new + (1.0 - m) * c_scr[:]
+            h = m * h_new + (1.0 - m) * h_prev
+        else:
+            c = c_new
+            h = h_new
         c_scr[:] = c
         ys_ref[0] = h
         if save_residuals:
@@ -373,15 +450,24 @@ def _lstm_tiled_kernel(xproj_ref, u_ref, h0_ref, c0_ref,
             cT_ref[:] = c
 
 
-def _lstm_bwd_tiled_kernel(z_ref, dys_ref, c_ref, cprev_ref, ut_ref,
-                           dhT_ref, dcT_ref,
-                           dz_ref, dh0_ref, dc0_ref,
-                           dh_scr, dc_scr, dhacc_scr, dz_tiles,
-                           *, hidden: int, ttile: int):
+def _lstm_bwd_tiled_kernel(*refs, hidden: int, ttile: int, has_mask: bool):
     """Tiled BPTT: computes ONLY the sequential part — dz_t and the dh/dc
     carries — streaming U^T in [ttile, H] row-tiles for the carry matmul.
     The weight cotangents (dU, dW, db) and dxs contract over all T·B outside
-    the kernel as single large MXU matmuls (`_pallas_backward`)."""
+    the kernel as single large MXU matmuls (`_pallas_backward`). The cell
+    state c_t is recomputed from (z_t, c_{t-1}). With ``has_mask`` the
+    skipped cotangent ``(1-m)*dh_tot`` is staged in a scratch at the first
+    tile and added to the carry at the last tile."""
+    n_in = 6 + has_mask
+    z_ref, dys_ref, cprev_ref = refs[:3]
+    mask_ref = refs[3] if has_mask else None
+    ut_ref, dhT_ref, dcT_ref = refs[3 + has_mask:n_in]
+    dz_ref, dh0_ref, dc0_ref = refs[n_in:n_in + 3]
+    scratch = refs[n_in + 3:]
+    if has_mask:
+        dh_scr, dc_scr, dhacc_scr, dz_tiles, dhskip_scr = scratch
+    else:
+        dh_scr, dc_scr, dhacc_scr, dz_tiles = scratch
     t = pl.program_id(0)
     k = pl.program_id(1)
     T = pl.num_programs(0)
@@ -400,19 +486,30 @@ def _lstm_bwd_tiled_kernel(z_ref, dys_ref, c_ref, cprev_ref, ut_ref,
         f = jax.nn.sigmoid(z[:, H : 2 * H])
         g = jnp.tanh(z[:, 2 * H : 3 * H])
         o = jax.nn.sigmoid(z[:, 3 * H :])
-        c = c_ref[0]
-        tc = jnp.tanh(c)
-        dh = dh_scr[:] + dys_ref[0]
-        dc = dc_scr[:] + dh * o * (1.0 - tc * tc)
-        do = dh * tc * o * (1.0 - o)
-        di = dc * g * i * (1.0 - i)
-        df = dc * cprev_ref[0] * f * (1.0 - f)
-        dg = dc * i * (1.0 - g * g)
+        c_prev = cprev_ref[0]
+        tc = jnp.tanh(f * c_prev + i * g)  # tanh(c_new), recomputed
+        dh_tot = dh_scr[:] + dys_ref[0]
+        if has_mask:
+            m = mask_ref[0][:, :1]
+            dh_eff = m * dh_tot
+            dc_eff = m * dc_scr[:]
+            dhskip_scr[:] = (1.0 - m) * dh_tot
+        else:
+            dh_eff = dh_tot
+            dc_eff = dc_scr[:]
+        dc_new = dc_eff + dh_eff * o * (1.0 - tc * tc)
+        do = dh_eff * tc * o * (1.0 - o)
+        di = dc_new * g * i * (1.0 - i)
+        df = dc_new * c_prev * f * (1.0 - f)
+        dg = dc_new * i * (1.0 - g * g)
         dz = jnp.concatenate([di, df, dg, do], axis=1)  # [B, 4H] f32
         dz_ref[0] = dz
         for j in range(K):
             dz_tiles[j] = dz[:, j * ttile : (j + 1) * ttile]
-        dc_scr[:] = dc * f
+        if has_mask:
+            dc_scr[:] = dc_new * f + (1.0 - m) * dc_scr[:]
+        else:
+            dc_scr[:] = dc_new * f
         dhacc_scr[:] = jnp.zeros_like(dhacc_scr)
 
     dhacc_scr[:] = dhacc_scr[:] + jnp.dot(
@@ -422,11 +519,14 @@ def _lstm_bwd_tiled_kernel(z_ref, dys_ref, c_ref, cprev_ref, ut_ref,
 
     @pl.when(k == K - 1)
     def _():
-        dh_scr[:] = dhacc_scr[:]
+        if has_mask:
+            dh_scr[:] = dhacc_scr[:] + dhskip_scr[:]
+        else:
+            dh_scr[:] = dhacc_scr[:]
 
         @pl.when(t == T - 1)
         def _():
-            dh0_ref[:] = dhacc_scr[:]
+            dh0_ref[:] = dh_scr[:]
             dc0_ref[:] = dc_scr[:]
 
 
@@ -435,10 +535,11 @@ def _lstm_bwd_tiled_kernel(z_ref, dys_ref, c_ref, cprev_ref, ut_ref,
 # ---------------------------------------------------------------------------
 
 
-def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False,
-                    save_residuals: bool = False):
+def _pallas_forward(fused, xs, h0, c0, mask_tbl=None, *,
+                    interpret: bool = False, save_residuals: bool = False):
     """xs [B,T,D] -> (ys [B,T,H], hT, cT[, z, cs]). fused: FusedLSTMParams.
 
+    ``mask_tbl`` (optional) is the lane-broadcast f32 mask [T, B, LANE].
     ``save_residuals`` additionally returns the z/c trajectories ([T,B,...])
     for the fused backward. Strategy (resident vs tiled U) comes from the
     shared cost model."""
@@ -446,7 +547,9 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False,
     H = fused.hidden_size
     dtype = fused.kernel.dtype
     pbytes = 2 if dtype == jnp.bfloat16 else 4
-    plan = _plan_fwd(B, H, pbytes, save_residuals=save_residuals)
+    has_mask = mask_tbl is not None
+    plan = _plan_fwd(B, H, pbytes, save_residuals=save_residuals,
+                     has_mask=has_mask)
     if plan is None:  # callers gate via supported(); belt-and-braces
         raise ValueError(f"no pallas forward plan for B={B}, H={H}")
     strategy, htile = plan
@@ -486,9 +589,12 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False,
 
     xproj_spec = pl.BlockSpec((C, B, 4 * H), lambda t, *k: (t, 0, 0),
                               memory_space=pltpu.VMEM)
+    mask_spec = pl.BlockSpec((C, B, _LANE), lambda t, *k: (t, 0, 0),
+                             memory_space=pltpu.VMEM)
     if strategy == "resident":
         kernel = functools.partial(
-            _lstm_kernel, hidden=H, chunk=C, save_residuals=save_residuals
+            _lstm_kernel, hidden=H, chunk=C, save_residuals=save_residuals,
+            has_mask=has_mask,
         )
         grid = (T // C,)
         u_spec = pl.BlockSpec(memory_space=pltpu.VMEM)  # U resident
@@ -500,7 +606,7 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False,
         K = H // htile
         kernel = functools.partial(
             _lstm_tiled_kernel, hidden=H, htile=htile,
-            save_residuals=save_residuals,
+            save_residuals=save_residuals, has_mask=has_mask,
         )
         grid = (T, K)
         u_spec = pl.BlockSpec((htile, 4 * H), lambda t, k: (k, 0),
@@ -511,28 +617,35 @@ def _pallas_forward(fused, xs, h0, c0, *, interpret: bool = False,
             pltpu.VMEM((B, 4 * H), jnp.float32),  # z accumulator
         ]
 
+    in_specs = [
+        xproj_spec,
+        u_spec,
+        pl.BlockSpec(memory_space=pltpu.VMEM),  # h0
+        pl.BlockSpec(memory_space=pltpu.VMEM),  # c0
+    ]
+    operands = [xproj, fused.recurrent,
+                h0.astype(jnp.float32), c0.astype(jnp.float32)]
+    if has_mask:
+        in_specs.append(mask_spec)
+        operands.append(mask_tbl)
+
     out = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            xproj_spec,
-            u_spec,
-            pl.BlockSpec(memory_space=pltpu.VMEM),  # h0
-            pl.BlockSpec(memory_space=pltpu.VMEM),  # c0
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=interpret,
-    )(xproj, fused.recurrent, h0.astype(jnp.float32), c0.astype(jnp.float32))
+    )(*operands)
     ys = jnp.moveaxis(out[0], 0, 1)
     if save_residuals:
         return ys, out[1], out[2], out[3], out[4]
     return ys, out[1], out[2]
 
 
-def _pallas_backward(fused, params, xs, h0, c0, ys, z, cs, dys, dhT, dcT,
-                     *, interpret: bool = False):
+def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
+                     dys, dhT, dcT, *, interpret: bool = False):
     """Fused BPTT via `_lstm_bwd_kernel` / `_lstm_bwd_tiled_kernel` + big
     MXU matmuls outside.
 
@@ -542,7 +655,8 @@ def _pallas_backward(fused, params, xs, h0, c0, ys, z, cs, dys, dhT, dcT,
     H = fused.hidden_size
     dtype = fused.kernel.dtype
     pbytes = 2 if dtype == jnp.bfloat16 else 4
-    plan = _plan_bwd(B, H, pbytes)
+    has_mask = mask_tbl is not None
+    plan = _plan_bwd(B, H, pbytes, has_mask)
     if plan is None:
         raise ValueError(f"no pallas backward plan for B={B}, H={H}")
     strategy, ttile = plan
@@ -557,20 +671,30 @@ def _pallas_backward(fused, params, xs, h0, c0, ys, z, cs, dys, dhT, dcT,
         C = _time_chunk(T)
         n = T // C
         rev = lambda t: (n - 1 - t, 0, 0)  # reverse-time grid
-        kernel = functools.partial(_lstm_bwd_kernel, hidden=H, chunk=C)
+        kernel = functools.partial(_lstm_bwd_kernel, hidden=H, chunk=C,
+                                   has_mask=has_mask)
+        in_specs = [
+            pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # z
+            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # dys
+            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # c_prev
+            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # h_prev
+        ]
+        operands = [z, dys_t, c_prev, h_prev]
+        if has_mask:
+            in_specs.append(
+                pl.BlockSpec((C, B, _LANE), rev, memory_space=pltpu.VMEM)
+            )
+            operands.append(mask_tbl)
+        in_specs += [
+            pl.BlockSpec(memory_space=pltpu.VMEM),                   # U^T
+            pl.BlockSpec(memory_space=pltpu.VMEM),                   # dhT
+            pl.BlockSpec(memory_space=pltpu.VMEM),                   # dcT
+        ]
+        operands += [u_t, dhT.astype(jnp.float32), dcT.astype(jnp.float32)]
         dz, dU, dh0, dc0 = pl.pallas_call(
             kernel,
             grid=(n,),
-            in_specs=[
-                pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # z
-                pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # dys
-                pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # c
-                pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # c_prev
-                pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # h_prev
-                pl.BlockSpec(memory_space=pltpu.VMEM),                   # U^T
-                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dhT
-                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dcT
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # dz
                 pl.BlockSpec(memory_space=pltpu.VMEM),                   # dU
@@ -589,26 +713,42 @@ def _pallas_backward(fused, params, xs, h0, c0, ys, z, cs, dys, dhT, dcT,
                 pltpu.VMEM((H, 4 * H), jnp.float32),
             ],
             interpret=interpret,
-        )(z, dys_t, cs, c_prev, h_prev, u_t,
-          dhT.astype(jnp.float32), dcT.astype(jnp.float32))
+        )(*operands)
     else:
         K = 4 * H // ttile
         rev1 = lambda t, k: (T - 1 - t, 0, 0)
         kernel = functools.partial(_lstm_bwd_tiled_kernel, hidden=H,
-                                   ttile=ttile)
+                                   ttile=ttile, has_mask=has_mask)
+        in_specs = [
+            pl.BlockSpec((1, B, 4 * H), rev1, memory_space=pltpu.VMEM),  # z
+            pl.BlockSpec((1, B, H), rev1, memory_space=pltpu.VMEM),  # dys
+            pl.BlockSpec((1, B, H), rev1, memory_space=pltpu.VMEM),  # c_prev
+        ]
+        operands = [z, dys_t, c_prev]
+        if has_mask:
+            in_specs.append(
+                pl.BlockSpec((1, B, _LANE), rev1, memory_space=pltpu.VMEM)
+            )
+            operands.append(mask_tbl)
+        in_specs += [
+            pl.BlockSpec((ttile, H), lambda t, k: (k, 0),
+                         memory_space=pltpu.VMEM),                   # U^T tile
+            pl.BlockSpec(memory_space=pltpu.VMEM),                   # dhT
+            pl.BlockSpec(memory_space=pltpu.VMEM),                   # dcT
+        ]
+        operands += [u_t, dhT.astype(jnp.float32), dcT.astype(jnp.float32)]
+        scratch = [
+            pltpu.VMEM((B, H), jnp.float32),          # dh carry
+            pltpu.VMEM((B, H), jnp.float32),          # dc carry
+            pltpu.VMEM((B, H), jnp.float32),          # dh accumulator
+            pltpu.VMEM((K, B, ttile), jnp.float32),   # dz, tile-major
+        ]
+        if has_mask:
+            scratch.append(pltpu.VMEM((B, H), jnp.float32))  # dh skip
         dz, dh0, dc0 = pl.pallas_call(
             kernel,
             grid=(T, K),
-            in_specs=[
-                pl.BlockSpec((1, B, 4 * H), rev1, memory_space=pltpu.VMEM),  # z
-                pl.BlockSpec((1, B, H), rev1, memory_space=pltpu.VMEM),  # dys
-                pl.BlockSpec((1, B, H), rev1, memory_space=pltpu.VMEM),  # c
-                pl.BlockSpec((1, B, H), rev1, memory_space=pltpu.VMEM),  # c_prev
-                pl.BlockSpec((ttile, H), lambda t, k: (k, 0),
-                             memory_space=pltpu.VMEM),                   # U^T tile
-                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dhT
-                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dcT
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, B, 4 * H), rev1, memory_space=pltpu.VMEM),  # dz
                 pl.BlockSpec(memory_space=pltpu.VMEM),                   # dh0
@@ -619,15 +759,9 @@ def _pallas_backward(fused, params, xs, h0, c0, ys, z, cs, dys, dhT, dcT,
                 jax.ShapeDtypeStruct((B, H), jnp.float32),
                 jax.ShapeDtypeStruct((B, H), jnp.float32),
             ],
-            scratch_shapes=[
-                pltpu.VMEM((B, H), jnp.float32),          # dh carry
-                pltpu.VMEM((B, H), jnp.float32),          # dc carry
-                pltpu.VMEM((B, H), jnp.float32),          # dh accumulator
-                pltpu.VMEM((K, B, ttile), jnp.float32),   # dz, tile-major
-            ],
+            scratch_shapes=scratch,
             interpret=interpret,
-        )(z, dys_t, cs, c_prev, u_t,
-          dhT.astype(jnp.float32), dcT.astype(jnp.float32))
+        )(*operands)
         # dU contracts over all T·B at once — one large MXU matmul (the
         # whole point of the tiled split: no VMEM-resident accumulator).
         dU = jnp.einsum(
@@ -663,24 +797,31 @@ def _pallas_backward(fused, params, xs, h0, c0, ys, z, cs, dys, dhT, dcT,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _scan_core(params, xs, h0, c0, compute_dtype, interpret, remat_chunk,
-               unroll):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _scan_core(params, xs, h0, c0, mask_tbl, compute_dtype, interpret,
+               remat_chunk, unroll, has_mask):
     fused = fuse_params(params, compute_dtype=compute_dtype)
-    ys, hT, cT = _pallas_forward(fused, xs, h0, c0, interpret=interpret)
+    ys, hT, cT = _pallas_forward(
+        fused, xs, h0, c0, mask_tbl if has_mask else None, interpret=interpret
+    )
     return ys, hT, cT
 
 
-def _reference(params, xs, h0, c0, compute_dtype, remat_chunk, unroll):
+def _mask_bt(mask_tbl):
+    """Recover the [B, T] bool mask from the lane-broadcast [T, B, LANE]."""
+    return jnp.moveaxis(mask_tbl[:, :, 0] > 0, 0, 1)
+
+
+def _reference(params, xs, h0, c0, mask, compute_dtype, remat_chunk, unroll):
     (hT, cT), ys = lstm_scan(
-        params, xs, (h0, c0),
+        params, xs, (h0, c0), mask=mask,
         compute_dtype=compute_dtype, remat_chunk=remat_chunk, unroll=unroll,
     )
     return ys, hT, cT
 
 
-def _scan_core_fwd(params, xs, h0, c0, compute_dtype, interpret, remat_chunk,
-                   unroll):
+def _scan_core_fwd(params, xs, h0, c0, mask_tbl, compute_dtype, interpret,
+                   remat_chunk, unroll, has_mask):
     fused = fuse_params(params, compute_dtype=compute_dtype)
     B, T, _ = xs.shape
     H = fused.hidden_size
@@ -693,42 +834,48 @@ def _scan_core_fwd(params, xs, h0, c0, compute_dtype, interpret, remat_chunk,
     use_fused_bwd = (
         remat_chunk is None
         and _residual_bytes(T, B, H) <= _RESIDUAL_HBM_BUDGET
-        and _plan_bwd(B, H, pbytes) is not None
-        and _plan_fwd(B, H, pbytes, save_residuals=True) is not None
+        and _plan_bwd(B, H, pbytes, has_mask) is not None
+        and _plan_fwd(B, H, pbytes, save_residuals=True,
+                      has_mask=has_mask) is not None
     )
     if use_fused_bwd:
         ys, hT, cT, z, cs = _pallas_forward(
-            fused, xs, h0, c0, interpret=interpret, save_residuals=True
+            fused, xs, h0, c0, mask_tbl if has_mask else None,
+            interpret=interpret, save_residuals=True,
         )
-        return (ys, hT, cT), (params, xs, h0, c0, ys, z, cs)
+        return (ys, hT, cT), (params, xs, h0, c0, mask_tbl, ys, z, cs)
     out = _scan_core(
-        params, xs, h0, c0, compute_dtype, interpret, remat_chunk, unroll
+        params, xs, h0, c0, mask_tbl, compute_dtype, interpret, remat_chunk,
+        unroll, has_mask,
     )
-    return out, (params, xs, h0, c0, None, None, None)
+    return out, (params, xs, h0, c0, mask_tbl, None, None, None)
 
 
-def _scan_core_bwd(compute_dtype, interpret, remat_chunk, unroll, residuals,
-                   cotangents):
-    params, xs, h0, c0, ys, z, cs = residuals
+def _scan_core_bwd(compute_dtype, interpret, remat_chunk, unroll, has_mask,
+                   residuals, cotangents):
+    params, xs, h0, c0, mask_tbl, ys, z, cs = residuals
     if z is not None:
         # Fused Pallas BPTT (see _lstm_bwd_kernel / _lstm_bwd_tiled_kernel).
         fused = fuse_params(params, compute_dtype=compute_dtype)
         dys, dhT, dcT = cotangents
-        return _pallas_backward(
-            fused, params, xs, h0, c0, ys, z, cs, dys, dhT, dcT,
-            interpret=interpret,
+        dparams, dxs, dh0, dc0 = _pallas_backward(
+            fused, params, xs, h0, c0, mask_tbl if has_mask else None,
+            ys, z, cs, dys, dhT, dcT, interpret=interpret,
         )
+        return dparams, dxs, dh0, dc0, jnp.zeros_like(mask_tbl)
     # Remat-style backward: recompute the forward with the pure-jax scan and
     # pull gradients through it — bit-exact with the reference BPTT.
     # remat_chunk bounds the recompute's own residual memory to O(T/chunk)
     # carries, so --use-pallas composes with --remat-chunk on long sequences.
+    mask = _mask_bt(mask_tbl) if has_mask else None
     _, vjp = jax.vjp(
         lambda p, x, h, c: _reference(
-            p, x, h, c, compute_dtype, remat_chunk, unroll
+            p, x, h, c, mask, compute_dtype, remat_chunk, unroll
         ),
         params, xs, h0, c0,
     )
-    return vjp(cotangents)
+    dparams, dxs, dh0, dc0 = vjp(cotangents)
+    return dparams, dxs, dh0, dc0, jnp.zeros_like(mask_tbl)
 
 
 _scan_core.defvjp(_scan_core_fwd, _scan_core_bwd)
@@ -753,12 +900,20 @@ def pallas_lstm_scan(
     xs: jax.Array,
     carry: tuple[jax.Array, jax.Array] | None = None,
     *,
+    mask: jax.Array | None = None,
+    reverse: bool = False,
     compute_dtype=None,
     remat_chunk: int | None = None,
     unroll: int = 1,
     interpret: bool = False,
 ):
-    """Drop-in fused-kernel variant of `lstm_scan` (no mask/reverse support).
+    """Drop-in fused-kernel variant of `lstm_scan` (mask + reverse included).
+
+    ``mask`` ([B, T] bool) freezes the carry at False steps; ``reverse``
+    scans right-to-left. Reverse is realised by flipping the time axis
+    outside the custom VJP (the kernels always run forward), so a reversed
+    masked scan over a right-padded batch — the bi-LSTM's backward direction
+    — walks the padding first with a frozen carry, exactly like `lstm_scan`.
 
     Backward strategy (module docstring): fused BPTT kernel by default;
     setting ``remat_chunk`` selects the recompute backward (bounded residual
@@ -769,9 +924,13 @@ def pallas_lstm_scan(
     the pad/slice sits outside the custom VJP, so gradients transpose
     through it automatically and exactly.
     """
-    B, _, _ = xs.shape
+    B, T, _ = xs.shape
     H = params.hidden_size
     hp = _pad_to_lane(H)
+    if reverse:
+        xs = jnp.flip(xs, axis=1)
+        if mask is not None:
+            mask = jnp.flip(mask, axis=1)
     if carry is None:
         h0 = jnp.zeros((B, hp), jnp.float32)
         c0 = jnp.zeros((B, hp), jnp.float32)
@@ -781,8 +940,18 @@ def pallas_lstm_scan(
             h0 = jnp.pad(h0, ((0, 0), (0, hp - H)))
             c0 = jnp.pad(c0, ((0, 0), (0, hp - H)))
     run_params = _pad_params_lane(params, hp) if hp != H else params
-    ys, hT, cT = _scan_core(run_params, xs, h0, c0, compute_dtype, interpret,
-                            remat_chunk, unroll)
+    has_mask = mask is not None
+    if has_mask:
+        mask_tbl = jnp.broadcast_to(
+            jnp.moveaxis(mask, 0, 1).astype(jnp.float32)[:, :, None],
+            (T, B, _LANE),
+        )
+    else:
+        mask_tbl = jnp.zeros((1, 1, _LANE), jnp.float32)  # unused dummy
+    ys, hT, cT = _scan_core(run_params, xs, h0, c0, mask_tbl, compute_dtype,
+                            interpret, remat_chunk, unroll, has_mask)
     if hp != H:
         ys, hT, cT = ys[..., :H], hT[:, :H], cT[:, :H]
+    if reverse:
+        ys = jnp.flip(ys, axis=1)
     return (hT, cT), ys

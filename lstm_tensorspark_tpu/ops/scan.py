@@ -123,6 +123,44 @@ def lstm_scan(
     return final, jnp.moveaxis(ys, 0, 1)
 
 
+def auto_lstm_scan(
+    params: LSTMParams,
+    xs: jax.Array,
+    carry: tuple[jax.Array, jax.Array] | None = None,
+    *,
+    mask: jax.Array | None = None,
+    reverse: bool = False,
+    use_pallas: bool = False,
+    compute_dtype=None,
+    remat_chunk: int | None = None,
+    unroll: int = 1,
+):
+    """`lstm_scan` with optional fused-Pallas dispatch.
+
+    When ``use_pallas`` and the shapes/platform pass the kernel's VMEM cost
+    model (`pallas_lstm.supported`), runs the fused `pallas_lstm_scan` —
+    which now covers masked AND reversed scans, so the bi-LSTM classifier
+    and seq2seq decoder recurrences take the fused path too; otherwise
+    falls back to the plain `lax.scan`. Same signature contract as
+    `lstm_scan`; returns ``((hT, cT), ys)``.
+    """
+    if use_pallas:
+        from .pallas_lstm import pallas_lstm_scan, supported
+
+        pbytes = 2 if compute_dtype == jnp.bfloat16 else 4
+        if supported(xs.shape[0], params.hidden_size,
+                     param_dtype_bytes=pbytes, has_mask=mask is not None):
+            return pallas_lstm_scan(
+                params, xs, carry, mask=mask, reverse=reverse,
+                compute_dtype=compute_dtype, remat_chunk=remat_chunk,
+                unroll=unroll,
+            )
+    return lstm_scan(
+        params, xs, carry, mask=mask, reverse=reverse,
+        compute_dtype=compute_dtype, remat_chunk=remat_chunk, unroll=unroll,
+    )
+
+
 def stacked_lstm_scan(
     layer_params: Sequence[LSTMParams],
     xs: jax.Array,
@@ -146,22 +184,13 @@ def stacked_lstm_scan(
     n = len(layer_params)
     for idx, p in enumerate(layer_params):
         c0 = None if carries is None else carries[idx]
-        took_pallas = False
-        if use_pallas and mask is None and not scan_kwargs.get("reverse", False):
-            from .pallas_lstm import pallas_lstm_scan, supported
-
-            cdtype = scan_kwargs.get("compute_dtype")
-            pbytes = 2 if cdtype == jnp.bfloat16 else 4
-            if supported(ys.shape[0], p.hidden_size, param_dtype_bytes=pbytes):
-                final, ys = pallas_lstm_scan(
-                    p, ys, c0,
-                    compute_dtype=cdtype,
-                    remat_chunk=scan_kwargs.get("remat_chunk"),
-                    unroll=scan_kwargs.get("unroll", 1),
-                )
-                took_pallas = True
-        if not took_pallas:
-            final, ys = lstm_scan(p, ys, c0, mask=mask, **scan_kwargs)
+        final, ys = auto_lstm_scan(
+            p, ys, c0, mask=mask, use_pallas=use_pallas,
+            reverse=scan_kwargs.get("reverse", False),
+            compute_dtype=scan_kwargs.get("compute_dtype"),
+            remat_chunk=scan_kwargs.get("remat_chunk"),
+            unroll=scan_kwargs.get("unroll", 1),
+        )
         finals.append(final)
         if idx < n - 1 and dropout_rate > 0.0 and not deterministic:
             if dropout_rng is None:

@@ -28,6 +28,10 @@ def run_classifier(args, logger) -> int:
     if data["synthetic"]:
         logger.log({"note": "dataset imdb: using synthetic stand-in"})
     vocab = data["vocab"]
+    if args.use_pallas and args.tensor_parallel > 1:
+        raise SystemExit("--use-pallas is not supported with --tensor-parallel "
+                         "(the GSPMD-sharded hidden dim cannot enter the fused "
+                         "kernel)")
     cfg = ClassifierConfig(
         vocab_size=len(vocab),
         num_classes=data["num_classes"],
@@ -36,6 +40,7 @@ def run_classifier(args, logger) -> int:
         dropout=args.dropout,
         compute_dtype=args.compute_dtype,
         remat_chunk=args.remat_chunk,
+        use_pallas=args.use_pallas,
     )
 
     def loss_fn(params, batch, dropout_rng):

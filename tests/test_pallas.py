@@ -246,3 +246,169 @@ def test_residual_hbm_heuristic(monkeypatch):
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
         g_fused, g_recompute,
     )
+
+
+# ---------------------------------------------------------------------------
+# masked / reversed scans (round 3: configs 2 & 4 fused-path coverage)
+# ---------------------------------------------------------------------------
+
+
+def _lengths_mask(key, b, t):
+    lengths = jax.random.randint(key, (b,), 1, t + 1)
+    return jnp.arange(t)[None, :] < lengths[:, None]
+
+
+def test_masked_forward_parity():
+    params, xs = _setup()
+    mask = _lengths_mask(jax.random.PRNGKey(20), B, T)
+    (hT, cT), ys = pallas_lstm_scan(params, xs, mask=mask, interpret=True)
+    (hT2, cT2), ys2 = lstm_scan(params, xs, mask=mask)
+    np.testing.assert_allclose(ys, ys2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hT, hT2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cT, cT2, rtol=1e-5, atol=1e-5)
+
+
+def test_reverse_forward_parity():
+    params, xs = _setup()
+    h0 = jax.random.normal(jax.random.PRNGKey(21), (B, H))
+    c0 = jax.random.normal(jax.random.PRNGKey(22), (B, H))
+    (hT, cT), ys = pallas_lstm_scan(
+        params, xs, (h0, c0), reverse=True, interpret=True
+    )
+    (hT2, cT2), ys2 = lstm_scan(params, xs, (h0, c0), reverse=True)
+    np.testing.assert_allclose(ys, ys2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hT, hT2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cT, cT2, rtol=1e-5, atol=1e-5)
+
+
+def test_masked_reverse_parity():
+    """The bi-LSTM's backward direction: reversed scan over a right-padded
+    batch with a carry-freeze mask. Forward AND grads must match."""
+    params, xs = _setup()
+    mask = _lengths_mask(jax.random.PRNGKey(23), B, T)
+
+    def lp(p, x):
+        (hT, cT), ys = pallas_lstm_scan(
+            p, x, mask=mask, reverse=True, interpret=True
+        )
+        return jnp.mean(ys**2) + jnp.sum(hT * 0.3) + jnp.sum(cT * 0.1)
+
+    def lr(p, x):
+        (hT, cT), ys = lstm_scan(p, x, mask=mask, reverse=True)
+        return jnp.mean(ys**2) + jnp.sum(hT * 0.3) + jnp.sum(cT * 0.1)
+
+    np.testing.assert_allclose(lp(params, xs), lr(params, xs),
+                               rtol=1e-5, atol=1e-6)
+    g1 = jax.grad(lp, argnums=(0, 1))(params, xs)
+    g2 = jax.grad(lr, argnums=(0, 1))(params, xs)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        g1, g2,
+    )
+
+
+def test_masked_grad_parity_fused_bwd():
+    """Masked FUSED backward (not the recompute fallback): the masked
+    cotangent algebra inside _lstm_bwd_kernel must match lstm_scan grads."""
+    from lstm_tensorspark_tpu.ops.pallas_lstm import _plan_bwd
+
+    assert _plan_bwd(B, H, 4, True) is not None  # fused bwd is the live path
+    params, xs = _setup()
+    mask = _lengths_mask(jax.random.PRNGKey(24), B, T)
+    h0 = jax.random.normal(jax.random.PRNGKey(25), (B, H))
+    c0 = jax.random.normal(jax.random.PRNGKey(26), (B, H))
+
+    def lp(p, x, h, c):
+        (hT, cT), ys = pallas_lstm_scan(p, x, (h, c), mask=mask,
+                                        interpret=True)
+        return jnp.mean(ys**2) + jnp.sum(hT * 0.3) + jnp.sum(cT * 0.1)
+
+    def lr(p, x, h, c):
+        (hT, cT), ys = lstm_scan(p, x, (h, c), mask=mask)
+        return jnp.mean(ys**2) + jnp.sum(hT * 0.3) + jnp.sum(cT * 0.1)
+
+    g1 = jax.grad(lp, argnums=(0, 1, 2, 3))(params, xs, h0, c0)
+    g2 = jax.grad(lr, argnums=(0, 1, 2, 3))(params, xs, h0, c0)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        g1, g2,
+    )
+
+
+def test_masked_tiled_parity():
+    """Masked TILED kernels (H=1024 → U streamed): forward + grads."""
+    from lstm_tensorspark_tpu.ops.pallas_lstm import _plan_bwd, _plan_fwd
+
+    assert _plan_fwd(8, 1024, 4, save_residuals=True, has_mask=True)[0] == "tiled"
+    assert _plan_bwd(8, 1024, 4, True)[0] == "tiled"
+    params = init_lstm_params(jax.random.PRNGKey(27), 32, 1024)
+    xs = jax.random.normal(jax.random.PRNGKey(28), (8, 4, 32))
+    mask = _lengths_mask(jax.random.PRNGKey(29), 8, 4)
+
+    (hT, cT), ys = pallas_lstm_scan(params, xs, mask=mask, interpret=True)
+    (hT2, cT2), ys2 = lstm_scan(params, xs, mask=mask)
+    np.testing.assert_allclose(ys, ys2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hT, hT2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cT, cT2, rtol=1e-5, atol=1e-5)
+
+    def lp(p, x):
+        return jnp.mean(pallas_lstm_scan(p, x, mask=mask, interpret=True)[1] ** 2)
+
+    def lr(p, x):
+        return jnp.mean(lstm_scan(p, x, mask=mask)[1] ** 2)
+
+    g1 = jax.grad(lp, argnums=(0, 1))(params, xs)
+    g2 = jax.grad(lr, argnums=(0, 1))(params, xs)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        g1, g2,
+    )
+
+
+def test_masked_recompute_bwd_parity(monkeypatch):
+    """Masked scan with the recompute backward (residual budget forced to 0):
+    the fallback must thread the mask through lstm_scan."""
+    import lstm_tensorspark_tpu.ops.pallas_lstm as pallas_mod
+
+    monkeypatch.setattr(pallas_mod, "_RESIDUAL_HBM_BUDGET", 1)
+    params, xs = _setup()
+    mask = _lengths_mask(jax.random.PRNGKey(30), B, T)
+
+    def lp(p):
+        return jnp.mean(
+            pallas_lstm_scan(p, xs, mask=mask, interpret=True)[1] ** 2
+        )
+
+    def lr(p):
+        return jnp.mean(lstm_scan(p, xs, mask=mask)[1] ** 2)
+
+    g1 = jax.grad(lp)(params)
+    g2 = jax.grad(lr)(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        g1, g2,
+    )
+
+
+def test_masked_padded_h650_parity():
+    """Mask + lane padding together (config-3-like H=650 → padded 768)."""
+    params = init_lstm_params(jax.random.PRNGKey(31), 48, 650)
+    xs = jax.random.normal(jax.random.PRNGKey(32), (8, 6, 48))
+    mask = _lengths_mask(jax.random.PRNGKey(33), 8, 6)
+    (hT, cT), ys = pallas_lstm_scan(params, xs, mask=mask, interpret=True)
+    (hT2, cT2), ys2 = lstm_scan(params, xs, mask=mask)
+    np.testing.assert_allclose(ys, ys2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hT, hT2, rtol=1e-5, atol=1e-5)
+
+    def lp(p):
+        return jnp.mean(pallas_lstm_scan(p, xs, mask=mask, interpret=True)[1] ** 2)
+
+    def lr(p):
+        return jnp.mean(lstm_scan(p, xs, mask=mask)[1] ** 2)
+
+    g1 = jax.grad(lp)(params)
+    g2 = jax.grad(lr)(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        g1, g2,
+    )
