@@ -129,6 +129,13 @@ def main(argv=None) -> int:
         raise SystemExit(f"--top-k must be >= 1, got {args.top_k}")
     if args.top_p is not None and not 0.0 < args.top_p <= 1.0:
         raise SystemExit(f"--top-p must be in (0, 1], got {args.top_p}")
+    # one shared gate for every task runner: the fused kernel cannot run on
+    # a "model"-axis-sharded hidden dim (GSPMD cannot partition pallas_call);
+    # it DOES compose with --pipeline-stages (collective-free stage interiors)
+    if args.use_pallas and args.tensor_parallel > 1:
+        raise SystemExit("--use-pallas is not supported with --tensor-parallel "
+                         "(the GSPMD-sharded hidden dim cannot enter the fused "
+                         "kernel)")
 
     from .parallel import distributed_init
     distributed_init(args.coordinator, args.num_processes, args.process_id)
@@ -625,10 +632,10 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     if pp > 1 and sp > 1:
         raise SystemExit("--pipeline-stages cannot combine with --seq-parallel "
                          "(both schedule the wavefront; tp composes with either)")
-    if args.use_pallas:
-        raise SystemExit("--use-pallas is not supported with --tensor-parallel/"
-                         "--seq-parallel/--pipeline-stages (the wavefront "
-                         "losses use lax.scan)")
+    if args.use_pallas and sp > 1:
+        raise SystemExit("--use-pallas is not supported with --seq-parallel "
+                         "(the wavefront splits the time axis the kernel "
+                         "needs whole); it composes with --pipeline-stages")
     if args.microbatches is not None and args.microbatches < 1:
         raise SystemExit(f"--microbatches must be >= 1, got {args.microbatches}")
     n = jax.device_count()

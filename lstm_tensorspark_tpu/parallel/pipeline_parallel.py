@@ -43,7 +43,7 @@ except ImportError:  # pragma: no cover
 
 from ..models.lstm_lm import LMConfig
 from ..ops.lstm_cell import LSTMParams, fuse_params, zero_carry
-from ..ops.scan import lstm_scan
+from ..ops.scan import auto_lstm_scan, lstm_scan
 from ..train.loop import TrainState, step_body
 
 
@@ -154,6 +154,7 @@ def pp_lm_loss(
     data_axis: str = "data",
     dropout_rng: jax.Array | None = None,
     uniform: bool = False,
+    use_pallas: bool = False,
 ):
     """Global-mean LM loss under the pipeline wavefront.
 
@@ -176,6 +177,13 @@ def pp_lm_loss(
     instead of skipped with lax.cond — GSPMD-inserted TP collectives must
     execute in lockstep across devices, and divergent cond branches would
     deadlock them (the same constraint as sp_lstm_scan's uniform mode).
+
+    ``use_pallas`` runs each stage-interior recurrence through the fused
+    Pallas kernel (ops/pallas_lstm.py) — legal because a stage's scan
+    contains NO collectives (the only inter-device traffic is the ppermute
+    between ticks), so the kernel sits entirely inside this device's manual
+    shard. Callers must keep it off when "model" is an auto TP axis: GSPMD
+    cannot partition a pallas_call over the sharded hidden dim.
     """
     S = lax.axis_size(pipe_axis)
     s = lax.axis_index(pipe_axis)
@@ -211,11 +219,12 @@ def pp_lm_loss(
     def run_stage(src, rng):
         ys = src  # [b, T, Dmax]
         for i, layer in enumerate(local_layers):
-            _, ys = lstm_scan(
+            _, ys = auto_lstm_scan(
                 layer, ys,
                 compute_dtype=cdtype,
                 remat_chunk=cfg.remat_chunk,
                 unroll=cfg.scan_unroll,
+                use_pallas=use_pallas,
             )
             g = s * n_local + i  # global layer index (traced: s is an
             # axis_index, so gate "not the last layer" with where, not if)
@@ -302,15 +311,20 @@ def make_pp_lm_eval_step(
     S = mesh.shape["pipe"]
     if microbatches is None:
         microbatches = max(S, 1)
+    use_pallas = cfg.use_pallas and not tp
     loss_shard = shard_map(
         lambda p, bt: pp_lm_loss(
             p, bt, cfg, microbatches=microbatches, uniform=tp,
+            use_pallas=use_pallas,
         ),
         mesh=mesh,
         in_specs=(pp_lm_param_specs(params_stacked),
                   {"inputs": P("data"), "targets": P("data")}),
         out_specs=P(),
-        axis_names={"pipe", "data"},
+        # Mosaic refuses a pallas_call inside a PARTIALLY-manual shard_map;
+        # with the fused kernel live (no TP ⇒ "model"/"seq" are size 1) make
+        # every mesh axis manual — semantically identical, Mosaic-legal.
+        axis_names=(set(mesh.axis_names) if use_pallas else {"pipe", "data"}),
         check_vma=False,
     )
 
@@ -371,15 +385,22 @@ def make_pp_lm_train_step(
 
     param_specs = pp_lm_param_specs(params_stacked)
     batch_spec = {"inputs": P("data"), "targets": P("data")}
+    # the auto "model" axis cannot partition a pallas_call, so the fused
+    # stage-interior kernel is PP-only (no TP hybrid)
+    use_pallas = cfg.use_pallas and not tp
     loss_shard = shard_map(
         lambda p, bt, rng: pp_lm_loss(
             p, bt, cfg, microbatches=microbatches, dropout_rng=rng,
             uniform=tp,  # TP collectives need lockstep ticks
+            use_pallas=use_pallas,
         ),
         mesh=mesh,
         in_specs=(param_specs, batch_spec, P()),
         out_specs=P(),
-        axis_names={"pipe", "data"},  # "model" stays auto (GSPMD TP)
+        # "model" stays auto (GSPMD TP) — except with the fused kernel live,
+        # where Mosaic requires a FULLY-manual shard_map; no TP ⇒ the extra
+        # axes are size 1, so making them manual changes nothing semantically
+        axis_names=(set(mesh.axis_names) if use_pallas else {"pipe", "data"}),
         check_vma=False,
     )
 

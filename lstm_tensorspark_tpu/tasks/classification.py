@@ -28,10 +28,7 @@ def run_classifier(args, logger) -> int:
     if data["synthetic"]:
         logger.log({"note": "dataset imdb: using synthetic stand-in"})
     vocab = data["vocab"]
-    if args.use_pallas and args.tensor_parallel > 1:
-        raise SystemExit("--use-pallas is not supported with --tensor-parallel "
-                         "(the GSPMD-sharded hidden dim cannot enter the fused "
-                         "kernel)")
+    # --use-pallas + --tensor-parallel is rejected centrally in cli.main()
     cfg = ClassifierConfig(
         vocab_size=len(vocab),
         num_classes=data["num_classes"],

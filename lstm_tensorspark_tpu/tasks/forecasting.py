@@ -29,10 +29,7 @@ def run_forecaster(args, logger) -> int:
         logger.log({"note": "dataset uci_electricity: using synthetic stand-in"})
     context_len = args.seq_len or 168  # one week of hours
     horizon = 24
-    if args.use_pallas and args.tensor_parallel > 1:
-        raise SystemExit("--use-pallas is not supported with --tensor-parallel "
-                         "(the GSPMD-sharded hidden dim cannot enter the fused "
-                         "kernel)")
+    # --use-pallas + --tensor-parallel is rejected centrally in cli.main()
     cfg = Seq2SeqConfig(
         num_features=data["num_features"],
         hidden_size=args.hidden_units,

@@ -305,3 +305,43 @@ def test_seq2seq_pallas_train_trajectory():
     lr = run(False)
     np.testing.assert_allclose(lp, lr, rtol=2e-3, atol=2e-3)
     assert lp[-1] < lp[0]
+
+
+def test_pp_wavefront_with_pallas_compiles_on_chip():
+    """PP wavefront with fused stage interiors through Mosaic: a pp=1 mesh
+    (one real chip) still runs pp_lm_loss's shard_map + pallas_call
+    composition — the construct the CPU-mesh test can only interpret.
+    Parity against the plain-scan PP step on the same mesh."""
+    from lstm_tensorspark_tpu.models import LMConfig, init_lm
+    from lstm_tensorspark_tpu.parallel import make_mesh
+    from lstm_tensorspark_tpu.parallel.pipeline_parallel import (
+        make_pp_lm_train_step, place_pp_lm_params, stack_lm_params,
+    )
+    from lstm_tensorspark_tpu.train import make_optimizer
+    from lstm_tensorspark_tpu.train.loop import init_train_state
+
+    V, H, B, T = 64, 256, 16, 32
+
+    def run(use_pallas):
+        cfg = LMConfig(vocab_size=V, hidden_size=H, num_layers=2,
+                       use_pallas=use_pallas)
+        opt = make_optimizer("sgd", 0.5)
+        params = init_lm(jax.random.PRNGKey(15), cfg)
+        mesh = make_mesh(dp=1, pp=1)
+        stacked = stack_lm_params(params)
+        placed = place_pp_lm_params(stacked, mesh)
+        step = make_pp_lm_train_step(cfg, opt, mesh, stacked,
+                                     microbatches=2, donate=False)
+        s = init_train_state(placed, opt, jax.random.PRNGKey(16))
+        data = jax.random.randint(jax.random.PRNGKey(17), (B, T + 1), 0, V)
+        batch = {"inputs": data[:, :-1], "targets": data[:, 1:]}
+        losses = []
+        for _ in range(6):
+            s, m = step(s, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    lp = run(True)
+    lr = run(False)
+    np.testing.assert_allclose(lp, lr, rtol=2e-3, atol=2e-3)
+    assert lp[-1] < lp[0]
