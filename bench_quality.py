@@ -1,20 +1,24 @@
 #!/usr/bin/env python
-"""Wall-clock-to-perplexity: the QUALITY half of the north star.
+"""Wall-clock-to-quality: the QUALITY half of the north star, ALL 5 configs.
 
 ``BASELINE.json:metric`` is "seq/sec/chip; wall-clock to reference
-perplexity" — this harness measures the second half for configs 1 and 3:
-train the IDENTICAL config (same synthetic corpus, same seed, same
+perplexity" — this harness measures the second half for every BASELINE.md
+config: train the IDENTICAL config (same synthetic corpus, same seed, same
 hyperparameters) on the TPU and on single-process CPU (the offline stand-in
-for the reference's Spark-CPU executors), log the eval-perplexity curve to
-JSONL, and record the first wall-clock time each run reaches each
-perplexity target.
+for the reference's Spark-CPU executors), log the task's eval-quality curve
+to JSONL, and record the first wall-clock time each run reaches each target.
+
+Per-config quality metric (VERDICT r2 item 2):
+- configs 1/3/5 (LM): eval perplexity, lower is better;
+- config 2 (IMDB bi-LSTM): eval accuracy, higher is better;
+- config 4 (UCI seq2seq): free-running eval MSE, lower is better.
 
 Outputs:
 - ``quality_curves/<config>_<platform>.jsonl`` — full metric curves (the
-  CLI's own JSONL: {"t": seconds, "step", "eval_ppl", ...});
+  CLI's own JSONL: {"t": seconds, "step", <metric>, ...});
 - ``BASELINE_MEASURED.json`` gains a "quality" section:
-  time-to-ppl per config/platform + the TPU speedup at the tightest target
-  both platforms reached.
+  time-to-target per config/platform + the TPU speedup at the tightest
+  target both platforms reached.
 
 Timing honesty: "t" counts from process logger start (includes compile —
 the launch-to-quality number); "t_train" additionally subtracts the time of
@@ -22,8 +26,18 @@ the first logged training record (post-compile steady-state). Both are
 reported. The tunneled-TPU async-queue caveat does not bite here: each eval
 fetches loss values to the host, a true barrier.
 
-Run: ``python bench_quality.py`` (TPU visible; CPU leg runs in a
-subprocess with the platform forced before any device query).
+Each platform runs its FASTEST HONEST configuration of the same model/data/
+optimizer (identical math; trajectories agree to float tolerance): the TPU
+legs add --use-pallas (fused recurrence kernels; no-op fallback on CPU) and
+K-step dispatch batching where the tunnel dispatch would otherwise dominate
+(tests/test_multistep.py proves K-step parity); the CPU legs stay per-step —
+compute-bound, and faithful to the reference's one-Spark-round-per-step.
+NOTE: with --steps-per-call K, --log-every/--eval-every count CALLS
+(train_loop contract), so TPU cadences are pre-divided by K below;
+--num-steps still counts optimizer steps.
+
+Run: ``python bench_quality.py [config ...]`` (TPU visible; CPU leg runs in
+a subprocess with the platform forced before any device query).
 """
 
 from __future__ import annotations
@@ -37,39 +51,69 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 CURVES = os.path.join(_DIR, "quality_curves")
 CACHE = os.path.join(_DIR, "BASELINE_MEASURED.json")
 
-# Perplexity targets scanned from loose to tight; the summary reports the
-# tightest one BOTH platforms reached inside the step budget.
-TARGETS = [12.0, 10.0, 8.0, 6.0, 5.0, 4.5, 4.0, 3.5, 3.0, 2.5, 2.0]
+# Targets are ordered loose → tight; the summary reports the tightest one
+# BOTH platforms reached inside the step budget.
+PPL_TARGETS = [12.0, 10.0, 8.0, 6.0, 5.0, 4.5, 4.0, 3.5, 3.0, 2.5, 2.0]
 
 CONFIGS = {
-    "config1_ptb_char": [
-        "--dataset", "ptb_char", "--hidden-units", "128", "--num-layers", "1",
-        "--batch-size", "64", "--seq-len", "64", "--learning-rate", "1.0",
-        "--num-steps", "800", "--log-every", "50", "--eval-every", "100",
-        "--backend", "single",
-    ],
-    "config3_wikitext2": [
-        "--dataset", "wikitext2", "--hidden-units", "650", "--num-layers", "2",
-        "--batch-size", "64", "--seq-len", "35", "--learning-rate", "1.0",
-        "--num-steps", "400", "--log-every", "25", "--eval-every", "50",
-        "--backend", "single",
-    ],
-}
-
-# Per-platform extras: each platform runs its FASTEST HONEST configuration
-# of the same model/data/optimizer. The tiny config-1 model is host-dispatch
-# bound on the tunneled TPU, so its TPU leg stages the corpus in HBM and
-# batches K steps per dispatch (identical optimizer trajectory —
-# tests/test_multistep.py proves K-step parity); the CPU leg is
-# compute-bound and gains nothing from dispatch batching, so it stays
-# per-step (also faithful to the reference's one-Spark-round-per-step).
-# NOTE: with --steps-per-call K, --log-every/--eval-every count CALLS
-# (train_loop contract), so the cadences below are rescaled by K=25;
-# --num-steps still counts optimizer steps.
-PLATFORM_EXTRA = {
-    ("config1_ptb_char", "tpu"): [
-        "--steps-per-call", "25", "--log-every", "2", "--eval-every", "4",
-    ],
+    "config1_ptb_char": dict(
+        metric="eval_ppl", mode="min", targets=PPL_TARGETS,
+        argv=[
+            "--dataset", "ptb_char", "--hidden-units", "128",
+            "--num-layers", "1", "--batch-size", "64", "--seq-len", "64",
+            "--learning-rate", "1.0", "--num-steps", "800",
+            "--log-every", "50", "--eval-every", "100", "--backend", "single",
+        ],
+        tpu_extra=["--use-pallas", "--steps-per-call", "25",
+                   "--log-every", "2", "--eval-every", "4"],
+    ),
+    "config2_imdb": dict(
+        metric="eval_accuracy", mode="max",
+        targets=[0.70, 0.80, 0.85, 0.90, 0.95],
+        argv=[
+            "--dataset", "imdb", "--hidden-units", "256", "--num-layers", "1",
+            "--batch-size", "64", "--seq-len", "400",
+            "--learning-rate", "0.2", "--num-steps", "100",
+            "--log-every", "10", "--eval-every", "10", "--backend", "single",
+        ],
+        tpu_extra=["--use-pallas", "--steps-per-call", "10",
+                   "--log-every", "1", "--eval-every", "1"],
+    ),
+    "config3_wikitext2": dict(
+        metric="eval_ppl", mode="min", targets=PPL_TARGETS,
+        argv=[
+            "--dataset", "wikitext2", "--hidden-units", "650",
+            "--num-layers", "2", "--batch-size", "64", "--seq-len", "35",
+            "--learning-rate", "1.0", "--num-steps", "400",
+            "--log-every", "25", "--eval-every", "50", "--backend", "single",
+        ],
+        tpu_extra=["--use-pallas", "--steps-per-call", "25",
+                   "--log-every", "1", "--eval-every", "2"],
+    ),
+    "config4_uci": dict(
+        metric="eval_mse", mode="min",
+        targets=[0.5, 0.3, 0.2, 0.15, 0.12, 0.10, 0.08, 0.05],
+        argv=[
+            "--dataset", "uci_electricity", "--hidden-units", "256",
+            "--num-layers", "2", "--batch-size", "64", "--seq-len", "168",
+            "--learning-rate", "0.05", "--num-steps", "150",
+            "--log-every", "15", "--eval-every", "15", "--backend", "single",
+        ],
+        tpu_extra=["--use-pallas", "--steps-per-call", "15",
+                   "--log-every", "1", "--eval-every", "1"],
+    ),
+    "config5_wikitext103": dict(
+        metric="eval_ppl", mode="min", targets=PPL_TARGETS,
+        argv=[
+            "--dataset", "wikitext103", "--hidden-units", "1024",
+            "--num-layers", "4", "--batch-size", "32", "--seq-len", "64",
+            "--learning-rate", "1.0", "--num-steps", "60",
+            "--log-every", "10", "--eval-every", "20",
+            "--eval-batches", "4", "--backend", "single",
+        ],
+        tpu_extra=["--use-pallas", "--steps-per-call", "5",
+                   "--log-every", "2", "--eval-every", "4"],
+    ),
 }
 
 
@@ -79,8 +123,11 @@ def run_leg(name: str, platform: str) -> str:
     jsonl = os.path.join(CURVES, f"{name}_{platform}.jsonl")
     if os.path.exists(jsonl):
         os.remove(jsonl)
-    argv = CONFIGS[name] + PLATFORM_EXTRA.get((name, platform), []) + [
-        "--jsonl", jsonl]
+    spec = CONFIGS[name]
+    argv = list(spec["argv"])
+    if platform == "tpu":
+        argv += spec.get("tpu_extra", [])
+    argv += ["--jsonl", jsonl]
     if platform == "cpu":
         code = (
             "import sys, jax;"
@@ -103,20 +150,25 @@ def run_leg(name: str, platform: str) -> str:
     return jsonl
 
 
-def time_to_targets(jsonl: str) -> dict:
-    """Scan the curve: first wall-clock at/below each perplexity target."""
+def time_to_targets(jsonl: str, metric: str, mode: str, targets) -> dict:
+    """Scan the curve: first wall-clock at/beyond each quality target."""
     evals = []
     first_step_t = None
     for line in open(jsonl):
         r = json.loads(line)
         if first_step_t is None and "loss" in r and "step" in r:
             first_step_t = r["t"]
-        if "eval_ppl" in r:
-            evals.append((r["t"], r["eval_ppl"], r.get("step")))
-    out = {"targets": {}, "final_ppl": evals[-1][1] if evals else None,
+        if metric in r:
+            evals.append((r["t"], r[metric], r.get("step")))
+    out = {"metric": metric, "targets": {},
+           "final": evals[-1][1] if evals else None,
            "first_step_t": first_step_t}
-    for tgt in TARGETS:
-        hit = next((e for e in evals if e[1] <= tgt), None)
+    reached = (
+        (lambda v, tgt: v <= tgt) if mode == "min"
+        else (lambda v, tgt: v >= tgt)
+    )
+    for tgt in targets:
+        hit = next((e for e in evals if reached(e[1], tgt)), None)
         if hit:
             out["targets"][str(tgt)] = {
                 "t": hit[0],
@@ -133,15 +185,18 @@ def main(only: list[str] | None = None) -> int:
         with open(CACHE) as f:
             results = json.load(f).get("quality", {}).get("results", {})
     for name in (only or CONFIGS):
-        results[name] = {}
+        spec = CONFIGS[name]
+        results[name] = {"metric": spec["metric"]}
         for platform in ("tpu", "cpu"):
             print(f"[bench_quality] {name} on {platform} ...", flush=True)
             jsonl = run_leg(name, platform)
-            results[name][platform] = time_to_targets(jsonl)
+            results[name][platform] = time_to_targets(
+                jsonl, spec["metric"], spec["mode"], spec["targets"]
+            )
 
         # tightest target both reached → the headline speedup
         both = [
-            t for t in map(str, TARGETS)
+            t for t in map(str, spec["targets"])
             if t in results[name]["tpu"]["targets"]
             and t in results[name]["cpu"]["targets"]
         ]
@@ -150,7 +205,8 @@ def main(only: list[str] | None = None) -> int:
             tt = results[name]["tpu"]["targets"][tight]
             tc = results[name]["cpu"]["targets"][tight]
             results[name]["summary"] = {
-                "target_ppl": float(tight),
+                "metric": spec["metric"],
+                "target": float(tight),
                 "tpu_seconds": tt["t"],
                 "cpu_seconds": tc["t"],
                 "speedup": round(tc["t"] / tt["t"], 2),
@@ -159,7 +215,7 @@ def main(only: list[str] | None = None) -> int:
                 "speedup_train": round(
                     tc["t_train"] / max(tt["t_train"], 1e-9), 2),
             }
-            print(f"[bench_quality] {name}: ppl<={tight} "
+            print(f"[bench_quality] {name}: {spec['metric']} @ {tight} "
                   f"TPU {tt['t']:.1f}s vs CPU {tc['t']:.1f}s "
                   f"({results[name]['summary']['speedup']}x; "
                   f"post-compile {results[name]['summary']['speedup_train']}x)",
@@ -170,9 +226,10 @@ def main(only: list[str] | None = None) -> int:
         with open(CACHE) as f:
             cache = json.load(f)
     cache["quality"] = {
-        "note": ("wall-clock to perplexity target, identical config+data+"
-                 "seed on TPU vs single-process CPU (Spark-CPU stand-in); "
-                 "t includes compile, t_train is post-compile"),
+        "note": ("wall-clock to quality target (ppl / accuracy / mse per "
+                 "task), identical config+data+seed on TPU vs single-process "
+                 "CPU (Spark-CPU stand-in); t includes compile, t_train is "
+                 "post-compile"),
         "results": results,
     }
     with open(CACHE, "w") as f:

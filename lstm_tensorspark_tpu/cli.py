@@ -93,6 +93,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-steps", type=int, default=None,
                    help="total step budget for the job, resume-inclusive (overrides epochs)")
     p.add_argument("--eval-every", type=int, default=0)
+    p.add_argument("--eval-batches", type=int, default=None,
+                   help="cap each eval pass at N batches (default: the full "
+                        "held-out split) — bounds eval cost at large dims")
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--jsonl", type=str, default=None, help="metrics JSONL path")
@@ -129,6 +132,8 @@ def main(argv=None) -> int:
         raise SystemExit(f"--top-k must be >= 1, got {args.top_k}")
     if args.top_p is not None and not 0.0 < args.top_p <= 1.0:
         raise SystemExit(f"--top-p must be in (0, 1], got {args.top_p}")
+    if args.eval_batches is not None and args.eval_batches < 1:
+        raise SystemExit(f"--eval-batches must be >= 1, got {args.eval_batches}")
     # one shared gate for every task runner: the fused kernel cannot run on
     # a "model"-axis-sharded hidden dim (GSPMD cannot partition pallas_call);
     # it DOES compose with --pipeline-stages (collective-free stage interiors)
@@ -484,6 +489,9 @@ def _run_lm(args, logger) -> int:
 
     train_tokens, valid_tokens = data["train"], data["valid"]
     steps_per_epoch = max((len(train_tokens) - 1) // (args.batch_size * seq_len), 1)
+    # data-exact resume: fast-forward every stream to the restored step so a
+    # resumed run sees exactly the windows the uninterrupted run would
+    start_step = int(state.step)
     if args.device_data:
         if args.prefetch:
             raise SystemExit("--device-data has no host feed; drop --prefetch")
@@ -507,9 +515,11 @@ def _run_lm(args, logger) -> int:
                 stateful=stateful, grad_accum=args.grad_accum,
             )
         train_step = lambda state, w0: dstep(state, ddata.arrays, w0)  # noqa: E731
-        batches = window_index_stream(ddata, k)
+        batches = window_index_stream(ddata, k, start_step=start_step)
     else:
-        batches = wrap_stream(lm_batch_stream(train_tokens, args.batch_size, seq_len))
+        batches = wrap_stream(lm_batch_stream(
+            train_tokens, args.batch_size, seq_len, start_step=start_step
+        ))
 
     if mesh is None:
         eval_step = make_eval_step(loss_fn, stateful=stateful)
@@ -524,7 +534,10 @@ def _run_lm(args, logger) -> int:
     def eval_fn(params):
         if eval_bs <= 0:
             return {"eval_skipped": 1}
-        ev = lm_epoch_batches(valid_tokens, eval_bs, seq_len)
+        from .data.batching import cap_batches
+
+        ev = cap_batches(lm_epoch_batches(valid_tokens, eval_bs, seq_len),
+                         args.eval_batches)
         ev_carries = init_carries(cfg, eval_bs) if stateful else None
         if mesh is not None:
             ev = (shard_batch(b, mesh) for b in ev)
@@ -698,12 +711,17 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     def eval_fn(params_dev):
         if eval_bs <= 0:
             return {"eval_skipped": 1}
-        ev = lm_epoch_batches(valid_tokens, eval_bs, seq_len)
+        from .data.batching import cap_batches
+
+        ev = cap_batches(lm_epoch_batches(valid_tokens, eval_bs, seq_len),
+                         args.eval_batches)
         return evaluate(eval_step, params_dev, ev)
 
     train_tokens = data["train"]
     steps_per_epoch = max((len(train_tokens) - 1) // (args.batch_size * seq_len), 1)
-    batches = lm_batch_stream(train_tokens, args.batch_size, seq_len)
+    # data-exact resume (same contract as _run_lm's streams)
+    batches = lm_batch_stream(train_tokens, args.batch_size, seq_len,
+                              start_step=int(state.step))
 
     logger.log({
         "note": "start", "dataset": args.dataset, "vocab": cfg.vocab_size,

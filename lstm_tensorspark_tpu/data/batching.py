@@ -14,9 +14,31 @@ fixed-unroll truncated-BPTT equivalent (SURVEY.md §5 "Long-context" row).
 
 from __future__ import annotations
 
+import itertools
 from typing import Iterator
 
 import numpy as np
+
+
+def epoch_stream(epoch_fn, *, steps_per_epoch: int, start_step: int = 0):
+    """Endless epochs of ``epoch_fn(epoch)`` batches with data-exact resume:
+    the epoch index (and therefore any per-epoch shuffle seed inside
+    ``epoch_fn``) and the in-epoch offset follow ``start_step`` — shared by
+    the classifier and forecaster task runners."""
+    epoch, skip = divmod(start_step, steps_per_epoch) if start_step else (0, 0)
+    while True:
+        it = epoch_fn(epoch)
+        if skip:
+            it = itertools.islice(it, skip, None)
+            skip = 0
+        yield from it
+        epoch += 1
+
+
+def cap_batches(batches, n: int | None):
+    """First ``n`` batches when set (the --eval-batches cost bound), else
+    the full stream."""
+    return itertools.islice(batches, n) if n else batches
 
 
 def lm_windows(tokens: np.ndarray, batch_size: int, seq_len: int):
@@ -57,11 +79,25 @@ def lm_batch_stream(
     seq_len: int,
     *,
     num_epochs: int | None = None,
+    start_step: int = 0,
 ) -> Iterator[dict]:
-    """Repeat epochs (forever if num_epochs is None)."""
-    epoch = 0
+    """Repeat epochs (forever if num_epochs is None).
+
+    ``start_step`` fast-forwards the stream to the window a resumed run
+    would be at (data-exact resume: each optimizer step consumes one
+    window; epochs are identical — no shuffle — so only the in-epoch
+    offset matters, and skipped epochs still count toward ``num_epochs``).
+    """
+    epoch, skip = 0, 0
+    if start_step:
+        _, _, n_windows = lm_windows(tokens, batch_size, seq_len)
+        epoch, skip = divmod(start_step, n_windows)
     while num_epochs is None or epoch < num_epochs:
-        yield from lm_epoch_batches(tokens, batch_size, seq_len)
+        it = lm_epoch_batches(tokens, batch_size, seq_len)
+        if skip:
+            it = itertools.islice(it, skip, None)
+            skip = 0
+        yield from it
         epoch += 1
 
 
@@ -108,19 +144,31 @@ def forecast_starts(
     return starts
 
 
-def index_groups(order_fn, batch_size: int, steps_per_call: int) -> Iterator[np.ndarray]:
+def index_groups(order_fn, batch_size: int, steps_per_call: int,
+                 *, start_step: int = 0) -> Iterator[np.ndarray]:
     """Epochs of index batches packed into [K, B] dispatch groups — the
     index-stream sibling of `stacked_batches`. ``order_fn(epoch)`` returns
     that epoch's 1-D index order; full batches only (host-path parity),
-    partial K-groups carry over into the next epoch."""
-    epoch, group = 0, []
+    partial K-groups carry over into the next epoch.
+
+    ``start_step`` fast-forwards to the batch a resumed run would be at:
+    the epoch index advances (so ``order_fn``'s per-epoch shuffle seed
+    matches the uninterrupted run) and the in-epoch batches already
+    consumed are skipped — data-exact resume."""
+    epoch, group, skip = 0, [], 0
+    if start_step:
+        per_epoch = max(len(order_fn(0)) // batch_size, 0)
+        if per_epoch:
+            epoch, skip = divmod(start_step, per_epoch)
     while True:
         order = order_fn(epoch)
-        for b0 in range(0, len(order) - batch_size + 1, batch_size):
+        for b0 in range(skip * batch_size, len(order) - batch_size + 1,
+                        batch_size):
             group.append(order[b0 : b0 + batch_size].astype(np.int32))
             if len(group) == steps_per_call:
                 yield np.stack(group)
                 group = []
+        skip = 0
         epoch += 1
 
 

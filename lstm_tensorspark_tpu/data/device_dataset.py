@@ -94,11 +94,13 @@ def slice_window(arrays: dict, w: jax.Array, seq_len: int) -> dict:
     }
 
 
-def window_index_stream(data: DeviceLMData, steps_per_call: int):
+def window_index_stream(data: DeviceLMData, steps_per_call: int,
+                        *, start_step: int = 0):
     """Host-side iterator of starting window indices, one per K-step dispatch
     (the entire per-call feed). Wraps around epochs forever, matching
-    `lm_batch_stream`'s ordering."""
-    w = 0
+    `lm_batch_stream`'s ordering. ``start_step`` fast-forwards to the window
+    a resumed run would be at (data-exact resume)."""
+    w = start_step % data.n_windows
     while True:
         yield np.int32(w)
         w = (w + steps_per_call) % data.n_windows

@@ -82,6 +82,10 @@ def run_classifier(args, logger) -> int:
             f"train set too small: {len(train_seqs)} examples < batch {args.batch_size}"
         )
     steps_per_epoch = max(len(train_seqs) // args.batch_size, 1)
+    # data-exact resume: epoch seeds and in-epoch offsets follow the
+    # restored step, so the resumed shuffle order matches the
+    # uninterrupted run exactly
+    start_step = int(state.step)
 
     if getattr(args, "device_data", False):
         # HBM-staged padded example matrix; batches gathered on-device by
@@ -131,28 +135,33 @@ def run_classifier(args, logger) -> int:
             lambda epoch: example_order(
                 lengths_all, shuffle_seed=args.seed + epoch
             ),
-            args.batch_size, k,
+            args.batch_size, k, start_step=start_step,
         )
     else:
-        def batches():
-            epoch = 0
-            while True:
-                yield from padded_batches(
-                    train_seqs, train_labels, args.batch_size, max_len,
-                    shuffle_seed=args.seed + epoch,
-                )
-                epoch += 1
+        from ..data.batching import epoch_stream
 
-        stream = wrap_stream(batches())
+        stream = wrap_stream(epoch_stream(
+            lambda epoch: padded_batches(
+                train_seqs, train_labels, args.batch_size, max_len,
+                shuffle_seed=args.seed + epoch,
+            ),
+            steps_per_epoch=steps_per_epoch, start_step=start_step,
+        ))
     eval_step = jax.jit(lambda p, b: classifier_loss(p, b, cfg)[1])
 
     def eval_fn(params):
         if not valid_seqs:
             return {"eval_skipped": 1}
+        from ..data.batching import cap_batches
+
         tot_w = tot_loss = tot_acc = 0.0
         eval_bs = min(args.batch_size, len(valid_seqs))
-        for b in padded_batches(valid_seqs, valid_labels, eval_bs, max_len,
-                                drop_remainder=False):
+        ev = cap_batches(
+            padded_batches(valid_seqs, valid_labels, eval_bs, max_len,
+                           drop_remainder=False),
+            getattr(args, "eval_batches", None),
+        )
+        for b in ev:
             m = eval_step(params, b)
             w = float(b["valid"].sum())
             tot_loss += float(m["loss"]) * w

@@ -78,6 +78,9 @@ def run_forecaster(args, logger) -> int:
             f"train series too short: {n_windows} windows < batch {args.batch_size}"
         )
     steps_per_epoch = max(n_windows // args.batch_size, 1)
+    # data-exact resume: epoch seeds and in-epoch offsets follow the
+    # restored step (same contract as the classifier runner)
+    start_step = int(state.step)
 
     if getattr(args, "device_data", False):
         # HBM-staged series; (context, horizon) windows sliced on-device from
@@ -114,19 +117,18 @@ def run_forecaster(args, logger) -> int:
             lambda epoch: forecast_starts(
                 staged.num_windows, shuffle_seed=args.seed + epoch
             ),
-            args.batch_size, k,
+            args.batch_size, k, start_step=start_step,
         )
     else:
-        def batches():
-            epoch = 0
-            while True:
-                yield from forecast_windows(
-                    train_series, context_len, horizon, args.batch_size,
-                    shuffle_seed=args.seed + epoch,
-                )
-                epoch += 1
+        from ..data.batching import epoch_stream
 
-        stream = wrap_stream(batches())
+        stream = wrap_stream(epoch_stream(
+            lambda epoch: forecast_windows(
+                train_series, context_len, horizon, args.batch_size,
+                shuffle_seed=args.seed + epoch,
+            ),
+            steps_per_epoch=steps_per_epoch, start_step=start_step,
+        ))
     fc = jax.jit(lambda p, ctx: forecast(p, ctx, cfg))
 
     def eval_fn(params):
@@ -134,10 +136,16 @@ def run_forecaster(args, logger) -> int:
         weighted by valid rows (filler rows in the last batch excluded)."""
         if len(valid_series) < context_len + horizon:
             return {"eval_skipped": 1}
+        from ..data.batching import cap_batches
+
         tot_n = tot_mse = tot_mae = 0.0
         eval_bs = min(args.batch_size, 64)
-        for b in forecast_windows(valid_series, context_len, horizon, eval_bs,
-                                  drop_remainder=False):
+        ev = cap_batches(
+            forecast_windows(valid_series, context_len, horizon, eval_bs,
+                             drop_remainder=False),
+            getattr(args, "eval_batches", None),
+        )
+        for b in ev:
             preds = np.asarray(fc(params, b["context"]))
             err = (preds - b["targets"])[b["valid"]]
             n = b["valid"].sum()
