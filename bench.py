@@ -199,13 +199,19 @@ def _rand_batch(kind: str, c: dict, key):
     raise ValueError(kind)
 
 
-def measure_config(name: str, *, steps: int = 64, warmup: int = 8,
-                   steps_per_call: int = 8, reps: int = 2) -> dict:
+def measure_config(name: str, *, warmup: int = 64,
+                   steps_per_call: int = 32, reps: int = 2) -> dict:
     """Throughput + MFU for one named config at real model dimensions.
 
     The K-stacked synthetic batch is staged on device ONCE and re-fed every
     dispatch (throughput measurement — the data values don't change the
-    compute). Returns the BENCH_TABLE.json record."""
+    compute). Returns the BENCH_TABLE.json record.
+
+    Rep length is SELF-CALIBRATING: this environment's tunneled backend has
+    ~65 ms fixed fetch latency plus ~0.2 ms per queued dispatch (measured),
+    which at a fixed 64-step rep contaminated small configs by up to
+    1 ms/step. A short probe separates fixed vs per-call cost, then the
+    timed rep is sized so the fixed cost is <5% of the measurement."""
     import jax
     import jax.numpy as jnp
 
@@ -267,10 +273,24 @@ def measure_config(name: str, *, steps: int = 64, warmup: int = 8,
     )
     stacked = jax.device_put(stacked)  # staged once, outside the timed loop
 
-    calls, warm_calls = max(steps // kk, 1), max(warmup // kk, 1)
-    for _ in range(warm_calls):
+    for _ in range(max(warmup // kk, 1)):
         state, m = step(state, stacked)
     float(m["loss"])  # true barrier (tunneled-TPU honesty)
+
+    def probe(k):
+        nonlocal state, m
+        for _ in range(k):
+            state, m = step(state, stacked)
+        float(m["loss"])
+
+    fixed, per_call = _two_point(probe, 8)
+    if per_call is None:  # every probe rep collapsed: be conservative
+        fixed, per_call = 0.065, 0.05
+    # rep long enough that the fixed cost is <5%, bounded in wall time so a
+    # mis-probe can never turn one config into a multi-minute runaway
+    calls = int(min(max(20.0 * fixed / per_call, 8), 3000,
+                    10.0 / per_call + 1))
+
     best = 0.0
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -284,6 +304,7 @@ def measure_config(name: str, *, steps: int = 64, warmup: int = 8,
     tflops = best * train_flops_step / 1e12
     rec = {
         "kind": kind,
+        "train_flops_step": train_flops_step,
         "dims": {k: v for k, v in c.items() if k != "kind"},
         "seq_per_sec": round(best * B_, 2),
         "tokens_per_sec": round(best * tokens_per_step, 1),
@@ -294,6 +315,114 @@ def measure_config(name: str, *, steps: int = 64, warmup: int = 8,
         "note": "real model dims, synthetic data; train FLOPs = 3x fwd matmuls",
     }
     return rec
+
+
+def _two_point(run, n: int, reps: int = 3):
+    """Split the tunnel's fixed dispatch+fetch latency from real per-call
+    cost: ``t1 = fixed + d``, ``tn = fixed + n*d`` ⇒ ``d = (tn-t1)/(n-1)``.
+
+    ``run(k)`` must execute k queued dispatches then fetch one value. The
+    difference estimator is noise-sensitive (fixed-latency jitter can rival
+    the signal), so each probe repeats ``reps`` times, reps where the
+    difference collapses (tn <= t1: a latency spike ate the signal) are
+    REJECTED, and the MEDIAN d wins — min-of-reps would select the
+    worst-case underestimate. Returns (fixed, d), or (None, None) when
+    every rep collapsed (caller must treat the probe as failed)."""
+    pairs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run(1)
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run(n)
+        tn = time.perf_counter() - t0
+        if tn > t1:
+            pairs.append(((tn - t1) / (n - 1), t1))
+    if not pairs:
+        return None, None
+    d = sorted(p[0] for p in pairs)[len(pairs) // 2]
+    t1_med = sorted(p[1] for p in pairs)[len(pairs) // 2]
+    return max(t1_med - d, 0.0), d
+
+
+def measure_roofline(name: str, *, chains: int = 256, reps: int = 3) -> dict:
+    """Sequential-recurrence roofline for one config (VERDICT r2 item 4).
+
+    An LSTM train step cannot beat its DEPENDENT chain: T forward steps of
+    ``h @ U`` + gates, then the T-step cotangent chain backward — no batching
+    or fusion removes that serialization. The bound is built from MEASURED
+    latency, not FLOPs: ``chain_sec`` times the fastest implementation we
+    have of the full gated chain (the fused Pallas forward at this config's
+    local (B, H, T_chain)), k-chained hT→h0 inside ONE jitted fori_loop so
+    the tunnel dispatch amortises away. Then
+
+        bound_sec = 2*chain_sec                (fwd chain + bwd chain)
+                  + (train_flops - 3*chain_flops) / peak   (everything else,
+                    assumed perfectly parallel — other layers/directions
+                    COULD overlap the chain, so the bound is a true floor)
+
+    and ``fraction_of_bound = bound_sec / measured_sec_per_step``: 1.0 means
+    the step runs AT the recurrence bound — the remaining MFU gap is the
+    serial chain's arithmetic-intensity floor, not implementation slack.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from lstm_tensorspark_tpu.ops import init_lstm_params
+    from lstm_tensorspark_tpu.ops.pallas_lstm import pallas_lstm_scan, supported
+
+    c = CONFIGS[name]
+    B_, H_ = c["B"], c["H"]
+    kind = c["kind"]
+    # critical-path length: layers/directions can pipeline (path T + L - 1
+    # ≈ T); the seq2seq decoder chain EXTENDS the encoder's (dependent)
+    T_chain = c["T"] + (c["horizon"] if kind == "seq2seq" else 0)
+    if not supported(B_, H_):
+        return {"error": f"no fused kernel plan for B={B_}, H={H_}"}
+
+    D = 32  # input width is irrelevant to the chain; keep xproj tiny
+    params = init_lstm_params(jax.random.PRNGKey(0), D, H_)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (B_, T_chain, D))
+
+    def chained(params, xs, h0, c0):
+        def body(_, carry):
+            (hT, cT), _ys = pallas_lstm_scan(
+                params, xs, carry, compute_dtype=jnp.bfloat16
+            )
+            return (hT, cT)
+        hT, cT = lax.fori_loop(0, chains, body, (h0, c0))
+        return hT, cT, jnp.sum(hT)  # sum on-device: ONE tiny fetch suffices
+
+    h0 = jnp.zeros((B_, H_), jnp.float32)
+    c0 = jnp.zeros((B_, H_), jnp.float32)
+    run = jax.jit(chained)
+    # The tunneled backend has ~65 ms FIXED dispatch+fetch latency — orders
+    # above a chain's real cost, and it poisons naive division (measured:
+    # it made a 14 µs chain read as 270 µs). `_two_point` removes it with
+    # median-robust calibration; `chains` is large enough per dispatch that
+    # the ~0.2 ms queue overhead per dispatch is <5% of the signal.
+    hT, cT, s = run(params, xs, h0, c0)
+    float(s)  # warm + true barrier (tunneled-TPU honesty)
+
+    def probe(k):
+        out = None
+        for _ in range(k):
+            out = run(params, xs, h0, c0)
+        float(out[2])
+
+    _, d = _two_point(probe, 16, reps=reps)
+    if d is None:
+        return {"error": "calibration collapsed (tunnel latency jitter ate "
+                         "the signal in every probe rep)"}
+    chain_sec = d / chains
+    chain_flops = 8.0 * B_ * H_ * H_ * T_chain  # the chain's h@U matmuls
+    return {
+        "chain": {"B": B_, "H": H_, "T": T_chain},
+        "chain_sec": chain_sec,
+        "per_step_latency_us": round(chain_sec / T_chain * 1e6, 3),
+        "chain_flops": chain_flops,
+    }
 
 
 def measure_pp_config5(*, steps: int = 48, warmup: int = 8) -> dict:
@@ -403,6 +532,25 @@ def main() -> int:
             rec = measure_config(name)
         except Exception as e:  # a config failing must not kill the headline
             rec = {"error": f"{type(e).__name__}: {e}"}
+        if "error" not in rec:
+            # sequential-recurrence roofline: is the residual MFU gap
+            # implementation slack or the chain's latency floor?
+            try:
+                rl = measure_roofline(name)
+            except Exception as e:
+                rl = {"error": f"{type(e).__name__}: {e}"}
+            if "error" not in rl:
+                measured = CONFIGS[name]["B"] / rec["seq_per_sec"]  # s/step
+                parallel = max(
+                    rec["train_flops_step"] - 3.0 * rl["chain_flops"], 0.0
+                ) / (PEAK_TFLOPS * 1e12)
+                bound = 2.0 * rl["chain_sec"] + parallel
+                rl.update(
+                    measured_sec_per_step=round(measured, 6),
+                    bound_sec_per_step=round(bound, 6),
+                    fraction_of_bound=round(bound / measured, 4),
+                )
+            rec["roofline"] = rl
         table[name] = rec
         if "error" not in rec:
             compact[name] = {
@@ -410,6 +558,7 @@ def main() -> int:
                 "tok_s": rec["tokens_per_sec"],
                 "tflops": rec["model_tflops_per_sec"],
                 "mfu": rec["mfu_vs_bf16_peak"],
+                "bound_frac": rec["roofline"].get("fraction_of_bound"),
             }
         else:
             compact[name] = rec
