@@ -88,6 +88,12 @@ _LANE = 128
 # memory/speed trade ADVICE.md flagged, now an explicit heuristic
 # (override with LSTM_TSP_RESIDUAL_HBM_MB).
 _RESIDUAL_HBM_BUDGET = int(os.environ.get("LSTM_TSP_RESIDUAL_HBM_MB", 4096)) * 2**20
+# The fully-fused residentx strategy trades the [T,B,4H] xproj/z HBM
+# round-trips for in-kernel projection matmuls serialized with the chain.
+# Measured on v5e: +28% at T=400 (config 2), −3% at T=64..192 (configs
+# 1/4) — the traffic saved scales with T while the serialization cost is
+# per-step. Only prefer it for long sequences (tests override to 0).
+_FUSEDX_MIN_T = 256
 
 
 def _pad_to_lane(h: int) -> int:
@@ -99,6 +105,52 @@ def _pad_to_lane(h: int) -> int:
 # four functions; there is no second, implicit accounting (ADVICE.md #1).
 # Streamed blocks are counted ×2 for the pipeline's double-buffering.
 # ---------------------------------------------------------------------------
+
+
+def _residentx_fwd_vmem(B: int, H: int, Dp: int, pbytes: int,
+                        save_c: bool, has_mask: bool = False,
+                        c: int = 8) -> int:
+    """Fully-fused resident forward: W AND U live in VMEM, the input
+    projection happens in-kernel (one chunk-batched MXU matmul per grid
+    step), and nothing but ys/cs ever leaves — the [T,B,4H] xproj and z
+    arrays the hoisted variants round-trip through HBM do not exist.
+    ``c`` is the time chunk — the planner shrinks it when the streamed
+    blocks would not fit at 8."""
+    v = 4 * H * H * pbytes  # U resident
+    v += Dp * 4 * H * pbytes  # W resident
+    v += 4 * H * 4  # bias
+    v += 2 * c * B * Dp * 4  # xs blocks (double-buffered)
+    v += c * B * 4 * H * 4  # in-kernel zx chunk (live value)
+    v += 2 * c * B * H * 4  # ys out blocks
+    v += 6 * B * H * 4  # h0/c0 in, hT/cT out, h/c scratch
+    if has_mask:
+        v += 2 * c * B * _LANE * 4  # mask blocks
+    if save_c:
+        v += 2 * c * B * H * 4  # cs out blocks (the ONLY residual)
+    return v
+
+
+def _residentx_bwd_vmem(B: int, H: int, Dp: int, pbytes: int,
+                        has_mask: bool = False, c: int = 8) -> int:
+    """Recompute-z fused BPTT: z_t is rebuilt in-kernel from the streamed
+    xs/h_prev (W, U resident) instead of being read back from HBM — the
+    forward never saved it. ``c`` as in `_residentx_fwd_vmem`."""
+    streamed = (
+        c * B * Dp * 4  # xs blocks
+        + c * B * 4 * H * 4  # dz out blocks
+        + c * B * H * 4 * 3  # dys/c_prev/h_prev blocks
+    )
+    if has_mask:
+        streamed += c * B * _LANE * 4  # mask blocks
+    return (
+        2 * 4 * H * H * pbytes  # U (z recompute) + U^T (dh carry) resident
+        + Dp * 4 * H * pbytes  # W resident
+        + 4 * H * 4  # bias
+        + c * B * 4 * H * 4  # in-kernel zx chunk (live value)
+        + 2 * 4 * H * H * 4  # dU: f32 scratch + output block
+        + streamed * 2  # double-buffered pipelining
+        + 4 * B * H * 4  # dh/dc scratch + dh0/dc0 out
+    )
 
 
 def _resident_fwd_vmem(B: int, H: int, pbytes: int, save_residuals: bool,
@@ -164,10 +216,19 @@ def _tiled_bwd_vmem(B: int, H: int, pbytes: int, ttile: int,
 
 
 def _plan_fwd(B: int, H: int, pbytes: int, *, save_residuals: bool,
-              has_mask: bool = False) -> tuple[str, int] | None:
+              has_mask: bool = False,
+              Dp: int | None = None) -> tuple[str, int] | None:
     """(strategy, htile) for the forward kernel at PADDED hidden size H,
-    or None when nothing fits. Prefers the resident kernel (least HBM
-    traffic), then the largest feasible U row-tile."""
+    or None when nothing fits. Preference order = least HBM traffic:
+    fully-fused residentx (needs the padded input width ``Dp``; with
+    residuals it saves cs ONLY — callers must pair it with the residentx
+    backward), then hoisted-projection resident, then the largest feasible
+    U row-tile."""
+    if Dp is not None:
+        for c in (8, 4, 2, 1):
+            if _residentx_fwd_vmem(B, H, Dp, pbytes, save_residuals,
+                                   has_mask, c) <= _VMEM_BUDGET:
+                return ("residentx", c)
     if _resident_fwd_vmem(B, H, pbytes, save_residuals, has_mask) <= _VMEM_BUDGET:
         return ("resident", 0)
     for htile in (512, 256, 128):
@@ -177,10 +238,18 @@ def _plan_fwd(B: int, H: int, pbytes: int, *, save_residuals: bool,
     return None
 
 
-def _plan_bwd(B: int, H: int, pbytes: int,
-              has_mask: bool = False) -> tuple[str, int] | None:
+def _plan_bwd(B: int, H: int, pbytes: int, has_mask: bool = False,
+              Dp: int | None = None) -> tuple[str, int] | None:
     """(strategy, ttile) for the fused backward kernel, or None → recompute
-    fallback. ttile tiles U^T's leading (4H) dim."""
+    fallback. ttile tiles U^T's leading (4H) dim. The residentx strategy
+    (recompute-z) is only offered when the matching residentx FORWARD also
+    fits — its cs-only residual contract requires the pair."""
+    if Dp is not None and _residentx_fwd_vmem(
+            B, H, Dp, pbytes, True, has_mask, 1) <= _VMEM_BUDGET:
+        for c in (8, 4, 2, 1):
+            if _residentx_bwd_vmem(B, H, Dp, pbytes, has_mask,
+                                   c) <= _VMEM_BUDGET:
+                return ("residentx", c)
     if _resident_bwd_vmem(B, H, pbytes, has_mask) <= _VMEM_BUDGET:
         return ("resident", 0)
     for ttile in (1024, 512, 256, 128):
@@ -190,7 +259,9 @@ def _plan_bwd(B: int, H: int, pbytes: int,
     return None
 
 
-def _residual_bytes(T: int, B: int, H: int) -> int:
+def _residual_bytes(T: int, B: int, H: int, bwd_strategy: str = "resident") -> int:
+    if bwd_strategy == "residentx":
+        return T * B * H * 4  # cs only (z recomputed in-kernel)
     return T * B * 5 * H * 4  # z [T,B,4H] + cs [T,B,H], both f32
 
 
@@ -220,6 +291,154 @@ def supported(
         and _plan_fwd(batch, hp, param_dtype_bytes,
                       save_residuals=False, has_mask=has_mask) is not None
     )
+
+
+# ---------------------------------------------------------------------------
+# Fully-fused resident kernels (W AND U in VMEM; xproj in-kernel; the
+# backward RECOMPUTES z — neither xproj nor z ever exists in HBM)
+# ---------------------------------------------------------------------------
+
+
+def _lstm_fwdx_kernel(*refs, hidden: int, dpad: int, chunk: int,
+                      save_c: bool, has_mask: bool):
+    """Fully-fused forward: per grid step, ONE chunk-batched MXU matmul
+    ``[C·B, Dp] @ [Dp, 4H]`` projects the whole chunk's inputs into a live
+    VMEM value, then the sequential sub-steps add ``h @ U`` and the gates.
+    With ``save_c`` only the cell states stream out (the residentx
+    backward's sole residual); z is never materialised."""
+    n_in = 6 + has_mask
+    xs_ref, w_ref, b_ref, u_ref, h0_ref, c0_ref = refs[:6]
+    mask_ref = refs[6] if has_mask else None
+    ys_ref, hT_ref, cT_ref = refs[n_in:n_in + 3]
+    rest = refs[n_in + 3:]
+    if save_c:
+        cs_ref, h_scr, c_scr = rest
+    else:
+        h_scr, c_scr = rest
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+    H = hidden
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    zx = jnp.dot(
+        xs_ref[:].reshape(-1, dpad).astype(w_ref.dtype), w_ref[:],
+        preferred_element_type=jnp.float32,
+    ) + b_ref[:]
+    zx = zx.reshape(chunk, -1, 4 * H)
+    h = h_scr[:]
+    c = c_scr[:]
+    for s in range(chunk):
+        z = zx[s] + jnp.dot(
+            h.astype(u_ref.dtype), u_ref[:], preferred_element_type=jnp.float32
+        )
+        i = jax.nn.sigmoid(z[:, :H])
+        f = jax.nn.sigmoid(z[:, H : 2 * H])
+        g = jnp.tanh(z[:, 2 * H : 3 * H])
+        o = jax.nn.sigmoid(z[:, 3 * H :])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        if has_mask:
+            m = mask_ref[s][:, :1]
+            c = m * c_new + (1.0 - m) * c
+            h = m * h_new + (1.0 - m) * h
+        else:
+            c = c_new
+            h = h_new
+        ys_ref[s] = h
+        if save_c:
+            cs_ref[s] = c
+    h_scr[:] = h
+    c_scr[:] = c
+
+    @pl.when(t == T - 1)
+    def _():
+        hT_ref[:] = h
+        cT_ref[:] = c
+
+
+def _lstm_bwdx_kernel(*refs, hidden: int, dpad: int, chunk: int,
+                      has_mask: bool):
+    """Recompute-z fused BPTT: the forward saved ONLY the cell states; this
+    kernel rebuilds ``z_t = x_t@W + b + h_{t-1}@U`` in-kernel (chunk-batched
+    x@W, per-step h_prev@U — bit-identical to the forward's f32 values) and
+    runs the same reverse cotangent algebra as `_lstm_bwd_kernel`. Costs one
+    extra matmul per step; deletes the [T,B,4H] z round-trip entirely."""
+    n_in = 10 + has_mask
+    xs_ref, dys_ref, cprev_ref, hprev_ref = refs[:4]
+    mask_ref = refs[4] if has_mask else None
+    w_ref, b_ref, u_ref, ut_ref, dhT_ref, dcT_ref = refs[4 + has_mask:n_in]
+    dz_ref, du_ref, dh0_ref, dc0_ref = refs[n_in:n_in + 4]
+    dh_scr, dc_scr, du_scr = refs[n_in + 4:]
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+    H = hidden
+
+    @pl.when(t == 0)
+    def _():
+        dh_scr[:] = dhT_ref[:]
+        dc_scr[:] = dcT_ref[:]
+        du_scr[:] = jnp.zeros_like(du_scr)
+
+    zx = jnp.dot(
+        xs_ref[:].reshape(-1, dpad).astype(w_ref.dtype), w_ref[:],
+        preferred_element_type=jnp.float32,
+    ) + b_ref[:]
+    zx = zx.reshape(chunk, -1, 4 * H)
+    dh = dh_scr[:]
+    dc = dc_scr[:]
+    du = du_scr[:]
+    for s in range(chunk - 1, -1, -1):
+        z = zx[s] + jnp.dot(
+            hprev_ref[s].astype(u_ref.dtype), u_ref[:],
+            preferred_element_type=jnp.float32,
+        )
+        i = jax.nn.sigmoid(z[:, :H])
+        f = jax.nn.sigmoid(z[:, H : 2 * H])
+        g = jnp.tanh(z[:, 2 * H : 3 * H])
+        o = jax.nn.sigmoid(z[:, 3 * H :])
+        c_prev = cprev_ref[s]
+        tc = jnp.tanh(f * c_prev + i * g)  # tanh(c_new), recomputed
+        dh_tot = dh + dys_ref[s]
+        dc_in = dc
+        if has_mask:
+            m = mask_ref[s][:, :1]
+            dh_eff = m * dh_tot
+            dc_eff = m * dc_in
+        else:
+            dh_eff = dh_tot
+            dc_eff = dc_in
+        dc_new = dc_eff + dh_eff * o * (1.0 - tc * tc)
+        do = dh_eff * tc * o * (1.0 - o)
+        di = dc_new * g * i * (1.0 - i)
+        df = dc_new * c_prev * f * (1.0 - f)
+        dg = dc_new * i * (1.0 - g * g)
+        dz = jnp.concatenate([di, df, dg, do], axis=1)  # [B, 4H] f32
+        dz_ref[s] = dz
+        dz_c = dz.astype(ut_ref.dtype)
+        du = du + jax.lax.dot_general(
+            hprev_ref[s].astype(ut_ref.dtype), dz_c,
+            (((0,), (0,)), ((), ())),  # contract batch -> [H, 4H]
+            preferred_element_type=jnp.float32,
+        )
+        dh = jnp.dot(dz_c, ut_ref[:], preferred_element_type=jnp.float32)
+        dc = dc_new * f
+        if has_mask:
+            # frozen fraction of the cotangents bypasses the gates
+            dh = dh + (1.0 - m) * dh_tot
+            dc = dc + (1.0 - m) * dc_in
+    dh_scr[:] = dh
+    dc_scr[:] = dc
+    du_scr[:] = du
+
+    @pl.when(t == T - 1)
+    def _():
+        dh0_ref[:] = dh
+        dc0_ref[:] = dc
+        du_ref[:] = du
 
 
 # ---------------------------------------------------------------------------
@@ -288,12 +507,17 @@ def _lstm_kernel(*refs, hidden: int, chunk: int, save_residuals: bool,
         cT_ref[:] = c
 
 
-def _time_chunk(T: int) -> int:
-    """Largest chunk (≤8) dividing T — python-unrolled inside the kernel."""
+def _chunk_for(T: int, cap: int) -> int:
+    """Largest chunk ≤ the planner's VMEM-feasible cap that divides T."""
     for c in (8, 4, 2):
-        if T % c == 0:
+        if c <= cap and T % c == 0:
             return c
     return 1
+
+
+def _time_chunk(T: int) -> int:
+    """Largest chunk (≤8) dividing T — python-unrolled inside the kernel."""
+    return _chunk_for(T, 8)
 
 
 def _lstm_bwd_kernel(*refs, hidden: int, chunk: int, has_mask: bool):
@@ -535,24 +759,106 @@ def _lstm_bwd_tiled_kernel(*refs, hidden: int, ttile: int, has_mask: bool):
 # ---------------------------------------------------------------------------
 
 
+def _pad_inputs_lane(xs, kernel, Dp: int):
+    """Time-major f32 xs and W with the input width zero-padded to ``Dp``
+    (shared by the residentx forward AND backward, which must recompute z
+    from bit-identical inputs). Zero W rows multiply zero xs lanes: exact."""
+    xs_t = jnp.moveaxis(xs, 0, 1).astype(jnp.float32)  # [T, B, D]
+    D = xs_t.shape[-1]
+    if Dp != D:
+        xs_t = jnp.pad(xs_t, ((0, 0), (0, 0), (0, Dp - D)))
+        kernel = jnp.pad(kernel, ((0, Dp - D), (0, 0)))
+    return xs_t, kernel
+
+
 def _pallas_forward(fused, xs, h0, c0, mask_tbl=None, *,
-                    interpret: bool = False, save_residuals: bool = False):
+                    interpret: bool = False, save_residuals: bool = False,
+                    allow_fusedx: bool = True):
     """xs [B,T,D] -> (ys [B,T,H], hT, cT[, z, cs]). fused: FusedLSTMParams.
 
     ``mask_tbl`` (optional) is the lane-broadcast f32 mask [T, B, LANE].
-    ``save_residuals`` additionally returns the z/c trajectories ([T,B,...])
-    for the fused backward. Strategy (resident vs tiled U) comes from the
-    shared cost model."""
-    B, T, _ = xs.shape
+    ``save_residuals`` additionally returns residuals for the fused
+    backward: the residentx strategy saves cs ONLY (z is recomputed in its
+    backward; the z slot returns None), the others save z AND cs. Callers
+    pairing a non-residentx backward must pass ``allow_fusedx=False`` so
+    the z residual exists. Strategy comes from the shared cost model."""
+    B, T, D = xs.shape
     H = fused.hidden_size
     dtype = fused.kernel.dtype
     pbytes = 2 if dtype == jnp.bfloat16 else 4
     has_mask = mask_tbl is not None
+    Dp = (_pad_to_lane(D)
+          if allow_fusedx and T >= _FUSEDX_MIN_T else None)
     plan = _plan_fwd(B, H, pbytes, save_residuals=save_residuals,
-                     has_mask=has_mask)
+                     has_mask=has_mask, Dp=Dp)
     if plan is None:  # callers gate via supported(); belt-and-braces
         raise ValueError(f"no pallas forward plan for B={B}, H={H}")
-    strategy, htile = plan
+    strategy, parg = plan
+    htile = parg  # (tiled strategy; for residentx parg is the chunk cap)
+    if strategy == "residentx":
+        C = _chunk_for(T, parg)
+    elif strategy == "resident":
+        C = _time_chunk(T)
+    else:
+        C = 1
+    mask_spec = pl.BlockSpec((C, B, _LANE), lambda t, *k: (t, 0, 0),
+                             memory_space=pltpu.VMEM)
+
+    if strategy == "residentx":
+        Dp = _pad_to_lane(D)
+        xs_t, w = _pad_inputs_lane(xs, fused.kernel, Dp)
+        in_specs = [
+            pl.BlockSpec((C, B, Dp), lambda t, *k: (t, 0, 0),
+                         memory_space=pltpu.VMEM),  # xs
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # W resident
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # bias
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # U resident
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # h0
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # c0
+        ]
+        operands = [xs_t, w, fused.bias.reshape(1, -1).astype(jnp.float32),
+                    fused.recurrent, h0.astype(jnp.float32),
+                    c0.astype(jnp.float32)]
+        if has_mask:
+            in_specs.append(mask_spec)
+            operands.append(mask_tbl)
+        out_specs = [
+            pl.BlockSpec((C, B, H), lambda t, *k: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+        ]
+        if save_residuals:
+            out_specs.append(
+                pl.BlockSpec((C, B, H), lambda t, *k: (t, 0, 0),
+                             memory_space=pltpu.VMEM)
+            )
+            out_shape.append(jax.ShapeDtypeStruct((T, B, H), jnp.float32))
+        out = pl.pallas_call(
+            functools.partial(
+                _lstm_fwdx_kernel, hidden=H, dpad=Dp, chunk=C,
+                save_c=save_residuals, has_mask=has_mask,
+            ),
+            grid=(T // C,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[
+                pltpu.VMEM((B, H), jnp.float32),  # h
+                pltpu.VMEM((B, H), jnp.float32),  # c
+            ],
+            interpret=interpret,
+        )(*operands)
+        ys = jnp.moveaxis(out[0], 0, 1)
+        if save_residuals:
+            return ys, out[1], out[2], None, out[3]
+        return ys, out[1], out[2]
+
     # one big MXU matmul for every step's input projection
     xproj = (
         jnp.einsum(
@@ -562,7 +868,6 @@ def _pallas_forward(fused, xs, h0, c0, mask_tbl=None, *,
         + fused.bias
     )  # [B, T, 4H] f32
     xproj = jnp.moveaxis(xproj, 0, 1)  # [T, B, 4H]
-    C = _time_chunk(T) if strategy == "resident" else 1
 
     out_specs = [
         pl.BlockSpec((C, B, H), lambda t, *k: (t, 0, 0),
@@ -589,8 +894,6 @@ def _pallas_forward(fused, xs, h0, c0, mask_tbl=None, *,
 
     xproj_spec = pl.BlockSpec((C, B, 4 * H), lambda t, *k: (t, 0, 0),
                               memory_space=pltpu.VMEM)
-    mask_spec = pl.BlockSpec((C, B, _LANE), lambda t, *k: (t, 0, 0),
-                             memory_space=pltpu.VMEM)
     if strategy == "resident":
         kernel = functools.partial(
             _lstm_kernel, hidden=H, chunk=C, save_residuals=save_residuals,
@@ -651,15 +954,20 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
 
     Returns per-gate grads in the LSTMParams structure plus (dxs, dh0, dc0).
     """
-    B, T, _ = xs.shape
+    B, T, D = xs.shape
     H = fused.hidden_size
     dtype = fused.kernel.dtype
     pbytes = 2 if dtype == jnp.bfloat16 else 4
     has_mask = mask_tbl is not None
-    plan = _plan_bwd(B, H, pbytes, has_mask)
-    if plan is None:
+    # z is None ⇔ the forward ran residentx and saved cs only — the
+    # recompute-z backward is then the ONLY strategy whose residual
+    # contract matches (the planner guarantees it fits in that case)
+    Dp = _pad_to_lane(D) if z is None else None
+    plan = _plan_bwd(B, H, pbytes, has_mask, Dp)
+    if plan is None or (z is None and plan[0] != "residentx"):
         raise ValueError(f"no pallas backward plan for B={B}, H={H}")
-    strategy, ttile = plan
+    strategy, parg = plan
+    ttile = parg  # (tiled strategy; for residentx parg is the chunk cap)
 
     ys_t = jnp.moveaxis(ys, 0, 1)  # [T, B, H] f32
     h_prev = jnp.concatenate([h0.astype(jnp.float32)[None], ys_t[:-1]], axis=0)
@@ -667,7 +975,59 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
     dys_t = jnp.moveaxis(dys.astype(jnp.float32), 0, 1)
     u_t = fused.recurrent.T  # [4H, H], compute dtype
 
-    if strategy == "resident":
+    if strategy == "residentx":
+        C = _chunk_for(T, parg)
+        n = T // C
+        rev = lambda t: (n - 1 - t, 0, 0)  # reverse-time grid
+        xs_t, w = _pad_inputs_lane(xs, fused.kernel, Dp)
+        in_specs = [
+            pl.BlockSpec((C, B, Dp), rev, memory_space=pltpu.VMEM),  # xs
+            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # dys
+            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # c_prev
+            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # h_prev
+        ]
+        operands = [xs_t, dys_t, c_prev, h_prev]
+        if has_mask:
+            in_specs.append(
+                pl.BlockSpec((C, B, _LANE), rev, memory_space=pltpu.VMEM)
+            )
+            operands.append(mask_tbl)
+        in_specs += [
+            pl.BlockSpec(memory_space=pltpu.VMEM),                   # W
+            pl.BlockSpec(memory_space=pltpu.VMEM),                   # bias
+            pl.BlockSpec(memory_space=pltpu.VMEM),                   # U
+            pl.BlockSpec(memory_space=pltpu.VMEM),                   # U^T
+            pl.BlockSpec(memory_space=pltpu.VMEM),                   # dhT
+            pl.BlockSpec(memory_space=pltpu.VMEM),                   # dcT
+        ]
+        operands += [w, fused.bias.reshape(1, -1).astype(jnp.float32),
+                     fused.recurrent, u_t,
+                     dhT.astype(jnp.float32), dcT.astype(jnp.float32)]
+        dz, dU, dh0, dc0 = pl.pallas_call(
+            functools.partial(_lstm_bwdx_kernel, hidden=H, dpad=Dp,
+                              chunk=C, has_mask=has_mask),
+            grid=(n,),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # dz
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dU
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dh0
+                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dc0
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
+                jax.ShapeDtypeStruct((H, 4 * H), jnp.float32),
+                jax.ShapeDtypeStruct((B, H), jnp.float32),
+                jax.ShapeDtypeStruct((B, H), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((B, H), jnp.float32),
+                pltpu.VMEM((B, H), jnp.float32),
+                pltpu.VMEM((H, 4 * H), jnp.float32),
+            ],
+            interpret=interpret,
+        )(*operands)
+    elif strategy == "resident":
         C = _time_chunk(T)
         n = T // C
         rev = lambda t: (n - 1 - t, 0, 0)  # reverse-time grid
@@ -823,25 +1183,31 @@ def _reference(params, xs, h0, c0, mask, compute_dtype, remat_chunk, unroll):
 def _scan_core_fwd(params, xs, h0, c0, mask_tbl, compute_dtype, interpret,
                    remat_chunk, unroll, has_mask):
     fused = fuse_params(params, compute_dtype=compute_dtype)
-    B, T, _ = xs.shape
+    B, T, D = xs.shape
     H = fused.hidden_size
     pbytes = 2 if fused.kernel.dtype == jnp.bfloat16 else 4
+    Dp = _pad_to_lane(D) if T >= _FUSEDX_MIN_T else None
     # Fused Pallas backward when (a) no remat was requested (remat_chunk is
     # the memory-over-speed signal: the recompute backward stores O(T/chunk)
-    # carries, the fused one stores z/cs O(T)), (b) the O(T) f32 residuals
-    # fit the HBM heuristic budget, and (c) a backward kernel and a
+    # carries, the fused ones store O(T) residuals), (b) those residuals fit
+    # the HBM heuristic budget, and (c) a backward kernel and a matching
     # residual-saving forward both fit VMEM per the shared cost model.
+    # Strategy PAIRING: residentx bwd consumes the residentx fwd's cs-only
+    # residuals; the legacy bwds need z, so their fwd must not take the
+    # fusedx path (allow_fusedx=False keeps the plans aligned).
+    plan_b = _plan_bwd(B, H, pbytes, has_mask, Dp)
+    fusedx = plan_b is not None and plan_b[0] == "residentx"
     use_fused_bwd = (
         remat_chunk is None
-        and _residual_bytes(T, B, H) <= _RESIDUAL_HBM_BUDGET
-        and _plan_bwd(B, H, pbytes, has_mask) is not None
-        and _plan_fwd(B, H, pbytes, save_residuals=True,
-                      has_mask=has_mask) is not None
+        and plan_b is not None
+        and _residual_bytes(T, B, H, plan_b[0]) <= _RESIDUAL_HBM_BUDGET
+        and _plan_fwd(B, H, pbytes, save_residuals=True, has_mask=has_mask,
+                      Dp=Dp if fusedx else None) is not None
     )
     if use_fused_bwd:
         ys, hT, cT, z, cs = _pallas_forward(
             fused, xs, h0, c0, mask_tbl if has_mask else None,
-            interpret=interpret, save_residuals=True,
+            interpret=interpret, save_residuals=True, allow_fusedx=fusedx,
         )
         return (ys, hT, cT), (params, xs, h0, c0, mask_tbl, ys, z, cs)
     out = _scan_core(
@@ -854,8 +1220,8 @@ def _scan_core_fwd(params, xs, h0, c0, mask_tbl, compute_dtype, interpret,
 def _scan_core_bwd(compute_dtype, interpret, remat_chunk, unroll, has_mask,
                    residuals, cotangents):
     params, xs, h0, c0, mask_tbl, ys, z, cs = residuals
-    if z is not None:
-        # Fused Pallas BPTT (see _lstm_bwd_kernel / _lstm_bwd_tiled_kernel).
+    if cs is not None:
+        # Fused Pallas BPTT; z is None ⇔ the residentx pair (recompute-z).
         fused = fuse_params(params, compute_dtype=compute_dtype)
         dys, dhT, dcT = cotangents
         dparams, dxs, dh0, dc0 = _pallas_backward(

@@ -412,3 +412,87 @@ def test_masked_padded_h650_parity():
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
         g1, g2,
     )
+
+
+# ---------------------------------------------------------------------------
+# fully-fused residentx strategy (in-kernel xproj + recompute-z backward)
+# ---------------------------------------------------------------------------
+
+
+def test_residentx_is_planned_for_small_shapes():
+    from lstm_tensorspark_tpu.ops.pallas_lstm import _plan_bwd, _plan_fwd
+
+    # config-1/2/4 shape class: both directions of the pair fit
+    assert _plan_fwd(64, 128, 2, save_residuals=True, Dp=128)[0] == "residentx"
+    assert _plan_bwd(64, 128, 2, False, 128)[0] == "residentx"
+    assert _plan_fwd(64, 256, 2, save_residuals=True, Dp=512)[0] == "residentx"
+    assert _plan_bwd(64, 256, 2, False, 512)[0] == "residentx"
+    # H=1024: U+U^T resident cannot fit — falls to the legacy strategies
+    assert _plan_bwd(8, 1024, 4, False, 128)[0] == "tiled"
+    # no Dp (hoisted-xproj callers): residentx is never offered
+    assert _plan_fwd(64, 128, 2, save_residuals=True)[0] == "resident"
+
+
+def test_residentx_grads_with_mask_carry_and_padded_d(monkeypatch):
+    """The fully-fused pair at an off-lane input width (D=50 → padded 128):
+    forward + grads (params, xs, carry) must match lstm_scan, mask on.
+    (_FUSEDX_MIN_T forced to 0 so the short test sequence takes the path.)"""
+    import lstm_tensorspark_tpu.ops.pallas_lstm as pallas_mod
+    from lstm_tensorspark_tpu.ops.pallas_lstm import _plan_bwd
+
+    monkeypatch.setattr(pallas_mod, "_FUSEDX_MIN_T", 0)
+    D_odd = 50
+    assert _plan_bwd(B, H, 4, True, 128)[0] == "residentx"
+    params = init_lstm_params(jax.random.PRNGKey(40), D_odd, H)
+    xs = jax.random.normal(jax.random.PRNGKey(41), (B, T, D_odd))
+    mask = _lengths_mask(jax.random.PRNGKey(42), B, T)
+    h0 = jax.random.normal(jax.random.PRNGKey(43), (B, H))
+    c0 = jax.random.normal(jax.random.PRNGKey(44), (B, H))
+
+    def lp(p, x, h, c):
+        (hT, cT), ys = pallas_lstm_scan(p, x, (h, c), mask=mask,
+                                        interpret=True)
+        return jnp.mean(ys**2) + jnp.sum(hT * 0.3) + jnp.sum(cT * 0.1)
+
+    def lr(p, x, h, c):
+        (hT, cT), ys = lstm_scan(p, x, (h, c), mask=mask)
+        return jnp.mean(ys**2) + jnp.sum(hT * 0.3) + jnp.sum(cT * 0.1)
+
+    np.testing.assert_allclose(lp(params, xs, h0, c0), lr(params, xs, h0, c0),
+                               rtol=1e-5, atol=1e-6)
+    g1 = jax.grad(lp, argnums=(0, 1, 2, 3))(params, xs, h0, c0)
+    g2 = jax.grad(lr, argnums=(0, 1, 2, 3))(params, xs, h0, c0)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        g1, g2,
+    )
+
+
+def test_legacy_resident_path_still_works(monkeypatch):
+    """Force the hoisted-xproj resident pair (residentx priced out) — the
+    legacy path must stay healthy for shapes where W cannot be resident."""
+    import lstm_tensorspark_tpu.ops.pallas_lstm as pallas_mod
+
+    monkeypatch.setattr(pallas_mod, "_residentx_fwd_vmem",
+                        lambda *a, **k: 10**12)
+    monkeypatch.setattr(pallas_mod, "_residentx_bwd_vmem",
+                        lambda *a, **k: 10**12)
+    assert pallas_mod._plan_fwd(B, H, 4, save_residuals=True,
+                                Dp=128)[0] == "resident"
+    params, xs = _setup()
+    mask = _lengths_mask(jax.random.PRNGKey(45), B, T)
+
+    def lp(p):
+        return jnp.mean(
+            pallas_lstm_scan(p, xs, mask=mask, interpret=True)[1] ** 2
+        )
+
+    def lr(p):
+        return jnp.mean(lstm_scan(p, xs, mask=mask)[1] ** 2)
+
+    g1 = jax.grad(lp)(params)
+    g2 = jax.grad(lr)(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        g1, g2,
+    )

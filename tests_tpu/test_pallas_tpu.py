@@ -345,3 +345,40 @@ def test_pp_wavefront_with_pallas_compiles_on_chip():
     lr = run(False)
     np.testing.assert_allclose(lp, lr, rtol=2e-3, atol=2e-3)
     assert lp[-1] < lp[0]
+
+
+def test_mosaic_residentx_long_sequence_parity():
+    """The fully-fused residentx pair through Mosaic at its REAL activation
+    shape (config-2 class: T=400 >= _FUSEDX_MIN_T, masked): in-kernel
+    projection forward + recompute-z backward must match the scan."""
+    from lstm_tensorspark_tpu.ops.pallas_lstm import _FUSEDX_MIN_T, _plan_bwd
+
+    H, B, T, D = 256, 64, 400, 256
+    assert T >= _FUSEDX_MIN_T
+    assert _plan_bwd(B, H, 4, True, 256)[0] == "residentx"
+    params = init_lstm_params(jax.random.PRNGKey(20), D, H)
+    xs = jax.random.normal(jax.random.PRNGKey(21), (B, T, D)) * 0.3
+    mask = _lengths_mask(jax.random.PRNGKey(22), B, T)
+
+    (hT, cT), ys = jax.jit(lambda p, x: pallas_lstm_scan(p, x, mask=mask))(params, xs)
+    # NOT bit-exact vs interpret (unlike the hoisted kernels): the in-kernel
+    # chunk projection's K-dim accumulation order differs between the MXU
+    # and interpret's CPU dot; ~1e-7 rounding amplifies over T=400.
+    (hTi, cTi), ysi = pallas_lstm_scan(params, xs, mask=mask, interpret=True)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(hTi),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(cTi),
+                               rtol=1e-3, atol=1e-3)
+
+    def lp(p, x):
+        return jnp.mean(pallas_lstm_scan(p, x, mask=mask)[1] ** 2)
+
+    def lr(p, x):
+        return jnp.mean(lstm_scan(p, x, mask=mask)[1] ** 2)
+
+    g1 = jax.jit(jax.grad(lp, argnums=(0, 1)))(params, xs)
+    g2 = jax.jit(jax.grad(lr, argnums=(0, 1)))(params, xs)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3, atol=2e-3),
+        g1, g2,
+    )
