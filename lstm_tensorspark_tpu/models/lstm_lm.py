@@ -130,8 +130,16 @@ def lm_loss(
         dropout_rng=dropout_rng,
         deterministic=deterministic,
     )
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)[..., 0]
+    # nll via logsumexp, NOT log_softmax: identical math (nll = lse - z_t),
+    # but the full [B,T,V] log-prob array is never materialised — at
+    # V=33k (config 3) that array is ~300 MB/step of pure HBM traffic,
+    # measured 12% of the whole train step
+    logits_f = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits_f, axis=-1)
+    tgt = jnp.take_along_axis(
+        logits_f, batch["targets"][..., None], axis=-1
+    )[..., 0]
+    nll = lse - tgt
     loss = jnp.mean(nll)
     aux = {
         "loss": loss,

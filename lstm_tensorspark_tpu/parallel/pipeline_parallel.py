@@ -244,9 +244,12 @@ def pp_lm_loss(
                     preferred_element_type=jnp.float32)
             + head["bias"]
         )
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-        return jnp.mean(nll)
+        # logsumexp form — keep identical to lm_loss (parity tests compare
+        # the two bit-for-bit) and skip the [b,T,V] log-prob array
+        lg = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(lg, axis=-1)
+        t_ = jnp.take_along_axis(lg, tgt[..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - t_)
 
     x_in = jnp.zeros((b, T, Dmax), jnp.float32)
     loss_acc = jnp.zeros((), jnp.float32)

@@ -72,9 +72,12 @@ def sp_lm_loss(params, batch, cfg: LMConfig, *, seq_axis: str = "seq",
                 preferred_element_type=jnp.float32)
         + head["bias"]
     )
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)[..., 0]
-    loss = jnp.mean(nll)  # local mean; caller pmeans over data+seq
+    # logsumexp form — keep identical to lm_loss (parity tests compare
+    # the two bit-for-bit) and skip the [b,C,V] log-prob array
+    lg = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lg, axis=-1)
+    tgt = jnp.take_along_axis(lg, batch["targets"][..., None], axis=-1)[..., 0]
+    loss = jnp.mean(lse - tgt)  # local mean; caller pmeans over data+seq
     return loss, {"loss": loss}
 
 
