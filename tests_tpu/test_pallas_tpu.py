@@ -382,3 +382,4 @@ def test_mosaic_residentx_long_sequence_parity():
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3, atol=2e-3),
         g1, g2,
     )
+
