@@ -22,7 +22,11 @@ import subprocess
 import sys
 import time
 
-B, T, HIDDEN, LAYERS, STEPS, WARMUP = 64, 64, 128, 1, 100, 10
+# STEPS sized so one timed rep runs ~2.5 s: the tunneled backend's ~65 ms
+# fixed fetch latency (see _two_point) must be <3% of the rep, not ~11% as
+# at the old 100-step rep length. The CPU baseline subprocess overrides
+# steps=10 explicitly (cpu_baseline), unaffected.
+B, T, HIDDEN, LAYERS, STEPS, WARMUP = 64, 64, 128, 1, 500, 10
 UNROLL = 8  # lax.scan unroll (used by the Pallas backward's recompute scan;
             # the CPU baseline keeps unroll=1, faithful to the reference's
             # step-at-a-time unroll)
