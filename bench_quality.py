@@ -64,8 +64,12 @@ CONFIGS = {
             "--learning-rate", "1.0", "--num-steps", "800",
             "--log-every", "50", "--eval-every", "100", "--backend", "single",
         ],
+        # eval-every 8 calls = 200 steps: on the tunneled chip each eval
+        # costs ~3.3 s wall (train/eval executable swap), which DOMINATED
+        # this tiny config's post-compile time; coarser cadence only delays
+        # target detection (conservative for the TPU number)
         tpu_extra=["--use-pallas", "--steps-per-call", "25",
-                   "--log-every", "2", "--eval-every", "4"],
+                   "--log-every", "2", "--eval-every", "8"],
     ),
     "config2_imdb": dict(
         metric="eval_accuracy", mode="max",
