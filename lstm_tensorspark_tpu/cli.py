@@ -531,11 +531,11 @@ def _run_lm(args, logger) -> int:
     eval_bs = min(args.batch_size, max((len(valid_tokens) - 1) // seq_len, 0))
     eval_bs -= eval_bs % max(shards, 1)
 
+    from .data.batching import cap_batches
+
     def eval_fn(params):
         if eval_bs <= 0:
             return {"eval_skipped": 1}
-        from .data.batching import cap_batches
-
         ev = cap_batches(lm_epoch_batches(valid_tokens, eval_bs, seq_len),
                          args.eval_batches)
         ev_carries = init_carries(cfg, eval_bs) if stateful else None
@@ -708,11 +708,11 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     eval_quantum = dp * mb if pp > 1 else dp
     eval_bs -= eval_bs % max(eval_quantum, 1)
 
+    from .data.batching import cap_batches
+
     def eval_fn(params_dev):
         if eval_bs <= 0:
             return {"eval_skipped": 1}
-        from .data.batching import cap_batches
-
         ev = cap_batches(lm_epoch_batches(valid_tokens, eval_bs, seq_len),
                          args.eval_batches)
         return evaluate(eval_step, params_dev, ev)

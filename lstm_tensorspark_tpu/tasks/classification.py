@@ -163,11 +163,11 @@ def run_classifier(args, logger) -> int:
         eval_step = jax.jit(lambda p, b: classifier_loss(p, b, cfg)[1])
         eval_quantum = 1
 
+    from ..data.batching import cap_batches
+
     def eval_fn(params):
         if not valid_seqs:
             return {"eval_skipped": 1}
-        from ..data.batching import cap_batches
-
         tot_w = tot_loss = tot_acc = 0.0
         eval_bs = min(args.batch_size, len(valid_seqs))
         # TP eval shards batches over "data": keep the static batch shape a

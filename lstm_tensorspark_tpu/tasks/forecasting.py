@@ -145,13 +145,13 @@ def run_forecaster(args, logger) -> int:
         fc = jax.jit(lambda p, ctx: forecast(p, ctx, cfg))
         eval_quantum = 1
 
+    from ..data.batching import cap_batches
+
     def eval_fn(params):
         """Free-running (no teacher forcing) MSE/MAE over the valid tail,
         weighted by valid rows (filler rows in the last batch excluded)."""
         if len(valid_series) < context_len + horizon:
             return {"eval_skipped": 1}
-        from ..data.batching import cap_batches
-
         tot_n = tot_mse = tot_mae = 0.0
         eval_bs = min(args.batch_size, 64)
         # TP eval shards contexts over "data": keep the static batch shape a
