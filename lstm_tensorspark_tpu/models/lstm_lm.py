@@ -20,6 +20,16 @@ import jax.numpy as jnp
 from ..ops.lstm_cell import LSTMParams, init_lstm_params, zero_carry
 from ..ops.scan import stacked_lstm_scan
 
+# Above this vocab size lm_loss switches to the vocab-chunked cross-entropy
+# (ops/xent.py), which bounds loss memory at O(N·Vc) instead of O(N·V).
+# MEASURED on v5e: at V=33k/50k the chunked path is 16-18% SLOWER than the
+# plain logsumexp loss (XLA already fuses the head matmul + reduction well;
+# the scan serializes chunk matmuls and doubles the exp work), so the
+# threshold sits ABOVE those configs — the chunked path is a memory
+# capability for vocabularies whose [B,T,V] logits would not fit HBM,
+# not a throughput optimisation.
+_CHUNKED_XENT_MIN_V = 2**17
+
 
 @dataclasses.dataclass(frozen=True)
 class LMConfig:
@@ -74,7 +84,7 @@ def init_carries(cfg: LMConfig, batch: int):
     return [zero_carry(batch, cfg.hidden_size) for _ in range(cfg.num_layers)]
 
 
-def lm_forward(
+def lm_backbone(
     params,
     tokens: jax.Array,
     cfg: LMConfig,
@@ -83,10 +93,10 @@ def lm_forward(
     dropout_rng: jax.Array | None = None,
     deterministic: bool = True,
 ):
-    """tokens [B, T] int32 → (logits [B, T, V], final per-layer carries)."""
+    """tokens [B, T] int32 → (pre-head activations [B, T, H], finals)."""
     cdtype = cfg.cdtype
     xs = jnp.take(params["embedding"], tokens, axis=0)
-    finals, ys = stacked_lstm_scan(
+    return stacked_lstm_scan(
         params["layers"],
         xs,
         carries,
@@ -98,11 +108,32 @@ def lm_forward(
         unroll=cfg.scan_unroll,
         use_pallas=cfg.use_pallas,
     )
+
+
+def _head_kernel(params, cfg: LMConfig):
     head = params["head"]
     kernel = params["embedding"].T if cfg.tie_embeddings else head["kernel"]
+    return kernel, head["bias"]
+
+
+def lm_forward(
+    params,
+    tokens: jax.Array,
+    cfg: LMConfig,
+    *,
+    carries=None,
+    dropout_rng: jax.Array | None = None,
+    deterministic: bool = True,
+):
+    """tokens [B, T] int32 → (logits [B, T, V], final per-layer carries)."""
+    finals, ys = lm_backbone(
+        params, tokens, cfg, carries=carries, dropout_rng=dropout_rng,
+        deterministic=deterministic,
+    )
+    kernel, bias = _head_kernel(params, cfg)
     logits = (
         jnp.dot(ys.astype(kernel.dtype), kernel, preferred_element_type=jnp.float32)
-        + head["bias"]
+        + bias
     )
     return logits, finals
 
@@ -122,28 +153,41 @@ def lm_loss(
     batch: dict with "inputs" [B,T] and "targets" [B,T] int32.
     Returns (loss, aux) with aux = {"loss", "tokens", "carries"}.
     """
-    logits, finals = lm_forward(
-        params,
-        batch["inputs"],
-        cfg,
-        carries=carries,
-        dropout_rng=dropout_rng,
-        deterministic=deterministic,
-    )
-    # nll via logsumexp, NOT log_softmax: identical math (nll = lse - z_t),
-    # but the full [B,T,V] log-prob array is never materialised — at
-    # V=33k (config 3) that array is ~300 MB/step of pure HBM traffic,
-    # measured 12% of the whole train step
-    logits_f = logits.astype(jnp.float32)
-    lse = jax.nn.logsumexp(logits_f, axis=-1)
-    tgt = jnp.take_along_axis(
-        logits_f, batch["targets"][..., None], axis=-1
-    )[..., 0]
-    nll = lse - tgt
-    loss = jnp.mean(nll)
+    if cfg.vocab_size >= _CHUNKED_XENT_MIN_V:
+        # big-vocab path: vocab-chunked cross-entropy (ops/xent.py) — the
+        # [B,T,V] logits/dlogits arrays (~300-400 MB at V=33k/50k) never
+        # exist in HBM; head matmul recomputed chunk-wise in the backward
+        finals, ys = lm_backbone(
+            params, batch["inputs"], cfg, carries=carries,
+            dropout_rng=dropout_rng, deterministic=deterministic,
+        )
+        kernel, bias = _head_kernel(params, cfg)
+        from ..ops.xent import chunked_xent_mean
+
+        loss = chunked_xent_mean(ys.astype(jnp.float32), kernel, bias,
+                                 batch["targets"])
+        nll_size = batch["targets"].size
+    else:
+        logits, finals = lm_forward(
+            params,
+            batch["inputs"],
+            cfg,
+            carries=carries,
+            dropout_rng=dropout_rng,
+            deterministic=deterministic,
+        )
+        # nll via logsumexp, NOT log_softmax: identical math
+        # (nll = lse - z_t) without the full [B,T,V] log-prob array
+        logits_f = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits_f, axis=-1)
+        tgt = jnp.take_along_axis(
+            logits_f, batch["targets"][..., None], axis=-1
+        )[..., 0]
+        loss = jnp.mean(lse - tgt)
+        nll_size = batch["targets"].size
     aux = {
         "loss": loss,
-        "tokens": jnp.array(nll.size, jnp.float32),
+        "tokens": jnp.array(nll_size, jnp.float32),
         "carries": finals,
     }
     return loss, aux

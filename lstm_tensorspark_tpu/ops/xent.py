@@ -1,0 +1,159 @@
+"""Vocab-chunked cross-entropy: the [N, V] logits never exist in HBM.
+
+Motivation (BASELINE.md configs 3/5): at V = 33k/50k the LM softmax head's
+logits array is 300–400 MB; a train step writes it (head matmul), reads it
+(logsumexp + target gather), writes the same-sized dlogits in the backward
+and reads it twice more (dW and dys matmuls) — ~1.5–2 GB of HBM traffic per
+step that dwarfs the head's actual FLOPs. This module computes the exact
+same mean-NLL with the vocabulary processed in `chunk`-column tiles:
+
+- forward: one pass of ONLINE logsumexp (flash-attention-style running
+  (m, s) accumulators) + in-chunk target-logit gather — the only [N, Vc]
+  tile alive is the current one;
+- backward (custom VJP): recompute each chunk's logits, form its dlogits
+  tile, and immediately contract it into dys / dW / db accumulators.
+
+The trade is the standard recompute-vs-traffic one: head matmul FLOPs ×2
+(the backward re-projects each chunk) against deleting ~5 full-logits HBM
+round-trips. XLA's job remains the matmuls; this is pure jax-level
+restructuring (lax.scan over weight column tiles), no Pallas needed —
+the tiles are large MXU-friendly matmuls already.
+
+Reference parity note: the reference computes a plain softmax cross-entropy
+(SURVEY.md §3.2 ``xent(softmax(h·W_out), y)``); this is the same math to
+float rounding (exactness tests in tests/test_xent.py), restructured for
+HBM economics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _pad_vocab(kernel, bias, chunk):
+    """Pad V up to a multiple of ``chunk``. Padded columns get bias -1e30,
+    so their softmax mass underflows to exactly 0 and the online logsumexp
+    ignores them (no target ever points at a padded id)."""
+    V = kernel.shape[1]
+    pad = -V % chunk
+    if pad:
+        kernel = jnp.pad(kernel, ((0, 0), (0, pad)))
+        bias = jnp.pad(bias, (0, pad), constant_values=-1e30)
+    return kernel, bias, V + pad
+
+
+def _chunk_logits(ys, k_tile, b_tile):
+    return (
+        jnp.dot(ys.astype(k_tile.dtype), k_tile,
+                preferred_element_type=jnp.float32)
+        + b_tile
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def chunked_xent_mean(ys, kernel, bias, targets, chunk: int = 8192):
+    """Mean next-token NLL over all N = B·T positions, logits never
+    materialised. ``ys`` [B, T, H] (float), ``kernel`` [H, V], ``bias``
+    [V], ``targets`` [B, T] int32. Returns a scalar; grads flow to
+    ys/kernel/bias via the recompute backward."""
+    loss, _ = _xent_fwd_pass(ys, kernel, bias, targets, chunk)
+    return loss
+
+
+def _xent_fwd_pass(ys, kernel, bias, targets, chunk):
+    B, T, H = ys.shape
+    N = B * T
+    ys_f = ys.reshape(N, H)
+    tgt = targets.reshape(N)
+    kernel_p, bias_p, Vp = _pad_vocab(kernel, bias, chunk)
+    K = Vp // chunk
+    k_tiles = kernel_p.T.reshape(K, chunk, H)  # [K, Vc, H] (scan-sliced)
+    b_tiles = bias_p.reshape(K, chunk)
+
+    def body(carry, tile):
+        m, s, tl = carry
+        k_t, b_t, c0 = tile
+        logits = _chunk_logits(ys_f, k_t.T, b_t)  # [N, Vc]
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        idx = tgt - c0
+        in_chunk = (idx >= 0) & (idx < chunk)
+        got = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, chunk - 1)[:, None], axis=-1
+        )[:, 0]
+        tl = jnp.where(in_chunk, got, tl)
+        return (m_new, s, tl), None
+
+    init = (
+        jnp.full((N,), -jnp.inf, jnp.float32),
+        jnp.zeros((N,), jnp.float32),
+        jnp.zeros((N,), jnp.float32),
+    )
+    c0s = jnp.arange(K, dtype=jnp.int32) * chunk
+    (m, s, tl), _ = lax.scan(body, init, (k_tiles, b_tiles, c0s))
+    lse = m + jnp.log(s)
+    loss = jnp.mean(lse - tl)
+    return loss, (ys_f, tgt, lse, (B, T, H))
+
+
+def _xent_fwd(ys, kernel, bias, targets, chunk):
+    loss, (ys_f, tgt, lse, dims) = _xent_fwd_pass(ys, kernel, bias, targets,
+                                                  chunk)
+    return loss, (ys_f, kernel, bias, tgt, lse, dims)
+
+
+def _xent_bwd(chunk, residuals, g):
+    ys_f, kernel, bias, tgt, lse, (B, T, H) = residuals
+    N = B * T
+    kernel_p, bias_p, Vp = _pad_vocab(kernel, bias, chunk)
+    K = Vp // chunk
+    k_tiles = kernel_p.T.reshape(K, chunk, H)
+    b_tiles = bias_p.reshape(K, chunk)
+    gN = (g / N).astype(jnp.float32)  # d(mean)/d(per-token nll)
+    cdtype = kernel.dtype
+
+    def body(dys, tile):
+        k_t, b_t, c0 = tile
+        logits = _chunk_logits(ys_f, k_t.T, b_t)
+        # dlogits tile = (softmax - onehot) * g/N; padded cols: softmax
+        # underflows to 0 and no target points there, so exactly 0
+        p = jnp.exp(logits - lse[:, None])
+        idx = tgt - c0
+        in_chunk = (idx >= 0) & (idx < chunk)
+        onehot = (
+            jax.nn.one_hot(jnp.clip(idx, 0, chunk - 1), chunk,
+                           dtype=jnp.float32)
+            * in_chunk[:, None]
+        )
+        dlog = (p - onehot) * gN
+        dlog_c = dlog.astype(cdtype)
+        dk_t = jnp.dot(ys_f.astype(cdtype).T, dlog_c,
+                       preferred_element_type=jnp.float32)  # [H, Vc]
+        db_t = jnp.sum(dlog, axis=0)
+        dys = dys + jnp.dot(dlog_c, k_t.astype(cdtype),
+                            preferred_element_type=jnp.float32)
+        return dys, (dk_t, db_t)
+
+    c0s = jnp.arange(K, dtype=jnp.int32) * chunk
+    dys, (dk_tiles, db_tiles) = lax.scan(
+        body, jnp.zeros((N, H), jnp.float32), (k_tiles, b_tiles, c0s)
+    )
+    V = kernel.shape[1]
+    dkernel = jnp.moveaxis(dk_tiles, 0, 1).reshape(H, Vp)[:, :V]
+    dbias = db_tiles.reshape(Vp)[:V]
+    return (
+        dys.reshape(B, T, H).astype(ys_f.dtype),
+        dkernel.astype(kernel.dtype),
+        dbias.astype(bias.dtype),
+        np.zeros((B, T), dtype=jax.dtypes.float0),  # int targets
+    )
+
+
+chunked_xent_mean.defvjp(_xent_fwd, _xent_bwd)
