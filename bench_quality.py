@@ -28,9 +28,13 @@ fetches loss values to the host, a true barrier.
 
 Each platform runs its FASTEST HONEST configuration of the same model/data/
 optimizer (identical math; trajectories agree to float tolerance): the TPU
-legs add --use-pallas (fused recurrence kernels; no-op fallback on CPU) and
+legs add --use-pallas (fused recurrence kernels; no-op fallback on CPU),
 K-step dispatch batching where the tunnel dispatch would otherwise dominate
-(tests/test_multistep.py proves K-step parity); the CPU legs stay per-step —
+(tests/test_multistep.py proves K-step parity), and --device-data
+--fused-eval (the eval pass runs inside the train executable on
+device-resident eval data — identical eval math, tests/test_fused_eval.py,
+but zero train/eval executable swaps: the swap cost ~3.3 s/eval on the
+tunneled chip and DOMINATED the small configs); the CPU legs stay per-step —
 compute-bound, and faithful to the reference's one-Spark-round-per-step.
 NOTE: with --steps-per-call K, --log-every/--eval-every count CALLS
 (train_loop contract), so TPU cadences are pre-divided by K below;
@@ -64,12 +68,16 @@ CONFIGS = {
             "--learning-rate", "1.0", "--num-steps", "800",
             "--log-every", "50", "--eval-every", "100", "--backend", "single",
         ],
-        # eval-every 8 calls = 200 steps: on the tunneled chip each eval
-        # costs ~3.3 s wall (train/eval executable swap), which DOMINATED
-        # this tiny config's post-compile time; coarser cadence only delays
-        # target detection (conservative for the TPU number)
+        # --fused-eval: the eval pass runs INSIDE the train executable on a
+        # device-resident valid stream (no train/eval program swap — the
+        # swap cost ~3.3 s on the tunneled chip and DOMINATED this tiny
+        # config). Eval cadence 4 calls = 100 steps, matching the CPU
+        # leg's --eval-every 100 exactly: both platforms can detect a
+        # target crossing at the same optimizer steps (unequal cadences
+        # would bias time-to-target toward the finer-grained leg)
         tpu_extra=["--use-pallas", "--steps-per-call", "25",
-                   "--log-every", "2", "--eval-every", "8"],
+                   "--device-data", "--fused-eval",
+                   "--log-every", "2", "--eval-every", "4"],
     ),
     "config2_imdb": dict(
         metric="eval_accuracy", mode="max",
@@ -81,6 +89,7 @@ CONFIGS = {
             "--log-every", "10", "--eval-every", "10", "--backend", "single",
         ],
         tpu_extra=["--use-pallas", "--steps-per-call", "10",
+                   "--device-data", "--fused-eval",
                    "--log-every", "1", "--eval-every", "1"],
     ),
     "config3_wikitext2": dict(
@@ -91,7 +100,9 @@ CONFIGS = {
             "--learning-rate", "1.0", "--num-steps", "400",
             "--log-every", "25", "--eval-every", "50", "--backend", "single",
         ],
+        # eval cadence 2 calls = 50 steps = the CPU leg's --eval-every 50
         tpu_extra=["--use-pallas", "--steps-per-call", "25",
+                   "--device-data", "--fused-eval",
                    "--log-every", "1", "--eval-every", "2"],
     ),
     "config4_uci": dict(
@@ -104,6 +115,7 @@ CONFIGS = {
             "--log-every", "15", "--eval-every", "15", "--backend", "single",
         ],
         tpu_extra=["--use-pallas", "--steps-per-call", "15",
+                   "--device-data", "--fused-eval",
                    "--log-every", "1", "--eval-every", "1"],
     ),
     # bounded-step time-to-ppl at WT-103-class scale: 100 steps is the
@@ -122,6 +134,7 @@ CONFIGS = {
             "--eval-batches", "4", "--backend", "single",
         ],
         tpu_extra=["--use-pallas", "--steps-per-call", "5",
+                   "--device-data", "--fused-eval",
                    "--log-every", "2", "--eval-every", "4"],
     ),
 }

@@ -80,6 +80,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "gather; uci: series windows) — per-dispatch host "
                         "traffic shrinks to indices; the cached-RDD "
                         "equivalent; dataset must fit HBM")
+    p.add_argument("--fused-eval", action="store_true",
+                   help="run the eval pass INSIDE the train executable on "
+                        "device-resident eval data (every task; requires "
+                        "--device-data): one program for both cadences, so "
+                        "an eval costs zero train/eval executable swaps — "
+                        "the swap is ~3 s/eval on dispatch-expensive "
+                        "backends and dominates small-model runs")
     # --- inference / generation (LM tasks) ---
     p.add_argument("--generate-tokens", type=int, default=0,
                    help="after training, sample N continuation tokens from the LM")
@@ -102,6 +109,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true", help="resume from latest checkpoint in --checkpoint-dir")
+    p.add_argument("--compilation-cache", type=str, default=None,
+                   help="persistent XLA compilation-cache directory: repeat "
+                        "runs of the same program shapes skip compilation "
+                        "entirely (first TPU compile is ~20-40 s — for "
+                        "short production runs the cache is the difference "
+                        "between launch-to-quality and post-compile time)")
     p.add_argument("--profile-dir", type=str, default=None, help="jax.profiler trace output dir")
     p.add_argument("--trace", type=str, default=None,
                    help="host-side span trace output (Chrome trace-event "
@@ -141,6 +154,17 @@ def main(argv=None) -> int:
         raise SystemExit("--use-pallas is not supported with --tensor-parallel "
                          "(the GSPMD-sharded hidden dim cannot enter the fused "
                          "kernel)")
+    if args.fused_eval and not args.device_data:
+        raise SystemExit("--fused-eval requires --device-data (the eval pass "
+                         "runs over device-resident eval data inside the "
+                         "train executable)")
+
+    if args.compilation_cache:
+        # cache EVERY executable (the defaults skip sub-second compiles,
+        # which is exactly the small-config regime where fixed costs bite)
+        jax.config.update("jax_compilation_cache_dir", args.compilation_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
     from .parallel import distributed_init
     distributed_init(args.coordinator, args.num_processes, args.process_id)
@@ -388,7 +412,8 @@ def _wire_checkpoint(args, logger, template_fn):
 
 
 def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
-                      eval_fn=None, checkpoint_fn=None, tokens_per_batch=None):
+                      eval_fn=None, checkpoint_fn=None, tokens_per_batch=None,
+                      fused_eval=None):
     from .train.loop import train_loop
 
     total = args.num_steps or args.epochs * steps_per_epoch
@@ -416,6 +441,7 @@ def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
             checkpoint_every=args.checkpoint_every,
             tokens_per_batch=tokens_per_batch,
             steps_per_call=k,
+            fused_eval=fused_eval,
         )
     finally:
         if args.profile_dir:
@@ -489,6 +515,12 @@ def _run_lm(args, logger) -> int:
 
     train_tokens, valid_tokens = data["train"], data["valid"]
     steps_per_epoch = max((len(train_tokens) - 1) // (args.batch_size * seq_len), 1)
+    # The valid split can be smaller than one training-size window; evaluate
+    # with the largest batch that fits (multiple of the shard count).
+    eval_bs = min(args.batch_size, max((len(valid_tokens) - 1) // seq_len, 0))
+    eval_bs -= eval_bs % max(shards, 1)
+
+    fused_eval = bool(args.fused_eval)
     # data-exact resume: fast-forward every stream to the restored step so a
     # resumed run sees exactly the windows the uninterrupted run would
     start_step = int(state.step)
@@ -504,17 +536,33 @@ def _run_lm(args, logger) -> int:
         # values below were normalized+validated by _setup_training
         k = args.steps_per_call
         ddata = stage_lm_data(train_tokens, args.batch_size, seq_len, mesh=mesh)
+        if fused_eval and eval_bs <= 0:
+            logger.log({"note": "fused-eval: valid split smaller than one "
+                                "window; falling back to host-driven eval"})
+            fused_eval = False
+        edata = (stage_lm_data(valid_tokens, eval_bs, seq_len, mesh=mesh)
+                 if fused_eval else None)
         if mesh is None:
             dstep = make_device_lm_train_step(
-                loss_fn, optimizer, ddata, steps_per_call=k,
+                loss_fn, optimizer, ddata, eval_data=edata,
+                eval_windows=args.eval_batches, steps_per_call=k,
                 stateful=stateful, grad_accum=args.grad_accum,
             )
         else:
             dstep = make_device_dp_lm_train_step(
-                loss_fn, optimizer, ddata, mesh, steps_per_call=k,
+                loss_fn, optimizer, ddata, mesh, eval_data=edata,
+                eval_windows=args.eval_batches, steps_per_call=k,
                 stateful=stateful, grad_accum=args.grad_accum,
             )
-        train_step = lambda state, w0: dstep(state, ddata.arrays, w0)  # noqa: E731
+        if fused_eval:
+            ev_carries0 = init_carries(cfg, eval_bs) if stateful else None
+            if mesh is not None and stateful:
+                ev_carries0 = shard_batch(ev_carries0, mesh)
+            train_step = lambda state, w0, do_eval: dstep(  # noqa: E731
+                state, ddata.arrays, w0, edata.arrays, do_eval, ev_carries0
+            )
+        else:
+            train_step = lambda state, w0: dstep(state, ddata.arrays, w0)  # noqa: E731
         batches = window_index_stream(ddata, k, start_step=start_step)
     else:
         batches = wrap_stream(lm_batch_stream(
@@ -525,11 +573,6 @@ def _run_lm(args, logger) -> int:
         eval_step = make_eval_step(loss_fn, stateful=stateful)
     else:
         eval_step = make_dp_eval_step(loss_fn, mesh, stateful=stateful)
-
-    # The valid split can be smaller than one training-size window; evaluate
-    # with the largest batch that fits (multiple of the shard count).
-    eval_bs = min(args.batch_size, max((len(valid_tokens) - 1) // seq_len, 0))
-    eval_bs -= eval_bs % max(shards, 1)
 
     from .data.batching import cap_batches
 
@@ -550,12 +593,16 @@ def _run_lm(args, logger) -> int:
         "devices": jax.device_count(), "partitions": shards,
         "steps_per_epoch": steps_per_epoch, "backend": "dp" if mesh is not None else "single",
     })
+    from .train.loop import eval_metrics
+
     with span("train", steps_per_epoch=steps_per_epoch, backend="dp" if mesh is not None else "single"):
         state = _make_logged_loop(
             args, state, train_step, batches, steps_per_epoch, logger,
-            eval_fn=eval_fn if args.eval_every else None,
+            eval_fn=None if fused_eval else (eval_fn if args.eval_every else None),
             checkpoint_fn=checkpoint_fn,
             tokens_per_batch=args.batch_size * seq_len,
+            fused_eval=(lambda ms: eval_metrics(float(ms["eval_loss"])))
+            if fused_eval else None,
         )
     with span("eval_final"):
         final = eval_fn(state.params)

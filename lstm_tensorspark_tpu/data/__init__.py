@@ -13,6 +13,7 @@ from .device_dataset import (
     stage_lm_data,
     stage_examples,
     stage_series,
+    stage_stacked_batches,
     slice_window,
     slice_forecast_batch,
     take_batch,
@@ -36,6 +37,7 @@ __all__ = [
     "stage_lm_data",
     "stage_examples",
     "stage_series",
+    "stage_stacked_batches",
     "slice_window",
     "slice_forecast_batch",
     "take_batch",

@@ -106,6 +106,19 @@ def window_index_stream(data: DeviceLMData, steps_per_call: int,
         w = (w + steps_per_call) % data.n_windows
 
 
+def stage_stacked_batches(batches, *, mesh: Mesh | None = None) -> dict:
+    """Stack an iterator of equal-shape host batch dicts into ONE
+    [n_batches, ...] pytree placed on device (replicated under a mesh) —
+    the staging step for fused in-executable eval (train/device_step.py):
+    the traced eval scans the leading axis, so the batches must be the
+    EXACT ones the host eval loop would see."""
+    ev_list = list(batches)
+    if not ev_list:
+        raise ValueError("stage_stacked_batches: empty batch iterator")
+    put = _placer(mesh)
+    return {k: put(np.stack([b[k] for b in ev_list])) for k in ev_list[0]}
+
+
 # ---- generic per-example staging (classification: BASELINE.md config 2) ----
 
 

@@ -87,6 +87,26 @@ def run_classifier(args, logger) -> int:
     # uninterrupted run exactly
     start_step = int(state.step)
 
+    from ..data.batching import cap_batches, padded_batches
+
+    def eval_batches(eval_quantum: int = 1):
+        """THE eval-batch constructor shared by the host eval_fn and the
+        fused-eval staging — one source, so the two paths can never see
+        different batches. ``eval_quantum`` keeps the static batch shape a
+        multiple of the TP data axis (the fused path is always quantum 1:
+        TP rejects --device-data upstream)."""
+        eval_bs = min(args.batch_size, len(valid_seqs))
+        eval_bs = max(eval_bs - eval_bs % eval_quantum, eval_quantum)
+        return cap_batches(
+            padded_batches(valid_seqs, valid_labels, eval_bs, max_len,
+                           drop_remainder=False),
+            getattr(args, "eval_batches", None),
+        )
+
+    # --fused-eval without --device-data is rejected in cli.main()
+    fused_eval = bool(getattr(args, "fused_eval", False)) and getattr(
+        args, "device_data", False
+    )
     if getattr(args, "device_data", False):
         # HBM-staged padded example matrix; batches gathered on-device by
         # row indices in the same shuffle+bucket order as padded_batches.
@@ -114,19 +134,49 @@ def run_classifier(args, logger) -> int:
             },
             mesh=mesh,
         )
+        from jax.sharding import PartitionSpec as P
+
+        arrays_spec = {k2: P() for k2 in staged.arrays}
+        if fused_eval and not valid_seqs:
+            logger.log({"note": "fused-eval: empty valid split; "
+                                "falling back to host-driven eval"})
+            fused_eval = False
+        if fused_eval:
+            # Stack the EXACT host eval batches (same `eval_batches`
+            # constructor as eval_fn below: padded_batches order, filler
+            # rows valid=False) into one [n_ev, ...] pytree staged in HBM;
+            # the weighted accuracy/loss sums run inside the train
+            # executable (zero train/eval program swaps).
+            from ..data import stage_stacked_batches
+
+            ev_stacked = stage_stacked_batches(eval_batches(), mesh=mesh)
+
+            def metric_fn(p, b):
+                _, aux = classifier_loss(p, b, cfg)
+                w = b["valid"].astype(np.float32).sum()
+                return ({"eval_loss": aux["loss"],
+                         "eval_accuracy": aux["accuracy"]}, w)
+
+            keys = ("eval_loss", "eval_accuracy")
+        else:
+            metric_fn, keys = None, ()
         if mesh is None:
             dstep = make_device_train_step(
-                loss_fn, optimizer, take_batch, grad_accum=args.grad_accum
+                loss_fn, optimizer, take_batch, metric_fn=metric_fn,
+                metric_keys=keys, grad_accum=args.grad_accum,
             )
         else:
-            from jax.sharding import PartitionSpec as P
-
-            arrays_spec = {k2: P() for k2 in staged.arrays}
             dstep = make_device_dp_train_step(
                 loss_fn, optimizer, take_batch, mesh, arrays_spec,
+                metric_fn=metric_fn, metric_keys=keys,
                 idx_spec=P(None, "data"), grad_accum=args.grad_accum,
             )
-        train_step = lambda state, idxs: dstep(state, staged.arrays, idxs)  # noqa: E731
+        if fused_eval:
+            train_step = lambda state, idxs, do_eval: dstep(  # noqa: E731
+                state, staged.arrays, idxs, ev_stacked, do_eval
+            )
+        else:
+            train_step = lambda state, idxs: dstep(state, staged.arrays, idxs)  # noqa: E731
 
         from ..data.batching import example_order, index_groups
 
@@ -163,22 +213,11 @@ def run_classifier(args, logger) -> int:
         eval_step = jax.jit(lambda p, b: classifier_loss(p, b, cfg)[1])
         eval_quantum = 1
 
-    from ..data.batching import cap_batches
-
     def eval_fn(params):
         if not valid_seqs:
             return {"eval_skipped": 1}
         tot_w = tot_loss = tot_acc = 0.0
-        eval_bs = min(args.batch_size, len(valid_seqs))
-        # TP eval shards batches over "data": keep the static batch shape a
-        # multiple of the axis (padded_batches filler rows carry valid=False)
-        eval_bs = max(eval_bs - eval_bs % eval_quantum, eval_quantum)
-        ev = cap_batches(
-            padded_batches(valid_seqs, valid_labels, eval_bs, max_len,
-                           drop_remainder=False),
-            getattr(args, "eval_batches", None),
-        )
-        for b in ev:
+        for b in eval_batches(eval_quantum):
             m = eval_step(params, b)
             w = float(b["valid"].sum())
             tot_loss += float(m["loss"]) * w
@@ -195,9 +234,12 @@ def run_classifier(args, logger) -> int:
     })
     state = _make_logged_loop(
         args, state, train_step, stream, steps_per_epoch, logger,
-        eval_fn=eval_fn if args.eval_every else None,
+        eval_fn=None if fused_eval else (eval_fn if args.eval_every else None),
         checkpoint_fn=checkpoint_fn,
         tokens_per_batch=args.batch_size * max_len,
+        fused_eval=(lambda ms: {"eval_loss": float(ms["eval_loss"]),
+                                "eval_accuracy": float(ms["eval_accuracy"])})
+        if fused_eval else None,
     )
     # final eval on the device-resident params (TP: sharded in place; DP:
     # replicated) — no host round-trip of the model

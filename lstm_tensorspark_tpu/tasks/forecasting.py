@@ -82,6 +82,26 @@ def run_forecaster(args, logger) -> int:
     # restored step (same contract as the classifier runner)
     start_step = int(state.step)
 
+    from ..data.batching import cap_batches
+
+    def eval_batches(eval_quantum: int = 1):
+        """THE eval-batch constructor shared by the host eval_fn and the
+        fused-eval staging — one source, so the two paths can never see
+        different batches. ``eval_quantum`` keeps the static batch shape a
+        multiple of the TP data axis (the fused path is always quantum 1:
+        TP rejects --device-data upstream)."""
+        eval_bs = min(args.batch_size, 64)
+        eval_bs = max(eval_bs - eval_bs % eval_quantum, eval_quantum)
+        return cap_batches(
+            forecast_windows(valid_series, context_len, horizon, eval_bs,
+                             drop_remainder=False),
+            getattr(args, "eval_batches", None),
+        )
+
+    # --fused-eval without --device-data is rejected in cli.main()
+    fused_eval = bool(getattr(args, "fused_eval", False)) and getattr(
+        args, "device_data", False
+    )
     if getattr(args, "device_data", False):
         # HBM-staged series; (context, horizon) windows sliced on-device from
         # per-step start indices — same shuffled order as forecast_windows,
@@ -98,18 +118,53 @@ def run_forecaster(args, logger) -> int:
         window_fn = functools.partial(
             slice_forecast_batch, context_len=context_len, horizon=horizon
         )
+        from jax.sharding import PartitionSpec as P
+
+        if fused_eval and len(valid_series) < context_len + horizon:
+            logger.log({"note": "fused-eval: valid series shorter than one "
+                                "window; falling back to host-driven eval"})
+            fused_eval = False
+        if fused_eval:
+            # Stack the EXACT host eval batches (same `eval_batches`
+            # constructor as eval_fn below: forecast_windows order, filler
+            # repeats valid=False) in HBM; the free-running forecast and
+            # its masked MSE/MAE sums run inside the train executable.
+            import jax.numpy as jnp
+
+            from ..data import stage_stacked_batches
+
+            ev_stacked = stage_stacked_batches(eval_batches(), mesh=mesh)
+
+            def metric_fn(p, b):
+                preds = forecast(p, b["context"], cfg)
+                w = b["valid"].astype(jnp.float32)
+                n = jnp.maximum(w.sum(), 1.0)
+                err = (preds - b["targets"]) * w[:, None, None]
+                per_elem = float(horizon * preds.shape[-1])
+                mse = (err ** 2).sum() / (n * per_elem)
+                mae = jnp.abs(err).sum() / (n * per_elem)
+                return {"eval_mse": mse, "eval_mae": mae}, w.sum()
+
+            keys = ("eval_mse", "eval_mae")
+        else:
+            metric_fn, keys = None, ()
         if mesh is None:
             dstep = make_device_train_step(
-                loss_fn, optimizer, window_fn, grad_accum=args.grad_accum
+                loss_fn, optimizer, window_fn, metric_fn=metric_fn,
+                metric_keys=keys, grad_accum=args.grad_accum,
             )
         else:
-            from jax.sharding import PartitionSpec as P
-
             dstep = make_device_dp_train_step(
                 loss_fn, optimizer, window_fn, mesh, {"series": P()},
+                metric_fn=metric_fn, metric_keys=keys,
                 idx_spec=P(None, "data"), grad_accum=args.grad_accum,
             )
-        train_step = lambda state, idxs: dstep(state, staged.arrays, idxs)  # noqa: E731
+        if fused_eval:
+            train_step = lambda state, idxs, do_eval: dstep(  # noqa: E731
+                state, staged.arrays, idxs, ev_stacked, do_eval
+            )
+        else:
+            train_step = lambda state, idxs: dstep(state, staged.arrays, idxs)  # noqa: E731
 
         from ..data.batching import forecast_starts, index_groups
 
@@ -145,24 +200,13 @@ def run_forecaster(args, logger) -> int:
         fc = jax.jit(lambda p, ctx: forecast(p, ctx, cfg))
         eval_quantum = 1
 
-    from ..data.batching import cap_batches
-
     def eval_fn(params):
         """Free-running (no teacher forcing) MSE/MAE over the valid tail,
         weighted by valid rows (filler rows in the last batch excluded)."""
         if len(valid_series) < context_len + horizon:
             return {"eval_skipped": 1}
         tot_n = tot_mse = tot_mae = 0.0
-        eval_bs = min(args.batch_size, 64)
-        # TP eval shards contexts over "data": keep the static batch shape a
-        # multiple of the axis (forecast_windows filler repeats, valid=False)
-        eval_bs = max(eval_bs - eval_bs % eval_quantum, eval_quantum)
-        ev = cap_batches(
-            forecast_windows(valid_series, context_len, horizon, eval_bs,
-                             drop_remainder=False),
-            getattr(args, "eval_batches", None),
-        )
-        for b in ev:
+        for b in eval_batches(eval_quantum):
             preds = np.asarray(fc(params, b["context"]))
             err = (preds - b["targets"])[b["valid"]]
             n = b["valid"].sum()
@@ -181,9 +225,12 @@ def run_forecaster(args, logger) -> int:
     })
     state = _make_logged_loop(
         args, state, train_step, stream, steps_per_epoch, logger,
-        eval_fn=eval_fn if args.eval_every else None,
+        eval_fn=None if fused_eval else (eval_fn if args.eval_every else None),
         checkpoint_fn=checkpoint_fn,
         tokens_per_batch=args.batch_size * context_len,
+        fused_eval=(lambda ms: {"eval_mse": float(ms["eval_mse"]),
+                                "eval_mae": float(ms["eval_mae"])})
+        if fused_eval else None,
     )
     # final eval on the device-resident params (TP: sharded in place; DP:
     # replicated) — no host round-trip of the model

@@ -17,6 +17,19 @@ Three dataset shapes share ONE generic core (`make_device_train_step` /
 
 The scan body is the shared `step_body`, so semantics are identical to the
 host-fed paths — tests/test_device_data.py asserts bit-level parity.
+
+Fused train+eval — the eval pass lives INSIDE the train executable.
+On dispatch-expensive backends (the tunneled chip here) switching between
+the train and eval executables costs ~3 s per swap — far more than either
+program's compute at small dims, and it DOMINATED the wall-clock-to-quality
+runs. The reference never had this problem only because it never had
+executables: eval was one more Spark job. The TPU-native answer is ONE
+program: the K-step train scan followed by a lax.cond-gated forward-only
+eval pass, requested by passing ``metric_fn``/``metric_keys`` (generic,
+over stacked eval batches) or ``eval_data`` (LM, over a staged valid
+stream) to the builders below. The ``do_eval`` flag is a traced scalar —
+both cadences run the SAME executable, and XLA's cond skips the eval
+branch entirely on non-eval calls (tests/test_fused_eval.py).
 """
 
 from __future__ import annotations
@@ -38,6 +51,7 @@ from ..data.device_dataset import DeviceLMData, slice_window
 from .loop import (
     TrainState,
     _donation_supported,
+    call_loss,
     dp_reduce_fn,
     dp_rng_transform,
     step_body,
@@ -61,30 +75,135 @@ def _scan_indexed(loss_fn, optimizer, state, arrays, idxs, *, window_fn,
     return state, summarize_scan_metrics(ms)
 
 
+def _jit_step(step, jit: bool, donate: bool | None):
+    """The ONE jit/donation wrapper shared by every builder here."""
+    if not jit:
+        return step
+    if donate is None:
+        donate = _donation_supported()
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+# ---- traced eval bodies (the on-device forms of the host eval loops) ----
+
+
+def _device_eval_batches(metric_fn, params, eval_batches, keys):
+    """Traced weighted-mean eval over a stacked [n_ev, ...] batch pytree:
+    ``metric_fn(params, batch) -> (metrics dict, weight)``; returns
+    ``{k: sum(m_k * w) / sum(w)}`` — the on-device body of the task
+    runners' host eval loops."""
+
+    def body(acc, batch):
+        tot, wt = acc
+        m, w = metric_fn(params, batch)
+        w = w.astype(jnp.float32)
+        tot = {k: tot[k] + m[k].astype(jnp.float32) * w for k in keys}
+        return (tot, wt + w), None
+
+    zeros = {k: jnp.zeros((), jnp.float32) for k in keys}
+    (tot, wt), _ = lax.scan(
+        body, (zeros, jnp.zeros((), jnp.float32)), eval_batches
+    )
+    wt = jnp.maximum(wt, 1.0)
+    return {k: tot[k] / wt for k in keys}
+
+
+def _gated_eval_batches(metric_fn, state, eval_batches, do_eval, ms, keys):
+    ms.update(lax.cond(
+        do_eval,
+        lambda _: _device_eval_batches(metric_fn, state.params, eval_batches,
+                                       keys),
+        lambda _: {k: jnp.float32(jnp.nan) for k in keys},
+        operand=None,
+    ))
+    return ms
+
+
+def _device_lm_eval(loss_fn, params, eval_arrays, n_windows, seq_len, *,
+                    stateful, eval_carries, psum_axis=None):
+    """Traced token-weighted eval over the staged valid stream — the
+    on-device body of `evaluate()` (train/loop.py): sum(loss*tokens) /
+    sum(tokens) over the epoch's windows, carries threaded when stateful."""
+
+    def body(acc, w):
+        carries, tot, wt = acc
+        batch = slice_window(eval_arrays, w, seq_len)
+        loss, aux = call_loss(loss_fn, params, batch, None, carries,
+                              stateful=stateful)
+        tok = (aux["tokens"] if isinstance(aux, dict) and "tokens" in aux
+               else jnp.float32(1.0))
+        carries = aux["carries"] if stateful else carries
+        return (carries, tot + loss * tok, wt + tok), None
+
+    zero = jnp.zeros((), jnp.float32)
+    (_, tot, wt), _ = lax.scan(
+        body, (eval_carries, zero, zero),
+        jnp.arange(n_windows, dtype=jnp.int32),
+    )
+    if psum_axis is not None:
+        # per-shard sums → exact global token-weighted mean (equal-shape
+        # shards make this identical to make_dp_eval_step + evaluate())
+        tot = lax.psum(tot, psum_axis)
+        wt = lax.psum(wt, psum_axis)
+    return tot / jnp.maximum(wt, 1.0)
+
+
+def _gated_lm_eval(loss_fn, state, eval_arrays, do_eval, ms, *, n_windows,
+                   seq_len, stateful, eval_carries, psum_axis=None):
+    ms["eval_loss"] = lax.cond(
+        do_eval,
+        lambda _: _device_lm_eval(
+            loss_fn, state.params, eval_arrays, n_windows, seq_len,
+            stateful=stateful, eval_carries=eval_carries,
+            psum_axis=psum_axis,
+        ),
+        lambda _: jnp.float32(jnp.nan),
+        operand=None,
+    )
+    return ms
+
+
+# ---- generic builders (classification / forecasting / any window_fn) ----
+
+
 def make_device_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,
     window_fn: Callable,
     *,
+    metric_fn: Callable | None = None,
+    metric_keys=(),
     stateful: bool = False,
     grad_accum: int = 1,
     jit: bool = True,
     donate: bool | None = None,
 ):
     """Generic single-chip device-data step: ``step(state, arrays, idxs)``
-    with ``idxs`` carrying a leading K axis (one entry per optimizer step)."""
+    with ``idxs`` carrying a leading K axis (one entry per optimizer step).
 
-    def step(state: TrainState, arrays, idxs):
+    With ``metric_fn`` set, returns the FUSED train+eval step
+    ``step(state, arrays, idxs, eval_batches, do_eval)``: a lax.cond-gated
+    weighted eval over the HBM-staged ``eval_batches`` follows the train
+    scan in the SAME executable; its metrics appear under ``metric_keys``
+    (NaN on non-eval calls)."""
+    def core(state: TrainState, arrays, idxs):
         return _scan_indexed(
             loss_fn, optimizer, state, arrays, idxs, window_fn=window_fn,
             stateful=stateful, grad_accum=grad_accum,
         )
 
-    if jit:
-        if donate is None:
-            donate = _donation_supported()
-        step = jax.jit(step, donate_argnums=(0,) if donate else ())
-    return step
+    if metric_fn is None:
+        step = core
+    else:
+        keys = tuple(metric_keys)
+
+        def step(state: TrainState, arrays, idxs, eval_batches, do_eval):
+            state, ms = core(state, arrays, idxs)
+            return state, _gated_eval_batches(
+                metric_fn, state, eval_batches, do_eval, ms, keys
+            )
+
+    return _jit_step(step, jit, donate)
 
 
 def make_device_dp_train_step(
@@ -94,6 +213,8 @@ def make_device_dp_train_step(
     mesh: Mesh,
     arrays_spec,
     *,
+    metric_fn: Callable | None = None,
+    metric_keys=(),
     idx_spec=P(),
     axis: str = "data",
     stateful: bool = False,
@@ -105,32 +226,45 @@ def make_device_dp_train_step(
     staged arrays' shardings (LM streams shard their batch rows; example/
     series arrays replicate); ``idx_spec`` the index array's (P() when every
     shard uses the same indices, P(None, axis) to split a [K, B] batch of
-    row ids). Grads pmean over the ICI mesh as always."""
+    row ids). Grads pmean over the ICI mesh as always.
 
-    def per_shard(state: TrainState, arrays, idxs):
-        return _scan_indexed(
-            loss_fn, optimizer, state, arrays, idxs, window_fn=window_fn,
-            stateful=stateful, grad_accum=grad_accum,
-            rng_transform=dp_rng_transform(axis),
-            reduce_fn=dp_reduce_fn(axis),
-        )
-
+    With ``metric_fn`` set, the fused step's eval batches are REPLICATED
+    (``P()``): every shard runs the identical eval concurrently — same
+    wall-clock as one shard, exact same value on all, no collective."""
+    kw = dict(stateful=stateful, grad_accum=grad_accum,
+              rng_transform=dp_rng_transform(axis), reduce_fn=dp_reduce_fn(axis))
     state_spec = TrainState(
         step=P(), params=P(), opt_state=P(), rng=P(),
         carries=P(axis) if stateful else P(),
     )
+    def core(state: TrainState, arrays, idxs):
+        return _scan_indexed(
+            loss_fn, optimizer, state, arrays, idxs, window_fn=window_fn,
+            **kw,
+        )
+
+    if metric_fn is None:
+        per_shard = core
+        in_specs = (state_spec, arrays_spec, idx_spec)
+    else:
+        keys = tuple(metric_keys)
+
+        def per_shard(state: TrainState, arrays, idxs, eval_batches, do_eval):
+            state, ms = core(state, arrays, idxs)
+            return state, _gated_eval_batches(
+                metric_fn, state, eval_batches, do_eval, ms, keys
+            )
+
+        in_specs = (state_spec, arrays_spec, idx_spec, P(), P())
+
     sharded = shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(state_spec, arrays_spec, idx_spec),
+        in_specs=in_specs,
         out_specs=(state_spec, P()),
         check_vma=False,
     )
-    if jit:
-        if donate is None:
-            donate = _donation_supported()
-        sharded = jax.jit(sharded, donate_argnums=(0,) if donate else ())
-    return sharded
+    return _jit_step(sharded, jit, donate)
 
 
 # ---- LM wrappers: scalar-w0 per-dispatch API (window indices computed
@@ -150,27 +284,45 @@ def make_device_lm_train_step(
     optimizer: optax.GradientTransformation,
     data: DeviceLMData,
     *,
+    eval_data: DeviceLMData | None = None,
+    eval_windows: int | None = None,
     steps_per_call: int = 1,
     stateful: bool = False,
     grad_accum: int = 1,
     jit: bool = True,
     donate: bool | None = None,
 ):
-    """Single-chip LM device-data step: ``step(state, data.arrays, w0)``."""
+    """Single-chip LM device-data step: ``step(state, data.arrays, w0)``.
+
+    With ``eval_data`` (a staged valid stream) set, returns the FUSED
+    train+eval step ``step(state, arrays, w0, eval_arrays, do_eval
+    [, eval_carries])`` whose ``metrics["eval_loss"]`` is the token-weighted
+    valid loss when ``do_eval`` is true, NaN otherwise. ``eval_windows``
+    caps the eval pass (the --eval-batches bound)."""
     window_fn = lambda arrays, w: slice_window(arrays, w, data.seq_len)  # noqa: E731
 
-    def step(state: TrainState, arrays, w0):
+    def core(state: TrainState, arrays, w0):
         return _scan_indexed(
             loss_fn, optimizer, state, arrays,
             _lm_window_idxs(w0, data, steps_per_call),
             window_fn=window_fn, stateful=stateful, grad_accum=grad_accum,
         )
 
-    if jit:
-        if donate is None:
-            donate = _donation_supported()
-        step = jax.jit(step, donate_argnums=(0,) if donate else ())
-    return step
+    if eval_data is None:
+        step = core
+    else:
+        n_ev = min(eval_data.n_windows, eval_windows or eval_data.n_windows)
+        ev_T = eval_data.seq_len
+
+        def step(state: TrainState, arrays, w0, eval_arrays, do_eval,
+                 eval_carries=None):
+            state, ms = core(state, arrays, w0)
+            return state, _gated_lm_eval(
+                loss_fn, state, eval_arrays, do_eval, ms, n_windows=n_ev,
+                seq_len=ev_T, stateful=stateful, eval_carries=eval_carries,
+            )
+
+    return _jit_step(step, jit, donate)
 
 
 def make_device_dp_lm_train_step(
@@ -179,6 +331,8 @@ def make_device_dp_lm_train_step(
     data: DeviceLMData,
     mesh: Mesh,
     *,
+    eval_data: DeviceLMData | None = None,
+    eval_windows: int | None = None,
     axis: str = "data",
     steps_per_call: int = 1,
     stateful: bool = False,
@@ -189,32 +343,52 @@ def make_device_dp_lm_train_step(
     """Data-parallel LM device-data step: streams live sharded
     ``P(axis, None)`` (each chip's HBM holds only its batch rows — a cached
     RDD partition); the window slice is along time, so the feed needs no
-    collective."""
+    collective.
+
+    With ``eval_data`` set (FUSED step), the valid stream shards its batch
+    rows the same way and the per-shard eval sums psum into the exact
+    global token-weighted mean (same value as make_dp_eval_step +
+    evaluate())."""
     window_fn = lambda arrays, w: slice_window(arrays, w, data.seq_len)  # noqa: E731
-
-    def per_shard(state: TrainState, arrays, w0):
-        return _scan_indexed(
-            loss_fn, optimizer, state, arrays,
-            _lm_window_idxs(w0, data, steps_per_call),
-            window_fn=window_fn, stateful=stateful, grad_accum=grad_accum,
-            rng_transform=dp_rng_transform(axis),
-            reduce_fn=dp_reduce_fn(axis),
-        )
-
+    kw = dict(stateful=stateful, grad_accum=grad_accum,
+              rng_transform=dp_rng_transform(axis), reduce_fn=dp_reduce_fn(axis))
     state_spec = TrainState(
         step=P(), params=P(), opt_state=P(), rng=P(),
         carries=P(axis) if stateful else P(),
     )
+    stream_spec = {"streams": P(axis, None), "shifted": P(axis, None)}
+
+    def core(state: TrainState, arrays, w0):
+        return _scan_indexed(
+            loss_fn, optimizer, state, arrays,
+            _lm_window_idxs(w0, data, steps_per_call),
+            window_fn=window_fn, **kw,
+        )
+
+    if eval_data is None:
+        per_shard = core
+        in_specs = (state_spec, stream_spec, P())
+    else:
+        n_ev = min(eval_data.n_windows, eval_windows or eval_data.n_windows)
+        ev_T = eval_data.seq_len
+
+        def per_shard(state: TrainState, arrays, w0, eval_arrays, do_eval,
+                      eval_carries):
+            state, ms = core(state, arrays, w0)
+            return state, _gated_lm_eval(
+                loss_fn, state, eval_arrays, do_eval, ms, n_windows=n_ev,
+                seq_len=ev_T, stateful=stateful, eval_carries=eval_carries,
+                psum_axis=axis,
+            )
+
+        in_specs = (state_spec, stream_spec, P(), stream_spec, P(),
+                    P(axis) if stateful else P())
+
     sharded = shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(state_spec,
-                  {"streams": P(axis, None), "shifted": P(axis, None)}, P()),
+        in_specs=in_specs,
         out_specs=(state_spec, P()),
         check_vma=False,
     )
-    if jit:
-        if donate is None:
-            donate = _donation_supported()
-        sharded = jax.jit(sharded, donate_argnums=(0,) if donate else ())
-    return sharded
+    return _jit_step(sharded, jit, donate)

@@ -20,6 +20,7 @@ from typing import Any, Callable, Iterable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 
@@ -258,7 +259,16 @@ def evaluate(
         total += float(m["loss"]) * w
         weight += w
     loss = total / max(weight, 1.0)
-    return {"eval_loss": loss, "eval_ppl": float(jnp.exp(jnp.minimum(loss, 30.0)))}
+    return eval_metrics(loss)
+
+
+def eval_metrics(loss: float) -> dict[str, float]:
+    """The ONE loss→metrics mapping shared by host-side `evaluate()` and the
+    fused on-device eval (device_step.py) so their records are comparable."""
+    return {
+        "eval_loss": float(loss),
+        "eval_ppl": float(jnp.exp(jnp.minimum(loss, 30.0))),
+    }
 
 
 def train_loop(
@@ -275,6 +285,7 @@ def train_loop(
     checkpoint_every: int = 0,
     tokens_per_batch: int | None = None,
     steps_per_call: int = 1,
+    fused_eval: Callable[[dict], dict] | None = None,
 ) -> TrainState:
     """Drive the jitted step over a batch iterator, logging scalar metrics.
 
@@ -285,6 +296,13 @@ def train_loop(
     iteration is one K-step dispatch: ``num_steps``/``log_every``/
     ``eval_every``/``checkpoint_every`` count CALLS, and throughput metrics
     are scaled by K to stay in optimizer-steps/tokens per second.
+
+    With ``fused_eval`` set (device_step.py's train+eval builders) the step
+    signature is ``train_step(state, batch, do_eval)`` and the eval record
+    is ``fused_eval(metrics)`` — a task-specific mapper from the step's own
+    eval scalars (the LM derives perplexity, the classifier reads accuracy)
+    — instead of calling ``eval_fn``: one executable for both cadences,
+    zero train/eval program swaps.
     """
     t0 = time.perf_counter()
     window_start = t0
@@ -292,9 +310,13 @@ def train_loop(
     for i, batch in enumerate(batches):
         if num_steps is not None and i >= num_steps:
             break
-        state, metrics = train_step(state, batch)
-        last_metrics = metrics
         step = i + 1
+        if fused_eval:
+            do_eval = bool(eval_every) and step % eval_every == 0
+            state, metrics = train_step(state, batch, np.bool_(do_eval))
+        else:
+            state, metrics = train_step(state, batch)
+        last_metrics = metrics
         if log_every and step % log_every == 0:
             loss = float(metrics["loss"])  # sync point
             now = time.perf_counter()
@@ -312,9 +334,14 @@ def train_loop(
                 )
             if logger is not None:
                 logger.log(record)
-        if eval_fn is not None and eval_every and step % eval_every == 0:
-            ev = eval_fn(state.params)
-            if logger is not None:
+        if eval_every and step % eval_every == 0:
+            if fused_eval is not None:
+                ev = fused_eval(metrics)
+            elif eval_fn is not None:
+                ev = eval_fn(state.params)
+            else:
+                ev = None
+            if ev is not None and logger is not None:
                 logger.log({"step": int(state.step), **ev})
         if checkpoint_fn is not None and checkpoint_every and step % checkpoint_every == 0:
             checkpoint_fn(state)
