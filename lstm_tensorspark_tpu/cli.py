@@ -82,11 +82,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "equivalent; dataset must fit HBM")
     p.add_argument("--fused-eval", action="store_true",
                    help="run the eval pass INSIDE the train executable on "
-                        "device-resident eval data (every task; requires "
-                        "--device-data): one program for both cadences, so "
-                        "an eval costs zero train/eval executable swaps — "
-                        "the swap is ~3 s/eval on dispatch-expensive "
-                        "backends and dominates small-model runs")
+                        "device-resident eval data (every task; composes "
+                        "with --device-data or the host-fed feed — only the "
+                        "EVAL split must fit HBM): one program for both "
+                        "cadences, so an eval costs zero train/eval "
+                        "executable swaps — the swap is ~3 s/eval on "
+                        "dispatch-expensive backends and dominates "
+                        "small-model runs")
     # --- inference / generation (LM tasks) ---
     p.add_argument("--generate-tokens", type=int, default=0,
                    help="after training, sample N continuation tokens from the LM")
@@ -154,10 +156,17 @@ def main(argv=None) -> int:
         raise SystemExit("--use-pallas is not supported with --tensor-parallel "
                          "(the GSPMD-sharded hidden dim cannot enter the fused "
                          "kernel)")
-    if args.fused_eval and not args.device_data:
-        raise SystemExit("--fused-eval requires --device-data (the eval pass "
-                         "runs over device-resident eval data inside the "
-                         "train executable)")
+    if args.fused_eval and max(args.tensor_parallel, args.seq_parallel,
+                               args.pipeline_stages) > 1:
+        raise SystemExit("--fused-eval is not supported with --tensor-parallel/"
+                         "--seq-parallel/--pipeline-stages (those train steps "
+                         "place their own shardings); it composes with "
+                         "--backend single/dp, with or without --device-data")
+    if args.fused_eval and not args.eval_every:
+        raise SystemExit("--fused-eval needs --eval-every > 0 (it fuses the "
+                         "PERIODIC eval pass into the train executable; "
+                         "without a cadence it would stage eval data and "
+                         "compile the eval branch for nothing)")
 
     if args.compilation_cache:
         # cache EVERY executable (the defaults skip sub-second compiles,
@@ -307,8 +316,11 @@ def _setup_training(
                 loss_fn, optimizer, stateful=stateful, grad_accum=accum
             )
 
-        def wrap_stream(it):
-            if k > 1:
+        def wrap_stream(it, always_stack=False):
+            # always_stack: the fused host-fed train+eval step is a K-step
+            # (multistep) program even at K=1, so its feed needs the
+            # leading axis regardless of --steps-per-call
+            if k > 1 or always_stack:
                 it = stacked_batches(it, k)
             if depth > 0:
                 it = prefetch_to_device(it, depth)
@@ -331,9 +343,10 @@ def _setup_training(
 
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        def wrap_stream(it):
-            dim = 1 if k > 1 else 0
-            if k > 1:
+        def wrap_stream(it, always_stack=False):
+            stacked = k > 1 or always_stack
+            dim = 1 if stacked else 0
+            if stacked:
                 it = stacked_batches(it, k)
             if depth > 0:
                 sharding = NamedSharding(mesh, P(*([None] * dim), "data"))
@@ -521,6 +534,10 @@ def _run_lm(args, logger) -> int:
     eval_bs -= eval_bs % max(shards, 1)
 
     fused_eval = bool(args.fused_eval)
+    if fused_eval and eval_bs <= 0:
+        logger.log({"note": "fused-eval: valid split smaller than one "
+                            "window; falling back to host-driven eval"})
+        fused_eval = False
     # data-exact resume: fast-forward every stream to the restored step so a
     # resumed run sees exactly the windows the uninterrupted run would
     start_step = int(state.step)
@@ -536,10 +553,6 @@ def _run_lm(args, logger) -> int:
         # values below were normalized+validated by _setup_training
         k = args.steps_per_call
         ddata = stage_lm_data(train_tokens, args.batch_size, seq_len, mesh=mesh)
-        if fused_eval and eval_bs <= 0:
-            logger.log({"note": "fused-eval: valid split smaller than one "
-                                "window; falling back to host-driven eval"})
-            fused_eval = False
         edata = (stage_lm_data(valid_tokens, eval_bs, seq_len, mesh=mesh)
                  if fused_eval else None)
         if mesh is None:
@@ -565,9 +578,37 @@ def _run_lm(args, logger) -> int:
             train_step = lambda state, w0: dstep(state, ddata.arrays, w0)  # noqa: E731
         batches = window_index_stream(ddata, k, start_step=start_step)
     else:
-        batches = wrap_stream(lm_batch_stream(
+        stream = lm_batch_stream(
             train_tokens, args.batch_size, seq_len, start_step=start_step
-        ))
+        )
+        if fused_eval:
+            # host-fed train feed + fused in-executable eval: only the VALID
+            # split must fit HBM (the case where the train set exceeds it)
+            from .data import stage_lm_data
+            from .train import make_dp_multi_train_step, make_multi_train_step
+
+            edata = stage_lm_data(valid_tokens, eval_bs, seq_len, mesh=mesh)
+            ev_carries0 = init_carries(cfg, eval_bs) if stateful else None
+            if mesh is not None and stateful:
+                ev_carries0 = shard_batch(ev_carries0, mesh)
+            if mesh is None:
+                mstep = make_multi_train_step(
+                    loss_fn, optimizer, eval_data=edata,
+                    eval_windows=args.eval_batches,
+                    stateful=stateful, grad_accum=args.grad_accum,
+                )
+            else:
+                mstep = make_dp_multi_train_step(
+                    loss_fn, optimizer, mesh, eval_data=edata,
+                    eval_windows=args.eval_batches,
+                    stateful=stateful, grad_accum=args.grad_accum,
+                )
+            train_step = lambda state, b, do_eval: mstep(  # noqa: E731
+                state, b, edata.arrays, do_eval, ev_carries0
+            )
+            batches = wrap_stream(stream, always_stack=True)
+        else:
+            batches = wrap_stream(stream)
 
     if mesh is None:
         eval_step = make_eval_step(loss_fn, stateful=stateful)

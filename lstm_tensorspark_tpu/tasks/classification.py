@@ -16,7 +16,6 @@ def run_classifier(args, logger) -> int:
     from ..cli import _make_logged_loop, _setup_training
     from ..data import get_dataset, padded_batches
     from ..models.classifier import ClassifierConfig, classifier_loss, init_classifier
-    from ..train import make_optimizer
 
     if args.stateful:
         raise SystemExit(
@@ -103,10 +102,32 @@ def run_classifier(args, logger) -> int:
             getattr(args, "eval_batches", None),
         )
 
-    # --fused-eval without --device-data is rejected in cli.main()
-    fused_eval = bool(getattr(args, "fused_eval", False)) and getattr(
-        args, "device_data", False
-    )
+    fused_eval = bool(getattr(args, "fused_eval", False))
+    if fused_eval and not valid_seqs:
+        logger.log({"note": "fused-eval: empty valid split; "
+                            "falling back to host-driven eval"})
+        fused_eval = False
+    if fused_eval:
+        # Fused in-executable eval (works with BOTH feeds — device-data and
+        # host-fed): the weighted accuracy/loss sums run over the stacked
+        # host eval batches (same `eval_batches` constructor as eval_fn, so
+        # the two paths can never see different batches).
+        import numpy as np
+
+        from ..data import stage_stacked_batches
+
+        ev_stacked = stage_stacked_batches(eval_batches(), mesh=mesh)
+
+        def metric_fn(p, b):
+            _, aux = classifier_loss(p, b, cfg)
+            w = b["valid"].astype(np.float32).sum()
+            return ({"eval_loss": aux["loss"],
+                     "eval_accuracy": aux["accuracy"]}, w)
+
+        metric_keys = ("eval_loss", "eval_accuracy")
+    else:
+        metric_fn, metric_keys = None, ()
+
     if getattr(args, "device_data", False):
         # HBM-staged padded example matrix; batches gathered on-device by
         # row indices in the same shuffle+bucket order as padded_batches.
@@ -137,38 +158,15 @@ def run_classifier(args, logger) -> int:
         from jax.sharding import PartitionSpec as P
 
         arrays_spec = {k2: P() for k2 in staged.arrays}
-        if fused_eval and not valid_seqs:
-            logger.log({"note": "fused-eval: empty valid split; "
-                                "falling back to host-driven eval"})
-            fused_eval = False
-        if fused_eval:
-            # Stack the EXACT host eval batches (same `eval_batches`
-            # constructor as eval_fn below: padded_batches order, filler
-            # rows valid=False) into one [n_ev, ...] pytree staged in HBM;
-            # the weighted accuracy/loss sums run inside the train
-            # executable (zero train/eval program swaps).
-            from ..data import stage_stacked_batches
-
-            ev_stacked = stage_stacked_batches(eval_batches(), mesh=mesh)
-
-            def metric_fn(p, b):
-                _, aux = classifier_loss(p, b, cfg)
-                w = b["valid"].astype(np.float32).sum()
-                return ({"eval_loss": aux["loss"],
-                         "eval_accuracy": aux["accuracy"]}, w)
-
-            keys = ("eval_loss", "eval_accuracy")
-        else:
-            metric_fn, keys = None, ()
         if mesh is None:
             dstep = make_device_train_step(
                 loss_fn, optimizer, take_batch, metric_fn=metric_fn,
-                metric_keys=keys, grad_accum=args.grad_accum,
+                metric_keys=metric_keys, grad_accum=args.grad_accum,
             )
         else:
             dstep = make_device_dp_train_step(
                 loss_fn, optimizer, take_batch, mesh, arrays_spec,
-                metric_fn=metric_fn, metric_keys=keys,
+                metric_fn=metric_fn, metric_keys=metric_keys,
                 idx_spec=P(None, "data"), grad_accum=args.grad_accum,
             )
         if fused_eval:
@@ -190,13 +188,33 @@ def run_classifier(args, logger) -> int:
     else:
         from ..data.batching import epoch_stream
 
-        stream = wrap_stream(epoch_stream(
+        raw = epoch_stream(
             lambda epoch: padded_batches(
                 train_seqs, train_labels, args.batch_size, max_len,
                 shuffle_seed=args.seed + epoch,
             ),
             steps_per_epoch=steps_per_epoch, start_step=start_step,
-        ))
+        )
+        if fused_eval:
+            # host-fed feed + fused in-executable eval
+            from ..train import make_dp_multi_train_step, make_multi_train_step
+
+            if mesh is None:
+                mstep = make_multi_train_step(
+                    loss_fn, optimizer, metric_fn=metric_fn,
+                    metric_keys=metric_keys, grad_accum=args.grad_accum,
+                )
+            else:
+                mstep = make_dp_multi_train_step(
+                    loss_fn, optimizer, mesh, metric_fn=metric_fn,
+                    metric_keys=metric_keys, grad_accum=args.grad_accum,
+                )
+            train_step = lambda state, b, do_eval: mstep(  # noqa: E731
+                state, b, ev_stacked, do_eval
+            )
+            stream = wrap_stream(raw, always_stack=True)
+        else:
+            stream = wrap_stream(raw)
     if args.tensor_parallel > 1:
         # eval on the DEVICE-RESIDENT sharded params — no host gather
         # (VERDICT r2 weak #6); batches shard over the data axis

@@ -17,7 +17,6 @@ def run_forecaster(args, logger) -> int:
     from ..data import get_dataset
     from ..data.batching import forecast_windows
     from ..models.seq2seq import Seq2SeqConfig, forecast, init_seq2seq, seq2seq_loss
-    from ..train import make_optimizer
 
     if args.stateful:
         raise SystemExit(
@@ -98,10 +97,37 @@ def run_forecaster(args, logger) -> int:
             getattr(args, "eval_batches", None),
         )
 
-    # --fused-eval without --device-data is rejected in cli.main()
-    fused_eval = bool(getattr(args, "fused_eval", False)) and getattr(
-        args, "device_data", False
-    )
+    fused_eval = bool(getattr(args, "fused_eval", False))
+    if fused_eval and len(valid_series) < context_len + horizon:
+        logger.log({"note": "fused-eval: valid series shorter than one "
+                            "window; falling back to host-driven eval"})
+        fused_eval = False
+    if fused_eval:
+        # Fused in-executable eval (works with BOTH feeds — device-data and
+        # host-fed): the free-running forecast and its masked MSE/MAE sums
+        # run over the stacked host eval batches (same `eval_batches`
+        # constructor as eval_fn, so the two paths can never see different
+        # batches).
+        import jax.numpy as jnp
+
+        from ..data import stage_stacked_batches
+
+        ev_stacked = stage_stacked_batches(eval_batches(), mesh=mesh)
+
+        def metric_fn(p, b):
+            preds = forecast(p, b["context"], cfg)
+            w = b["valid"].astype(jnp.float32)
+            n = jnp.maximum(w.sum(), 1.0)
+            err = (preds - b["targets"]) * w[:, None, None]
+            per_elem = float(horizon * preds.shape[-1])
+            mse = (err ** 2).sum() / (n * per_elem)
+            mae = jnp.abs(err).sum() / (n * per_elem)
+            return {"eval_mse": mse, "eval_mae": mae}, w.sum()
+
+        metric_keys = ("eval_mse", "eval_mae")
+    else:
+        metric_fn, metric_keys = None, ()
+
     if getattr(args, "device_data", False):
         # HBM-staged series; (context, horizon) windows sliced on-device from
         # per-step start indices — same shuffled order as forecast_windows,
@@ -120,43 +146,15 @@ def run_forecaster(args, logger) -> int:
         )
         from jax.sharding import PartitionSpec as P
 
-        if fused_eval and len(valid_series) < context_len + horizon:
-            logger.log({"note": "fused-eval: valid series shorter than one "
-                                "window; falling back to host-driven eval"})
-            fused_eval = False
-        if fused_eval:
-            # Stack the EXACT host eval batches (same `eval_batches`
-            # constructor as eval_fn below: forecast_windows order, filler
-            # repeats valid=False) in HBM; the free-running forecast and
-            # its masked MSE/MAE sums run inside the train executable.
-            import jax.numpy as jnp
-
-            from ..data import stage_stacked_batches
-
-            ev_stacked = stage_stacked_batches(eval_batches(), mesh=mesh)
-
-            def metric_fn(p, b):
-                preds = forecast(p, b["context"], cfg)
-                w = b["valid"].astype(jnp.float32)
-                n = jnp.maximum(w.sum(), 1.0)
-                err = (preds - b["targets"]) * w[:, None, None]
-                per_elem = float(horizon * preds.shape[-1])
-                mse = (err ** 2).sum() / (n * per_elem)
-                mae = jnp.abs(err).sum() / (n * per_elem)
-                return {"eval_mse": mse, "eval_mae": mae}, w.sum()
-
-            keys = ("eval_mse", "eval_mae")
-        else:
-            metric_fn, keys = None, ()
         if mesh is None:
             dstep = make_device_train_step(
                 loss_fn, optimizer, window_fn, metric_fn=metric_fn,
-                metric_keys=keys, grad_accum=args.grad_accum,
+                metric_keys=metric_keys, grad_accum=args.grad_accum,
             )
         else:
             dstep = make_device_dp_train_step(
                 loss_fn, optimizer, window_fn, mesh, {"series": P()},
-                metric_fn=metric_fn, metric_keys=keys,
+                metric_fn=metric_fn, metric_keys=metric_keys,
                 idx_spec=P(None, "data"), grad_accum=args.grad_accum,
             )
         if fused_eval:
@@ -177,13 +175,33 @@ def run_forecaster(args, logger) -> int:
     else:
         from ..data.batching import epoch_stream
 
-        stream = wrap_stream(epoch_stream(
+        raw = epoch_stream(
             lambda epoch: forecast_windows(
                 train_series, context_len, horizon, args.batch_size,
                 shuffle_seed=args.seed + epoch,
             ),
             steps_per_epoch=steps_per_epoch, start_step=start_step,
-        ))
+        )
+        if fused_eval:
+            # host-fed feed + fused in-executable eval
+            from ..train import make_dp_multi_train_step, make_multi_train_step
+
+            if mesh is None:
+                mstep = make_multi_train_step(
+                    loss_fn, optimizer, metric_fn=metric_fn,
+                    metric_keys=metric_keys, grad_accum=args.grad_accum,
+                )
+            else:
+                mstep = make_dp_multi_train_step(
+                    loss_fn, optimizer, mesh, metric_fn=metric_fn,
+                    metric_keys=metric_keys, grad_accum=args.grad_accum,
+                )
+            train_step = lambda state, b, do_eval: mstep(  # noqa: E731
+                state, b, ev_stacked, do_eval
+            )
+            stream = wrap_stream(raw, always_stack=True)
+        else:
+            stream = wrap_stream(raw)
     if args.tensor_parallel > 1:
         # eval on the DEVICE-RESIDENT sharded params — no host gather
         # (VERDICT r2 weak #6); contexts shard over the data axis

@@ -18,6 +18,12 @@ parity against K sequential single steps.
 
 Metrics: ``loss`` is the mean over the K steps (the natural logging quantity
 for a K-step window), ``loss_last``/``grad_norm`` are the final step's.
+
+Fused eval composes with the HOST-FED feed too: only the EVAL data must be
+device-resident for the in-executable eval pass (device_step.py), so
+``eval_data`` (LM valid stream) or ``metric_fn`` (stacked task eval
+batches) turn these builders into fused train+eval steps — the case where
+the train set exceeds HBM but the valid split fits.
 """
 
 from __future__ import annotations
@@ -34,6 +40,8 @@ try:  # jax >= 0.4.35
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+from ..data.device_dataset import DeviceLMData
+from .device_step import _gated_eval_batches, _gated_lm_eval, _jit_step
 from .loop import (
     TrainState,
     _donation_supported,
@@ -60,10 +68,42 @@ def _scan_steps(loss_fn, optimizer, state, batches, *, stateful, rng_transform=N
     return state, summarize_scan_metrics(ms)
 
 
+def _fused_tail(loss_fn, eval_data, eval_windows, metric_fn, metric_keys,
+                stateful, psum_axis=None):
+    """Resolve which fused-eval tail (if any) the builder should append:
+    returns None (plain step) or a closure (state, ms, *eval_args) -> ms."""
+    if eval_data is not None:
+        n_ev = min(eval_data.n_windows, eval_windows or eval_data.n_windows)
+        ev_T = eval_data.seq_len
+
+        def tail(state, ms, eval_arrays, do_eval, eval_carries=None):
+            return _gated_lm_eval(
+                loss_fn, state, eval_arrays, do_eval, ms, n_windows=n_ev,
+                seq_len=ev_T, stateful=stateful, eval_carries=eval_carries,
+                psum_axis=psum_axis,
+            )
+
+        return tail
+    if metric_fn is not None:
+        keys = tuple(metric_keys)
+
+        def tail(state, ms, eval_batches, do_eval):
+            return _gated_eval_batches(
+                metric_fn, state, eval_batches, do_eval, ms, keys
+            )
+
+        return tail
+    return None
+
+
 def make_multi_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,
     *,
+    eval_data: DeviceLMData | None = None,
+    eval_windows: int | None = None,
+    metric_fn: Callable | None = None,
+    metric_keys=(),
     jit: bool = True,
     donate: bool | None = None,
     stateful: bool = False,
@@ -74,19 +114,31 @@ def make_multi_train_step(
     ``multi_step(state, batches)`` where ``batches`` is the usual batch pytree
     with an extra leading K axis (see data.batching.stacked_batches). K is
     read from the array shapes — one compilation per distinct K.
-    """
 
-    def multi_step(state: TrainState, batches):
+    With ``eval_data`` (LM valid stream) or ``metric_fn`` (stacked task
+    eval batches), returns the FUSED step
+    ``multi_step(state, batches, <eval args>, do_eval[, eval_carries])`` —
+    identical semantics to device_step.py's fused builders but with a
+    host-fed train feed.
+    """
+    tail = _fused_tail(loss_fn, eval_data, eval_windows, metric_fn,
+                       metric_keys, stateful)
+
+    def core(state: TrainState, batches):
         return _scan_steps(
             loss_fn, optimizer, state, batches,
             stateful=stateful, grad_accum=grad_accum,
         )
 
-    if jit:
-        if donate is None:
-            donate = _donation_supported()
-        multi_step = jax.jit(multi_step, donate_argnums=(0,) if donate else ())
-    return multi_step
+    if tail is None:
+        multi_step = core
+    else:
+
+        def multi_step(state: TrainState, batches, *eval_args):
+            state, ms = core(state, batches)
+            return state, tail(state, ms, *eval_args)
+
+    return _jit_step(multi_step, jit, donate)
 
 
 def make_dp_multi_train_step(
@@ -94,6 +146,10 @@ def make_dp_multi_train_step(
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
     *,
+    eval_data: DeviceLMData | None = None,
+    eval_windows: int | None = None,
+    metric_fn: Callable | None = None,
+    metric_keys=(),
     axis: str = "data",
     jit: bool = True,
     donate: bool | None = None,
@@ -104,9 +160,16 @@ def make_dp_multi_train_step(
     pmean grad all-reduce — parallel/data_parallel.py) scanned K times inside
     the shard_map, so the ICI all-reduce happens every step but the host
     dispatch only once per K. ``batches`` leading axes are [K, B, ...] with B
-    sharded over the data axis (spec ``P(None, axis)``)."""
+    sharded over the data axis (spec ``P(None, axis)``).
 
-    def per_shard_multi(state: TrainState, batches):
+    ``eval_data``/``metric_fn`` append the fused eval tail (device_step.py
+    sharding contracts: LM valid stream shards batch rows + psums the
+    token-weighted sums; task eval batches replicate)."""
+    tail = _fused_tail(loss_fn, eval_data, eval_windows, metric_fn,
+                       metric_keys, stateful,
+                       psum_axis=axis if eval_data is not None else None)
+
+    def core(state: TrainState, batches):
         return _scan_steps(
             loss_fn, optimizer, state, batches, stateful=stateful,
             grad_accum=grad_accum,
@@ -118,15 +181,31 @@ def make_dp_multi_train_step(
         step=P(), params=P(), opt_state=P(), rng=P(),
         carries=P(axis) if stateful else P(),
     )
+    if tail is None:
+        per_shard = core
+        in_specs = (state_spec, P(None, axis))
+    elif eval_data is not None:
+        stream_spec = {"streams": P(axis, None), "shifted": P(axis, None)}
+
+        def per_shard(state, batches, eval_arrays, do_eval, eval_carries):
+            state, ms = core(state, batches)
+            return state, tail(state, ms, eval_arrays, do_eval, eval_carries)
+
+        in_specs = (state_spec, P(None, axis), stream_spec, P(),
+                    P(axis) if stateful else P())
+    else:
+
+        def per_shard(state, batches, eval_batches, do_eval):
+            state, ms = core(state, batches)
+            return state, tail(state, ms, eval_batches, do_eval)
+
+        in_specs = (state_spec, P(None, axis), P(), P())
+
     sharded = shard_map(
-        per_shard_multi,
+        per_shard,
         mesh=mesh,
-        in_specs=(state_spec, P(None, axis)),
+        in_specs=in_specs,
         out_specs=(state_spec, P()),
         check_vma=False,
     )
-    if jit:
-        if donate is None:
-            donate = _donation_supported()
-        sharded = jax.jit(sharded, donate_argnums=(0,) if donate else ())
-    return sharded
+    return _jit_step(sharded, jit, donate)

@@ -263,7 +263,77 @@ def test_cli_fused_eval_dp_classifier(tmp_path):
     assert evals and all(np.isfinite(r["eval_accuracy"]) for r in evals)
 
 
-def test_cli_fused_eval_requires_device_data():
+def test_cli_fused_eval_host_fed_lm_matches_device_data(tmp_path):
+    """--fused-eval without --device-data (host-fed train feed, staged eval
+    stream): must produce the SAME eval records as the device-data run —
+    identical data order (tests/test_device_data.py) + identical eval."""
+    from lstm_tensorspark_tpu.cli import main
+
+    argv = [
+        "--dataset", "ptb_char", "--hidden-units", "16", "--num-layers", "1",
+        "--batch-size", "8", "--seq-len", "16", "--num-steps", "8",
+        "--steps-per-call", "2", "--fused-eval", "--eval-every", "2",
+        "--log-every", "1", "--backend", "single",
+    ]
+    a, b = tmp_path / "host.jsonl", tmp_path / "dev.jsonl"
+    assert main(argv + ["--jsonl", str(a)]) == 0
+    assert main(argv + ["--device-data", "--jsonl", str(b)]) == 0
+
+    def evals(p):
+        return [(r["step"], r["eval_loss"]) for r in map(json.loads, open(p))
+                if "eval_loss" in r]
+
+    ea, eb = evals(a), evals(b)
+    assert ea and [s for s, _ in ea] == [s for s, _ in eb]
+    np.testing.assert_allclose([v for _, v in ea], [v for _, v in eb],
+                               rtol=1e-6)
+
+
+def test_cli_fused_eval_host_fed_k1_single_step(tmp_path):
+    """Host-fed fused eval at --steps-per-call 1 (the K=1 stacked path)."""
+    from lstm_tensorspark_tpu.cli import main
+
+    jsonl = tmp_path / "k1.jsonl"
+    rc = main([
+        "--dataset", "ptb_char", "--hidden-units", "16", "--num-layers", "1",
+        "--batch-size", "8", "--seq-len", "16", "--num-steps", "4",
+        "--fused-eval", "--eval-every", "2", "--log-every", "1",
+        "--backend", "single", "--jsonl", str(jsonl),
+    ])
+    assert rc == 0
+    records = [json.loads(l) for l in open(jsonl)]
+    evals = [r for r in records if "eval_ppl" in r and r.get("note") != "final"]
+    final = [r for r in records if r.get("note") == "final"][0]
+    last = [r for r in evals if r["step"] == final["step"]]
+    assert last, (evals, final)
+    np.testing.assert_allclose(last[0]["eval_loss"], final["eval_loss"],
+                               rtol=1e-5)
+
+
+def test_cli_fused_eval_host_fed_forecaster_dp(tmp_path):
+    """Host-fed fused eval for a task runner under the DP backend."""
+    from lstm_tensorspark_tpu.cli import main
+
+    jsonl = tmp_path / "fdp.jsonl"
+    rc = main([
+        "--dataset", "uci_electricity", "--hidden-units", "16",
+        "--num-layers", "1", "--batch-size", "16", "--seq-len", "24",
+        "--num-steps", "4", "--steps-per-call", "2", "--fused-eval",
+        "--eval-every", "2", "--log-every", "1", "--backend", "dp",
+        "--num-partitions", "8", "--learning-rate", "0.05",
+        "--jsonl", str(jsonl),
+    ])
+    assert rc == 0
+    records = [json.loads(l) for l in open(jsonl)]
+    evals = [r for r in records if "eval_mse" in r and r.get("note") != "final"]
+    final = [r for r in records if r.get("note") == "final"][0]
+    last = [r for r in evals if r["step"] == final["step"]]
+    assert last, (evals, final)
+    np.testing.assert_allclose(last[0]["eval_mse"], final["eval_mse"],
+                               rtol=1e-4)
+
+
+def test_cli_fused_eval_rejected_with_tp():
     import pytest
 
     from lstm_tensorspark_tpu.cli import main
@@ -271,5 +341,14 @@ def test_cli_fused_eval_requires_device_data():
     with pytest.raises(SystemExit):
         main([
             "--dataset", "ptb_char", "--num-steps", "2", "--fused-eval",
-            "--backend", "single",
+            "--tensor-parallel", "2",
         ])
+
+
+def test_cli_fused_eval_requires_eval_cadence():
+    import pytest
+
+    from lstm_tensorspark_tpu.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["--dataset", "ptb_char", "--num-steps", "2", "--fused-eval"])
