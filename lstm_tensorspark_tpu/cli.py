@@ -18,6 +18,10 @@ import argparse
 import jax
 import numpy as np
 
+# The LM task family (word/char language modelling) — ONE definition for
+# task dispatch and every LM-specific CLI gate.
+LM_DATASETS = ("ptb_char", "wikitext2", "wikitext103")
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -84,11 +88,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the eval pass INSIDE the train executable on "
                         "device-resident eval data (every task; composes "
                         "with --device-data or the host-fed feed — only the "
-                        "EVAL split must fit HBM): one program for both "
-                        "cadences, so an eval costs zero train/eval "
-                        "executable swaps — the swap is ~3 s/eval on "
-                        "dispatch-expensive backends and dominates "
-                        "small-model runs")
+                        "EVAL split must fit HBM — and, for the classifier/"
+                        "forecaster, with --tensor-parallel): one program "
+                        "for both cadences, so an eval costs zero "
+                        "train/eval executable swaps — the swap is "
+                        "~3 s/eval on dispatch-expensive backends and "
+                        "dominates small-model runs")
     # --- inference / generation (LM tasks) ---
     p.add_argument("--generate-tokens", type=int, default=0,
                    help="after training, sample N continuation tokens from the LM")
@@ -156,12 +161,19 @@ def main(argv=None) -> int:
         raise SystemExit("--use-pallas is not supported with --tensor-parallel "
                          "(the GSPMD-sharded hidden dim cannot enter the fused "
                          "kernel)")
-    if args.fused_eval and max(args.tensor_parallel, args.seq_parallel,
-                               args.pipeline_stages) > 1:
-        raise SystemExit("--fused-eval is not supported with --tensor-parallel/"
-                         "--seq-parallel/--pipeline-stages (those train steps "
-                         "place their own shardings); it composes with "
-                         "--backend single/dp, with or without --device-data")
+    if args.fused_eval and max(args.seq_parallel, args.pipeline_stages) > 1:
+        raise SystemExit("--fused-eval is not supported with --seq-parallel/"
+                         "--pipeline-stages (a lax.cond around their manual "
+                         "wavefront collectives would diverge); it composes "
+                         "with --backend single/dp and, for the classifier/"
+                         "forecaster, with --tensor-parallel")
+    if args.fused_eval and args.tensor_parallel > 1 and args.dataset in (
+            LM_DATASETS):
+        raise SystemExit("--fused-eval with --tensor-parallel is supported "
+                         "for the classifier/forecaster (pure GSPMD jit "
+                         "steps); the LM's TP step is a manual {data,seq} "
+                         "shard_map where a gated eval branch could diverge "
+                         "on the auto-axis collectives")
     if args.fused_eval and not args.eval_every:
         raise SystemExit("--fused-eval needs --eval-every > 0 (it fuses the "
                          "PERIODIC eval pass into the train executable; "
@@ -188,7 +200,7 @@ def main(argv=None) -> int:
         set_tracer(tracer)
 
     try:
-        if args.dataset in ("ptb_char", "wikitext2", "wikitext103"):
+        if args.dataset in LM_DATASETS:
             rc = _run_lm(args, logger)
         elif args.generate_tokens > 0:
             raise SystemExit(
@@ -357,10 +369,15 @@ def _setup_training(
 
 
 def _setup_tp_training(args, logger, *, loss_fn, params, optimizer, rng,
-                       specs_fn, hidden: int):
+                       specs_fn, hidden: int, metric_fn=None,
+                       metric_keys=()):
     """Tensor-parallel (GSPMD dp×tp) setup for the classifier/forecaster
     tasks — the compiler-first recipe: annotate param shardings, let XLA
     insert the collectives. Returns the same tuple as _setup_training.
+
+    With ``metric_fn`` set (fused eval), the returned train_step has the
+    fused signature ``(state, batch, eval_batches, do_eval)`` — built ONCE
+    here, not rebuilt by the task runner.
     """
     from .parallel import make_mesh
     from .parallel.tensor_parallel import make_tp_train_step, place_params
@@ -399,7 +416,8 @@ def _setup_tp_training(args, logger, *, loss_fn, params, optimizer, rng,
     state = state._replace(params=place_params(state.params, specs, mesh))
 
     train_step = make_tp_train_step(
-        loss_fn, optimizer, mesh, params, param_specs=specs
+        loss_fn, optimizer, mesh, params, param_specs=specs,
+        metric_fn=metric_fn, metric_keys=metric_keys,
     )
     # jit's in_shardings place each host batch; the stream passes through
     return state, train_step, mesh, dp, (lambda it: it), checkpoint_fn

@@ -126,6 +126,8 @@ def make_tp_train_step(
     stateful: bool = False,
     donate: bool | None = None,
     param_specs=None,
+    metric_fn: Callable | None = None,
+    metric_keys=(),
 ):
     """Compiler-sharded (GSPMD) train step: TP via param shardings, DP via
     batch sharding — no shard_map, no manual collectives.
@@ -136,6 +138,16 @@ def make_tp_train_step(
     The batch's leading dim is sharded over ``dp_axis``; XLA derives every
     collective (h all-gather per step, logits psum, grad reductions) from
     the annotations.
+
+    With ``metric_fn`` set, returns the FUSED train+eval step
+    ``train_step(state, batch, eval_batches, do_eval)`` — the same
+    lax.cond-gated weighted eval as the device_step builders, legal here
+    because this is a pure GSPMD jit program (uniform replicated predicate;
+    no manual-axis collectives to diverge on — the hazard that keeps fused
+    eval out of the LM's wavefront steps). Eval batches arrive replicated
+    (stage_stacked_batches' placement — matching the DP fused builders) and
+    stay unconstrained in the jit signature; XLA partitions the eval branch
+    like any other code.
     """
     if param_specs is None:
         param_specs = lm_param_specs(params_template, tp_axis)
@@ -152,15 +164,38 @@ def make_tp_train_step(
         carries=NamedSharding(mesh, P(dp_axis)) if stateful else None,
     )
 
-    def train_step(state: TrainState, batch):
-        return step_body(loss_fn, optimizer, state, batch, stateful=stateful)
-
     from ..train.loop import _donation_supported
 
     if donate is None:
         donate = _donation_supported()
+
+    if metric_fn is None:
+
+        def train_step(state: TrainState, batch):
+            return step_body(loss_fn, optimizer, state, batch,
+                             stateful=stateful)
+
+        in_shardings = (state_shardings, NamedSharding(mesh, P(dp_axis)))
+    else:
+        from ..train.device_step import _gated_eval_batches
+
+        keys = tuple(metric_keys)
+
+        def train_step(state: TrainState, batch, eval_batches, do_eval):
+            state, ms = step_body(loss_fn, optimizer, state, batch,
+                                  stateful=stateful)
+            return state, _gated_eval_batches(
+                metric_fn, state, eval_batches, do_eval, ms, keys
+            )
+
+        in_shardings = (
+            state_shardings,
+            NamedSharding(mesh, P(dp_axis)),
+            None,  # eval batches: replicated placement stands
+            None,  # do_eval scalar
+        )
     return jax.jit(
         train_step,
-        in_shardings=(state_shardings, NamedSharding(mesh, P(dp_axis))),
+        in_shardings=in_shardings,
         donate_argnums=(0,) if donate else (),
     )

@@ -52,10 +52,43 @@ def run_classifier(args, logger) -> int:
     from ..cli import make_cli_optimizer
     optimizer = make_cli_optimizer(args)
 
+    train_seqs, train_labels = data["train"]
+    valid_seqs, valid_labels = data["valid"]
+    if len(train_seqs) < args.batch_size:
+        raise SystemExit(
+            f"train set too small: {len(train_seqs)} examples < batch {args.batch_size}"
+        )
+    steps_per_epoch = max(len(train_seqs) // args.batch_size, 1)
+
+    fused_eval = bool(getattr(args, "fused_eval", False))
+    if fused_eval and not valid_seqs:
+        logger.log({"note": "fused-eval: empty valid split; "
+                            "falling back to host-driven eval"})
+        fused_eval = False
+    if fused_eval:
+        # Fused in-executable eval (works with BOTH feeds — device-data and
+        # host-fed — and with --tensor-parallel): the weighted accuracy/loss
+        # sums run over the stacked host eval batches (same `eval_batches`
+        # constructor as eval_fn, so the two paths can never see different
+        # batches).
+        import numpy as np
+
+        def metric_fn(p, b):
+            _, aux = classifier_loss(p, b, cfg)
+            w = b["valid"].astype(np.float32).sum()
+            return ({"eval_loss": aux["loss"],
+                     "eval_accuracy": aux["accuracy"]}, w)
+
+        metric_keys = ("eval_loss", "eval_accuracy")
+    else:
+        metric_fn, metric_keys = None, ()
+
     if max(args.seq_parallel, args.pipeline_stages) > 1:
         raise SystemExit("--seq-parallel/--pipeline-stages apply to the LM "
                          "task; the classifier supports --tensor-parallel")
     if args.tensor_parallel > 1:
+        # metric_fn threads through so the (possibly fused) TP step is
+        # built exactly ONCE
         from ..cli import _setup_tp_training
         from ..parallel.tensor_parallel import classifier_param_specs
 
@@ -64,6 +97,7 @@ def run_classifier(args, logger) -> int:
                 args, logger, loss_fn=loss_fn, params=params,
                 optimizer=optimizer, rng=kr,
                 specs_fn=classifier_param_specs, hidden=cfg.hidden_size,
+                metric_fn=metric_fn, metric_keys=metric_keys,
             )
         )
     else:
@@ -74,13 +108,6 @@ def run_classifier(args, logger) -> int:
             )
         )
 
-    train_seqs, train_labels = data["train"]
-    valid_seqs, valid_labels = data["valid"]
-    if len(train_seqs) < args.batch_size:
-        raise SystemExit(
-            f"train set too small: {len(train_seqs)} examples < batch {args.batch_size}"
-        )
-    steps_per_epoch = max(len(train_seqs) // args.batch_size, 1)
     # data-exact resume: epoch seeds and in-epoch offsets follow the
     # restored step, so the resumed shuffle order matches the
     # uninterrupted run exactly
@@ -92,8 +119,8 @@ def run_classifier(args, logger) -> int:
         """THE eval-batch constructor shared by the host eval_fn and the
         fused-eval staging — one source, so the two paths can never see
         different batches. ``eval_quantum`` keeps the static batch shape a
-        multiple of the TP data axis (the fused path is always quantum 1:
-        TP rejects --device-data upstream)."""
+        multiple of the TP data axis (host AND fused eval under
+        --tensor-parallel both pass mesh.shape['data'])."""
         eval_bs = min(args.batch_size, len(valid_seqs))
         eval_bs = max(eval_bs - eval_bs % eval_quantum, eval_quantum)
         return cap_batches(
@@ -102,31 +129,15 @@ def run_classifier(args, logger) -> int:
             getattr(args, "eval_batches", None),
         )
 
-    fused_eval = bool(getattr(args, "fused_eval", False))
-    if fused_eval and not valid_seqs:
-        logger.log({"note": "fused-eval: empty valid split; "
-                            "falling back to host-driven eval"})
-        fused_eval = False
+    # TP eval shards batch rows over "data": the static batch shape must be
+    # a multiple of the axis — ONE quantum shared by host eval_fn and the
+    # fused-eval staging
+    eval_quantum = mesh.shape["data"] if args.tensor_parallel > 1 else 1
     if fused_eval:
-        # Fused in-executable eval (works with BOTH feeds — device-data and
-        # host-fed): the weighted accuracy/loss sums run over the stacked
-        # host eval batches (same `eval_batches` constructor as eval_fn, so
-        # the two paths can never see different batches).
-        import numpy as np
-
         from ..data import stage_stacked_batches
 
-        ev_stacked = stage_stacked_batches(eval_batches(), mesh=mesh)
-
-        def metric_fn(p, b):
-            _, aux = classifier_loss(p, b, cfg)
-            w = b["valid"].astype(np.float32).sum()
-            return ({"eval_loss": aux["loss"],
-                     "eval_accuracy": aux["accuracy"]}, w)
-
-        metric_keys = ("eval_loss", "eval_accuracy")
-    else:
-        metric_fn, metric_keys = None, ()
+        ev_stacked = stage_stacked_batches(eval_batches(eval_quantum),
+                                           mesh=mesh)
 
     if getattr(args, "device_data", False):
         # HBM-staged padded example matrix; batches gathered on-device by
@@ -195,7 +206,16 @@ def run_classifier(args, logger) -> int:
             ),
             steps_per_epoch=steps_per_epoch, start_step=start_step,
         )
-        if fused_eval:
+        if fused_eval and args.tensor_parallel > 1:
+            # the TP step from _setup_tp_training already carries the gated
+            # eval tail (uniform cond in a pure GSPMD jit program — no
+            # manual-axis collectives to diverge on); bind its eval operand
+            tstep = train_step
+            train_step = lambda state, b, do_eval: tstep(  # noqa: E731
+                state, b, ev_stacked, do_eval
+            )
+            stream = wrap_stream(raw)
+        elif fused_eval:
             # host-fed feed + fused in-executable eval
             from ..train import make_dp_multi_train_step, make_multi_train_step
 
@@ -226,10 +246,8 @@ def run_classifier(args, logger) -> int:
             lambda p, b: classifier_loss(p, b, cfg)[1], mesh,
             classifier_param_specs(params),
         )
-        eval_quantum = mesh.shape["data"]
     else:
         eval_step = jax.jit(lambda p, b: classifier_loss(p, b, cfg)[1])
-        eval_quantum = 1
 
     def eval_fn(params):
         if not valid_seqs:

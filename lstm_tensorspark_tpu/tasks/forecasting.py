@@ -48,28 +48,6 @@ def run_forecaster(args, logger) -> int:
     from ..cli import make_cli_optimizer
     optimizer = make_cli_optimizer(args)
 
-    if max(args.seq_parallel, args.pipeline_stages) > 1:
-        raise SystemExit("--seq-parallel/--pipeline-stages apply to the LM "
-                         "task; the forecaster supports --tensor-parallel")
-    if args.tensor_parallel > 1:
-        from ..cli import _setup_tp_training
-        from ..parallel.tensor_parallel import seq2seq_param_specs
-
-        state, train_step, mesh, shards, wrap_stream, checkpoint_fn = (
-            _setup_tp_training(
-                args, logger, loss_fn=loss_fn, params=params,
-                optimizer=optimizer, rng=kr,
-                specs_fn=seq2seq_param_specs, hidden=cfg.hidden_size,
-            )
-        )
-    else:
-        state, train_step, mesh, shards, wrap_stream, checkpoint_fn = (
-            _setup_training(
-                args, logger, loss_fn=loss_fn, params=params,
-                optimizer=optimizer, rng=kr,
-            )
-        )
-
     train_series, valid_series = data["train"], data["valid"]
     n_windows = max(len(train_series) - context_len - horizon + 1, 0)
     if n_windows < args.batch_size:
@@ -77,25 +55,6 @@ def run_forecaster(args, logger) -> int:
             f"train series too short: {n_windows} windows < batch {args.batch_size}"
         )
     steps_per_epoch = max(n_windows // args.batch_size, 1)
-    # data-exact resume: epoch seeds and in-epoch offsets follow the
-    # restored step (same contract as the classifier runner)
-    start_step = int(state.step)
-
-    from ..data.batching import cap_batches
-
-    def eval_batches(eval_quantum: int = 1):
-        """THE eval-batch constructor shared by the host eval_fn and the
-        fused-eval staging — one source, so the two paths can never see
-        different batches. ``eval_quantum`` keeps the static batch shape a
-        multiple of the TP data axis (the fused path is always quantum 1:
-        TP rejects --device-data upstream)."""
-        eval_bs = min(args.batch_size, 64)
-        eval_bs = max(eval_bs - eval_bs % eval_quantum, eval_quantum)
-        return cap_batches(
-            forecast_windows(valid_series, context_len, horizon, eval_bs,
-                             drop_remainder=False),
-            getattr(args, "eval_batches", None),
-        )
 
     fused_eval = bool(getattr(args, "fused_eval", False))
     if fused_eval and len(valid_series) < context_len + horizon:
@@ -104,15 +63,11 @@ def run_forecaster(args, logger) -> int:
         fused_eval = False
     if fused_eval:
         # Fused in-executable eval (works with BOTH feeds — device-data and
-        # host-fed): the free-running forecast and its masked MSE/MAE sums
-        # run over the stacked host eval batches (same `eval_batches`
-        # constructor as eval_fn, so the two paths can never see different
-        # batches).
+        # host-fed — and with --tensor-parallel): the free-running forecast
+        # and its masked MSE/MAE sums run over the stacked host eval batches
+        # (same `eval_batches` constructor as eval_fn, so the two paths can
+        # never see different batches).
         import jax.numpy as jnp
-
-        from ..data import stage_stacked_batches
-
-        ev_stacked = stage_stacked_batches(eval_batches(), mesh=mesh)
 
         def metric_fn(p, b):
             preds = forecast(p, b["context"], cfg)
@@ -127,6 +82,61 @@ def run_forecaster(args, logger) -> int:
         metric_keys = ("eval_mse", "eval_mae")
     else:
         metric_fn, metric_keys = None, ()
+
+    if max(args.seq_parallel, args.pipeline_stages) > 1:
+        raise SystemExit("--seq-parallel/--pipeline-stages apply to the LM "
+                         "task; the forecaster supports --tensor-parallel")
+    if args.tensor_parallel > 1:
+        # metric_fn threads through so the (possibly fused) TP step is
+        # built exactly ONCE
+        from ..cli import _setup_tp_training
+        from ..parallel.tensor_parallel import seq2seq_param_specs
+
+        state, train_step, mesh, shards, wrap_stream, checkpoint_fn = (
+            _setup_tp_training(
+                args, logger, loss_fn=loss_fn, params=params,
+                optimizer=optimizer, rng=kr,
+                specs_fn=seq2seq_param_specs, hidden=cfg.hidden_size,
+                metric_fn=metric_fn, metric_keys=metric_keys,
+            )
+        )
+    else:
+        state, train_step, mesh, shards, wrap_stream, checkpoint_fn = (
+            _setup_training(
+                args, logger, loss_fn=loss_fn, params=params,
+                optimizer=optimizer, rng=kr,
+            )
+        )
+
+    # data-exact resume: epoch seeds and in-epoch offsets follow the
+    # restored step (same contract as the classifier runner)
+    start_step = int(state.step)
+
+    from ..data.batching import cap_batches
+
+    def eval_batches(eval_quantum: int = 1):
+        """THE eval-batch constructor shared by the host eval_fn and the
+        fused-eval staging — one source, so the two paths can never see
+        different batches. ``eval_quantum`` keeps the static batch shape a
+        multiple of the TP data axis (host AND fused eval under
+        --tensor-parallel both pass mesh.shape['data'])."""
+        eval_bs = min(args.batch_size, 64)
+        eval_bs = max(eval_bs - eval_bs % eval_quantum, eval_quantum)
+        return cap_batches(
+            forecast_windows(valid_series, context_len, horizon, eval_bs,
+                             drop_remainder=False),
+            getattr(args, "eval_batches", None),
+        )
+
+    # TP eval shards contexts over "data": the static batch shape must be a
+    # multiple of the axis — ONE quantum shared by host eval_fn and the
+    # fused-eval staging
+    eval_quantum = mesh.shape["data"] if args.tensor_parallel > 1 else 1
+    if fused_eval:
+        from ..data import stage_stacked_batches
+
+        ev_stacked = stage_stacked_batches(eval_batches(eval_quantum),
+                                           mesh=mesh)
 
     if getattr(args, "device_data", False):
         # HBM-staged series; (context, horizon) windows sliced on-device from
@@ -182,7 +192,16 @@ def run_forecaster(args, logger) -> int:
             ),
             steps_per_epoch=steps_per_epoch, start_step=start_step,
         )
-        if fused_eval:
+        if fused_eval and args.tensor_parallel > 1:
+            # the TP step from _setup_tp_training already carries the gated
+            # eval tail (uniform cond in a pure GSPMD jit program — no
+            # manual-axis collectives to diverge on); bind its eval operand
+            tstep = train_step
+            train_step = lambda state, b, do_eval: tstep(  # noqa: E731
+                state, b, ev_stacked, do_eval
+            )
+            stream = wrap_stream(raw)
+        elif fused_eval:
             # host-fed feed + fused in-executable eval
             from ..train import make_dp_multi_train_step, make_multi_train_step
 
@@ -213,10 +232,8 @@ def run_forecaster(args, logger) -> int:
             lambda p, ctx: forecast(p, ctx, cfg), mesh,
             seq2seq_param_specs(params),
         )
-        eval_quantum = mesh.shape["data"]
     else:
         fc = jax.jit(lambda p, ctx: forecast(p, ctx, cfg))
-        eval_quantum = 1
 
     def eval_fn(params):
         """Free-running (no teacher forcing) MSE/MAE over the valid tail,

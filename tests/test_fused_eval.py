@@ -352,3 +352,63 @@ def test_cli_fused_eval_requires_eval_cadence():
 
     with pytest.raises(SystemExit):
         main(["--dataset", "ptb_char", "--num-steps", "2", "--fused-eval"])
+
+
+def test_cli_fused_eval_tp_classifier(tmp_path):
+    """Fused eval under --tensor-parallel (GSPMD jit step + gated eval tail):
+    fused and host evals must agree on the shared final step."""
+    from lstm_tensorspark_tpu.cli import main
+
+    jsonl = tmp_path / "tpc.jsonl"
+    rc = main([
+        "--dataset", "imdb", "--hidden-units", "16", "--num-layers", "1",
+        "--batch-size", "16", "--seq-len", "32", "--num-steps", "4",
+        "--fused-eval", "--eval-every", "2", "--log-every", "1",
+        "--tensor-parallel", "2", "--num-partitions", "2",
+        "--learning-rate", "0.1", "--jsonl", str(jsonl),
+    ])
+    assert rc == 0
+    records = [json.loads(l) for l in open(jsonl)]
+    evals = [r for r in records
+             if "eval_accuracy" in r and r.get("note") != "final"]
+    final = [r for r in records if r.get("note") == "final"][0]
+    last = [r for r in evals if r["step"] == final["step"]]
+    assert last, (evals, final)
+    np.testing.assert_allclose(last[0]["eval_loss"], final["eval_loss"],
+                               rtol=1e-5)
+    np.testing.assert_allclose(last[0]["eval_accuracy"],
+                               final["eval_accuracy"], rtol=1e-5)
+
+
+def test_cli_fused_eval_tp_forecaster(tmp_path):
+    from lstm_tensorspark_tpu.cli import main
+
+    jsonl = tmp_path / "tpf.jsonl"
+    rc = main([
+        "--dataset", "uci_electricity", "--hidden-units", "16",
+        "--num-layers", "1", "--batch-size", "16", "--seq-len", "24",
+        "--num-steps", "4", "--fused-eval", "--eval-every", "2",
+        "--log-every", "1", "--tensor-parallel", "2",
+        "--num-partitions", "2", "--learning-rate", "0.05",
+        "--jsonl", str(jsonl),
+    ])
+    assert rc == 0
+    records = [json.loads(l) for l in open(jsonl)]
+    evals = [r for r in records if "eval_mse" in r and r.get("note") != "final"]
+    final = [r for r in records if r.get("note") == "final"][0]
+    last = [r for r in evals if r["step"] == final["step"]]
+    assert last, (evals, final)
+    np.testing.assert_allclose(last[0]["eval_mse"], final["eval_mse"],
+                               rtol=1e-4)
+
+
+def test_cli_fused_eval_rejected_with_lm_tp():
+    import pytest
+
+    from lstm_tensorspark_tpu.cli import main
+
+    with pytest.raises(SystemExit):
+        main([
+            "--dataset", "ptb_char", "--num-steps", "2", "--fused-eval",
+            "--eval-every", "2", "--tensor-parallel", "2",
+        ])
