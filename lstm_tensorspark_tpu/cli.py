@@ -115,6 +115,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--jsonl", type=str, default=None, help="metrics JSONL path")
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--async-checkpoint", action="store_true",
+                   help="overlap checkpoint serialization + file IO with "
+                        "training: save() blocks only for the device-to-"
+                        "host snapshot, the write runs on a background "
+                        "thread (single-process runs; multi-process saves "
+                        "stay synchronous for their barriers)")
     p.add_argument("--resume", action="store_true", help="resume from latest checkpoint in --checkpoint-dir")
     p.add_argument("--compilation-cache", type=str, default=None,
                    help="persistent XLA compilation-cache directory: repeat "
@@ -433,7 +439,8 @@ def _wire_checkpoint(args, logger, template_fn):
         return None, None
     from .train.checkpoint import Checkpointer
 
-    ckpt = Checkpointer(args.checkpoint_dir)
+    ckpt = Checkpointer(args.checkpoint_dir,
+                        async_save=getattr(args, "async_checkpoint", False))
     restored = None
     if args.resume and ckpt.has_checkpoint():
         restored = ckpt.restore_latest(template_fn())
@@ -477,6 +484,13 @@ def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
     finally:
         if args.profile_dir:
             jax.profiler.stop_trace()
+        # finalize async checkpointing: the LAST write must be durable
+        # before this process reads checkpoints (same-process --resume) or
+        # exits, and a failed final write must fail the run, not vanish.
+        # checkpoint_fn is Checkpointer.save, so its __self__ is the owner.
+        owner = getattr(checkpoint_fn, "__self__", None)
+        if owner is not None and hasattr(owner, "wait"):
+            owner.wait()
     return state
 
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import os
 import re
+import threading
 
 import jax
 import numpy as np
@@ -48,9 +49,22 @@ class Checkpointer:
     _PROC_PAT = re.compile(r"step_(\d+)\.proc(\d+)\.msgpack$")
     _DONE_PAT = re.compile(r"step_(\d+)\.complete$")
 
-    def __init__(self, directory: str, *, keep: int = 3):
+    def __init__(self, directory: str, *, keep: int = 3,
+                 async_save: bool = False):
+        """``async_save``: overlap serialization + file IO with training.
+        ``save()`` then blocks only for the device→host snapshot
+        (`jax.device_get`) and hands the write to a background thread — at
+        most one in flight (a second save waits for the first). Write
+        errors surface at the next ``save()``/``wait()``; the interpreter
+        joins the non-daemon writer at exit, so the last checkpoint is
+        durable even without an explicit ``wait()``. Multi-process saves
+        always run synchronously (their cross-process barriers belong on
+        the main thread), whatever this flag says."""
         self.directory = directory
         self.keep = keep
+        self.async_save = async_save
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
         os.makedirs(directory, exist_ok=True)
 
     # -- discovery ---------------------------------------------------------
@@ -88,23 +102,55 @@ class Checkpointer:
         with span("checkpoint_save"):
             if jax.process_count() > 1:
                 path = self._save_sharded(state)
+                self._cleanup()
+            elif self.async_save:
+                self.wait()  # one write in flight; surface prior errors
+                host = jax.device_get(state)  # snapshot BEFORE training moves on
+                path = self._path_for(int(host.step))
+                self._thread = threading.Thread(
+                    target=self._write_and_clean, args=(host,),
+                    name="checkpoint-writer",
+                )
+                self._thread.start()
             else:
-                path = self._save_single(state)
-            # keep-N cleanup, oldest first (process 0 only — the others'
-            # files are deleted by step, after the save barrier)
-            if jax.process_index() == 0:
-                for step in self._steps()[: -self.keep]:
-                    for f in self._files_for_step(step):
-                        os.remove(f)
+                path = self._save_single(jax.device_get(state))
+                self._cleanup()
         return path
 
-    def _save_single(self, state) -> str:
-        state = jax.device_get(state)
-        step = int(state.step)
-        path = os.path.join(self.directory, f"step_{step}.msgpack")
+    def wait(self) -> None:
+        """Join any in-flight async write; re-raise its error if it failed."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def _write_and_clean(self, host_state) -> None:
+        try:
+            self._save_single(host_state)
+            self._cleanup()
+        except BaseException as e:  # surfaced by the next save()/wait()
+            self._error = e
+
+    def _cleanup(self) -> None:
+        # keep-N, oldest first (process 0 only — the others' files are
+        # deleted by step, after the save barrier)
+        if jax.process_index() == 0:
+            for step in self._steps()[: -self.keep]:
+                for f in self._files_for_step(step):
+                    os.remove(f)
+
+    def _path_for(self, step: int) -> str:
+        """Single-process checkpoint filename — the ONE naming authority
+        (must stay in sync with ``_PAT``)."""
+        return os.path.join(self.directory, f"step_{step}.msgpack")
+
+    def _save_single(self, host_state) -> str:
+        path = self._path_for(int(host_state.step))
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(serialization.to_bytes(state))
+            f.write(serialization.to_bytes(host_state))
         os.replace(tmp, path)  # atomic: partial writes never count
         return path
 
@@ -174,11 +220,12 @@ class Checkpointer:
         RESHARDED onto their shardings (works across a changed process
         count / mesh layout); host leaves come back as host arrays.
         """
+        self.wait()  # never read around an in-flight write
         steps = self._steps()
         if not steps:
             return None
         step = steps[-1]
-        single = os.path.join(self.directory, f"step_{step}.msgpack")
+        single = self._path_for(step)
         if os.path.exists(single):
             with open(single, "rb") as f:
                 restored = serialization.from_bytes(template, f.read())

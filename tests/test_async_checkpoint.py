@@ -1,0 +1,144 @@
+"""Async checkpointing (train/checkpoint.py async_save): background writes
+must produce byte-identical restorable checkpoints, serialize one-in-flight,
+keep N, and surface writer errors at the next save()/wait()."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from lstm_tensorspark_tpu.models import LMConfig, init_lm, lm_loss
+from lstm_tensorspark_tpu.train import make_optimizer, make_train_step
+from lstm_tensorspark_tpu.train.checkpoint import Checkpointer
+from lstm_tensorspark_tpu.train.loop import init_train_state
+
+V, H, B, T = 13, 16, 8, 12
+
+
+def _setup():
+    cfg = LMConfig(vocab_size=V, hidden_size=H, num_layers=1)
+
+    def loss_fn(p, b, r):
+        return lm_loss(p, b, cfg)
+
+    opt = make_optimizer("adam", 1e-2)
+    params = init_lm(jax.random.PRNGKey(0), cfg)
+    state = init_train_state(params, opt, jax.random.PRNGKey(1))
+    rng = np.random.RandomState(0)
+    batch = {
+        "inputs": rng.randint(0, V, (B, T)).astype(np.int32),
+        "targets": rng.randint(0, V, (B, T)).astype(np.int32),
+    }
+    return loss_fn, opt, state, batch
+
+
+def test_async_save_restores_identically(tmp_path):
+    loss_fn, opt, state, batch = _setup()
+    step = make_train_step(loss_fn, opt)
+    state, _ = step(state, batch)
+
+    sync_dir, async_dir = str(tmp_path / "s"), str(tmp_path / "a")
+    Checkpointer(sync_dir).save(state)
+    ca = Checkpointer(async_dir, async_save=True)
+    ca.save(state)
+    ca.wait()
+    # byte-identical files → identical restores
+    with open(os.path.join(sync_dir, "step_1.msgpack"), "rb") as f:
+        want = f.read()
+    with open(os.path.join(async_dir, "step_1.msgpack"), "rb") as f:
+        got = f.read()
+    assert want == got
+
+    template = init_train_state(
+        init_lm(jax.random.PRNGKey(9), LMConfig(vocab_size=V, hidden_size=H,
+                                                num_layers=1)),
+        opt, jax.random.PRNGKey(10),
+    )
+    restored = ca.restore_latest(template)
+    assert int(restored.step) == 1
+    for a, b in zip(jax.tree.leaves(restored.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a),
+                                      np.asarray(jax.device_get(b)))
+
+
+def test_async_snapshot_is_immune_to_later_updates(tmp_path):
+    """The host snapshot happens at save() time: training steps taken while
+    the write is in flight must NOT leak into the checkpoint."""
+    loss_fn, opt, state, batch = _setup()
+    step = make_train_step(loss_fn, opt)
+    state, _ = step(state, batch)
+    want = jax.device_get(state.params)
+
+    ck = Checkpointer(str(tmp_path), async_save=True)
+    ck.save(state)
+    for _ in range(3):  # keep training immediately
+        state, _ = step(state, batch)
+    ck.wait()
+    template = init_train_state(
+        init_lm(jax.random.PRNGKey(9), LMConfig(vocab_size=V, hidden_size=H,
+                                                num_layers=1)),
+        opt, jax.random.PRNGKey(10),
+    )
+    restored = ck.restore_latest(template)
+    assert int(restored.step) == 1
+    for a, b in zip(jax.tree.leaves(restored.params), jax.tree.leaves(want)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_async_keep_n_and_one_in_flight(tmp_path):
+    loss_fn, opt, state, batch = _setup()
+    step = make_train_step(loss_fn, opt)
+    ck = Checkpointer(str(tmp_path), keep=2, async_save=True)
+    for _ in range(4):
+        state, _ = step(state, batch)
+        ck.save(state)  # each save waits for the previous write
+    ck.wait()
+    names = sorted(n for n in os.listdir(tmp_path) if n.endswith(".msgpack"))
+    assert names == ["step_3.msgpack", "step_4.msgpack"]
+
+
+def test_async_write_error_surfaces_on_next_save(tmp_path, monkeypatch):
+    loss_fn, opt, state, batch = _setup()
+    step = make_train_step(loss_fn, opt)
+    state, _ = step(state, batch)
+    ck = Checkpointer(str(tmp_path), async_save=True)
+
+    def boom(host_state):
+        raise OSError("disk full (synthetic)")
+
+    monkeypatch.setattr(ck, "_save_single", boom)
+    ck.save(state)
+    with pytest.raises(OSError, match="disk full"):
+        ck.wait()
+    # the error is consumed: the checkpointer stays usable
+    monkeypatch.undo()
+    state, _ = step(state, batch)
+    ck.save(state)
+    ck.wait()
+    assert ck.has_checkpoint()
+
+
+def test_cli_async_checkpoint_resume(tmp_path):
+    """CLI e2e: --async-checkpoint run, then a --resume run continues from
+    the restored step."""
+    from lstm_tensorspark_tpu.cli import main
+
+    ckpt = str(tmp_path / "ck")
+    jsonl = tmp_path / "m.jsonl"
+    argv = [
+        "--dataset", "ptb_char", "--hidden-units", "16", "--num-layers", "1",
+        "--batch-size", "8", "--seq-len", "16", "--log-every", "2",
+        "--backend", "single", "--checkpoint-dir", ckpt,
+        "--checkpoint-every", "2", "--async-checkpoint",
+    ]
+    assert main(argv + ["--num-steps", "4"]) == 0
+    assert main(argv + ["--num-steps", "8", "--resume",
+                        "--jsonl", str(jsonl)]) == 0
+    records = [json.loads(l) for l in open(jsonl)]
+    notes = [r for r in records if "resumed at step" in str(r.get("note", ""))]
+    # the LAST checkpoint (step 4) must be the resume point — a stale
+    # restore (in-flight final write) would resume at step 2
+    assert notes and "resumed at step 4" in notes[0]["note"], records
