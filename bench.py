@@ -55,9 +55,14 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 CACHE = os.path.join(_DIR, "BASELINE_MEASURED.json")
 TABLE = os.path.join(_DIR, "BENCH_TABLE.json")
 
-# bf16 peak for MFU. TPU v5 lite (v5e): 197 TFLOP/s bf16 (public spec).
-# Override with LSTM_TSP_PEAK_TFLOPS on other chips.
-PEAK_TFLOPS = float(os.environ.get("LSTM_TSP_PEAK_TFLOPS", 197.0))
+# FLOPs accounting + bf16 peak: ONE source shared with the runtime's
+# --log-flops (lstm_tensorspark_tpu/utils/flops.py).
+from lstm_tensorspark_tpu.utils.flops import (  # noqa: E402
+    PEAK_TFLOPS,
+    classifier_fwd_flops_per_token as _classifier_fwd_flops_per_token,
+    lm_fwd_flops_per_token as _lm_fwd_flops_per_token,
+    seq2seq_fwd_flops_per_seq as _seq2seq_flops_per_seq,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -73,40 +78,6 @@ CONFIGS = {
                         horizon=24),
     "wikitext103": dict(kind="lm", V=50_000, H=1024, L=4, B=32, T=64),
 }
-
-
-def _lm_fwd_flops_per_token(V: int, H: int, L: int, E: int | None = None) -> float:
-    """Matmul-only forward FLOPs per token: per layer x@W (2*Din*4H) +
-    h@U (2*H*4H), plus the softmax head (2*H*V). Embedding gather ~0."""
-    E = E or H
-    f = 0.0
-    for layer in range(L):
-        din = E if layer == 0 else H
-        f += 8.0 * H * (din + H)
-    return f + 2.0 * H * V
-
-
-def _classifier_fwd_flops_per_token(V: int, H: int, L: int,
-                                    E: int | None = None) -> float:
-    """Bi-LSTM: two directions per layer; layer 0 input E, later 2H.
-    The [2H, C] head is per-sequence and negligible."""
-    E = E or H
-    f = 0.0
-    for layer in range(L):
-        din = E if layer == 0 else 2 * H
-        f += 2 * 8.0 * H * (din + H)
-    return f
-
-
-def _seq2seq_flops_per_seq(F: int, H: int, L: int, T: int, horizon: int) -> float:
-    """Encoder over T context steps + teacher-forced decoder over the
-    horizon + per-step projection [H, F]."""
-    enc = dec = 0.0
-    for layer in range(L):
-        din = F if layer == 0 else H
-        enc += 8.0 * H * (din + H)
-        dec += 8.0 * H * (din + H)
-    return T * enc + horizon * (dec + 2.0 * H * F)
 
 
 def measure(compute_dtype: str, steps: int, warmup: int, *,

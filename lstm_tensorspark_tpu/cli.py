@@ -111,6 +111,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cap each eval pass at N batches (default: the full "
                         "held-out split) — bounds eval cost at large dims")
     p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--log-flops", action="store_true",
+                   help="add live model-TFLOP/s and MFU (vs the bf16 peak, "
+                        "env LSTM_TSP_PEAK_TFLOPS) to every throughput log "
+                        "record — matmul-only accounting, train = 3x "
+                        "forward, same formulas as bench.py")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--jsonl", type=str, default=None, help="metrics JSONL path")
     p.add_argument("--checkpoint-dir", type=str, default=None)
@@ -446,12 +451,37 @@ def _wire_checkpoint(args, logger, template_fn):
         restored = ckpt.restore_latest(template_fn())
         if restored is not None:
             logger.log({"note": f"resumed at step {int(restored.step)}"})
-    return restored, ckpt.save
+
+    def checkpoint_fn(state):
+        return ckpt.save(state)
+
+    # EXPLICIT finalizer contract (not attribute-sniffing a bound method):
+    # _make_logged_loop calls .finalize after the loop so the last async
+    # write is durable before the process reads checkpoints or exits, and
+    # a failed final write fails the run. Anyone wrapping checkpoint_fn
+    # must carry the attribute forward.
+    checkpoint_fn.finalize = ckpt.wait
+    return restored, checkpoint_fn
+
+
+def _mfu_logging(args, fwd_flops_per_token, mesh):
+    """(flops_per_token, peak_tflops) for train_loop's live-MFU records, or
+    (None, None) without --log-flops. THE one place the accounting policy
+    lives: train = 3x forward (utils/flops.py), and the peak aggregates
+    every chip in the mesh — throughput records are global rates, so
+    per-chip MFU must divide by the global peak."""
+    if not getattr(args, "log_flops", False):
+        return None, None
+    from .utils.flops import PEAK_TFLOPS, TRAIN_FLOPS_MULTIPLIER
+
+    n = mesh.size if mesh is not None else 1
+    return (TRAIN_FLOPS_MULTIPLIER * fwd_flops_per_token,
+            PEAK_TFLOPS * max(n, 1))
 
 
 def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
                       eval_fn=None, checkpoint_fn=None, tokens_per_batch=None,
-                      fused_eval=None):
+                      fused_eval=None, flops_per_token=None, peak_tflops=None):
     from .train.loop import train_loop
 
     total = args.num_steps or args.epochs * steps_per_epoch
@@ -480,17 +510,19 @@ def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
             tokens_per_batch=tokens_per_batch,
             steps_per_call=k,
             fused_eval=fused_eval,
+            flops_per_token=flops_per_token,
+            peak_tflops=peak_tflops,
         )
     finally:
         if args.profile_dir:
             jax.profiler.stop_trace()
-        # finalize async checkpointing: the LAST write must be durable
-        # before this process reads checkpoints (same-process --resume) or
-        # exits, and a failed final write must fail the run, not vanish.
-        # checkpoint_fn is Checkpointer.save, so its __self__ is the owner.
-        owner = getattr(checkpoint_fn, "__self__", None)
-        if owner is not None and hasattr(owner, "wait"):
-            owner.wait()
+        # finalize async checkpointing (the _wire_checkpoint contract): the
+        # LAST write must be durable before this process reads checkpoints
+        # (same-process --resume) or exits, and a failed final write must
+        # fail the run, not vanish.
+        fin = getattr(checkpoint_fn, "finalize", None)
+        if fin is not None:
+            fin()
     return state
 
 
@@ -668,6 +700,15 @@ def _run_lm(args, logger) -> int:
     })
     from .train.loop import eval_metrics
 
+    from .utils.flops import lm_fwd_flops_per_token
+
+    flops_per_token, peak = _mfu_logging(
+        args,
+        lm_fwd_flops_per_token(cfg.vocab_size, cfg.hidden_size,
+                               cfg.num_layers, cfg.embed),
+        mesh,
+    )
+
     with span("train", steps_per_epoch=steps_per_epoch, backend="dp" if mesh is not None else "single"):
         state = _make_logged_loop(
             args, state, train_step, batches, steps_per_epoch, logger,
@@ -676,6 +717,8 @@ def _run_lm(args, logger) -> int:
             tokens_per_batch=args.batch_size * seq_len,
             fused_eval=(lambda ms: eval_metrics(float(ms["eval_loss"])))
             if fused_eval else None,
+            flops_per_token=flops_per_token,
+            peak_tflops=peak,
         )
     with span("eval_final"):
         final = eval_fn(state.params)
@@ -849,11 +892,21 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
         "microbatches": mb, "steps_per_epoch": steps_per_epoch,
         "backend": "pp" if pp > 1 else "tp/sp",
     })
+    from .utils.flops import lm_fwd_flops_per_token
+
+    flops_per_token, peak = _mfu_logging(
+        args,
+        lm_fwd_flops_per_token(cfg.vocab_size, cfg.hidden_size,
+                               cfg.num_layers, cfg.embed),
+        mesh,
+    )
     state = _make_logged_loop(
         args, state, train_step, batches, steps_per_epoch, logger,
         eval_fn=eval_fn if args.eval_every else None,
         checkpoint_fn=checkpoint_fn,
         tokens_per_batch=args.batch_size * seq_len,
+        flops_per_token=flops_per_token,
+        peak_tflops=peak,
     )
     final = eval_fn(state.params)
     logger.log({"step": int(state.step), **final, "note": "final"})

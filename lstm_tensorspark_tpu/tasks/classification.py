@@ -268,6 +268,15 @@ def run_classifier(args, logger) -> int:
         "steps_per_epoch": steps_per_epoch,
         "backend": "dp" if mesh is not None else "single",
     })
+    from ..cli import _mfu_logging
+    from ..utils.flops import classifier_fwd_flops_per_token
+
+    flops_per_token, peak = _mfu_logging(
+        args,
+        classifier_fwd_flops_per_token(cfg.vocab_size, cfg.hidden_size,
+                                       cfg.num_layers, cfg.embed),
+        mesh,
+    )
     state = _make_logged_loop(
         args, state, train_step, stream, steps_per_epoch, logger,
         eval_fn=None if fused_eval else (eval_fn if args.eval_every else None),
@@ -276,6 +285,8 @@ def run_classifier(args, logger) -> int:
         fused_eval=(lambda ms: {"eval_loss": float(ms["eval_loss"]),
                                 "eval_accuracy": float(ms["eval_accuracy"])})
         if fused_eval else None,
+        flops_per_token=flops_per_token,
+        peak_tflops=peak,
     )
     # final eval on the device-resident params (TP: sharded in place; DP:
     # replicated) — no host round-trip of the model

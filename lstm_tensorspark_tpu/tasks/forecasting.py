@@ -258,6 +258,19 @@ def run_forecaster(args, logger) -> int:
         "steps_per_epoch": steps_per_epoch,
         "backend": "dp" if mesh is not None else "single",
     })
+    from ..cli import _mfu_logging
+    from ..utils.flops import seq2seq_fwd_flops_per_seq
+
+    # tokens_per_batch counts context positions; spread the per-sequence
+    # FLOPs (encoder + decoder + projection) over them so
+    # tokens/sec x flops_per_token = sequences/sec x flops_per_seq
+    flops_per_token, peak = _mfu_logging(
+        args,
+        seq2seq_fwd_flops_per_seq(cfg.num_features, cfg.hidden_size,
+                                  cfg.num_layers, context_len,
+                                  horizon) / context_len,
+        mesh,
+    )
     state = _make_logged_loop(
         args, state, train_step, stream, steps_per_epoch, logger,
         eval_fn=None if fused_eval else (eval_fn if args.eval_every else None),
@@ -266,6 +279,8 @@ def run_forecaster(args, logger) -> int:
         fused_eval=(lambda ms: {"eval_mse": float(ms["eval_mse"]),
                                 "eval_mae": float(ms["eval_mae"])})
         if fused_eval else None,
+        flops_per_token=flops_per_token,
+        peak_tflops=peak,
     )
     # final eval on the device-resident params (TP: sharded in place; DP:
     # replicated) — no host round-trip of the model

@@ -286,6 +286,8 @@ def train_loop(
     tokens_per_batch: int | None = None,
     steps_per_call: int = 1,
     fused_eval: Callable[[dict], dict] | None = None,
+    flops_per_token: float | None = None,
+    peak_tflops: float | None = None,
 ) -> TrainState:
     """Drive the jitted step over a batch iterator, logging scalar metrics.
 
@@ -329,9 +331,19 @@ def train_loop(
                 "steps_per_sec": log_every * steps_per_call / dt,
             }
             if tokens_per_batch:
-                record["tokens_per_sec"] = (
-                    tokens_per_batch * log_every * steps_per_call / dt
-                )
+                tps = tokens_per_batch * log_every * steps_per_call / dt
+                record["tokens_per_sec"] = tps
+                if flops_per_token:
+                    # live MFU: achieved model TFLOP/s (train = 3x forward
+                    # matmul accounting, utils/flops.py). ``peak_tflops``
+                    # is the AGGREGATE peak of every participating chip —
+                    # tokens_per_sec is the global rate, so dividing by one
+                    # chip's peak would overstate MFU by the device count.
+                    record["model_tflops"] = tps * flops_per_token / 1e12
+                    if peak_tflops:
+                        record["mfu"] = round(
+                            record["model_tflops"] / peak_tflops, 4
+                        )
             if logger is not None:
                 logger.log(record)
         if eval_every and step % eval_every == 0:

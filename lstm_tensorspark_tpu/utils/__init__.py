@@ -1,3 +1,15 @@
 from .tracing import Tracer, get_tracer, set_tracer, span, instant
+from .flops import (
+    PEAK_TFLOPS,
+    TRAIN_FLOPS_MULTIPLIER,
+    classifier_fwd_flops_per_token,
+    lm_fwd_flops_per_token,
+    seq2seq_fwd_flops_per_seq,
+)
 
-__all__ = ["Tracer", "get_tracer", "set_tracer", "span", "instant"]
+__all__ = [
+    "Tracer", "get_tracer", "set_tracer", "span", "instant",
+    "PEAK_TFLOPS", "TRAIN_FLOPS_MULTIPLIER",
+    "classifier_fwd_flops_per_token", "lm_fwd_flops_per_token",
+    "seq2seq_fwd_flops_per_seq",
+]

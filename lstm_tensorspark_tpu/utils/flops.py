@@ -1,0 +1,55 @@
+"""Model-FLOPs accounting — ONE source shared by the benchmark harness
+(bench.py) and runtime logging (--log-flops).
+
+Matmul-only counts (the MXU work; embedding gathers and elementwise ops
+are excluded, matching standard MFU practice). Training ≈ 3× forward:
+the backward pass does ~2× the forward matmul work (dL/dW and dL/dx per
+matmul).
+"""
+
+from __future__ import annotations
+
+import os
+
+# bf16 peak for MFU. TPU v5 lite (v5e): 197 TFLOP/s bf16 (public spec).
+# Override with LSTM_TSP_PEAK_TFLOPS on other chips.
+PEAK_TFLOPS = float(os.environ.get("LSTM_TSP_PEAK_TFLOPS", 197.0))
+
+# fwd + bwd(2x) matmul accounting
+TRAIN_FLOPS_MULTIPLIER = 3.0
+
+
+def lm_fwd_flops_per_token(V: int, H: int, L: int,
+                           E: int | None = None) -> float:
+    """Matmul-only forward FLOPs per token: per layer x@W (2*Din*4H) +
+    h@U (2*H*4H), plus the softmax head (2*H*V). Embedding gather ~0."""
+    E = E or H
+    f = 0.0
+    for layer in range(L):
+        din = E if layer == 0 else H
+        f += 8.0 * H * (din + H)
+    return f + 2.0 * H * V
+
+
+def classifier_fwd_flops_per_token(V: int, H: int, L: int,
+                                   E: int | None = None) -> float:
+    """Bi-LSTM: two directions per layer; layer 0 input E, later 2H.
+    The [2H, C] head is per-sequence and negligible."""
+    E = E or H
+    f = 0.0
+    for layer in range(L):
+        din = E if layer == 0 else 2 * H
+        f += 2 * 8.0 * H * (din + H)
+    return f
+
+
+def seq2seq_fwd_flops_per_seq(F: int, H: int, L: int, T: int,
+                              horizon: int) -> float:
+    """Encoder over T context steps + teacher-forced decoder over the
+    horizon + per-step projection [H, F]."""
+    enc = dec = 0.0
+    for layer in range(L):
+        din = F if layer == 0 else H
+        enc += 8.0 * H * (din + H)
+        dec += 8.0 * H * (din + H)
+    return T * enc + horizon * (dec + 2.0 * H * F)
