@@ -296,10 +296,14 @@ def _summarize(name, spec, results) -> None:
                   f"({entry['summary']['speedup_warm']}x)", flush=True)
 
 
-def main(only: list[str] | None = None, *, mode: str = "full") -> int:
+def main(only: list[str] | None = None, *, mode: str = "full",
+         platforms=("tpu", "cpu")) -> int:
     """mode: "full" = run cold + warm legs; "warm" = run only the
     populate+warm legs (cold results recomputed from existing curves);
-    "recompute" = no runs, rebuild every result from the curves on disk."""
+    "recompute" = no runs, rebuild every result from the curves on disk.
+    ``platforms`` restricts which legs RUN (results for the other platform
+    are still recomputed from curves on disk when present) — lets the CPU
+    halves bank while the TPU is unavailable, and vice versa."""
     # merge into any existing results so single-config reruns keep the rest
     results = {}
     if os.path.exists(CACHE):
@@ -313,8 +317,9 @@ def main(only: list[str] | None = None, *, mode: str = "full") -> int:
         results[name] = {**(results.get(name) or {}),
                          "metric": spec["metric"]}
         for platform in ("tpu", "cpu"):
+            run_this = platform in platforms
             cold_jsonl = os.path.join(CURVES, f"{name}_{platform}.jsonl")
-            if mode == "full":
+            if mode == "full" and run_this:
                 print(f"[bench_quality] {name} on {platform} ...", flush=True)
                 cold_jsonl = run_leg(name, platform)
             if os.path.exists(cold_jsonl):
@@ -322,7 +327,7 @@ def main(only: list[str] | None = None, *, mode: str = "full") -> int:
                     cold_jsonl, spec["metric"], spec["mode"], spec["targets"]
                 )
             warm_jsonl = os.path.join(CURVES, f"{name}_{platform}_warm.jsonl")
-            if mode in ("full", "warm"):
+            if mode in ("full", "warm") and run_this:
                 # warm-cache leg: the LAUNCH-to-quality number a repeat run
                 # sees with --compilation-cache. Populate the cache with a
                 # few-step run (same program shapes → same executables
@@ -359,4 +364,11 @@ if __name__ == "__main__":
         if flag in argv:
             mode = m
             argv.remove(flag)
-    sys.exit(main(argv or None, mode=mode))
+    platforms = ("tpu", "cpu")
+    if "--platform" in argv:
+        i = argv.index("--platform")
+        if i + 1 >= len(argv) or argv[i + 1] not in ("tpu", "cpu"):
+            raise SystemExit("--platform takes exactly one of: tpu, cpu")
+        platforms = (argv[i + 1],)
+        del argv[i:i + 2]
+    sys.exit(main(argv or None, mode=mode, platforms=platforms))

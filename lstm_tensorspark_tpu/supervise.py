@@ -18,6 +18,15 @@ last checkpoint; ``--num-steps`` is resume-inclusive (cli.py), so the total
 step budget holds across restarts. Exit code: the child's final exit code —
 0 on success, or the LAST failing child's code when restarts are exhausted
 (so callers can still distinguish failure classes, e.g. OOM kills).
+
+Stall detection (``--stall-timeout N``): crashes are not the only failure
+mode — this environment's tunneled TPU backend has been observed to WEDGE
+(a dispatch that never returns; the child hangs forever without exiting).
+With a stall timeout the supervisor watches the child's output: if no line
+arrives for N seconds it terminates the child (SIGTERM, then SIGKILL) and
+treats it like a signal death — retryable, relaunched with ``--resume``.
+Size N well above the longest silent phase of the run (first XLA compile +
+the --log-every cadence).
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ from __future__ import annotations
 import argparse
 import subprocess
 import sys
+import threading
 import time
 
 
@@ -37,15 +47,65 @@ def build_parser() -> argparse.ArgumentParser:
                    help="restarts after the first attempt (default 3)")
     p.add_argument("--restart-delay", type=float, default=1.0,
                    help="seconds between attempts")
+    p.add_argument("--stall-timeout", type=float, default=None,
+                   help="kill + relaunch the child if it prints NOTHING for "
+                        "this many seconds (hang/wedge detection; size it "
+                        "above first-compile time + the log cadence; must "
+                        "be > 0; NOTE: the watchdog merges the child's "
+                        "stderr into stdout so one stream carries the "
+                        "liveness signal)")
     p.add_argument("cli_args", nargs=argparse.REMAINDER,
                    help="-- followed by the training CLI flags")
     return p
 
 
+def run_with_stall_watch(cmd: list[str], stall_timeout: float) -> int:
+    """Run ``cmd``, relaying its output line-by-line; if NO line arrives for
+    ``stall_timeout`` seconds, terminate (then kill) it. Returns the exit
+    code — negative (signal death) when the watchdog fired, so the caller's
+    retry logic treats a stall exactly like a crash-by-signal."""
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    last = [time.monotonic()]
+
+    def pump():
+        for line in proc.stdout:
+            last[0] = time.monotonic()
+            print(line, end="", flush=True)
+        proc.stdout.close()
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            t.join(timeout=5)
+            return rc
+        if time.monotonic() - last[0] > stall_timeout:
+            print(f"supervise: child silent for >{stall_timeout:.0f}s — "
+                  "stalled; terminating", file=sys.stderr)
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            t.join(timeout=5)
+            return proc.returncode
+        time.sleep(min(1.0, stall_timeout / 4))
+
+
 def supervise(cli_args: list[str], *, max_restarts: int = 3,
-              restart_delay: float = 1.0, runner=None) -> int:
+              restart_delay: float = 1.0, stall_timeout: float | None = None,
+              runner=None) -> int:
     """Run the CLI (as a subprocess by default); relaunch with --resume on
     failure. ``runner(argv) -> int`` is injectable for tests."""
+    if stall_timeout is not None and stall_timeout <= 0:
+        # 0 would silently mean "no watchdog" and a negative value would
+        # kill every healthy child at launch — both are operator mistakes
+        raise SystemExit(
+            f"--stall-timeout must be > 0, got {stall_timeout}"
+        )
     if not any(a == "--checkpoint-dir" or a.startswith("--checkpoint-dir=")
                for a in cli_args):
         print("supervise: warning: no --checkpoint-dir — a crash will "
@@ -53,9 +113,10 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
     subprocess_runner = runner is None
     if runner is None:
         def runner(argv):
-            return subprocess.run(
-                [sys.executable, "-m", "lstm_tensorspark_tpu.cli", *argv]
-            ).returncode
+            cmd = [sys.executable, "-m", "lstm_tensorspark_tpu.cli", *argv]
+            if stall_timeout:
+                return run_with_stall_watch(cmd, stall_timeout)
+            return subprocess.run(cmd).returncode
 
     attempt = 0
     while True:
@@ -106,6 +167,7 @@ def main(argv=None) -> int:
         cli_args,
         max_restarts=args.max_restarts,
         restart_delay=args.restart_delay,
+        stall_timeout=args.stall_timeout,
     )
 
 

@@ -109,3 +109,64 @@ def test_signal_death_maps_to_128_plus_signum():
 
     rc = supervise(["--a"], max_restarts=0, restart_delay=0.0, runner=runner)
     assert rc == 137  # 128 + 9
+
+
+def test_stall_watch_kills_silent_child():
+    import sys
+    import time
+
+    from lstm_tensorspark_tpu.supervise import run_with_stall_watch
+
+    t0 = time.monotonic()
+    rc = run_with_stall_watch(
+        [sys.executable, "-c",
+         "print('hello', flush=True); import time; time.sleep(300)"],
+        stall_timeout=5.0,
+    )
+    assert rc < 0, rc  # signal death: the watchdog fired
+    assert time.monotonic() - t0 < 120
+
+
+def test_stall_watch_passes_healthy_child_through():
+    import sys
+
+    from lstm_tensorspark_tpu.supervise import run_with_stall_watch
+
+    # generous timeout vs tick gap: the suite may share the machine with
+    # heavy load, and a loaded scheduler must not fake a stall
+    rc = run_with_stall_watch(
+        [sys.executable, "-c",
+         "import time\n"
+         "for i in range(4):\n"
+         "    print('tick', i, flush=True); time.sleep(0.3)\n"],
+        stall_timeout=15.0,
+    )
+    assert rc == 0
+
+
+def test_supervise_retries_stall_deaths():
+    """A watchdog kill surfaces as a signal death (rc >= 128 after
+    conversion) and must be retried, not classed as deterministic."""
+    from lstm_tensorspark_tpu.supervise import supervise
+
+    calls = []
+
+    def runner(argv):
+        calls.append(list(argv))
+        return -15 if len(calls) == 1 else 0  # stalled once, then healthy
+
+    rc = supervise(["--checkpoint-dir", "x"], max_restarts=2,
+                   restart_delay=0.0, runner=runner)
+    assert rc == 0
+    assert len(calls) == 2 and "--resume" in calls[1]
+
+
+def test_stall_timeout_must_be_positive():
+    import pytest
+
+    from lstm_tensorspark_tpu.supervise import supervise
+
+    for bad in (0.0, -60.0):
+        with pytest.raises(SystemExit):
+            supervise(["--checkpoint-dir", "x"], stall_timeout=bad,
+                      runner=lambda argv: 0)
