@@ -120,6 +120,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--jsonl", type=str, default=None, help="metrics JSONL path")
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--keep-best", action="store_true",
+                   help="additionally track the BEST-eval checkpoint "
+                        "(best.msgpack + best.json in --checkpoint-dir, "
+                        "overwritten on each improvement of the task's "
+                        "eval metric: LM perplexity / classifier accuracy "
+                        "/ forecast MSE) — outside the keep-N rotation; "
+                        "requires --checkpoint-dir and --eval-every")
     p.add_argument("--async-checkpoint", action="store_true",
                    help="overlap checkpoint serialization + file IO with "
                         "training: save() blocks only for the device-to-"
@@ -190,6 +197,14 @@ def main(argv=None) -> int:
                          "PERIODIC eval pass into the train executable; "
                          "without a cadence it would stage eval data and "
                          "compile the eval branch for nothing)")
+    if args.keep_best and not (args.checkpoint_dir and args.eval_every):
+        raise SystemExit("--keep-best needs --checkpoint-dir (where "
+                         "best.msgpack lives) and --eval-every > 0 (the "
+                         "metric it tracks)")
+    if args.keep_best and (args.num_processes or 1) > 1:
+        raise SystemExit("--keep-best is single-process only (multi-host "
+                         "best tracking would need the sharded checkpoint "
+                         "writer)")
 
     if args.compilation_cache:
         # cache EVERY executable (the defaults skip sub-second compiles,
@@ -459,8 +474,10 @@ def _wire_checkpoint(args, logger, template_fn):
     # _make_logged_loop calls .finalize after the loop so the last async
     # write is durable before the process reads checkpoints or exits, and
     # a failed final write fails the run. Anyone wrapping checkpoint_fn
-    # must carry the attribute forward.
+    # must carry the attributes forward (.save_best serves --keep-best).
     checkpoint_fn.finalize = ckpt.wait
+    checkpoint_fn.save_best = ckpt.save_best
+    checkpoint_fn.best_meta = ckpt.best_meta
     return restored, checkpoint_fn
 
 
@@ -481,8 +498,20 @@ def _mfu_logging(args, fwd_flops_per_token, mesh):
 
 def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
                       eval_fn=None, checkpoint_fn=None, tokens_per_batch=None,
-                      fused_eval=None, flops_per_token=None, peak_tflops=None):
+                      fused_eval=None, flops_per_token=None, peak_tflops=None,
+                      best_metric="eval_loss", best_mode="min"):
     from .train.loop import train_loop
+
+    best_fn, best_init = None, None
+    if getattr(args, "keep_best", False) and checkpoint_fn is not None:
+        best_fn = getattr(checkpoint_fn, "save_best", None)
+        # seed best-so-far from a previously saved best (resume/restart
+        # must never overwrite a better checkpoint with a worse one)
+        meta_fn = getattr(checkpoint_fn, "best_meta", None)
+        if best_fn is not None and meta_fn is not None:
+            meta = meta_fn()
+            if meta is not None:
+                best_init = meta["value"]
 
     total = args.num_steps or args.epochs * steps_per_epoch
     # --resume restores state.step; train only the REMAINING budget
@@ -512,6 +541,10 @@ def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
             fused_eval=fused_eval,
             flops_per_token=flops_per_token,
             peak_tflops=peak_tflops,
+            best_fn=best_fn,
+            best_metric=best_metric,
+            best_mode=best_mode,
+            best_init=best_init,
         )
     finally:
         if args.profile_dir:

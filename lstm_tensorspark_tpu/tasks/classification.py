@@ -287,6 +287,7 @@ def run_classifier(args, logger) -> int:
         if fused_eval else None,
         flops_per_token=flops_per_token,
         peak_tflops=peak,
+        best_metric="eval_accuracy", best_mode="max",
     )
     # final eval on the device-resident params (TP: sharded in place; DP:
     # replicated) — no host round-trip of the model

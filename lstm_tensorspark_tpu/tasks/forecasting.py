@@ -281,6 +281,7 @@ def run_forecaster(args, logger) -> int:
         if fused_eval else None,
         flops_per_token=flops_per_token,
         peak_tflops=peak,
+        best_metric="eval_mse", best_mode="min",
     )
     # final eval on the device-resident params (TP: sharded in place; DP:
     # replicated) — no host round-trip of the model

@@ -20,6 +20,7 @@ onto the template's shardings via ``make_array_from_callback``.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import threading
@@ -146,12 +147,78 @@ class Checkpointer:
         (must stay in sync with ``_PAT``)."""
         return os.path.join(self.directory, f"step_{step}.msgpack")
 
-    def _save_single(self, host_state) -> str:
-        path = self._path_for(int(host_state.step))
+    # -- best-metric checkpoint -------------------------------------------
+
+    @property
+    def _best_path(self) -> str:
+        return os.path.join(self.directory, "best.msgpack")
+
+    def save_best(self, state, value: float) -> str:
+        """Write/overwrite the best-eval checkpoint. ONE atomic artifact
+        (``best.msgpack``: {step, value, state-bytes}) so the metadata can
+        never describe different weights than the file holds; ``best.json``
+        is a derived convenience view written after (advisory only).
+        Called by the train loop only on metric improvement, so it stays
+        synchronous (rare) and independent of the step_N rotation — keep-N
+        cleanup never deletes it. Single-process runs only (multi-process
+        best tracking would need the sharded writer; not wired — cli.main
+        rejects the combination up front)."""
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "best-checkpoint tracking is single-process only"
+            )
+        self.wait()  # never interleave with an in-flight async write
+        host = jax.device_get(state)
+        payload = {
+            "step": int(host.step),
+            "value": float(value),
+            "state": serialization.to_bytes(host),
+        }
+        self._atomic_write(self._best_path,
+                           serialization.msgpack_serialize(payload))
+        meta = os.path.join(self.directory, "best.json")
+        self._atomic_write(
+            meta,
+            json.dumps({"step": payload["step"],
+                        "value": payload["value"]}).encode(),
+        )
+        return self._best_path
+
+    def best_meta(self) -> dict | None:
+        """{step, value} of the saved best checkpoint (from the
+        AUTHORITATIVE artifact, not the advisory sidecar), or None. Used
+        to seed the train loop's best-so-far across restarts so a resumed
+        run can never overwrite a better best with a worse one."""
+        self.wait()
+        if not os.path.exists(self._best_path):
+            return None
+        with open(self._best_path, "rb") as f:
+            payload = serialization.msgpack_restore(f.read())
+        return {"step": int(payload["step"]),
+                "value": float(payload["value"])}
+
+    def restore_best(self, template):
+        """Restore the best-metric checkpoint (None if never saved)."""
+        self.wait()
+        if not os.path.exists(self._best_path):
+            return None
+        with open(self._best_path, "rb") as f:
+            payload = serialization.msgpack_restore(f.read())
+        restored = serialization.from_bytes(template, payload["state"])
+        return self._reshard_like(template, restored)
+
+    @staticmethod
+    def _atomic_write(path: str, data: bytes) -> None:
+        """tmp-write + rename: partial writes never count (shared by the
+        single-file, best and marker writers)."""
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(serialization.to_bytes(host_state))
-        os.replace(tmp, path)  # atomic: partial writes never count
+            f.write(data)
+        os.replace(tmp, path)
+
+    def _save_single(self, host_state) -> str:
+        path = self._path_for(int(host_state.step))
+        self._atomic_write(path, serialization.to_bytes(host_state))
         return path
 
     def _save_sharded(self, state) -> str:

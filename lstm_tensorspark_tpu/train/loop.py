@@ -288,6 +288,10 @@ def train_loop(
     fused_eval: Callable[[dict], dict] | None = None,
     flops_per_token: float | None = None,
     peak_tflops: float | None = None,
+    best_fn: Callable | None = None,
+    best_metric: str = "eval_loss",
+    best_mode: str = "min",
+    best_init: float | None = None,
 ) -> TrainState:
     """Drive the jitted step over a batch iterator, logging scalar metrics.
 
@@ -305,10 +309,18 @@ def train_loop(
     eval scalars (the LM derives perplexity, the classifier reads accuracy)
     — instead of calling ``eval_fn``: one executable for both cadences,
     zero train/eval program swaps.
+
+    ``best_fn(state, value)`` (e.g. Checkpointer.save_best) fires whenever
+    an eval improves ``best_metric`` under ``best_mode`` ("min"/"max") —
+    best-checkpoint tracking, independent of the periodic rotation.
+    ``best_init`` seeds the best-so-far (a resumed run passes the saved
+    best's value so it can never overwrite a better checkpoint with a
+    worse one).
     """
     t0 = time.perf_counter()
     window_start = t0
     last_metrics = None
+    best_val = best_init
     for i, batch in enumerate(batches):
         if num_steps is not None and i >= num_steps:
             break
@@ -355,6 +367,18 @@ def train_loop(
                 ev = None
             if ev is not None and logger is not None:
                 logger.log({"step": int(state.step), **ev})
+            if best_fn is not None and ev is not None and best_metric in ev:
+                v = float(ev[best_metric])
+                improved = best_val is None or (
+                    v < best_val if best_mode == "min" else v > best_val
+                )
+                if improved:
+                    best_val = v
+                    best_fn(state, v)
+                    if logger is not None:
+                        logger.log({"step": int(state.step),
+                                    "note": f"new best {best_metric}",
+                                    best_metric: v})
         if checkpoint_fn is not None and checkpoint_every and step % checkpoint_every == 0:
             checkpoint_fn(state)
     if last_metrics is not None:

@@ -142,3 +142,86 @@ def test_cli_async_checkpoint_resume(tmp_path):
     # the LAST checkpoint (step 4) must be the resume point — a stale
     # restore (in-flight final write) would resume at step 2
     assert notes and "resumed at step 4" in notes[0]["note"], records
+
+
+def test_save_best_and_restore_best(tmp_path):
+    loss_fn, opt, state, batch = _setup()
+    step = make_train_step(loss_fn, opt)
+    state, _ = step(state, batch)
+    ck = Checkpointer(str(tmp_path))
+    ck.save_best(state, 3.14)
+    meta = json.load(open(os.path.join(tmp_path, "best.json")))
+    assert meta == {"step": 1, "value": 3.14}
+    template = init_train_state(
+        init_lm(jax.random.PRNGKey(9), LMConfig(vocab_size=V, hidden_size=H,
+                                                num_layers=1)),
+        opt, jax.random.PRNGKey(10),
+    )
+    restored = ck.restore_best(template)
+    assert int(restored.step) == 1
+    for a, b in zip(jax.tree.leaves(restored.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a),
+                                      np.asarray(jax.device_get(b)))
+    # best.msgpack lives OUTSIDE the keep-N rotation
+    for _ in range(5):
+        state, _ = step(state, batch)
+        ck.save(state)
+    assert os.path.exists(os.path.join(tmp_path, "best.msgpack"))
+
+
+def test_cli_keep_best_tracks_best_eval(tmp_path):
+    """--keep-best: best.json records the step whose eval metric is the
+    minimum of all eval records in the run's own JSONL."""
+    from lstm_tensorspark_tpu.cli import main
+
+    ckpt = str(tmp_path / "ck")
+    jsonl = tmp_path / "m.jsonl"
+    rc = main([
+        "--dataset", "ptb_char", "--hidden-units", "16", "--num-layers", "1",
+        "--batch-size", "8", "--seq-len", "16", "--num-steps", "8",
+        "--log-every", "2", "--eval-every", "2", "--backend", "single",
+        "--checkpoint-dir", ckpt, "--checkpoint-every", "4",
+        "--keep-best", "--jsonl", str(jsonl),
+    ])
+    assert rc == 0
+    meta = json.load(open(os.path.join(ckpt, "best.json")))
+    records = [json.loads(l) for l in open(jsonl)]
+    evals = {r["step"]: r["eval_loss"] for r in records
+             if "eval_loss" in r and r.get("note") is None}
+    best_step = min(evals, key=evals.get)
+    assert meta["step"] == best_step
+    np.testing.assert_allclose(meta["value"], evals[best_step], rtol=1e-6)
+
+
+def test_cli_keep_best_requires_dir_and_cadence():
+    import pytest
+
+    from lstm_tensorspark_tpu.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["--dataset", "ptb_char", "--num-steps", "2", "--keep-best"])
+
+
+def test_keep_best_survives_resume(tmp_path):
+    """A resumed run whose evals are WORSE than the stored best must not
+    overwrite best.msgpack (best-so-far is seeded from the saved best)."""
+    from lstm_tensorspark_tpu.cli import main
+
+    ckpt = str(tmp_path / "ck")
+    argv = [
+        "--dataset", "ptb_char", "--hidden-units", "16", "--num-layers", "1",
+        "--batch-size", "8", "--seq-len", "16", "--log-every", "2",
+        "--eval-every", "2", "--backend", "single",
+        "--checkpoint-dir", ckpt, "--checkpoint-every", "2", "--keep-best",
+    ]
+    assert main(argv + ["--num-steps", "4", "--learning-rate", "1.0"]) == 0
+    before = json.load(open(os.path.join(ckpt, "best.json")))
+    # resume with a divergent learning rate: evals only get worse
+    assert main(argv + ["--num-steps", "8", "--resume",
+                        "--learning-rate", "50.0"]) == 0
+    after = json.load(open(os.path.join(ckpt, "best.json")))
+    assert after == before, (before, after)
+
+    ck = Checkpointer(ckpt)
+    assert ck.best_meta() == before
