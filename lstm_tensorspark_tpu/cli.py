@@ -134,6 +134,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "thread (single-process runs; multi-process saves "
                         "stay synchronous for their barriers)")
     p.add_argument("--resume", action="store_true", help="resume from latest checkpoint in --checkpoint-dir")
+    p.add_argument("--resume-best", action="store_true",
+                   help="ONE-TIME REWIND to the best-eval checkpoint "
+                        "(--keep-best's best.msgpack) — e.g. to fine-tune "
+                        "the best model after overfitting. Deletes step_N "
+                        "checkpoints newer than the best and re-saves the "
+                        "rewound point, so later --resume runs continue "
+                        "THIS lineage; mutually exclusive with --resume "
+                        "(the supervisor converts it to --resume on "
+                        "relaunch); single-process only")
     p.add_argument("--compilation-cache", type=str, default=None,
                    help="persistent XLA compilation-cache directory: repeat "
                         "runs of the same program shapes skip compilation "
@@ -205,6 +214,20 @@ def main(argv=None) -> int:
         raise SystemExit("--keep-best is single-process only (multi-host "
                          "best tracking would need the sharded checkpoint "
                          "writer)")
+    if args.resume_best and not args.checkpoint_dir:
+        raise SystemExit("--resume-best needs --checkpoint-dir (where the "
+                         "producing run's best.msgpack lives) — without it "
+                         "the run would silently train from random init")
+    if args.resume_best and args.resume:
+        raise SystemExit("--resume-best and --resume are mutually exclusive "
+                         "(rewind vs continue are different intents; the "
+                         "supervisor converts --resume-best to --resume on "
+                         "relaunch so a crashed fine-tune continues its own "
+                         "lineage)")
+    if args.resume_best and (args.num_processes or 1) > 1:
+        raise SystemExit("--resume-best is single-process only (the rewind "
+                         "fences checkpoint files; multi-host would race "
+                         "the deletes)")
 
     if args.compilation_cache:
         # cache EVERY executable (the defaults skip sub-second compiles,
@@ -462,7 +485,23 @@ def _wire_checkpoint(args, logger, template_fn):
     ckpt = Checkpointer(args.checkpoint_dir,
                         async_save=getattr(args, "async_checkpoint", False))
     restored = None
-    if args.resume and ckpt.has_checkpoint():
+    if getattr(args, "resume_best", False):
+        meta = ckpt.best_meta()
+        if meta is None:
+            raise SystemExit("--resume-best: no best checkpoint in "
+                             f"{args.checkpoint_dir} (was --keep-best on "
+                             "in the producing run?)")
+        restored = ckpt.restore_best(template_fn())
+        # the rewind is a commitment: fence the abandoned lineage (its
+        # later step_N checkpoints must not win a future restore_latest)
+        # and make the rewound point itself durable as a step checkpoint —
+        # a crash before the fine-tune's first own save then resumes HERE,
+        # not from random init
+        ckpt.fence_after(meta["step"])
+        ckpt.save(restored)
+        logger.log({"note": f"resumed from BEST checkpoint at step "
+                            f"{int(restored.step)}", **meta})
+    elif args.resume and ckpt.has_checkpoint():
         restored = ckpt.restore_latest(template_fn())
         if restored is not None:
             logger.log({"note": f"resumed at step {int(restored.step)}"})

@@ -121,8 +121,13 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
     attempt = 0
     while True:
         argv = list(cli_args)
-        if attempt > 0 and "--resume" not in argv:
-            argv.append("--resume")
+        if attempt > 0:
+            # --resume-best is a ONE-TIME rewind (and mutually exclusive
+            # with --resume in the CLI): after the first attempt performed
+            # it, relaunches must continue the fine-tune's own lineage
+            argv = [a for a in argv if a != "--resume-best"]
+            if "--resume" not in argv:
+                argv.append("--resume")
         start = time.monotonic()
         rc = runner(argv)
         lifetime = time.monotonic() - start

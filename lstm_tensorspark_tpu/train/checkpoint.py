@@ -66,6 +66,7 @@ class Checkpointer:
         self.async_save = async_save
         self._thread: threading.Thread | None = None
         self._error: BaseException | None = None
+        self._best_meta_cache: dict | None = None
         os.makedirs(directory, exist_ok=True)
 
     # -- discovery ---------------------------------------------------------
@@ -94,6 +95,21 @@ class Checkpointer:
 
     def has_checkpoint(self) -> bool:
         return bool(self._steps())
+
+    def latest_step(self):
+        """Newest restorable step, or None."""
+        steps = self._steps()
+        return steps[-1] if steps else None
+
+    def fence_after(self, step: int) -> None:
+        """Delete every step_N checkpoint NEWER than ``step`` — the
+        --resume-best rewind: the abandoned lineage's later checkpoints
+        must not be restorable, or a subsequent --resume would silently
+        continue the diverged weights the user rewound away from."""
+        for s in self._steps():
+            if s > step:
+                for f in self._files_for_step(s):
+                    os.remove(f)
 
     # -- save --------------------------------------------------------------
 
@@ -182,20 +198,27 @@ class Checkpointer:
             json.dumps({"step": payload["step"],
                         "value": payload["value"]}).encode(),
         )
+        self._best_meta_cache = {"step": payload["step"],
+                                 "value": payload["value"]}
         return self._best_path
 
     def best_meta(self) -> dict | None:
         """{step, value} of the saved best checkpoint (from the
-        AUTHORITATIVE artifact, not the advisory sidecar), or None. Used
-        to seed the train loop's best-so-far across restarts so a resumed
-        run can never overwrite a better best with a worse one."""
+        AUTHORITATIVE artifact, not the advisory sidecar; cached after the
+        first read — the state-bearing file is parsed once, not once per
+        caller), or None. Used to seed the train loop's best-so-far across
+        restarts so a resumed run can never overwrite a better best with a
+        worse one."""
+        if self._best_meta_cache is not None:
+            return dict(self._best_meta_cache)
         self.wait()
         if not os.path.exists(self._best_path):
             return None
         with open(self._best_path, "rb") as f:
             payload = serialization.msgpack_restore(f.read())
-        return {"step": int(payload["step"]),
-                "value": float(payload["value"])}
+        self._best_meta_cache = {"step": int(payload["step"]),
+                                 "value": float(payload["value"])}
+        return dict(self._best_meta_cache)
 
     def restore_best(self, template):
         """Restore the best-metric checkpoint (None if never saved)."""

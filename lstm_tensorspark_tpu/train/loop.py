@@ -369,9 +369,15 @@ def train_loop(
                 logger.log({"step": int(state.step), **ev})
             if best_fn is not None and ev is not None and best_metric in ev:
                 v = float(ev[best_metric])
-                improved = best_val is None or (
+                # NaN must never become (or remain) the best: it would win
+                # once (any comparison with None/NaN) and then never be
+                # beaten, pinning the best checkpoint to a diverged model
+                # forever — a NaN seeded via best_init (legacy file)
+                # counts as "no best yet"
+                no_best = best_val is None or best_val != best_val
+                improved = v == v and (no_best or (
                     v < best_val if best_mode == "min" else v > best_val
-                )
+                ))
                 if improved:
                     best_val = v
                     best_fn(state, v)

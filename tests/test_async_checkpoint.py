@@ -225,3 +225,102 @@ def test_keep_best_survives_resume(tmp_path):
 
     ck = Checkpointer(ckpt)
     assert ck.best_meta() == before
+
+
+def test_cli_resume_best(tmp_path):
+    """--resume-best restarts from best.msgpack's step, not the latest."""
+    from lstm_tensorspark_tpu.cli import main
+
+    ckpt = str(tmp_path / "ck")
+    argv = [
+        "--dataset", "ptb_char", "--hidden-units", "16", "--num-layers", "1",
+        "--batch-size", "8", "--seq-len", "16", "--log-every", "2",
+        "--eval-every", "2", "--backend", "single",
+        "--checkpoint-dir", ckpt, "--checkpoint-every", "2", "--keep-best",
+    ]
+    # run 1: healthy to step 4, then a divergent continuation to step 8 —
+    # best stays at an early step while the LATEST checkpoint is step 8
+    assert main(argv + ["--num-steps", "4", "--learning-rate", "1.0"]) == 0
+    assert main(argv + ["--num-steps", "8", "--resume",
+                        "--learning-rate", "50.0"]) == 0
+    best = json.load(open(os.path.join(ckpt, "best.json")))
+    assert best["step"] < 8
+
+    jsonl = tmp_path / "m.jsonl"
+    rc = main(argv + ["--num-steps", str(best["step"] + 2), "--resume-best",
+                      "--learning-rate", "0.1", "--jsonl", str(jsonl)])
+    assert rc == 0
+    records = [json.loads(l) for l in open(jsonl)]
+    note = [r for r in records if "BEST" in str(r.get("note", ""))][0]
+    assert f"step {best['step']}" in note["note"]
+
+
+def test_best_tracking_ignores_nan():
+    """A NaN eval must never become (and pin) the best."""
+    from lstm_tensorspark_tpu.train.loop import train_loop
+
+    saved = []
+    evals = iter([float("nan"), 2.0, 1.5])
+
+    def train_step(state, batch):
+        return state, {"loss": 0.0, "grad_norm": 0.0}
+
+    loss_fn, opt, state, batch = _setup()
+    train_loop(
+        state, train_step, iter([batch] * 3), num_steps=3, log_every=0,
+        eval_fn=lambda p: {"eval_loss": next(evals)}, eval_every=1,
+        best_fn=lambda s, v: saved.append(v),
+    )
+    assert saved == [2.0, 1.5]
+
+
+def test_resume_best_fences_abandoned_lineage(tmp_path):
+    """--resume-best deletes the abandoned lineage's newer checkpoints, so
+    a later --resume continues the NEW lineage."""
+    from lstm_tensorspark_tpu.cli import main
+
+    ckpt = str(tmp_path / "ck")
+    argv = [
+        "--dataset", "ptb_char", "--hidden-units", "16", "--num-layers", "1",
+        "--batch-size", "8", "--seq-len", "16", "--log-every", "2",
+        "--eval-every", "2", "--backend", "single",
+        "--checkpoint-dir", ckpt, "--checkpoint-every", "2", "--keep-best",
+    ]
+    assert main(argv + ["--num-steps", "4", "--learning-rate", "1.0"]) == 0
+    assert main(argv + ["--num-steps", "8", "--resume",
+                        "--learning-rate", "50.0"]) == 0
+    best = json.load(open(os.path.join(ckpt, "best.json")))
+    assert best["step"] < 8
+    # rewind: fine-tune from best for 2 more steps
+    assert main(argv + ["--num-steps", str(best["step"] + 2),
+                        "--resume-best", "--learning-rate", "0.1"]) == 0
+    steps = sorted(int(n.split("_")[1].split(".")[0])
+                   for n in os.listdir(ckpt) if n.startswith("step_"))
+    assert all(s <= best["step"] + 2 for s in steps), steps
+    # a plain --resume now continues the fine-tune lineage, not step 8
+    jsonl = tmp_path / "m.jsonl"
+    assert main(argv + ["--num-steps", str(best["step"] + 4), "--resume",
+                        "--learning-rate", "0.1",
+                        "--jsonl", str(jsonl)]) == 0
+    records = [json.loads(l) for l in open(jsonl)]
+    note = [r for r in records if "resumed at step" in str(r.get("note", ""))]
+    assert note and f"step {best['step'] + 2}" in note[0]["note"], note
+
+
+def test_resume_best_requires_dir_and_best():
+    import pytest
+
+    from lstm_tensorspark_tpu.cli import main
+
+    with pytest.raises(SystemExit):  # no --checkpoint-dir
+        main(["--dataset", "ptb_char", "--num-steps", "2", "--resume-best"])
+
+
+def test_resume_best_fails_fast_without_best(tmp_path):
+    import pytest
+
+    from lstm_tensorspark_tpu.cli import main
+
+    with pytest.raises(SystemExit):  # dir exists but never had --keep-best
+        main(["--dataset", "ptb_char", "--num-steps", "2", "--resume-best",
+              "--checkpoint-dir", str(tmp_path)])

@@ -170,3 +170,21 @@ def test_stall_timeout_must_be_positive():
         with pytest.raises(SystemExit):
             supervise(["--checkpoint-dir", "x"], stall_timeout=bad,
                       runner=lambda argv: 0)
+
+
+def test_resume_best_converted_to_resume_on_relaunch():
+    """--resume-best is a one-time rewind: relaunches must continue the
+    fine-tune's own lineage via plain --resume."""
+    from lstm_tensorspark_tpu.supervise import supervise
+
+    calls = []
+
+    def runner(argv):
+        calls.append(list(argv))
+        return 1 if len(calls) == 1 else 0
+
+    rc = supervise(["--checkpoint-dir", "x", "--resume-best"],
+                   max_restarts=2, restart_delay=0.0, runner=runner)
+    assert rc == 0
+    assert "--resume-best" in calls[0]
+    assert "--resume-best" not in calls[1] and "--resume" in calls[1]
