@@ -105,7 +105,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="nucleus sampling mass in (0, 1]")
     p.add_argument("--greedy", action="store_true", help="argmax decoding")
     p.add_argument("--num-steps", type=int, default=None,
-                   help="total step budget for the job, resume-inclusive (overrides epochs)")
+                   help="total step budget for the job, resume-inclusive "
+                        "(overrides epochs). An explicit 0 runs ZERO "
+                        "training steps — the eval-only recipe with "
+                        "--resume (unset falls back to the epoch count)")
     p.add_argument("--eval-every", type=int, default=0)
     p.add_argument("--eval-batches", type=int, default=None,
                    help="cap each eval pass at N batches (default: the full "
@@ -552,7 +555,11 @@ def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
             if meta is not None:
                 best_init = meta["value"]
 
-    total = args.num_steps or args.epochs * steps_per_epoch
+    # explicit `--num-steps 0` means ZERO training steps (the eval-only
+    # recipe: resume a checkpoint, skip straight to the final eval) — only
+    # an UNSET budget falls back to the epoch count
+    total = (args.num_steps if args.num_steps is not None
+             else args.epochs * steps_per_epoch)
     # --resume restores state.step; train only the REMAINING budget
     total = max(total - int(state.step), 0)
     k = getattr(args, "steps_per_call", 1)

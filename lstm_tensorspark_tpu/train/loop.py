@@ -321,6 +321,8 @@ def train_loop(
     window_start = t0
     last_metrics = None
     best_val = best_init
+    if num_steps is not None and num_steps <= 0:
+        return state  # eval-only budget: never pull a batch from the feed
     for i, batch in enumerate(batches):
         if num_steps is not None and i >= num_steps:
             break

@@ -182,3 +182,27 @@ def test_cli_pipeline(tmp_path):
     assert any("resumed at step 3" in str(r.get("note", "")) for r in records)
     finals = [r for r in records if r.get("note") == "final"]
     assert finals and all(np.isfinite(f["eval_ppl"]) for f in finals)
+
+
+def test_eval_only_zero_step_budget(tmp_path):
+    """Explicit --num-steps 0 + --resume = the eval-only recipe: NO
+    training steps run (0 is not 'unset'), just the final eval at the
+    restored step."""
+    import json
+
+    from lstm_tensorspark_tpu.cli import main
+
+    ckpt = str(tmp_path / "ck")
+    jsonl = tmp_path / "m.jsonl"
+    argv = [
+        "--dataset", "ptb_char", "--hidden-units", "16", "--num-layers", "1",
+        "--batch-size", "8", "--seq-len", "16", "--backend", "single",
+        "--checkpoint-dir", ckpt, "--checkpoint-every", "2",
+    ]
+    assert main(argv + ["--num-steps", "4"]) == 0
+    assert main(argv + ["--num-steps", "0", "--resume",
+                        "--jsonl", str(jsonl)]) == 0
+    records = [json.loads(l) for l in open(jsonl)]
+    final = [r for r in records if r.get("note") == "final"][0]
+    assert final["step"] == 4, final
+    assert "eval_ppl" in final
