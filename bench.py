@@ -22,19 +22,24 @@ import subprocess
 import sys
 import time
 
-# STEPS sized so one timed rep runs ~2.5 s: the tunneled backend's ~65 ms
-# fixed fetch latency (see _two_point) must be <3% of the rep, not ~11% as
-# at the old 100-step rep length. The CPU baseline subprocess overrides
-# steps=10 explicitly (cpu_baseline), unaffected.
-B, T, HIDDEN, LAYERS, STEPS, WARMUP = 64, 64, 128, 1, 500, 10
+# STEPS counts K-step DISPATCHES for the headline run (calls = STEPS*K/K):
+# sized so one timed rep runs ~2.5 s at the measured ~22 ms/dispatch, so the
+# tunneled backend's ~65 ms fixed fetch latency (see _two_point) stays <3%
+# of the rep. The CPU baseline subprocess overrides steps=10 explicitly
+# (cpu_baseline), unaffected.
+B, T, HIDDEN, LAYERS, STEPS, WARMUP = 64, 64, 128, 1, 120, 10
 UNROLL = 8  # lax.scan unroll (used by the Pallas backward's recompute scan;
             # the CPU baseline keeps unroll=1, faithful to the reference's
             # step-at-a-time unroll)
-K = 32    # steps per dispatch for the TPU run (train/multistep.py): one
+K = 256   # steps per dispatch for the TPU run (train/multistep.py): one
           # jitted program runs K optimizer steps, so the host dispatch and
-          # tunnel round-trip amortise. The CPU baseline keeps
-          # one-dispatch-per-step — faithful to the reference's
-          # one-Spark-round-per-step structure.
+          # tunnel round-trip amortise. K=32 was device-bound at the old
+          # 148 us/step; after the one-hot indexing fix (ops/embedding.py)
+          # the step runs ~78 us device-side and 32-step dispatches went
+          # HOST-bound (~2 ms/dispatch tunnel cost ate the win). Measured
+          # sweep: K=32 ~421k, K=64 ~593k, K=256 ~750k seq/s. The CPU
+          # baseline keeps one-dispatch-per-step — faithful to the
+          # reference's one-Spark-round-per-step structure.
 DEVICE_DATA = True  # TPU run stages the corpus in HBM and slices windows
           # on-device (train/device_step.py): per-dispatch host traffic is
           # one scalar. This mirrors the reference's cached-RDD locality
@@ -547,7 +552,11 @@ def main() -> int:
     compact = {}
     for name in CONFIGS:
         try:
-            rec = measure_config(name)
+            # ptb_char's post-indexing-fix step (~78 us device) is host-
+            # bound at 32-step dispatches; the bigger configs are device-
+            # bound at K=32 already (>= 1 ms/step)
+            rec = measure_config(
+                name, steps_per_call=K if name == "ptb_char" else 32)
         except Exception as e:  # a config failing must not kill the headline
             rec = {"error": f"{type(e).__name__}: {e}"}
         if "error" not in rec:

@@ -21,6 +21,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
+from ..ops.embedding import embed_lookup
 from ..ops.lstm_cell import init_lstm_params
 from ..ops.masking import dropout, sequence_mask
 from ..ops.scan import auto_lstm_scan
@@ -81,7 +82,7 @@ def classifier_forward(
     """tokens [B,T] int32, lengths [B] → logits [B, num_classes]."""
     cdtype = None if cfg.cdtype == jnp.float32 else cfg.cdtype
     mask = sequence_mask(lengths, tokens.shape[1])
-    xs = jnp.take(params["embedding"], tokens, axis=0)
+    xs = embed_lookup(params["embedding"], tokens)
     h_fwd = h_bwd = None
     for i, (pf, pb) in enumerate(zip(params["fwd"], params["bwd"])):
         (h_fwd, _), ys_f = auto_lstm_scan(

@@ -17,6 +17,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
+from ..ops.embedding import embed_lookup, selected_logits
 from ..ops.lstm_cell import LSTMParams, init_lstm_params, zero_carry
 from ..ops.scan import stacked_lstm_scan
 
@@ -95,7 +96,10 @@ def lm_backbone(
 ):
     """tokens [B, T] int32 → (pre-head activations [B, T, H], finals)."""
     cdtype = cfg.cdtype
-    xs = jnp.take(params["embedding"], tokens, axis=0)
+    # embed_lookup: gather forward; at small V the gradient is an MXU
+    # matmul, not a scatter (ops/embedding.py — measured 28 us/step saved
+    # at the config-1 shape)
+    xs = embed_lookup(params["embedding"], tokens)
     return stacked_lstm_scan(
         params["layers"],
         xs,
@@ -177,12 +181,13 @@ def lm_loss(
             deterministic=deterministic,
         )
         # nll via logsumexp, NOT log_softmax: identical math
-        # (nll = lse - z_t) without the full [B,T,V] log-prob array
+        # (nll = lse - z_t) without the full [B,T,V] log-prob array.
+        # selected_logits: one-hot multiply-reduce at small V (bit-equal to
+        # the gather — the sum has one nonzero term — but fused and
+        # scatter-free in the backward; 43 us/step at the config-1 shape)
         logits_f = logits.astype(jnp.float32)
         lse = jax.nn.logsumexp(logits_f, axis=-1)
-        tgt = jnp.take_along_axis(
-            logits_f, batch["targets"][..., None], axis=-1
-        )[..., 0]
+        tgt = selected_logits(logits_f, batch["targets"])
         loss = jnp.mean(lse - tgt)
         nll_size = batch["targets"].size
     aux = {

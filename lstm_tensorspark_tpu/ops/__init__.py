@@ -5,6 +5,7 @@ from .lstm_cell import (
     lstm_step,
     lstm_step_unfused,
 )
+from .embedding import embed_lookup, selected_logits
 from .scan import auto_lstm_scan, lstm_scan, stacked_lstm_scan
 from .masking import sequence_mask, masked_mean, reverse_sequences
 
@@ -15,6 +16,8 @@ __all__ = [
     "lstm_step",
     "lstm_step_unfused",
     "auto_lstm_scan",
+    "embed_lookup",
+    "selected_logits",
     "lstm_scan",
     "stacked_lstm_scan",
     "sequence_mask",

@@ -42,6 +42,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from ..models.lstm_lm import LMConfig
+from ..ops.embedding import embed_lookup, selected_logits
 from ..ops.lstm_cell import LSTMParams, fuse_params, zero_carry
 from ..ops.scan import auto_lstm_scan, lstm_scan
 from ..train.loop import TrainState, step_body
@@ -248,7 +249,7 @@ def pp_lm_loss(
         # the two bit-for-bit) and skip the [b,T,V] log-prob array
         lg = logits.astype(jnp.float32)
         lse = jax.nn.logsumexp(lg, axis=-1)
-        t_ = jnp.take_along_axis(lg, tgt[..., None], axis=-1)[..., 0]
+        t_ = selected_logits(lg, tgt)
         return jnp.mean(lse - t_)
 
     x_in = jnp.zeros((b, T, Dmax), jnp.float32)
@@ -265,7 +266,7 @@ def pp_lm_loss(
         # stage 0 sources from the embedding; later stages from the left
         # neighbor's activations. where() zeroes the embedding gradient on
         # stages > 0, so the psum'd embedding grad is exactly stage 0's.
-        emb_x = pad_d(jnp.take(embedding, tok, axis=0).astype(jnp.float32))
+        emb_x = pad_d(embed_lookup(embedding, tok).astype(jnp.float32))
         src = jnp.where(s == 0, emb_x, x_in)
         rng_t = (
             jax.random.fold_in(dropout_rng, m_c * S + s) if use_dropout

@@ -26,6 +26,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from ..models.lstm_lm import LMConfig
+from ..ops.embedding import embed_lookup, selected_logits
 from ..train.loop import TrainState, step_body
 from .sequence_parallel import sp_lstm_scan
 from .tensor_parallel import lm_param_specs
@@ -45,7 +46,7 @@ def sp_lm_loss(params, batch, cfg: LMConfig, *, seq_axis: str = "seq",
     independent per shard — the DP backend's scheme extended to SP.
     """
     use_dropout = dropout_rng is not None and cfg.dropout > 0.0
-    xs = jnp.take(params["embedding"], batch["inputs"], axis=0)
+    xs = embed_lookup(params["embedding"], batch["inputs"])
     n = len(params["layers"])
     for idx, layer in enumerate(params["layers"]):
         xs = sp_lstm_scan(
@@ -76,7 +77,7 @@ def sp_lm_loss(params, batch, cfg: LMConfig, *, seq_axis: str = "seq",
     # the two bit-for-bit) and skip the [b,C,V] log-prob array
     lg = logits.astype(jnp.float32)
     lse = jax.nn.logsumexp(lg, axis=-1)
-    tgt = jnp.take_along_axis(lg, batch["targets"][..., None], axis=-1)[..., 0]
+    tgt = selected_logits(lg, batch["targets"])
     loss = jnp.mean(lse - tgt)  # local mean; caller pmeans over data+seq
     return loss, {"loss": loss}
 

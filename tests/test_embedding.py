@@ -1,0 +1,99 @@
+"""Parity tests for ops/embedding.py — the TPU-tuned vocabulary indexing.
+
+The helpers promise: forward bit-identical to the gather formulation at
+every vocab size, gradients equal up to float summation order (embedding)
+or bit-exact (selected_logits: the one-hot backward scatters exactly one
+term per position). A profile showed the gather/scatter formulations were
+48% of the config-1 step on v5e; these tests pin that the fast forms are
+drop-in numerically.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lstm_tensorspark_tpu.ops.embedding import (
+    _MM_GRAD_MAX_V,
+    embed_lookup,
+    selected_logits,
+)
+
+
+@pytest.mark.parametrize("V", [26, 370, _MM_GRAD_MAX_V, _MM_GRAD_MAX_V + 1])
+def test_embed_lookup_forward_matches_take(V):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    emb = jax.random.normal(k1, (V, 16), jnp.float32)
+    toks = jax.random.randint(k2, (4, 9), 0, V, jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(embed_lookup(emb, toks)),
+        np.asarray(jnp.take(emb, toks, axis=0)),
+    )
+
+
+@pytest.mark.parametrize("V", [26, _MM_GRAD_MAX_V + 1])
+def test_embed_lookup_grad_matches_take(V):
+    """Matmul-backward (small V) and scatter-backward (large V) agree with
+    the plain take gradient; tight tolerance because the difference is
+    summation order only."""
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    emb = jax.random.normal(k1, (V, 8), jnp.float32)
+    toks = jax.random.randint(k2, (3, 17), 0, min(V, 26), jnp.int32)
+    cot = jax.random.normal(k3, (3, 17, 8), jnp.float32)
+
+    g_fast = jax.grad(lambda e: jnp.vdot(embed_lookup(e, toks), cot))(emb)
+    g_ref = jax.grad(lambda e: jnp.vdot(jnp.take(e, toks, axis=0), cot))(emb)
+    np.testing.assert_allclose(np.asarray(g_fast), np.asarray(g_ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_embed_lookup_repeated_tokens_accumulate():
+    """Duplicate tokens must SUM their cotangents (the scatter-add
+    semantics), not overwrite."""
+    emb = jnp.zeros((4, 2), jnp.float32)
+    toks = jnp.array([1, 1, 1], jnp.int32)
+    g = jax.grad(lambda e: jnp.sum(embed_lookup(e, toks)))(emb)
+    np.testing.assert_array_equal(np.asarray(g[1]), np.array([3.0, 3.0]))
+    np.testing.assert_array_equal(np.asarray(g[0]), np.array([0.0, 0.0]))
+
+
+@pytest.mark.parametrize("V", [26, _MM_GRAD_MAX_V + 1])
+def test_selected_logits_forward_and_grad_exact(V):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
+    logits = jax.random.normal(k1, (5, 7, V), jnp.float32)
+    tgt = jax.random.randint(k2, (5, 7), 0, V, jnp.int32)
+    cot = jax.random.normal(k3, (5, 7), jnp.float32)
+
+    ref = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    np.testing.assert_array_equal(np.asarray(selected_logits(logits, tgt)),
+                                  np.asarray(ref))
+
+    g_fast = jax.grad(lambda l: jnp.vdot(selected_logits(l, tgt), cot))(logits)
+    g_ref = jax.grad(
+        lambda l: jnp.vdot(
+            jnp.take_along_axis(l, tgt[..., None], axis=-1)[..., 0], cot
+        )
+    )(logits)
+    np.testing.assert_array_equal(np.asarray(g_fast), np.asarray(g_ref))
+
+
+def test_lm_loss_value_unchanged_by_fast_indexing():
+    """lm_loss through the helpers equals the explicit gather formulation
+    (the helpers are drop-in: one-hot sum has a single nonzero term)."""
+    from lstm_tensorspark_tpu.models import LMConfig, init_lm, lm_loss
+    from lstm_tensorspark_tpu.models.lstm_lm import lm_forward
+
+    cfg = LMConfig(vocab_size=26, hidden_size=16, num_layers=1)
+    params = init_lm(jax.random.PRNGKey(3), cfg)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(4))
+    toks = jax.random.randint(k1, (2, 12 + 1), 0, 26, jnp.int32)
+    batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+
+    loss, _ = lm_loss(params, batch, cfg)
+
+    logits, _ = lm_forward(params, batch["inputs"], cfg)
+    lg = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lg, axis=-1)
+    tgt = jnp.take_along_axis(lg, batch["targets"][..., None], axis=-1)[..., 0]
+    ref = jnp.mean(lse - tgt)
+    np.testing.assert_array_equal(np.asarray(loss), np.asarray(ref))

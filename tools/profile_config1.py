@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Per-kernel device-time breakdown of the config-1 (PTB char) K-step train
+program on the real chip: trace a few dispatches with jax.profiler, parse
+the xplane with jax.profiler.ProfileData, aggregate kernel durations per
+optimizer step.
+
+This is the diagnostic that found the vocabulary-indexing bottleneck
+(ops/embedding.py): before the fix it showed 43 us/step in the target-logit
+gather and 28 us/step in the embedding-grad scatter vs 29 us/step for the
+fused Pallas recurrence pair — 48% of the step in indexing. After the fix
+the same trace reads ~78 us/step total with both kernels gone. Rerun it
+whenever a config's measured step time drifts from its roofline bound
+(BENCH_TABLE.json:roofline) to see where the slack actually is.
+"""
+
+import collections
+import glob
+import os
+import shutil
+import sys
+import time
+
+import jax
+
+PROF_DIR = "/tmp/prof_config1"
+K = 32  # dispatch size for the trace (per-step aggregation divides it out;
+        # bench.py's headline K differs — this only sets trace granularity)
+B, T, HIDDEN, LAYERS = 64, 64, 128, 1
+
+
+def build_step():
+    from lstm_tensorspark_tpu.data import (
+        get_dataset, stage_lm_data, window_index_stream,
+    )
+    from lstm_tensorspark_tpu.models import LMConfig, init_lm, lm_loss
+    from lstm_tensorspark_tpu.train import make_device_lm_train_step, make_optimizer
+    from lstm_tensorspark_tpu.train.loop import init_train_state
+
+    data = get_dataset("ptb_char")
+    cfg = LMConfig(vocab_size=len(data["vocab"]), hidden_size=HIDDEN,
+                   num_layers=LAYERS, compute_dtype="bfloat16",
+                   scan_unroll=8, use_pallas=True)
+
+    def loss_fn(params, batch, rng):
+        return lm_loss(params, batch, cfg)
+
+    opt = make_optimizer("sgd", 0.5)
+    params = init_lm(jax.random.PRNGKey(0), cfg)
+    state = init_train_state(params, opt, jax.random.PRNGKey(1))
+    staged = stage_lm_data(data["train"], B, T)
+    dstep = make_device_lm_train_step(loss_fn, opt, staged, steps_per_call=K)
+    it = window_index_stream(staged, K)
+    return (lambda s, w0: dstep(s, staged.arrays, w0)), state, it
+
+
+def main():
+    step, state, it = build_step()
+    # warm: compile + a few executions
+    for _ in range(4):
+        state, m = step(state, next(it))
+    float(m["loss"])
+
+    shutil.rmtree(PROF_DIR, ignore_errors=True)
+    calls = 8
+    with jax.profiler.trace(PROF_DIR):
+        for _ in range(calls):
+            state, m = step(state, next(it))
+        float(m["loss"])
+
+    paths = glob.glob(os.path.join(PROF_DIR, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        print("no xplane written", file=sys.stderr)
+        return 1
+    pd = jax.profiler.ProfileData.from_file(paths[0])
+    plane_names = [pl.name for pl in pd.planes]
+    print("planes:", plane_names, file=sys.stderr)
+
+    # Device plane(s): aggregate total duration + occurrence count per kernel.
+    for pl in pd.planes:
+        if "TPU" not in pl.name and "Device" not in pl.name:
+            continue
+        agg = collections.defaultdict(lambda: [0.0, 0])
+        t_min, t_max = float("inf"), 0.0
+        for line in pl.lines:
+            for ev in line.events:
+                name = ev.name
+                dur = (ev.duration_ns or 0) / 1e3  # us
+                agg[name][0] += dur
+                agg[name][1] += 1
+                if ev.start_ns:
+                    t_min = min(t_min, ev.start_ns)
+                    t_max = max(t_max, ev.start_ns + (ev.duration_ns or 0))
+        steps_total = calls * K
+        span_us = (t_max - t_min) / 1e3 if t_max > t_min else 0.0
+        print(f"\n=== plane {pl.name}: {steps_total} optimizer steps, "
+              f"trace span {span_us:.0f} us "
+              f"({span_us / steps_total:.2f} us/step) ===")
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
+        total = sum(v[0] for _, v in rows)
+        print(f"{'us/step':>9} {'count/step':>11} {'pct':>5}  kernel")
+        for name, (dur, cnt) in rows[:40]:
+            print(f"{dur / steps_total:9.3f} {cnt / steps_total:11.2f} "
+                  f"{100 * dur / total:5.1f}  {name[:100]}")
+        print(f"{total / steps_total:9.3f} {'':>11} 100.0  TOTAL device time")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
