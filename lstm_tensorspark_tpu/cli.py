@@ -296,7 +296,6 @@ def _select_backend(args):
     ``--backend dp`` is honored even with one device/partition (a 1-wide
     shard_map — useful to validate DP semantics anywhere); ``auto`` picks
     dp only when more than one shard is in play."""
-    from .parallel import make_mesh
     n_devices = jax.device_count()
     shards = args.num_partitions or n_devices
     if args.backend == "single" or (args.backend == "auto" and shards <= 1):
@@ -305,8 +304,25 @@ def _select_backend(args):
         raise SystemExit(
             f"--num-partitions {shards} exceeds {n_devices} available devices"
         )
-    devices = np.asarray(jax.devices()[:shards])
-    return make_mesh(dp=shards, devices=devices), shards
+    return _build_mesh(dp=shards,
+                       devices=np.asarray(jax.devices()[:shards])), shards
+
+
+def _build_mesh(**kw):
+    """Slice-aware mesh construction: order devices DCN-slowest
+    (make_hybrid_mesh — a no-op layout on one slice/process) so data-axis
+    psums decompose into ICI + one DCN phase and model/seq/pipe
+    collectives never cross slices. Falls back to the plain ordering ONLY
+    when a truncated device list leaves unequal domains (pathological but
+    previously legal — e.g. 6 partitions over 2 hosts of 4); a model
+    block that would straddle DCN stays the hard error mesh.py makes it."""
+    from .parallel import make_hybrid_mesh, make_mesh
+    try:
+        return make_hybrid_mesh(**kw)
+    except ValueError as e:
+        if "unequal" not in str(e):
+            raise
+        return make_mesh(**kw)
 
 
 def _setup_training(
@@ -431,7 +447,6 @@ def _setup_tp_training(args, logger, *, loss_fn, params, optimizer, rng,
     fused signature ``(state, batch, eval_batches, do_eval)`` — built ONCE
     here, not rebuilt by the task runner.
     """
-    from .parallel import make_mesh
     from .parallel.tensor_parallel import make_tp_train_step, place_params
     from .train.loop import init_train_state
 
@@ -455,7 +470,8 @@ def _setup_tp_training(args, logger, *, loss_fn, params, optimizer, rng,
         raise SystemExit(f"mesh dp*tp={dp * tp} exceeds {n} devices")
     if args.batch_size % dp != 0:
         raise SystemExit(f"--batch-size {args.batch_size} not divisible by dp={dp}")
-    mesh = make_mesh(dp=dp, tp=tp, devices=np.asarray(jax.devices()[: dp * tp]))
+    mesh = _build_mesh(dp=dp, tp=tp,
+                       devices=np.asarray(jax.devices()[: dp * tp]))
 
     state = init_train_state(params, optimizer, rng)
     restored, checkpoint_fn = _wire_checkpoint(args, logger, lambda: state)
@@ -854,7 +870,6 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     from .data import lm_batch_stream, lm_epoch_batches
     from .models import init_lm
     from .parallel import (
-        make_mesh,
         make_pp_lm_train_step,
         make_sharded_lm_train_step,
         place_pp_lm_params,
@@ -907,8 +922,8 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     if args.batch_size % (dp * mb) != 0:
         raise SystemExit(f"--batch-size {args.batch_size} not divisible by "
                          f"dp*microbatches = {dp}*{mb}")
-    mesh = make_mesh(dp=dp, tp=tp, sp=sp, pp=pp,
-                     devices=np.asarray(jax.devices()[:total]))
+    mesh = _build_mesh(dp=dp, tp=tp, sp=sp, pp=pp,
+                       devices=np.asarray(jax.devices()[:total]))
 
     optimizer = make_cli_optimizer(args)
     params = init_lm(jax.random.PRNGKey(args.seed), cfg)

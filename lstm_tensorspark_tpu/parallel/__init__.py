@@ -1,4 +1,10 @@
-from .mesh import make_mesh, local_device_count, distributed_init
+from .mesh import (
+    distributed_init,
+    local_device_count,
+    make_hybrid_mesh,
+    make_mesh,
+    slice_groups,
+)
 from .data_parallel import make_dp_train_step, make_dp_eval_step, shard_batch
 from .sequence_parallel import sp_lstm_scan
 from .tensor_parallel import (
@@ -19,7 +25,9 @@ __all__ = [
     "place_pp_lm_params",
     "stack_lm_params",
     "unstack_lm_params",
+    "make_hybrid_mesh",
     "make_mesh",
+    "slice_groups",
     "local_device_count",
     "distributed_init",
     "make_dp_train_step",

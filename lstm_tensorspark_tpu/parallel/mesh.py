@@ -58,6 +58,69 @@ def make_mesh(
     return Mesh(devices.reshape(dp, tp, sp, pp), AXES)
 
 
+def slice_groups(devices) -> list[list]:
+    """Group devices by interconnect domain, fastest first: TPU multi-slice
+    deployments report ``slice_index`` (ICI within a slice, DCN between);
+    everywhere else the process boundary is the domain boundary (a host's
+    local devices talk fast, cross-process traffic rides the network — the
+    2-process Gloo tests exercise exactly this). Groups come back sorted by
+    domain id, devices within a group sorted by device id."""
+    groups: dict = {}
+    for d in devices:
+        key = getattr(d, "slice_index", None)
+        if key is None:
+            key = d.process_index
+        groups.setdefault(key, []).append(d)
+    return [sorted(g, key=lambda d: d.id) for _, g in sorted(groups.items())]
+
+
+def make_hybrid_mesh(
+    dp: int | None = None,
+    tp: int = 1,
+    sp: int = 1,
+    pp: int = 1,
+    *,
+    devices=None,
+) -> Mesh:
+    """DCN-aware variant of `make_mesh`: same four named axes, devices
+    ordered SLICE-MAJOR before the reshape.
+
+    Why ordering is the whole feature (scaling-book recipe; SURVEY.md §5
+    comm-backend row): with the data axis slowest-varying and each
+    tp*sp*pp block contiguous, (a) every model/seq/pipe block lands
+    inside ONE interconnect domain — the latency-sensitive per-timestep
+    collectives (TP's h all-gather, SP's ppermute, PP's activation hops)
+    ride ICI only — and (b) `psum("data")`'s topology decomposes into an
+    intra-slice ICI phase plus one inter-slice DCN phase, which XLA
+    derives from device placement; no collective code changes. On a
+    single slice/process this degenerates to `make_mesh` exactly (one
+    group, same device order), so it is safe as a default.
+
+    Raises when tp*sp*pp does not divide the per-domain device count —
+    that layout would force a per-timestep collective across DCN, which
+    is a configuration error, not something to paper over.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    groups = slice_groups(devices)
+    sizes = {len(g) for g in groups}
+    if len(sizes) > 1:
+        raise ValueError(
+            f"unequal interconnect domains {sorted(len(g) for g in groups)}: "
+            "a hybrid mesh needs the same device count per slice/process"
+        )
+    block = tp * sp * pp
+    per = sizes.pop()
+    if per % block != 0:
+        raise ValueError(
+            f"model block tp*sp*pp={block} does not divide the slice size "
+            f"{per}: a model/seq/pipe collective would straddle the DCN "
+            "boundary (build such a layout explicitly with make_mesh if "
+            "you really mean it)"
+        )
+    ordered = [d for g in groups for d in g]
+    return make_mesh(dp, tp, sp, pp, devices=ordered)
+
+
 def distributed_init(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
