@@ -82,9 +82,14 @@ def _decode_one(params, fused_layers, cfg: LMConfig, carries, token: jax.Array):
         new_carries.append(carry)
     head = params["head"]
     kernel = params["embedding"].T if cfg.tie_embeddings else head["kernel"]
+    # cfg.ldtype, NOT hardcoded f32: the prefill's logits come from
+    # lm_forward at cfg.ldtype, and sampling from the prefill's last
+    # position must match sampling from a decode step over the same
+    # prefix — same precision or near-tied logits argmax differently
     logits = (
-        jnp.dot(x.astype(kernel.dtype), kernel, preferred_element_type=jnp.float32)
-        + head["bias"]
+        jnp.dot(x.astype(kernel.dtype), kernel,
+                preferred_element_type=cfg.ldtype)
+        + head["bias"].astype(cfg.ldtype)
     )
     return logits, new_carries
 

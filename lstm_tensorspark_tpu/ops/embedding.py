@@ -83,10 +83,20 @@ def embed_lookup(embedding: jax.Array, tokens: jax.Array) -> jax.Array:
 
 def selected_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """``logits[..., targets]`` over the trailing vocab axis: one-hot
-    multiply-reduce at small V (fused, scatter-free backward), gather
-    above the threshold. targets has logits' shape minus the last axis."""
+    multiply-reduce where it wins, gather elsewhere. targets has logits'
+    shape minus the last axis.
+
+    The two forms are BIT-EXACT equal (the sum has one nonzero term, and
+    the one-hot backward writes exactly one cotangent per position), so
+    the dispatch is pure performance policy: on TPU the one-hot fuses
+    into the surrounding loss reduction at ANY vocab size and keeps the
+    backward elementwise — measured at V=33k it is neutral with f32
+    logits and +20% with bf16 logits, where the gather's backward scatter
+    forces an f32 dlogits materialization. On CPU the fused one-hot pass
+    costs real work at large V while the gather is a cheap row lookup, so
+    large-V CPU keeps the gather (identical values either way)."""
     V = logits.shape[-1]
-    if V <= _SELECT_MAX_V:
+    if V <= _SELECT_MAX_V or jax.default_backend() == "tpu":
         oh = jax.nn.one_hot(targets, V, dtype=logits.dtype)
         return jnp.sum(logits * oh, axis=-1)
     return jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]

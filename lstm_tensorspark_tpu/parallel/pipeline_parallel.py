@@ -242,8 +242,8 @@ def pp_lm_loss(
     def mb_loss(ys, tgt):
         logits = (
             jnp.dot(ys[..., :H].astype(kernel.dtype), kernel,
-                    preferred_element_type=jnp.float32)
-            + head["bias"]
+                    preferred_element_type=cfg.ldtype)
+            + head["bias"].astype(cfg.ldtype)
         )
         # logsumexp form — keep identical to lm_loss (parity tests compare
         # the two bit-for-bit) and skip the [b,T,V] log-prob array

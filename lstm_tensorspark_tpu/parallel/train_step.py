@@ -70,8 +70,8 @@ def sp_lm_loss(params, batch, cfg: LMConfig, *, seq_axis: str = "seq",
     kernel = params["embedding"].T if cfg.tie_embeddings else head["kernel"]
     logits = (
         jnp.dot(xs.astype(kernel.dtype), kernel,
-                preferred_element_type=jnp.float32)
-        + head["bias"]
+                preferred_element_type=cfg.ldtype)
+        + head["bias"].astype(cfg.ldtype)
     )
     # logsumexp form — keep identical to lm_loss (parity tests compare
     # the two bit-for-bit) and skip the [b,C,V] log-prob array

@@ -47,6 +47,7 @@ def build_step(name: str, k: int):
         from lstm_tensorspark_tpu.models import LMConfig, init_lm, lm_loss
         cfg = LMConfig(vocab_size=c["V"], hidden_size=c["H"],
                        num_layers=c["L"], compute_dtype="bfloat16",
+                       logits_dtype=c.get("logits_dtype", "float32"),
                        use_pallas=True)
         params = init_lm(jax.random.PRNGKey(0), cfg)
         loss_fn = lambda p, b, r: lm_loss(p, b, cfg)  # noqa: E731
