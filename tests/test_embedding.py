@@ -97,3 +97,31 @@ def test_lm_loss_value_unchanged_by_fast_indexing():
     tgt = jnp.take_along_axis(lg, batch["targets"][..., None], axis=-1)[..., 0]
     ref = jnp.mean(lse - tgt)
     np.testing.assert_array_equal(np.asarray(loss), np.asarray(ref))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_embedding_helpers_property_sweep(seed):
+    """Randomized shapes/vocab around the one-hot threshold: forward
+    bit-equality and gradient agreement must hold for every draw."""
+    rng = np.random.RandomState(seed)
+    V = int(rng.choice([2, 26, 512, _MM_GRAD_MAX_V, _MM_GRAD_MAX_V + 1]))
+    B = int(rng.randint(1, 5))
+    T = int(rng.randint(1, 23))
+    E = int(rng.choice([1, 8, 48]))
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    emb = jax.random.normal(k1, (V, E), jnp.float32)
+    toks = jax.random.randint(k2, (B, T), 0, V, jnp.int32)
+    cot = jax.random.normal(k3, (B, T, E), jnp.float32)
+
+    np.testing.assert_array_equal(
+        np.asarray(embed_lookup(emb, toks)),
+        np.asarray(jnp.take(emb, toks, axis=0)))
+    g_fast = jax.grad(lambda e: jnp.vdot(embed_lookup(e, toks), cot))(emb)
+    g_ref = jax.grad(lambda e: jnp.vdot(jnp.take(e, toks, axis=0), cot))(emb)
+    np.testing.assert_allclose(np.asarray(g_fast), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)
+
+    logits = jax.random.normal(k1, (B, T, V), jnp.float32)
+    ref = jnp.take_along_axis(logits, toks[..., None], axis=-1)[..., 0]
+    np.testing.assert_array_equal(np.asarray(selected_logits(logits, toks)),
+                                  np.asarray(ref))

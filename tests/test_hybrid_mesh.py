@@ -85,3 +85,34 @@ def test_hybrid_mesh_slice_major_data_axis():
     arr = np.array(ordered, dtype=object).reshape(2, 4, 1, 1)
     for shard in range(2):
         assert {d.process_index for d in arr[shard].flat} == {shard}
+
+
+def test_cli_build_mesh_falls_back_only_on_unequal_domains(monkeypatch):
+    """_build_mesh: the unequal-domains truncation case falls back to the
+    plain ordering; the straddling-model-block case stays a hard error."""
+    from lstm_tensorspark_tpu import parallel
+    from lstm_tensorspark_tpu.cli import _build_mesh
+
+    # single-domain real devices: just works (hybrid == plain)
+    mesh = _build_mesh(dp=4, tp=2, devices=np.asarray(jax.devices()))
+    assert mesh.devices.shape == (4, 2, 1, 1)
+
+    # straddle error propagates (fakes: 2 domains of 4, tp=3)
+    devs = [FakeDev(id=i, process_index=i // 4) for i in range(8)]
+    with pytest.raises(ValueError, match="straddle"):
+        _build_mesh(dp=None, tp=3, devices=devs)
+
+    # unequal domains (truncation: 4 + 2 devices) take the fallback —
+    # plain make_mesh is reached with the original arguments (real Mesh
+    # construction rejects fake devices, so stub it to a sentinel)
+    uneven = devs[:6]
+    sentinel = object()
+    seen = {}
+
+    def fake_make_mesh(dp=None, tp=1, sp=1, pp=1, *, devices=None):
+        seen.update(dp=dp, tp=tp, n=len(devices))
+        return sentinel
+
+    monkeypatch.setattr(parallel, "make_mesh", fake_make_mesh)
+    assert _build_mesh(dp=6, tp=1, devices=uneven) is sentinel
+    assert seen == {"dp": 6, "tp": 1, "n": 6}
