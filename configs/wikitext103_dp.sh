@@ -4,4 +4,5 @@
 exec python main.py --dataset wikitext103 --hidden-units 1024 --num-layers 4 \
   --batch-size 256 --seq-len 128 --epochs 1 --optimizer adam --learning-rate 1e-3 \
   --clip-norm 1.0 --dropout 0.2 --stateful --compute-dtype bfloat16 \
+  --logits-dtype bfloat16 \
   --remat-chunk 32 --eval-every 1000 ${DATA:+--data-path "$DATA"} "$@"

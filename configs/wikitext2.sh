@@ -3,4 +3,5 @@
 exec python main.py --dataset wikitext2 --hidden-units 650 --num-layers 2 \
   --batch-size 32 --seq-len 70 --epochs 10 --optimizer sgd --learning-rate 2.0 \
   --clip-norm 0.25 --dropout 0.5 --stateful --compute-dtype bfloat16 \
+  --logits-dtype bfloat16 \
   --eval-every 1000 ${DATA:+--data-path "$DATA"} "$@"
