@@ -16,7 +16,6 @@ import os
 import numpy as np
 
 from .corpus import (
-    Vocab,
     build_char_vocab,
     build_word_vocab,
     load_text,

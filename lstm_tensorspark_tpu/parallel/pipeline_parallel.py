@@ -43,7 +43,7 @@ except ImportError:  # pragma: no cover
 
 from ..models.lstm_lm import LMConfig
 from ..ops.embedding import embed_lookup, selected_logits
-from ..ops.lstm_cell import LSTMParams, fuse_params, zero_carry
+from ..ops.lstm_cell import LSTMParams
 from ..ops.scan import auto_lstm_scan, lstm_scan
 from ..train.loop import TrainState, step_body
 

@@ -31,7 +31,6 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -44,7 +43,6 @@ from ..data.device_dataset import DeviceLMData
 from .device_step import _gated_eval_batches, _gated_lm_eval, _jit_step
 from .loop import (
     TrainState,
-    _donation_supported,
     dp_reduce_fn,
     dp_rng_transform,
     step_body,
