@@ -87,6 +87,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device-prefetch depth for the input feed (0 = off; "
                         "background-thread device_put can hurt on tunneled/"
                         "shared backends — measure before enabling)")
+    p.add_argument("--zero1", action="store_true",
+                   help="shard the OPTIMIZER state 1/dp over the data axis "
+                        "(ZeRO-1): grads reduce-scattered, each shard "
+                        "updates its slice of the raveled params with its "
+                        "slice of the moments, all-gather rebuilds params "
+                        "— same per-step collective volume as plain DP, "
+                        "optimizer memory /dp (Adam: 2x params -> "
+                        "2x params/dp). Requires a DP mesh; not with "
+                        "--stateful/--grad-accum/--steps-per-call>1/"
+                        "--device-data/--fused-eval/TP/SP/PP. ZeRO-1 "
+                        "checkpoints resume at the SAME --num-partitions "
+                        "(the sharded moments bake in the shard count)")
     p.add_argument("--device-data", action="store_true",
                    help="stage the dataset in device HBM once and build "
                         "batches on-device (LM: window slices; imdb: row "
@@ -284,15 +296,18 @@ def main(argv=None) -> int:
     return rc
 
 
-def make_cli_optimizer(args):
+def make_cli_optimizer(args, *, clip: bool = True):
     """The one optimizer constructor for every task runner — full flag
     surface (optimizer family, momentum, clipping, weight decay, warmup/
-    cosine schedule)."""
+    cosine schedule). ``clip=False`` builds the chain WITHOUT the
+    global-norm clip stage — required by the ZeRO-1 step, which clips
+    from the psum'd global norm itself (parallel/zero.py)."""
     from .train import make_optimizer
 
     return make_optimizer(
         args.optimizer, args.learning_rate,
-        momentum=args.momentum, clip_norm=args.clip_norm,
+        momentum=args.momentum,
+        clip_norm=args.clip_norm if clip else None,
         weight_decay=getattr(args, "weight_decay", 0.0),
         warmup_steps=getattr(args, "warmup_steps", 0),
         decay_steps=getattr(args, "decay_steps", None),
@@ -387,7 +402,35 @@ def _setup_training(
     args.steps_per_call = k
     args.grad_accum = accum
 
+    zero1 = bool(getattr(args, "zero1", False))
+    if zero1:
+        for bad, why in (
+            (mesh is None, "requires a DP mesh (--num-partitions > 1 or "
+                           "--backend dp)"),
+            (k > 1, "not with --steps-per-call > 1"),
+            (accum > 1, "not with --grad-accum"),
+            (stateful, "not with --stateful"),
+            (getattr(args, "device_data", False), "not with --device-data"),
+            (getattr(args, "fused_eval", False), "not with --fused-eval"),
+        ):
+            if bad:
+                raise SystemExit(f"--zero1: {why}")
+        # The ZeRO-1 step clips from the psum'd GLOBAL norm itself; the
+        # optax chain must not contain its own (per-slice) clip stage.
+        # Rebuilding from args is safe because every task runner's
+        # ``optimizer`` comes 1:1 from make_cli_optimizer(args) — if a
+        # caller ever passes a custom chain, strip its clip stage there
+        # and thread it through instead of relying on this rebuild.
+        optimizer = make_cli_optimizer(args, clip=False)
+
     state = init_train_state(params, optimizer, rng, carries=carries0)
+    if zero1:
+        from .parallel.zero import make_zero1_opt_init
+
+        # sharded moments from the start — also the checkpoint template,
+        # so restore reshards onto exactly these leaves
+        state = state._replace(
+            opt_state=make_zero1_opt_init(optimizer, mesh)(state.params))
 
     restored, checkpoint_fn = _wire_checkpoint(args, logger, lambda: state)
     if restored is not None:
@@ -416,7 +459,13 @@ def _setup_training(
             return it
 
     else:
-        if k > 1:
+        if zero1:
+            from .parallel.zero import make_zero1_train_step
+
+            train_step = make_zero1_train_step(
+                loss_fn, optimizer, mesh, clip_norm=args.clip_norm
+            )
+        elif k > 1:
             train_step = make_dp_multi_train_step(
                 loss_fn, optimizer, mesh, stateful=stateful, grad_accum=accum
             )
@@ -426,7 +475,10 @@ def _setup_training(
             )
         state = state._replace(
             params=replicate(state.params, mesh),
-            opt_state=replicate(state.opt_state, mesh),
+            # zero1: the moments are already sharded P("data") — replicate
+            # would gather them back onto every shard
+            opt_state=state.opt_state if zero1
+            else replicate(state.opt_state, mesh),
             carries=shard_batch(state.carries, mesh) if stateful else None,
         )
 
@@ -460,6 +512,8 @@ def _setup_tp_training(args, logger, *, loss_fn, params, optimizer, rng,
     from .train.loop import init_train_state
 
     tp = args.tensor_parallel
+    if getattr(args, "zero1", False):
+        raise SystemExit("--zero1 is not supported with --tensor-parallel")
     if getattr(args, "steps_per_call", 1) and args.steps_per_call > 1:
         raise SystemExit("--steps-per-call is not supported with --tensor-parallel")
     if getattr(args, "grad_accum", 1) and args.grad_accum > 1:
@@ -877,6 +931,9 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     no host gather; only post-training generation pulls params to host
     (sequential small-batch decode).
     """
+    if getattr(args, "zero1", False):
+        raise SystemExit("--zero1 is not supported with --tensor-parallel/"
+                         "--seq-parallel/--pipeline-stages")
     from .data import lm_batch_stream, lm_epoch_batches
     from .models import init_lm
     from .parallel import (

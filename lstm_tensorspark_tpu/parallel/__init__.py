@@ -5,6 +5,7 @@ from .mesh import (
     make_mesh,
     slice_groups,
 )
+from .zero import make_zero1_opt_init, make_zero1_train_step
 from .data_parallel import make_dp_train_step, make_dp_eval_step, shard_batch
 from .sequence_parallel import sp_lstm_scan
 from .tensor_parallel import (
@@ -27,6 +28,8 @@ __all__ = [
     "unstack_lm_params",
     "make_hybrid_mesh",
     "make_mesh",
+    "make_zero1_opt_init",
+    "make_zero1_train_step",
     "slice_groups",
     "local_device_count",
     "distributed_init",

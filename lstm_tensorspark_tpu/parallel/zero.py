@@ -1,0 +1,189 @@
+"""ZeRO-1 data parallelism: optimizer-state sharding over the data axis.
+
+The reference's DP (and this repo's default `make_dp_train_step`) keeps
+params AND optimizer state fully replicated — every chip stores Adam's two
+moment pytrees for the whole model. This module shards the OPTIMIZER state
+1/dp per chip (the ZeRO stage-1 recipe, arXiv:1910.02054, re-derived
+TPU-natively): per-shard gradients are `psum_scatter`-reduced so each chip
+receives only its 1/dp slice of the summed gradient vector, updates its
+slice of the raveled parameter vector with its slice of the optimizer
+state, and an `all_gather` rebuilds the full (replicated) params for the
+next forward. Communication volume per step is the SAME as the pmean DP
+step (reduce-scatter + all-gather = one all-reduce, ring-wise), so the
+memory saving is free at the collective level.
+
+Numerics: the update is elementwise (SGD/momentum/Adam/AdamW/RMSProp on a
+contiguous slice of the raveled vector ≡ the same transform leaf-wise), so
+trajectories match plain DP to float-reassociation. The one NON-elementwise
+transform — global-norm clipping — cannot run per-slice (each shard would
+clip by a different norm and slices would diverge), so clipping is done
+HERE from the globally-psum'd norm, and the optimizer chain passed in must
+exclude its own clip stage (`make_zero1_train_step(clip_norm=...)`).
+
+Scope: stateless losses, one optimizer step per dispatch (compose with
+K-step dispatch/device-data later if profitable). Params stay replicated —
+sharding them too (ZeRO-3) would re-gather per layer per step; at LSTM
+sizes the win is in the moments, which dominate optimizer memory.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..train.loop import TrainState, dp_rng_transform
+
+
+def _flat_meta(params, dp: int):
+    """(n, chunk) for the raveled parameter vector padded to dp chunks."""
+    n = sum(int(jnp.size(a)) for a in jax.tree.leaves(params))
+    chunk = -(-n // dp)  # ceil
+    return n, chunk
+
+
+def _local_slice(flat_pad: jax.Array, chunk: int, axis: str) -> jax.Array:
+    idx = lax.axis_index(axis)
+    return lax.dynamic_slice(flat_pad, (idx * chunk,), (chunk,))
+
+
+def _opt_state_specs(optimizer, chunk: int, axis: str):
+    """out_specs for the chunked optimizer state: vector leaves shard over
+    ``axis``, scalar leaves (e.g. Adam's count) stay replicated."""
+    shapes = jax.eval_shape(optimizer.init, jnp.zeros((chunk,), jnp.float32))
+    return jax.tree.map(lambda s: P() if s.ndim == 0 else P(axis), shapes)
+
+
+def make_zero1_opt_init(
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = "data",
+):
+    """Jitted initializer: full (replicated) params -> optimizer state over
+    each shard's [chunk] parameter slice, sharded P(axis) on vector leaves.
+    Use its result as TrainState.opt_state for `make_zero1_train_step` (and
+    as the checkpoint template — the checkpointer's per-leaf reshard
+    handles the sharded leaves like any PP-sharded state)."""
+    dp = mesh.shape[axis]
+
+    def per_shard_init(params):
+        n, chunk = _flat_meta(params, dp)
+        flat, _ = ravel_pytree(params)
+        flat = jnp.pad(flat.astype(jnp.float32), (0, dp * chunk - n))
+        return optimizer.init(_local_slice(flat, chunk, axis))
+
+    def build(params):
+        n, chunk = _flat_meta(params, dp)
+        return jax.jit(shard_map(
+            per_shard_init,
+            mesh=mesh,
+            in_specs=(P(),),
+            out_specs=_opt_state_specs(optimizer, chunk, axis),
+            check_vma=False,
+        ))(params)
+
+    return build
+
+
+def make_zero1_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    axis: str = "data",
+    clip_norm: float | None = None,
+    jit: bool = True,
+    donate: bool | None = None,
+):
+    """Build the ZeRO-1 DP train step.
+
+    ``loss_fn(params, batch, dropout_rng) -> (loss, aux)`` — the same
+    per-shard body as every other step builder. ``optimizer`` must NOT
+    include a global-norm clip stage; pass ``clip_norm`` here instead
+    (module docstring: clipping needs the GLOBAL norm, computed by psum
+    before the sliced update). ``donate`` follows the repo's step-builder
+    contract (default: platform-gated buffer donation of the state — the
+    memory-saving step must not hold a second copy of params + moments).
+
+    CHECKPOINT SHAPE CONTRACT: the sharded moment leaves bake in the
+    padded flat length dp*ceil(n_params/dp), so a ZeRO-1 checkpoint
+    resumes at the SAME data-shard count it was written with. To change
+    dp across a restart, round-trip through a non-zero1 run (restore
+    full state, re-save), or re-init the moments.
+    """
+    dp = mesh.shape[axis]
+
+    def per_shard_step(state: TrainState, batch):
+        rng, sub = jax.random.split(state.rng)
+        sub = dp_rng_transform(axis)(sub)
+        (loss, aux), grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, sub), has_aux=True
+        )(state.params)
+
+        n, chunk = _flat_meta(state.params, dp)
+        g_flat, _ = ravel_pytree(grads)
+        g_flat = jnp.pad(g_flat.astype(jnp.float32), (0, dp * chunk - n))
+        # reduce-scatter: this shard receives the cross-shard SUM of its
+        # 1/dp gradient slice; /dp makes it the treeAggregate-style mean
+        g_local = lax.psum_scatter(g_flat, axis, tiled=True) / dp
+
+        # global grad norm from the scattered slices (pad lanes are zero)
+        gsq = lax.psum(jnp.sum(jnp.square(g_local)), axis)
+        gnorm = jnp.sqrt(gsq)
+        if clip_norm is not None:
+            g_local = g_local * jnp.minimum(1.0, clip_norm / (gnorm + 1e-12))
+
+        p_flat, unravel = ravel_pytree(state.params)
+        p_dtype = p_flat.dtype
+        p_flat = jnp.pad(p_flat.astype(jnp.float32), (0, dp * chunk - n))
+        p_local = _local_slice(p_flat, chunk, axis)
+
+        updates, opt_state = optimizer.update(g_local, state.opt_state, p_local)
+        p_local = optax.apply_updates(p_local, updates)
+
+        p_flat = lax.all_gather(p_local, axis, tiled=True)[:n].astype(p_dtype)
+        params = unravel(p_flat)
+
+        loss = lax.pmean(loss, axis)
+        metrics = {"loss": loss, "grad_norm": gnorm}
+        return (
+            TrainState(state.step + 1, params, opt_state, rng, state.carries),
+            metrics,
+        )
+
+    def build_specs(params):
+        n, chunk = _flat_meta(params, dp)
+        opt_spec = _opt_state_specs(optimizer, chunk, axis)
+        state_spec = TrainState(
+            step=P(), params=P(), opt_state=opt_spec, rng=P(), carries=P(),
+        )
+        return state_spec
+
+    def step(state: TrainState, batch):
+        state_spec = build_specs(state.params)
+        fn = shard_map(
+            per_shard_step,
+            mesh=mesh,
+            in_specs=(state_spec, P(axis)),
+            out_specs=(state_spec, P()),
+            check_vma=False,
+        )
+        return fn(state, batch)
+
+    if not jit:
+        return step
+    from ..train.loop import _donation_supported
+
+    if donate is None:
+        donate = _donation_supported()
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
