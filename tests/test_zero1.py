@@ -129,3 +129,35 @@ def test_zero1_opt_state_is_sharded_one_over_dp():
         # each process-local shard holds chunk elements, not dp*chunk
         shard_shapes = {s.data.shape for s in leaf.addressable_shards}
         assert shard_shapes == {(chunk,)}
+
+
+@pytest.mark.parametrize("n_extra", [0, 1, 7])
+def test_zero1_padding_edges(n_extra):
+    """The raveled length may or may not divide dp: exercise exact-divide
+    (pad=0) and maximal-pad layouts with a tiny synthetic param pytree and
+    assert trajectory parity with plain DP."""
+    dp = 8
+    mesh = make_mesh(dp=dp)
+    # base 16*dp params + n_extra => pad = (-n_extra) % dp
+    sizes = [16 * dp, n_extra] if n_extra else [16 * dp]
+    keys = jax.random.split(jax.random.PRNGKey(7), len(sizes))
+    params = {f"w{i}": jax.random.normal(k, (s,), jnp.float32)
+              for i, (s, k) in enumerate(zip(sizes, keys))}
+
+    xs = jax.random.normal(jax.random.PRNGKey(8), (B, sum(sizes)), jnp.float32)
+
+    def loss_fn(p, batch, r):
+        flat = jnp.concatenate([p[k] for k in sorted(p)])
+        pred = batch @ flat
+        return jnp.mean(pred ** 2), {"loss": None, "carries": None}
+
+    opt = make_optimizer("adam", 1e-2)
+    batches = [xs] * 3
+
+    s_dp, _ = _run_dp(params, loss_fn, opt, mesh, batches)
+    s_z, _ = _run_zero1(params, loss_fn, opt, mesh, batches)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+        jax.device_get(s_z.params), jax.device_get(s_dp.params),
+    )
