@@ -94,11 +94,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "slice of the moments, all-gather rebuilds params "
                         "— same per-step collective volume as plain DP, "
                         "optimizer memory /dp (Adam: 2x params -> "
-                        "2x params/dp). Requires a DP mesh; not with "
-                        "--stateful/--grad-accum/--steps-per-call>1/"
-                        "--device-data/--fused-eval/TP/SP/PP. ZeRO-1 "
-                        "checkpoints resume at the SAME --num-partitions "
-                        "(the sharded moments bake in the shard count)")
+                        "2x params/dp). Composes with --steps-per-call. "
+                        "Requires a DP mesh; not with --stateful/"
+                        "--grad-accum/--device-data/--fused-eval/TP/SP/PP. "
+                        "ZeRO-1 checkpoints resume at the SAME "
+                        "--num-partitions (the sharded moments bake in "
+                        "the shard count)")
     p.add_argument("--device-data", action="store_true",
                    help="stage the dataset in device HBM once and build "
                         "batches on-device (LM: window slices; imdb: row "
@@ -407,7 +408,6 @@ def _setup_training(
         for bad, why in (
             (mesh is None, "requires a DP mesh (--num-partitions > 1 or "
                            "--backend dp)"),
-            (k > 1, "not with --steps-per-call > 1"),
             (accum > 1, "not with --grad-accum"),
             (stateful, "not with --stateful"),
             (getattr(args, "device_data", False), "not with --device-data"),
@@ -463,7 +463,8 @@ def _setup_training(
             from .parallel.zero import make_zero1_train_step
 
             train_step = make_zero1_train_step(
-                loss_fn, optimizer, mesh, clip_norm=args.clip_norm
+                loss_fn, optimizer, mesh, clip_norm=args.clip_norm,
+                steps_per_call=k,
             )
         elif k > 1:
             train_step = make_dp_multi_train_step(

@@ -20,10 +20,11 @@ clip by a different norm and slices would diverge), so clipping is done
 HERE from the globally-psum'd norm, and the optimizer chain passed in must
 exclude its own clip stage (`make_zero1_train_step(clip_norm=...)`).
 
-Scope: stateless losses, one optimizer step per dispatch (compose with
-K-step dispatch/device-data later if profitable). Params stay replicated —
-sharding them too (ZeRO-3) would re-gather per layer per step; at LSTM
-sizes the win is in the moments, which dominate optimizer memory.
+Scope: stateless losses; composes with K-step dispatch
+(``steps_per_call`` — the scan runs inside the shard_map). Params stay
+replicated — sharding them too (ZeRO-3) would re-gather per layer per
+step; at LSTM sizes the win is in the moments, which dominate optimizer
+memory.
 """
 
 from __future__ import annotations
@@ -104,6 +105,7 @@ def make_zero1_train_step(
     clip_norm: float | None = None,
     jit: bool = True,
     donate: bool | None = None,
+    steps_per_call: int = 1,
 ):
     """Build the ZeRO-1 DP train step.
 
@@ -114,6 +116,13 @@ def make_zero1_train_step(
     before the sliced update). ``donate`` follows the repo's step-builder
     contract (default: platform-gated buffer donation of the state — the
     memory-saving step must not hold a second copy of params + moments).
+
+    ``steps_per_call=K`` scans the per-shard step over K stacked batches
+    ([K, b_local, ...]) INSIDE the shard_map — K optimizer steps per host
+    dispatch, the same amortization as train/multistep.py. Collectives
+    inside the scan are uniform across shards (same trip count
+    everywhere), so the composition is lockstep-safe; metrics follow the
+    multi-step contract (mean loss + final step's loss/grad_norm).
 
     CHECKPOINT SHAPE CONTRACT: the sharded moment leaves bake in the
     padded flat length dp*ceil(n_params/dp), so a ZeRO-1 checkpoint
@@ -161,6 +170,21 @@ def make_zero1_train_step(
             metrics,
         )
 
+    if steps_per_call > 1:
+        from ..train.loop import summarize_scan_metrics
+
+        inner = per_shard_step
+
+        def per_shard_multi(state: TrainState, batches):
+            state, ms = lax.scan(inner, state, batches)
+            return state, summarize_scan_metrics(ms)
+
+        per_shard = per_shard_multi
+        batch_spec = P(None, axis)  # [K, b_local, ...]
+    else:
+        per_shard = per_shard_step
+        batch_spec = P(axis)
+
     def build_specs(params):
         n, chunk = _flat_meta(params, dp)
         opt_spec = _opt_state_specs(optimizer, chunk, axis)
@@ -172,9 +196,9 @@ def make_zero1_train_step(
     def step(state: TrainState, batch):
         state_spec = build_specs(state.params)
         fn = shard_map(
-            per_shard_step,
+            per_shard,
             mesh=mesh,
-            in_specs=(state_spec, P(axis)),
+            in_specs=(state_spec, batch_spec),
             out_specs=(state_spec, P()),
             check_vma=False,
         )

@@ -161,3 +161,32 @@ def test_zero1_padding_edges(n_extra):
             np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
         jax.device_get(s_z.params), jax.device_get(s_dp.params),
     )
+
+
+def test_zero1_multistep_matches_single_dispatch():
+    """K-step ZeRO-1 (scan inside the shard_map) == K single dispatches:
+    same final params, and the summarized metrics follow the multi-step
+    contract (mean loss over K, final grad_norm)."""
+    params, loss_fn, opt, mesh, batches = _setup("adam", 1e-2)
+    bs = list(batches(4))
+
+    s_one, l_one = _run_zero1(params, loss_fn, opt, mesh, bs)
+
+    step_k = make_zero1_train_step(loss_fn, opt, mesh, steps_per_call=4)
+    state = init_train_state(params, opt, jax.random.PRNGKey(1))
+    state = state._replace(
+        params=replicate(state.params, mesh),
+        opt_state=make_zero1_opt_init(opt, mesh)(replicate(params, mesh)),
+    )
+    stacked = jax.tree.map(lambda *a: np.stack(a), *bs)
+    state, m = step_k(state, shard_batch(stacked, mesh, dim=1))
+
+    np.testing.assert_allclose(float(m["loss"]), np.mean(l_one),
+                               rtol=1e-5, atol=1e-6)
+    assert "loss_last" in m
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+        jax.device_get(state.params), jax.device_get(s_one.params),
+    )
+    assert int(jax.device_get(state.step)) == 4
