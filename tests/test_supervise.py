@@ -132,14 +132,17 @@ def test_stall_watch_passes_healthy_child_through():
 
     from lstm_tensorspark_tpu.supervise import run_with_stall_watch
 
-    # generous timeout vs tick gap: the suite may share the machine with
-    # heavy load, and a loaded scheduler must not fake a stall
+    # VERY generous timeout vs tick gap: the healthy path returns as soon
+    # as the child exits (~1.2s), so the timeout's size costs nothing —
+    # and the suite may share the machine with heavy load (observed: a
+    # concurrent benchmark delayed a fresh interpreter's startup past a
+    # 15s window, faking a stall). A loaded scheduler must not flake this.
     rc = run_with_stall_watch(
         [sys.executable, "-c",
          "import time\n"
          "for i in range(4):\n"
          "    print('tick', i, flush=True); time.sleep(0.3)\n"],
-        stall_timeout=15.0,
+        stall_timeout=60.0,
     )
     assert rc == 0
 
