@@ -31,13 +31,16 @@ B, T, HIDDEN, LAYERS, STEPS, WARMUP = 64, 64, 128, 1, 120, 10
 UNROLL = 8  # lax.scan unroll (used by the Pallas backward's recompute scan;
             # the CPU baseline keeps unroll=1, faithful to the reference's
             # step-at-a-time unroll)
-K = 256   # steps per dispatch for the TPU run (train/multistep.py): one
+K = 512   # steps per dispatch for the TPU run (train/multistep.py): one
           # jitted program runs K optimizer steps, so the host dispatch and
           # tunnel round-trip amortise. K=32 was device-bound at the old
           # 148 us/step; after the one-hot indexing fix (ops/embedding.py)
           # the step runs ~78 us device-side and 32-step dispatches went
           # HOST-bound (~2 ms/dispatch tunnel cost ate the win). Measured
-          # sweep: K=32 ~421k, K=64 ~593k, K=256 ~750k seq/s. The CPU
+          # sweeps: K=32 ~421k, K=64 ~593k, K=256 ~750k seq/s; same-day
+          # 256/512/1024 sweep on the quiet chip: 797k/814k/817k — K=512
+          # takes the remaining dispatch amortisation, K=1024's extra
+          # +0.4% isn't worth doubling the dispatch granularity. The CPU
           # baseline keeps one-dispatch-per-step — faithful to the
           # reference's one-Spark-round-per-step structure.
 DEVICE_DATA = True  # TPU run stages the corpus in HBM and slices windows
@@ -413,6 +416,52 @@ def measure_roofline(name: str, *, chains: int = 256, reps: int = 3) -> dict:
     }
 
 
+def _impl_bound(name: str, rl: dict, rec: dict, measured: float) -> dict:
+    """Strategy-aware serialized-chain bound for one measured config.
+
+    Counts the sequential-kernel passes THIS implementation runs per
+    optimizer step, each costing ~chain_sec (every in-chain MXU op —
+    ``h@U``, z recompute, ``dz@U^T`` — moves the same 8BH² FLOPs per
+    step, so chain latency is the right unit): layers × directions
+    forward, times the backward strategy's in-chain multiplier. dU/dW/dxs
+    are OUTSIDE the chain (contracted from streamed dz) and so stay in
+    the parallel term. ``measured`` is the UNROUNDED s/step (the rounded
+    copy in ``rl`` would skew the fraction by up to 0.6% at config-1
+    step times). The strategy label comes from the runtime's own
+    `chosen_bwd_strategy` evaluated at the LAYER-0 scan's shape — the
+    same gate the runtime runs, but ONE label for all L×dirs scans. The
+    five table configs are homogeneous today (L=1, or Dp=None where
+    deeper layers share the no-xproj shape); a future config whose
+    deeper layers plan differently (e.g. a stacked classifier at
+    T >= _FUSEDX_MIN_T, whose layer-1 input width is 2H) would need a
+    per-scan derivation here before the single label is trustworthy."""
+    from lstm_tensorspark_tpu.ops.pallas_lstm import (
+        _FUSEDX_MIN_T, _pad_to_lane, chosen_bwd_strategy,
+    )
+
+    c = CONFIGS[name]
+    B_, H_, L_, T_ = c["B"], c["H"], c["L"], c["T"]
+    kind = c["kind"]
+    dirs = 2 if kind == "classifier" else 1  # the bi-LSTM runs both
+    has_mask = kind == "classifier"
+    D = c.get("F", H_)  # layer-0 input width: embed defaults to hidden
+    Hp = _pad_to_lane(H_)
+    Dp = _pad_to_lane(D) if T_ >= _FUSEDX_MIN_T else None
+    strategy = chosen_bwd_strategy(B_, T_, Hp, 2, has_mask=has_mask, Dp=Dp)
+    mult = {"residentx": 2, "resident": 1, "tiled": 1, "recompute": 2}[strategy]
+    passes = L_ * dirs * (1 + mult)
+    parallel = max(
+        rec["train_flops_step"] - passes * rl["chain_flops"], 0.0
+    ) / (PEAK_TFLOPS * 1e12)
+    bound = passes * rl["chain_sec"] + parallel
+    return {
+        "impl_serial_passes": passes,
+        "impl_bwd_strategy": strategy,
+        "impl_bound_sec_per_step": round(bound, 6),
+        "fraction_of_impl_bound": round(bound / measured, 4),
+    }
+
+
 def measure_generation(*, new_tokens: int = 512, batch: int = 64,
                        reps: int = 3) -> dict:
     """Autoregressive decode throughput (the inference surface, SURVEY.md §2
@@ -585,6 +634,21 @@ def main() -> int:
                     bound_sec_per_step=round(bound, 6),
                     fraction_of_bound=round(bound / measured, 4),
                 )
+                # Second, STRATEGY-AWARE bound: the floor above assumes one
+                # fwd + one bwd chain with everything else perfectly
+                # parallel. THIS implementation serializes layers,
+                # directions, and the chosen backward kernel's in-chain MXU
+                # ops (residentx recomputes z: 2 chain-latency units/step;
+                # resident/tiled stream z: 1; recompute fallback re-runs
+                # the forward: 2). fraction_of_impl_bound ≈ 1 therefore
+                # means "the step runs at the speed of ITS OWN serialized
+                # structure" — remaining MFU gap is the structure, not
+                # kernel slack; the gap between the two bounds is the
+                # (theoretical) prize for overlapping layers/directions.
+                try:
+                    rl.update(_impl_bound(name, rl, rec, measured))
+                except Exception as e:
+                    rl["impl_bound_error"] = f"{type(e).__name__}: {e}"
             rec["roofline"] = rl
         table[name] = rec
         if "error" not in rec:

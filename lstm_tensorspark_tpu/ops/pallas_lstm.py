@@ -49,14 +49,16 @@ Variable-length and bidirectional support (the bi-LSTM / seq2seq configs):
 Training support: `pallas_lstm_scan` carries a custom VJP with THREE
 backward strategies:
 - **resident fused BPTT** (`_lstm_bwd_kernel`): reverse sequential grid with
-  dh/dc carries and the dU accumulator resident in VMEM, consuming the z/c
-  trajectories the train-mode forward streams out; the cell state c_t is
-  RECOMPUTED from (z_t, c_{t-1}) in-kernel — bit-identical in f32 — so the
-  backward streams one fewer [T,B,H] tensor than a save-everything design;
+  dh/dc carries resident in VMEM, consuming the z/c trajectories the
+  train-mode forward streams out; the cell state c_t is RECOMPUTED from
+  (z_t, c_{t-1}) in-kernel — bit-identical in f32 — so the backward
+  streams one fewer [T,B,H] tensor than a save-everything design;
 - **tiled fused BPTT** (`_lstm_bwd_tiled_kernel`): the sequential kernel
-  computes only dz (streaming U^T in tiles for the dh carry); the weight
-  cotangents dU/dW/db and dxs are single large MXU matmuls OUTSIDE the
-  kernel (XLA's job — they contract over T·B at once);
+  computes only dz (streaming U^T in tiles for the dh carry);
+- in EVERY strategy the weight cotangents dU/dW/db and dxs are single
+  large MXU matmuls OUTSIDE the kernel (XLA's job — they contract over
+  T·B at once; an in-kernel dU accumulate would serialize one more MXU
+  op with the reverse dependent chain, measured real time on v5e);
 - **recompute fallback** (when `remat_chunk` is set — memory priority — or
   the O(T) f32 residuals would exceed `_RESIDUAL_HBM_BUDGET`, or no fused
   kernel fits): re-run the pure-jax scan under `jax.vjp` (remat-style),
@@ -147,10 +149,9 @@ def _residentx_bwd_vmem(B: int, H: int, Dp: int, pbytes: int,
         + Dp * 4 * H * pbytes  # W resident
         + 4 * H * 4  # bias
         + c * B * 4 * H * 4  # in-kernel zx chunk (live value)
-        + 2 * 4 * H * H * 4  # dU: f32 scratch + output block
         + streamed * 2  # double-buffered pipelining
         + 4 * B * H * 4  # dh/dc scratch + dh0/dc0 out
-    )
+    )  # (dU lives outside: contracted from the streamed dz, no accumulator)
 
 
 def _resident_fwd_vmem(B: int, H: int, pbytes: int, save_residuals: bool,
@@ -172,13 +173,13 @@ def _resident_bwd_vmem(B: int, H: int, pbytes: int,
                        has_mask: bool = False) -> int:
     streamed = (
         8 * B * 4 * H * 4 * 2  # z in + dz out blocks (chunk<=8)
-        + 8 * B * H * 4 * 3  # dys/c_prev/h_prev blocks (c_t recomputed)
+        + 8 * B * H * 4 * 2  # dys/c_prev blocks (c_t recomputed; h_prev
+                             # not read — dU is contracted outside)
     )
     if has_mask:
         streamed += 8 * B * _LANE * 4  # mask blocks
     return (
         4 * H * H * pbytes  # U^T resident
-        + 2 * 4 * H * H * 4  # dU: f32 scratch + output block
         + streamed * 2  # double-buffered pipelining
         + 4 * B * H * 4  # dh/dc scratch + dh0/dc0 out
     )
@@ -263,6 +264,33 @@ def _residual_bytes(T: int, B: int, H: int, bwd_strategy: str = "resident") -> i
     if bwd_strategy == "residentx":
         return T * B * H * 4  # cs only (z recomputed in-kernel)
     return T * B * 5 * H * 4  # z [T,B,4H] + cs [T,B,H], both f32
+
+
+def chosen_bwd_strategy(B: int, T: int, H: int, pbytes: int, *,
+                        has_mask: bool = False, Dp: int | None = None,
+                        remat_chunk: int | None = None) -> str:
+    """The SINGLE backward-strategy decision: which gradient path a
+    `pallas_lstm_scan` at PADDED hidden size ``H`` (and padded input width
+    ``Dp``, None when the xproj is hoisted) will actually take —
+    ``"residentx"`` / ``"resident"`` / ``"tiled"`` fused kernels, or
+    ``"recompute"`` (the pure-jax remat fallback). Both `_scan_core_fwd`
+    and bench.py's strategy-aware roofline read THIS function, so the
+    published `impl_bwd_strategy` can never diverge from the path that
+    ran. Gates, in order: remat_chunk is the explicit memory-priority
+    signal; a backward kernel must plan; its O(T) residuals must fit the
+    HBM budget; and the matching residual-saving forward must also fit
+    (residentx bwd consumes the residentx fwd's cs-only residuals; the
+    legacy bwds need z, so their fwd must not take the fusedx path)."""
+    plan_b = _plan_bwd(B, H, pbytes, has_mask, Dp)
+    if remat_chunk is not None or plan_b is None:
+        return "recompute"
+    fusedx = plan_b[0] == "residentx"
+    ok = (
+        _residual_bytes(T, B, H, plan_b[0]) <= _RESIDUAL_HBM_BUDGET
+        and _plan_fwd(B, H, pbytes, save_residuals=True, has_mask=has_mask,
+                      Dp=Dp if fusedx else None) is not None
+    )
+    return plan_b[0] if ok else "recompute"
 
 
 def supported(
@@ -366,13 +394,20 @@ def _lstm_bwdx_kernel(*refs, hidden: int, dpad: int, chunk: int,
     kernel rebuilds ``z_t = x_t@W + b + h_{t-1}@U`` in-kernel (chunk-batched
     x@W, per-step h_prev@U — bit-identical to the forward's f32 values) and
     runs the same reverse cotangent algebra as `_lstm_bwd_kernel`. Costs one
-    extra matmul per step; deletes the [T,B,4H] z round-trip entirely."""
+    extra matmul per step; deletes the [T,B,4H] z round-trip entirely.
+
+    The weight cotangent dU = Σ_t h_{t-1}^T dz_t is NOT accumulated here:
+    dz streams out anyway, so `_pallas_backward` contracts it against
+    h_prev over all T·B in one large MXU matmul outside — the same split
+    the tiled backward uses. That keeps the sequential chain to two MXU
+    ops per step (z recompute, dh carry) instead of three — the per-step
+    accumulate serialized real MXU issue slots with the chain."""
     n_in = 10 + has_mask
     xs_ref, dys_ref, cprev_ref, hprev_ref = refs[:4]
     mask_ref = refs[4] if has_mask else None
     w_ref, b_ref, u_ref, ut_ref, dhT_ref, dcT_ref = refs[4 + has_mask:n_in]
-    dz_ref, du_ref, dh0_ref, dc0_ref = refs[n_in:n_in + 4]
-    dh_scr, dc_scr, du_scr = refs[n_in + 4:]
+    dz_ref, dh0_ref, dc0_ref = refs[n_in:n_in + 3]
+    dh_scr, dc_scr = refs[n_in + 3:]
     t = pl.program_id(0)
     T = pl.num_programs(0)
     H = hidden
@@ -381,7 +416,6 @@ def _lstm_bwdx_kernel(*refs, hidden: int, dpad: int, chunk: int,
     def _():
         dh_scr[:] = dhT_ref[:]
         dc_scr[:] = dcT_ref[:]
-        du_scr[:] = jnp.zeros_like(du_scr)
 
     zx = jnp.dot(
         xs_ref[:].reshape(-1, dpad).astype(w_ref.dtype), w_ref[:],
@@ -390,7 +424,6 @@ def _lstm_bwdx_kernel(*refs, hidden: int, dpad: int, chunk: int,
     zx = zx.reshape(chunk, -1, 4 * H)
     dh = dh_scr[:]
     dc = dc_scr[:]
-    du = du_scr[:]
     for s in range(chunk - 1, -1, -1):
         z = zx[s] + jnp.dot(
             hprev_ref[s].astype(u_ref.dtype), u_ref[:],
@@ -418,13 +451,8 @@ def _lstm_bwdx_kernel(*refs, hidden: int, dpad: int, chunk: int,
         dg = dc_new * i * (1.0 - g * g)
         dz = jnp.concatenate([di, df, dg, do], axis=1)  # [B, 4H] f32
         dz_ref[s] = dz
-        dz_c = dz.astype(ut_ref.dtype)
-        du = du + jax.lax.dot_general(
-            hprev_ref[s].astype(ut_ref.dtype), dz_c,
-            (((0,), (0,)), ((), ())),  # contract batch -> [H, 4H]
-            preferred_element_type=jnp.float32,
-        )
-        dh = jnp.dot(dz_c, ut_ref[:], preferred_element_type=jnp.float32)
+        dh = jnp.dot(dz.astype(ut_ref.dtype), ut_ref[:],
+                     preferred_element_type=jnp.float32)
         dc = dc_new * f
         if has_mask:
             # frozen fraction of the cotangents bypasses the gates
@@ -432,13 +460,11 @@ def _lstm_bwdx_kernel(*refs, hidden: int, dpad: int, chunk: int,
             dc = dc + (1.0 - m) * dc_in
     dh_scr[:] = dh
     dc_scr[:] = dc
-    du_scr[:] = du
 
     @pl.when(t == T - 1)
     def _():
         dh0_ref[:] = dh
         dc0_ref[:] = dc
-        du_ref[:] = du
 
 
 # ---------------------------------------------------------------------------
@@ -521,20 +547,22 @@ def _time_chunk(T: int) -> int:
 
 
 def _lstm_bwd_kernel(*refs, hidden: int, chunk: int, has_mask: bool):
-    """Fused BPTT: reverse sequential grid; dh/dc carries and the dU
-    accumulator live in VMEM scratch across grid steps. Per time-step:
-    gate recompute from saved z (VPU), cell-state recompute
-    ``c_t = f*c_{t-1} + i*g`` (bit-identical f32 — saves streaming c_t),
-    cotangent algebra (VPU), and two MXU matmuls — dz @ U^T for the carry,
-    h_prev^T @ dz into dU. With ``has_mask`` the frozen fraction of the
-    incoming cotangents bypasses the gate algebra straight into the
-    previous step (the transpose of the forward's carry blend)."""
-    n_in = 7 + has_mask
-    z_ref, dys_ref, cprev_ref, hprev_ref = refs[:4]
-    mask_ref = refs[4] if has_mask else None
-    ut_ref, dhT_ref, dcT_ref = refs[4 + has_mask:n_in]
-    dz_ref, du_ref, dh0_ref, dc0_ref = refs[n_in:n_in + 4]
-    dh_scr, dc_scr, du_scr = refs[n_in + 4:]
+    """Fused BPTT: reverse sequential grid; dh/dc carries live in VMEM
+    scratch across grid steps. Per time-step: gate recompute from saved z
+    (VPU), cell-state recompute ``c_t = f*c_{t-1} + i*g`` (bit-identical
+    f32 — saves streaming c_t), cotangent algebra (VPU), and ONE MXU
+    matmul — dz @ U^T for the carry. dU is contracted outside the kernel
+    from the streamed dz (see `_lstm_bwdx_kernel`'s note). With
+    ``has_mask`` the frozen fraction of the incoming cotangents bypasses
+    the gate algebra straight into the previous step (the transpose of
+    the forward's carry blend). h_prev is not read at all — it only ever
+    fed the dU accumulate — so that input stream is gone too."""
+    n_in = 6 + has_mask
+    z_ref, dys_ref, cprev_ref = refs[:3]
+    mask_ref = refs[3] if has_mask else None
+    ut_ref, dhT_ref, dcT_ref = refs[3 + has_mask:n_in]
+    dz_ref, dh0_ref, dc0_ref = refs[n_in:n_in + 3]
+    dh_scr, dc_scr = refs[n_in + 3:]
     t = pl.program_id(0)
     T = pl.num_programs(0)
     H = hidden
@@ -543,11 +571,9 @@ def _lstm_bwd_kernel(*refs, hidden: int, chunk: int, has_mask: bool):
     def _():
         dh_scr[:] = dhT_ref[:]
         dc_scr[:] = dcT_ref[:]
-        du_scr[:] = jnp.zeros_like(du_scr)
 
     dh = dh_scr[:]
     dc = dc_scr[:]
-    du = du_scr[:]
     for s in range(chunk - 1, -1, -1):
         z = z_ref[s]
         i = jax.nn.sigmoid(z[:, :H])
@@ -572,13 +598,8 @@ def _lstm_bwd_kernel(*refs, hidden: int, chunk: int, has_mask: bool):
         dg = dc_new * i * (1.0 - g * g)
         dz = jnp.concatenate([di, df, dg, do], axis=1)  # [B, 4H] f32
         dz_ref[s] = dz
-        dz_c = dz.astype(ut_ref.dtype)
-        du = du + jax.lax.dot_general(
-            hprev_ref[s].astype(ut_ref.dtype), dz_c,
-            (((0,), (0,)), ((), ())),  # contract batch -> [H, 4H]
-            preferred_element_type=jnp.float32,
-        )
-        dh = jnp.dot(dz_c, ut_ref[:], preferred_element_type=jnp.float32)
+        dh = jnp.dot(dz.astype(ut_ref.dtype), ut_ref[:],
+                     preferred_element_type=jnp.float32)
         dc = dc_new * f
         if has_mask:
             # frozen fraction of the cotangents bypasses the gates
@@ -586,13 +607,11 @@ def _lstm_bwd_kernel(*refs, hidden: int, chunk: int, has_mask: bool):
             dc = dc + (1.0 - m) * dc_in
     dh_scr[:] = dh
     dc_scr[:] = dc
-    du_scr[:] = du
 
     @pl.when(t == T - 1)
     def _():
         dh0_ref[:] = dh
         dc0_ref[:] = dc
-        du_ref[:] = du
 
 
 # ---------------------------------------------------------------------------
@@ -1003,27 +1022,24 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
         operands += [w, fused.bias.reshape(1, -1).astype(jnp.float32),
                      fused.recurrent, u_t,
                      dhT.astype(jnp.float32), dcT.astype(jnp.float32)]
-        dz, dU, dh0, dc0 = pl.pallas_call(
+        dz, dh0, dc0 = pl.pallas_call(
             functools.partial(_lstm_bwdx_kernel, hidden=H, dpad=Dp,
                               chunk=C, has_mask=has_mask),
             grid=(n,),
             in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # dz
-                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dU
                 pl.BlockSpec(memory_space=pltpu.VMEM),                   # dh0
                 pl.BlockSpec(memory_space=pltpu.VMEM),                   # dc0
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
-                jax.ShapeDtypeStruct((H, 4 * H), jnp.float32),
                 jax.ShapeDtypeStruct((B, H), jnp.float32),
                 jax.ShapeDtypeStruct((B, H), jnp.float32),
             ],
             scratch_shapes=[
                 pltpu.VMEM((B, H), jnp.float32),
                 pltpu.VMEM((B, H), jnp.float32),
-                pltpu.VMEM((H, 4 * H), jnp.float32),
             ],
             interpret=interpret,
         )(*operands)
@@ -1037,9 +1053,8 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
             pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # z
             pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # dys
             pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # c_prev
-            pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # h_prev
         ]
-        operands = [z, dys_t, c_prev, h_prev]
+        operands = [z, dys_t, c_prev]
         if has_mask:
             in_specs.append(
                 pl.BlockSpec((C, B, _LANE), rev, memory_space=pltpu.VMEM)
@@ -1051,26 +1066,23 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
             pl.BlockSpec(memory_space=pltpu.VMEM),                   # dcT
         ]
         operands += [u_t, dhT.astype(jnp.float32), dcT.astype(jnp.float32)]
-        dz, dU, dh0, dc0 = pl.pallas_call(
+        dz, dh0, dc0 = pl.pallas_call(
             kernel,
             grid=(n,),
             in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((C, B, 4 * H), rev, memory_space=pltpu.VMEM),  # dz
-                pl.BlockSpec(memory_space=pltpu.VMEM),                   # dU
                 pl.BlockSpec(memory_space=pltpu.VMEM),                   # dh0
                 pl.BlockSpec(memory_space=pltpu.VMEM),                   # dc0
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
-                jax.ShapeDtypeStruct((H, 4 * H), jnp.float32),
                 jax.ShapeDtypeStruct((B, H), jnp.float32),
                 jax.ShapeDtypeStruct((B, H), jnp.float32),
             ],
             scratch_shapes=[
                 pltpu.VMEM((B, H), jnp.float32),
                 pltpu.VMEM((B, H), jnp.float32),
-                pltpu.VMEM((H, 4 * H), jnp.float32),
             ],
             interpret=interpret,
         )(*operands)
@@ -1122,12 +1134,14 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
             scratch_shapes=scratch,
             interpret=interpret,
         )(*operands)
-        # dU contracts over all T·B at once — one large MXU matmul (the
-        # whole point of the tiled split: no VMEM-resident accumulator).
-        dU = jnp.einsum(
-            "tbh,tbk->hk", h_prev.astype(dtype), dz.astype(dtype),
-            preferred_element_type=jnp.float32,
-        )
+
+    # dU contracts over all T·B at once — one large MXU matmul for EVERY
+    # strategy (the sequential kernels emit dz anyway; accumulating dU
+    # in-kernel would serialize an extra MXU op with the reverse chain).
+    dU = jnp.einsum(
+        "tbh,tbk->hk", h_prev.astype(dtype), dz.astype(dtype),
+        preferred_element_type=jnp.float32,
+    )
 
     # input-projection cotangents: one MXU matmul each (XLA's job)
     xs_t = jnp.moveaxis(xs, 0, 1).astype(dtype)  # [T, B, D]
@@ -1187,23 +1201,12 @@ def _scan_core_fwd(params, xs, h0, c0, mask_tbl, compute_dtype, interpret,
     H = fused.hidden_size
     pbytes = 2 if fused.kernel.dtype == jnp.bfloat16 else 4
     Dp = _pad_to_lane(D) if T >= _FUSEDX_MIN_T else None
-    # Fused Pallas backward when (a) no remat was requested (remat_chunk is
-    # the memory-over-speed signal: the recompute backward stores O(T/chunk)
-    # carries, the fused ones store O(T) residuals), (b) those residuals fit
-    # the HBM heuristic budget, and (c) a backward kernel and a matching
-    # residual-saving forward both fit VMEM per the shared cost model.
-    # Strategy PAIRING: residentx bwd consumes the residentx fwd's cs-only
-    # residuals; the legacy bwds need z, so their fwd must not take the
-    # fusedx path (allow_fusedx=False keeps the plans aligned).
-    plan_b = _plan_bwd(B, H, pbytes, has_mask, Dp)
-    fusedx = plan_b is not None and plan_b[0] == "residentx"
-    use_fused_bwd = (
-        remat_chunk is None
-        and plan_b is not None
-        and _residual_bytes(T, B, H, plan_b[0]) <= _RESIDUAL_HBM_BUDGET
-        and _plan_fwd(B, H, pbytes, save_residuals=True, has_mask=has_mask,
-                      Dp=Dp if fusedx else None) is not None
-    )
+    # gate rationale lives on chosen_bwd_strategy — the one decision both
+    # this path and bench.py's strategy-aware roofline read
+    strategy = chosen_bwd_strategy(B, T, H, pbytes, has_mask=has_mask, Dp=Dp,
+                                   remat_chunk=remat_chunk)
+    fusedx = strategy == "residentx"
+    use_fused_bwd = strategy != "recompute"
     if use_fused_bwd:
         ys, hT, cT, z, cs = _pallas_forward(
             fused, xs, h0, c0, mask_tbl if has_mask else None,

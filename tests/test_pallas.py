@@ -496,3 +496,16 @@ def test_legacy_resident_path_still_works(monkeypatch):
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
         g1, g2,
     )
+
+
+def test_du_hoist_loosens_resident_bwd_plan():
+    """dU is contracted outside every sequential kernel (from the streamed
+    dz), so the backward cost model carries no [H,4H] f32 accumulator and
+    no h_prev input stream. The config-4 encoder class (B=64, H=256 bf16,
+    no mask, hoisted xproj) fits the RESIDENT backward again — under the
+    old accounting it priced out to tiled. Big-H shapes still tile."""
+    from lstm_tensorspark_tpu.ops.pallas_lstm import _plan_bwd
+
+    assert _plan_bwd(64, 256, 2, False, None)[0] == "resident"
+    assert _plan_bwd(64, 768, 2, False, None)[0] == "tiled"
+    assert _plan_bwd(32, 1024, 2, False, None)[0] == "tiled"
