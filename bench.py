@@ -447,7 +447,12 @@ def _impl_bound(name: str, rl: dict, rec: dict, measured: float) -> dict:
     D = c.get("F", H_)  # layer-0 input width: embed defaults to hidden
     Hp = _pad_to_lane(H_)
     Dp = _pad_to_lane(D) if T_ >= _FUSEDX_MIN_T else None
-    strategy = chosen_bwd_strategy(B_, T_, Hp, 2, has_mask=has_mask, Dp=Dp)
+    # pbytes from the config's compute dtype, exactly as the runtime gate
+    # derives it from the fused kernel dtype (all table configs are bf16
+    # today; an f32 row would flip the VMEM plans at 4 bytes)
+    pbytes = 2 if c.get("compute_dtype", "bfloat16") == "bfloat16" else 4
+    strategy = chosen_bwd_strategy(B_, T_, Hp, pbytes,
+                                   has_mask=has_mask, Dp=Dp)
     mult = {"residentx": 2, "resident": 1, "tiled": 1, "recompute": 2}[strategy]
     passes = L_ * dirs * (1 + mult)
     parallel = max(

@@ -513,8 +513,6 @@ def _setup_tp_training(args, logger, *, loss_fn, params, optimizer, rng,
     from .train.loop import init_train_state
 
     tp = args.tensor_parallel
-    if getattr(args, "zero1", False):
-        raise SystemExit("--zero1 is not supported with --tensor-parallel")
     if getattr(args, "steps_per_call", 1) and args.steps_per_call > 1:
         raise SystemExit("--steps-per-call is not supported with --tensor-parallel")
     if getattr(args, "grad_accum", 1) and args.grad_accum > 1:
@@ -547,8 +545,20 @@ def _setup_tp_training(args, logger, *, loss_fn, params, optimizer, rng,
     # in_shardings, so jit reshards it to match the params on first call
     state = state._replace(params=place_params(state.params, specs, mesh))
 
+    opt_specs = None
+    if getattr(args, "zero1", False):
+        # GSPMD ZeRO-1 (parallel/zero.py): moment leaves shard over the
+        # data axis too; placing the (fresh or restored) state here means
+        # no device ever materializes a replicated copy of the moments
+        from .parallel.zero import zero1_tp_opt_specs
+
+        opt_specs = zero1_tp_opt_specs(optimizer, params, specs, mesh)
+        state = state._replace(
+            opt_state=place_params(state.opt_state, opt_specs, mesh))
+
     train_step = make_tp_train_step(
         loss_fn, optimizer, mesh, params, param_specs=specs,
+        opt_state_specs=opt_specs,
         metric_fn=metric_fn, metric_keys=metric_keys,
     )
     # jit's in_shardings place each host batch; the stream passes through
@@ -933,8 +943,12 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     (sequential small-batch decode).
     """
     if getattr(args, "zero1", False):
-        raise SystemExit("--zero1 is not supported with --tensor-parallel/"
-                         "--seq-parallel/--pipeline-stages")
+        raise SystemExit(
+            "--zero1 is not supported with the LM's --tensor-parallel/"
+            "--seq-parallel/--pipeline-stages steps (manual {data,seq} "
+            "axes; PP shards the moments per stage already). It DOES "
+            "compose with the classifier/forecaster --tensor-parallel "
+            "runners (GSPMD weight-update sharding, parallel/zero.py).")
     from .data import lm_batch_stream, lm_epoch_batches
     from .models import init_lm
     from .parallel import (

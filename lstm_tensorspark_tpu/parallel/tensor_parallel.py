@@ -126,6 +126,7 @@ def make_tp_train_step(
     stateful: bool = False,
     donate: bool | None = None,
     param_specs=None,
+    opt_state_specs=None,
     metric_fn: Callable | None = None,
     metric_keys=(),
 ):
@@ -138,6 +139,13 @@ def make_tp_train_step(
     The batch's leading dim is sharded over ``dp_axis``; XLA derives every
     collective (h all-gather per step, logits psum, grad reductions) from
     the annotations.
+
+    ``opt_state_specs`` (a PartitionSpec pytree from
+    `parallel.zero.zero1_tp_opt_specs`) turns on the GSPMD form of ZeRO-1:
+    moment leaves shard over ``dp_axis`` too, and the step's in/out
+    shardings PIN them there — without the pin, XLA's propagation from the
+    params would replicate the moments over data and silently undo the
+    memory saving.
 
     With ``metric_fn`` set, returns the FUSED train+eval step
     ``train_step(state, batch, eval_batches, do_eval)`` — the same
@@ -157,9 +165,12 @@ def make_tp_train_step(
             lambda s: NamedSharding(mesh, s), param_specs,
             is_leaf=lambda x: isinstance(x, P),
         ),
-        # opt_state stays unconstrained: XLA propagates the params' shardings
-        # onto the matching optimizer-state leaves
-        opt_state=None,
+        # without zero1 specs the opt_state stays unconstrained: XLA
+        # propagates the params' shardings onto the matching moment leaves
+        opt_state=None if opt_state_specs is None else jax.tree.map(
+            lambda s: NamedSharding(mesh, s), opt_state_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
         rng=NamedSharding(mesh, P()),
         carries=NamedSharding(mesh, P(dp_axis)) if stateful else None,
     )
@@ -194,8 +205,14 @@ def make_tp_train_step(
             None,  # eval batches: replicated placement stands
             None,  # do_eval scalar
         )
+    out_shardings = None
+    if opt_state_specs is not None:
+        # pin the OUTPUT state too: propagation from the (replicated-over-
+        # data) params would otherwise be free to emit replicated moments
+        out_shardings = (state_shardings, None)
     return jax.jit(
         train_step,
         in_shardings=in_shardings,
+        out_shardings=out_shardings,
         donate_argnums=(0,) if donate else (),
     )

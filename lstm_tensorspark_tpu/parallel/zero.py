@@ -25,6 +25,21 @@ Scope: stateless losses; composes with K-step dispatch
 replicated — sharding them too (ZeRO-3) would re-gather per layer per
 step; at LSTM sizes the win is in the moments, which dominate optimizer
 memory.
+
+TWO implementations live here, because the raveled-flat form above is
+hostile to tensor parallelism (raveling a model-sharded leaf would gather
+it):
+
+- the shard_map/ravel form (`make_zero1_train_step`) for the pure-DP
+  backend — explicit reduce-scatter/all-gather, K-step scan inside;
+- a GSPMD form (`zero1_tp_opt_specs`) for the TP task runners: the
+  optimizer-state moment leaves get a PartitionSpec that ADDS the data
+  axis on a dimension the param leaves unsharded (the classic XLA
+  weight-update-sharding recipe — annotate, let GSPMD place the update).
+  Grads stay logically replicated over data, so global-norm clipping
+  needs no special casing, and the sharded leaves keep their full
+  logical shapes, so checkpoints reshard across ANY later dp×tp (no
+  padded-flat-length contract like the ravel form).
 """
 
 from __future__ import annotations
@@ -211,3 +226,63 @@ def make_zero1_train_step(
     if donate is None:
         donate = _donation_supported()
     return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def _zero1_leaf_spec(spec: P, shape, dp: int, dp_axis: str) -> P:
+    """Extend a param leaf's PartitionSpec with ``dp_axis`` on the first
+    dimension the param leaves unsharded and the axis divides. A leaf with
+    no such dimension keeps the param's own sharding (no memory win on it,
+    but nothing breaks — GSPMD just replicates it over data as before)."""
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (p, dim) in enumerate(zip(parts, shape)):
+        if p is None and dim >= dp and dim % dp == 0:
+            parts[i] = dp_axis
+            break
+    while parts and parts[-1] is None:
+        parts.pop()
+    return P(*parts)
+
+
+def zero1_tp_opt_specs(
+    optimizer: optax.GradientTransformation,
+    params_template,
+    param_specs,
+    mesh: Mesh,
+    *,
+    dp_axis: str = "data",
+):
+    """PartitionSpec pytree for the optimizer state that composes ZeRO-1
+    with GSPMD tensor parallelism (the TP task runners' recipe).
+
+    Moment leaves mirror the params tree inside optax's state NamedTuples;
+    they are matched to their param by TREE-PATH SUFFIX (an adam ``mu``
+    leaf at ``[0].mu['fwd'][0].W_i`` matches the param path
+    ``['fwd'][0].W_i``), guarded by shape equality, longest suffix wins.
+    Matched leaves get the param's spec extended with the data axis
+    (`_zero1_leaf_spec`); scalars and unmatched leaves stay replicated.
+    Use the result as ``opt_state_specs`` for `make_tp_train_step` and to
+    `place_params` the initial/restored state."""
+    from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+    dp = mesh.shape[dp_axis]
+    param_leaves, _ = tree_flatten_with_path(params_template)
+    spec_leaves = jax.tree.leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P))
+    by_path = [
+        (tuple(path), leaf.shape, spec)
+        for (path, leaf), spec in zip(param_leaves, spec_leaves)
+    ]
+    by_path.sort(key=lambda t: -len(t[0]))  # longest suffix wins
+
+    shapes = jax.eval_shape(optimizer.init, params_template)
+    flat, treedef = tree_flatten_with_path(shapes)
+
+    def match(path, shape):
+        for q, qshape, spec in by_path:
+            if (len(path) >= len(q) and tuple(path[-len(q):]) == q
+                    and tuple(shape) == tuple(qshape)):
+                return _zero1_leaf_spec(spec, shape, dp, dp_axis)
+        return P()
+
+    return tree_unflatten(
+        treedef, [match(tuple(p), s.shape) for p, s in flat])

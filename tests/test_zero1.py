@@ -190,3 +190,131 @@ def test_zero1_multistep_matches_single_dispatch():
         jax.device_get(state.params), jax.device_get(s_one.params),
     )
     assert int(jax.device_get(state.step)) == 4
+
+
+# ---------------------------------------------------------------------------
+# GSPMD ZeRO-1 x tensor parallelism (zero1_tp_opt_specs): the TP task
+# runners' form — moment leaves sharded over data AND model, trajectory
+# identical to the plain TP step, no clip special-casing.
+# ---------------------------------------------------------------------------
+
+
+def _tp_setup(zero1: bool, *, clip=None):
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from lstm_tensorspark_tpu.models import (
+        ClassifierConfig, classifier_loss, init_classifier,
+    )
+    from lstm_tensorspark_tpu.parallel.tensor_parallel import (
+        classifier_param_specs, make_tp_train_step, place_params,
+    )
+    from lstm_tensorspark_tpu.parallel.zero import zero1_tp_opt_specs
+
+    cfg = ClassifierConfig(vocab_size=V, hidden_size=H, num_layers=1)
+    params = init_classifier(jax.random.PRNGKey(0), cfg)
+    opt = make_optimizer("adam", 1e-2, clip_norm=clip)
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                ("data", "model"))
+    specs = classifier_param_specs(params)
+
+    def loss_fn(p, b, r):
+        return classifier_loss(p, b, cfg)
+
+    state = init_train_state(params, opt, jax.random.PRNGKey(1))
+    state = state._replace(params=place_params(state.params, specs, mesh))
+    opt_specs = None
+    if zero1:
+        opt_specs = zero1_tp_opt_specs(opt, params, specs, mesh)
+        state = state._replace(
+            opt_state=place_params(state.opt_state, opt_specs, mesh))
+    step = make_tp_train_step(loss_fn, opt, mesh, params, param_specs=specs,
+                              opt_state_specs=opt_specs)
+    rng = np.random.RandomState(7)
+
+    def batches(k):
+        for _ in range(k):
+            yield {
+                "tokens": rng.randint(0, V, (B, T)).astype(np.int32),
+                "lengths": np.full((B,), T, np.int32),
+                "labels": rng.randint(0, 2, (B,)).astype(np.int32),
+                "valid": np.ones((B,), np.float32),
+            }
+
+    return state, step, batches, opt_specs
+
+
+@pytest.mark.parametrize("clip", [None, 0.5])
+def test_zero1_tp_matches_plain_tp_trajectory(clip):
+    """Same batches, same seed: the data-sharded-moments step must walk the
+    exact trajectory of the propagation-sharded step — the annotation moves
+    MEMORY, not math. Clipping needs no special casing here (grads are
+    logically replicated over data), so it rides along unchanged."""
+    out = {}
+    for zero1 in (False, True):
+        state, step, batches, _ = _tp_setup(zero1, clip=clip)
+        losses = []
+        for b in batches(5):
+            state, m = step(state, b)
+            losses.append(float(m["loss"]))
+        out[zero1] = (losses, state)
+    np.testing.assert_allclose(out[True][0], out[False][0], rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
+        out[True][1].params, out[False][1].params,
+    )
+
+
+def test_zero1_tp_moments_shard_over_data_and_model():
+    """The published memory claim: after a step, every matrix moment leaf
+    is sharded over BOTH axes (1/(dp*tp) per device), and the output state
+    PRESERVES it (the out_shardings pin — propagation alone would undo it)."""
+    from jax.sharding import PartitionSpec as P
+
+    from jax.tree_util import GetAttrKey, tree_flatten_with_path
+
+    state, step, batches, opt_specs = _tp_setup(True)
+    for b in batches(2):
+        state, _ = step(state, b)
+    leaves = tree_flatten_with_path(state.opt_state)[0]
+    mats = [a for path, a in leaves
+            if GetAttrKey("mu") in path and a.ndim == 2]
+    assert mats, "expected matrix moment leaves under .mu"
+    both = 0
+    for a in mats:
+        spec = a.sharding.spec
+        # every matrix moment picks up the data axis; the TP-sharded cell
+        # kernels keep the model axis too -> 1/(dp*tp) per device
+        assert "data" in spec, spec
+        if "model" in spec:
+            both += 1
+            shard = a.addressable_shards[0].data
+            assert shard.size * 4 == a.size, (shard.shape, a.shape)
+    assert both >= 16, f"cell kernels should shard over both axes ({both})"
+    # scalar leaves (adam's count) stay replicated
+    counts = [a for path, a in leaves
+              if GetAttrKey("count") in path]
+    assert counts and all(c.sharding.spec == P() for c in counts)
+
+
+def test_zero1_tp_specs_suffix_matching_is_shape_guarded():
+    """Path-suffix matching must not mis-bind a moment leaf whose suffix
+    matches a param path with a DIFFERENT shape; unmatched/scalar leaves
+    stay replicated."""
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from lstm_tensorspark_tpu.parallel.zero import zero1_tp_opt_specs
+
+    params = {"a": {"b": jnp.zeros((8, 8))}, "b": jnp.zeros((4,))}
+    specs = {"a": {"b": P(None, "model")}, "b": P()}
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                ("data", "model"))
+    out = zero1_tp_opt_specs(optax.adam(1e-3), params, specs, mesh)
+    mu = out[0].mu
+    # ['a']['b'] ends with ('b',) too, but shape 8x8 != (4,): the longer
+    # exact match must win and carry the model axis forward
+    assert mu["a"]["b"] == P("data", "model")
+    assert mu["b"] == P("data")
+    assert out[0].count == P()
