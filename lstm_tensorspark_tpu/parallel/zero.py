@@ -266,23 +266,46 @@ def zero1_tp_opt_specs(
 
     dp = mesh.shape[dp_axis]
     param_leaves, _ = tree_flatten_with_path(params_template)
-    spec_leaves = jax.tree.leaves(
+    spec_flat, _ = tree_flatten_with_path(
         param_specs, is_leaf=lambda x: isinstance(x, P))
+    # pair by PATH, not position: a same-count tree with a typoed key
+    # would silently mispair under zip and the step would then PIN wrong
+    # placements with no error
+    spec_by_path = {tuple(path): spec for path, spec in spec_flat}
+    param_paths = {tuple(p) for p, _ in param_leaves}
+    if spec_by_path.keys() != param_paths:
+        from jax.tree_util import keystr
+        odd = [keystr(p) for p in
+               (param_paths ^ spec_by_path.keys())][:3]
+        raise ValueError(
+            "param_specs does not mirror params_template "
+            f"(mismatched leaf paths, e.g. {odd})")
     by_path = [
-        (tuple(path), leaf.shape, spec)
-        for (path, leaf), spec in zip(param_leaves, spec_leaves)
+        (tuple(path), leaf.shape, spec_by_path[tuple(path)])
+        for path, leaf in param_leaves
     ]
     by_path.sort(key=lambda t: -len(t[0]))  # longest suffix wins
 
     shapes = jax.eval_shape(optimizer.init, params_template)
     flat, treedef = tree_flatten_with_path(shapes)
+    matched = 0
 
     def match(path, shape):
+        nonlocal matched
         for q, qshape, spec in by_path:
             if (len(path) >= len(q) and tuple(path[-len(q):]) == q
                     and tuple(shape) == tuple(qshape)):
+                matched += 1
                 return _zero1_leaf_spec(spec, shape, dp, dp_axis)
         return P()
 
-    return tree_unflatten(
-        treedef, [match(tuple(p), s.shape) for p, s in flat])
+    out = tree_unflatten(treedef, [match(tuple(p), s.shape) for p, s in flat])
+    if matched == 0 and any(s.ndim > 0 for _, s in flat):
+        # nothing mirrors the params (e.g. a factored optimizer like
+        # adafactor): pinning everything P() would use MORE memory than
+        # plain propagation — refuse rather than silently regress
+        raise ValueError(
+            "no optimizer-state leaf mirrors the params (factored "
+            "optimizer?) — GSPMD ZeRO-1 only shards param-shaped moments; "
+            "drop opt_state_specs and let propagation place this state")
+    return out

@@ -318,3 +318,34 @@ def test_zero1_tp_specs_suffix_matching_is_shape_guarded():
     assert mu["a"]["b"] == P("data", "model")
     assert mu["b"] == P("data")
     assert out[0].count == P()
+
+
+def test_zero1_tp_specs_reject_malformed_inputs():
+    """Hardening: a spec tree with the wrong leaf count must error (zip
+    would silently mispair), and an optimizer whose state mirrors nothing
+    (factored accumulators) must refuse rather than pin everything
+    replicated — which would use MORE memory than plain propagation."""
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from lstm_tensorspark_tpu.parallel.zero import zero1_tp_opt_specs
+
+    params = {"a": jnp.zeros((8, 8)), "b": jnp.zeros((4,))}
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                ("data", "model"))
+    with pytest.raises(ValueError, match="mirror"):
+        zero1_tp_opt_specs(optax.adam(1e-3), params, {"a": P()}, mesh)
+    # same leaf COUNT but a typoed key: positional zip would mispair
+    # silently; the path-keyed pairing must refuse
+    with pytest.raises(ValueError, match="mirror"):
+        zero1_tp_opt_specs(optax.adam(1e-3), params,
+                           {"a": P(None, "model"), "z": P()}, mesh)
+    specs = {"a": P(None, "model"), "b": P()}
+    # a factored-accumulator-style state (nothing mirrors the params):
+    # refusal, not a silent all-replicated pin
+    factored = optax.GradientTransformation(
+        init=lambda p: {"acc": jnp.zeros((3,))},
+        update=lambda g, s, p=None: (g, s),
+    )
+    with pytest.raises(ValueError, match="mirrors the params"):
+        zero1_tp_opt_specs(factored, params, specs, mesh)
