@@ -601,7 +601,75 @@ def cpu_baseline() -> float:
     return value
 
 
+def _fail_json(error: str) -> None:
+    """The driver's zero-value failure contract — SAME metric/unit strings
+    as the success line (main), so the failure is recorded as a 0-value
+    datapoint of the tracked metric, not an unknown one. ONE copy, used by
+    the start-of-run liveness probe and the whole-run watchdog."""
+    print(json.dumps({
+        "metric": "ptb_char_lstm_train_seq_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "seq/sec",
+        "vs_baseline": 0.0,
+        "error": f"{error}; see BENCH_TABLE.json for the last complete "
+                 "measurement",
+    }), flush=True)
+    os._exit(3)
+
+
+def _liveness_probe(timeout_s: float = 60.0) -> None:
+    """Fail FAST on a wedged chip: one tiny matmul + value fetch in a
+    subprocess with a hard timeout. The tunneled TPU has been observed to
+    wedge indefinitely on executable swaps; without this probe a
+    dead-from-the-start chip burns the full watchdog budget (~40 min of
+    driver time) before reporting the same 0-value line.
+
+    Two deliberate details: (a) the probe prints its backend platform and
+    the parent REQUIRES "tpu" unless the caller explicitly exported a CPU
+    platform — a cleanly-FAILING TPU init silently falls back to CPU,
+    where the matmul would succeed and main() would then publish a CPU
+    number under the TPU metric; (b) the child is managed with Popen +
+    poll, never a blocking communicate after kill — the documented wedge
+    leaves children in uninterruptible driver calls where even SIGKILL
+    cannot reap them, and waiting on one would burn the watchdog budget
+    this probe exists to save."""
+    cpu_ok = "cpu" in os.environ.get("JAX_PLATFORMS", "").lower()
+    probe = (
+        "import jax; "
+        # sitecustomize overrides JAX_PLATFORMS to prefer the TPU plugin;
+        # when the caller explicitly asked for CPU, re-assert it at the
+        # config level BEFORE the first device query (verify-skill gotcha)
+        + ("jax.config.update('jax_platforms', 'cpu'); " if cpu_ok else "")
+        + "import jax.numpy as jnp; "
+          "x = jnp.ones((128, 128)); float((x @ x).sum()); "
+          "print('platform=' + jax.devices()[0].platform, flush=True)"
+    )
+    child = subprocess.Popen(
+        [sys.executable, "-c", probe],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    deadline = time.monotonic() + timeout_s
+    while child.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.5)
+    if child.poll() is None:
+        child.kill()  # may not reap a D-state child — do NOT wait on it
+        _fail_json("TPU backend unreachable/wedged at benchmark start: "
+                   f"probe matmul did not complete in {timeout_s:.0f}s")
+    out = (child.stdout.read() or "") if child.stdout else ""
+    if child.returncode != 0:
+        _fail_json("TPU backend unreachable/wedged at benchmark start: "
+                   f"probe exited rc={child.returncode}")
+    platform = out.strip().rsplit("platform=", 1)[-1] if "platform=" in out else "?"
+    # the tunneled plugin reports an experimental platform name ("axon"),
+    # not "tpu" — accept anything that is not the silent CPU fallback
+    if platform == "cpu" and not cpu_ok:
+        _fail_json("TPU init failed and jax silently fell back to CPU "
+                   "(probe platform=cpu without JAX_PLATFORMS=cpu); "
+                   "refusing to publish a CPU number under the TPU metric")
+
+
 def main() -> int:
+    _liveness_probe()
     baseline = cpu_baseline()
     value = measure(
         "bfloat16", STEPS * K, WARMUP * K,
@@ -705,19 +773,8 @@ def _watchdog(seconds: float) -> None:
     import threading
 
     def expire():
-        # SAME metric/unit strings as the success line (main), so the
-        # driver records the wedge as a 0-value datapoint of the tracked
-        # metric, not an unknown one
-        print(json.dumps({
-            "metric": "ptb_char_lstm_train_seq_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "seq/sec",
-            "vs_baseline": 0.0,
-            "error": f"benchmark exceeded {seconds:.0f}s — TPU backend "
-                     "unreachable/wedged; see BENCH_TABLE.json for the "
-                     "last complete measurement",
-        }), flush=True)
-        os._exit(3)
+        _fail_json(f"benchmark exceeded {seconds:.0f}s — TPU backend "
+                   "unreachable/wedged")
 
     t = threading.Timer(seconds, expire)
     t.daemon = True
