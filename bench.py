@@ -633,7 +633,9 @@ def _liveness_probe(timeout_s: float = 60.0) -> None:
     leaves children in uninterruptible driver calls where even SIGKILL
     cannot reap them, and waiting on one would burn the watchdog budget
     this probe exists to save."""
-    cpu_ok = "cpu" in os.environ.get("JAX_PLATFORMS", "").lower()
+    # exact match only: "tpu,cpu" (fallback-ordering syntax) must NOT
+    # disable the TPU guard or force the probe onto CPU
+    cpu_ok = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
     probe = (
         "import jax; "
         # sitecustomize overrides JAX_PLATFORMS to prefer the TPU plugin;

@@ -67,6 +67,11 @@ def _load():
             ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
         ]
         lib.vocab_free.argtypes = [ctypes.c_void_p]
+        lib.csv_decimal_comma.restype = ctypes.c_int64
+        lib.csv_decimal_comma.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ]
         _lib = lib
     except Exception:
         _lib = None
@@ -140,6 +145,29 @@ def encode_words(
     return np.asarray(
         [lookup.get(w, unk_id) for w in text.split()], np.int32
     )
+
+
+def parse_decimal_comma_csv(body: bytes, take: int) -> np.ndarray | None:
+    """Parse the body (header already stripped) of a semicolon-separated
+    decimal-comma CSV (UCI LD2011_2014 format) into a [rows, take] float32
+    array: per line, skip the timestamp field, convert the next ``take``
+    values. Returns None when the native library is unavailable OR when
+    the C parser hits a value Python's float() might treat differently
+    (caller falls back to the pure loop, which keeps the exact historical
+    semantics, including its ValueError on garbage)."""
+    lib = _load()
+    if lib is None or take <= 0:
+        return None
+    max_rows = body.count(b"\n") + 1
+    out = np.empty((max_rows, take), np.float32)
+    rows = lib.csv_decimal_comma(
+        body, len(body), take,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size,
+    )
+    if rows < 0:
+        return None
+    return out[:rows]
 
 
 def most_common_words(text: str, max_size: int | None = None) -> list[str]:

@@ -10,6 +10,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <unordered_map>
@@ -140,5 +141,81 @@ void vocab_fill(const void* handle, char* words_buf, int64_t* counts_buf) {
 }
 
 void vocab_free(void* handle) { delete static_cast<VocabCount*>(handle); }
+
+// ---- semicolon-separated decimal-comma CSV (UCI LD2011_2014) ----
+//
+// The forecaster's real-data loader (data/datasets.py _uci_real) parses a
+// European-locale CSV: per line, a timestamp field then per-customer loads
+// with DECIMAL COMMAS ("3,1415"). The Python per-value
+// float(v.replace(",", ".")) loop is the slowest host step on the real
+// ~700 MB file; this kernel parses the same format at memory speed.
+//
+// Semantics mirror the Python loader EXACTLY:
+//   - caller strips the header line (Python reads it for the column count);
+//   - a line with fewer than take+1 fields is skipped, not an error;
+//   - an empty value parses as 0.0 (`float(v.replace(...) or 0.0)`);
+//   - any other unparsable value returns -2 (the Python fallback then
+//     raises the same ValueError the pure loader always raised).
+// Returns rows written (row-major [rows, take] floats into out), -1 if
+// out_cap is too small, -2 on a value Python's float() would reject.
+int64_t csv_decimal_comma(const char* buf, int64_t len, int32_t take,
+                          float* out, int64_t out_cap) {
+  int64_t rows = 0;
+  int64_t i = 0;
+  char field[64];
+  while (i < len) {
+    const int64_t line_start = i;
+    while (i < len && buf[i] != '\n') ++i;
+    const int64_t line_end = i;  // excl. '\n'
+    if (i < len) ++i;            // skip '\n'
+    // count fields (separator count + 1 on a non-empty split result —
+    // Python "".split(";") -> [""] has 1 field)
+    int64_t nfields = 1;
+    for (int64_t j = line_start; j < line_end; ++j)
+      if (buf[j] == ';') ++nfields;
+    if (nfields < take + 1) continue;  // short row: skipped, like Python
+    if (rows * take + take > out_cap) return -1;
+    // walk fields 1..take (field 0 is the timestamp)
+    int64_t p = line_start;
+    while (p < line_end && buf[p] != ';') ++p;  // skip timestamp
+    for (int32_t k = 0; k < take; ++k) {
+      ++p;  // skip ';'
+      int64_t q = p;
+      while (q < line_end && buf[q] != ';') ++q;
+      const int64_t raw_flen = q - p;
+      int64_t flen = raw_flen;
+      // strip whitespace the way float() does (incl. the \r of CRLF rows)
+      while (flen > 0 && is_ws(buf[p])) { ++p; --flen; }
+      while (flen > 0 && is_ws(buf[p + flen - 1])) --flen;
+      float v = 0.0f;
+      if (flen == 0) {
+        // only a TRULY empty field is 0.0 (`v or 0.0` on the raw string);
+        // a whitespace-only field reaches float(" ") in Python and raises
+        if (raw_flen != 0) return -2;
+      } else {
+        if (flen >= static_cast<int64_t>(sizeof(field))) return -2;
+        for (int64_t j = 0; j < flen; ++j) {
+          const char c = buf[p + j];
+          // strtod accepts a SUPERSET of float()'s grammar: hex floats
+          // ("0x10") and "nan(chars)". Reject their marker chars so such
+          // fields take the -2 fallback (where Python raises).
+          if (c == 'x' || c == 'X' || c == '(') return -2;
+          field[j] = c == ',' ? '.' : c;
+        }
+        field[flen] = '\0';
+        char* end = nullptr;
+        // parse as double THEN cast, exactly like the Python loop
+        // (float(v) builds a double; np.float32 casts) — strtof's direct
+        // single rounding can differ in the last ulp
+        v = static_cast<float>(std::strtod(field, &end));
+        if (end != field + flen) return -2;  // float() would raise
+      }
+      out[rows * take + k] = v;
+      p = q;
+    }
+    ++rows;
+  }
+  return rows;
+}
 
 }  // extern "C"

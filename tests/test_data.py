@@ -273,3 +273,74 @@ def test_uci_real_loader(tmp_path):
     # the file path itself is accepted too
     ds2 = get_dataset("uci_electricity", str(f), num_series=2)
     assert ds2["train"].shape == (80, 2)
+
+
+def test_native_csv_decimal_comma_parity(tmp_path):
+    """The C++ CSV kernel and the pure-Python loop must produce
+    byte-identical arrays on the LD2011_2014 format, including the edge
+    rows: empty values (-> 0.0), CRLF line ends, short rows (skipped),
+    scientific notation, and signs."""
+    import os
+
+    from lstm_tensorspark_tpu.data import native
+    from lstm_tensorspark_tpu.data.datasets import _uci_real
+
+    lines = ['"";"MT_001";"MT_002"']
+    rows = ['"t0";1,5;-2,25', '"t1";;3,0', '"t2";1e-3;+4,125',
+            '"t3";0;0,0', '"t4-short";7,0', '"t5";  8,5  ;9']
+    f = tmp_path / "LD2011_2014.txt"
+    # mixed \n and \r\n endings
+    f.write_bytes(("\n".join(lines + rows[:3]) + "\r\n"
+                   + "\r\n".join(rows[3:]) + "\n").encode())
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    got = _uci_real(str(f), num_series=2)
+
+    os.environ["LSTM_TSP_NO_NATIVE"] = "1"
+    try:
+        native._load_attempted = False
+        native._lib = None
+        want = _uci_real(str(f), num_series=2)
+    finally:
+        del os.environ["LSTM_TSP_NO_NATIVE"]
+        native._load_attempted = False
+        native._lib = None
+
+    for k in ("train", "valid", "test"):
+        np.testing.assert_array_equal(got[k], want[k])
+    # 5 data rows survive (the short row is skipped on both paths)
+    total = sum(len(got[k]) for k in ("train", "valid", "test"))
+    assert total == 5
+
+
+def test_native_csv_garbage_falls_back_to_python_error(tmp_path):
+    """A value float() would reject makes the C kernel return -2; the
+    loader falls back to the pure loop, which raises the SAME ValueError
+    it always raised — the native path never changes error semantics."""
+    import pytest
+
+    from lstm_tensorspark_tpu.data.datasets import _uci_real
+
+    f = tmp_path / "LD2011_2014.txt"
+    f.write_text('"";"MT_001"\n"t0";not_a_number\n')
+    with pytest.raises(ValueError):
+        _uci_real(str(f), num_series=1)
+
+
+def test_native_csv_python_grammar_divergences_fall_back(tmp_path):
+    """Fields where strtod and Python float() disagree must take the -2
+    fallback: whitespace-only (Python raises), hex floats, nan(chars)."""
+    import pytest
+
+    from lstm_tensorspark_tpu.data import native
+    from lstm_tensorspark_tpu.data.datasets import _uci_real
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    for bad in ("   ", "0x10", "nan(7)"):
+        f = tmp_path / "LD2011_2014.txt"
+        f.write_text(f'"";"MT_001"\n"t0";{bad}\n"t1";1,5\n')
+        with pytest.raises(ValueError):
+            _uci_real(str(f), num_series=1)
