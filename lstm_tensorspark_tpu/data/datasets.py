@@ -219,18 +219,30 @@ def _uci_real(path: str, *, num_series: int):
     synthetic file), pure-Python loop otherwise."""
     from .native import available, parse_decimal_comma_csv
 
+    # header via TEXT mode: universal newlines, exactly like the fallback
+    # loop below (a binary readline would mis-read CR-only files)
+    with open(path, encoding="utf-8", errors="replace") as f:
+        ncols = f.readline().count(";")
+    take = min(num_series, ncols) if ncols else num_series
     data = None
-    with open(path, "rb") as fb:
-        header_b = fb.readline()
-        ncols = header_b.count(b";")
-        take = min(num_series, ncols) if ncols else num_series
-        # read the body only when the native kernel will consume it — the
-        # fallback path streams line-by-line and must not hold ~700 MB of
-        # raw bytes alive alongside its row list
-        if available() and take > 0:
-            body = fb.read()
-            data = parse_decimal_comma_csv(body, take)
-            del body
+    if available() and take > 0:
+        # the fallback path streams line-by-line and must not hold ~700 MB
+        # of raw bytes alive alongside its row list, so the whole file is
+        # read only here, for the kernel
+        with open(path, "rb") as fb:
+            raw = fb.read()
+        # skip the header up to the FIRST line terminator of any style —
+        # matching the text-mode sniff above (a binary readline would eat
+        # the first data row of a \r-header/\n-body mixed file). CR-only
+        # bodies then parse 0 rows (the kernel splits on \n) or hit the
+        # -2 sentinel, and the text fallback handles them as it always did.
+        i_r, i_n = raw.find(b"\r"), raw.find(b"\n")
+        ends = [i for i in (i_r, i_n) if i >= 0]
+        if ends:
+            i = min(ends)
+            i += 2 if raw[i:i + 2] == b"\r\n" else 1
+            data = parse_decimal_comma_csv(raw[i:], take)
+        del raw
     if data is not None and not len(data):
         data = None  # empty parse: let the fallback raise the format error
     if data is None:

@@ -12,6 +12,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <locale.h>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -206,8 +207,13 @@ int64_t csv_decimal_comma(const char* buf, int64_t len, int32_t take,
         char* end = nullptr;
         // parse as double THEN cast, exactly like the Python loop
         // (float(v) builds a double; np.float32 casts) — strtof's direct
-        // single rounding can differ in the last ulp
-        v = static_cast<float>(std::strtod(field, &end));
+        // single rounding can differ in the last ulp. strtod_l against a
+        // cached C locale: plain strtod reads LC_NUMERIC, and a host app
+        // that setlocale()'d to a comma-decimal locale would reject
+        // every '.'-converted field and silently disable this kernel.
+        static locale_t c_loc = newlocale(LC_ALL_MASK, "C", nullptr);
+        if (!c_loc) return -2;  // strtod_l(.., 0) is UB — fall back instead
+        v = static_cast<float>(strtod_l(field, &end, c_loc));
         if (end != field + flen) return -2;  // float() would raise
       }
       out[rows * take + k] = v;

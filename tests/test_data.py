@@ -1,6 +1,9 @@
 """Data-layer tests: vocab, LM windowing (target shift), padded batches,
 dataset registry (SURVEY.md §4 test pyramid)."""
 
+import contextlib
+import os
+
 import numpy as np
 
 from lstm_tensorspark_tpu.data import (
@@ -11,6 +14,23 @@ from lstm_tensorspark_tpu.data import (
     padded_batches,
 )
 from lstm_tensorspark_tpu.data.corpus import synthetic_text
+
+
+@contextlib.contextmanager
+def force_python_native():
+    """Disable the native library inside the block (and reset the load
+    cache on BOTH edges so neither direction leaks into other tests)."""
+    from lstm_tensorspark_tpu.data import native
+
+    os.environ["LSTM_TSP_NO_NATIVE"] = "1"
+    native._load_attempted = False
+    native._lib = None
+    try:
+        yield
+    finally:
+        del os.environ["LSTM_TSP_NO_NATIVE"]
+        native._load_attempted = False
+        native._lib = None
 
 
 def test_char_vocab_roundtrip():
@@ -82,7 +102,6 @@ def test_dataset_registry():
 def test_native_encode_parity():
     """Native C++ encoders must match the pure-Python paths exactly (and the
     suite still passes if the .so is unavailable — fallback is automatic)."""
-    import os
 
     from lstm_tensorspark_tpu.data import native
     from lstm_tensorspark_tpu.data.corpus import synthetic_text
@@ -99,16 +118,9 @@ def test_native_encode_parity():
     assert got_w[-1] == wv.stoi["<unk>"]
 
     # forced-fallback parity
-    os.environ["LSTM_TSP_NO_NATIVE"] = "1"
-    try:
-        native._load_attempted = False
-        native._lib = None
+    with force_python_native():
         np.testing.assert_array_equal(cv.encode_text(text, "char"), want_c)
         np.testing.assert_array_equal(wv.encode_text(text, "word"), want_w)
-    finally:
-        del os.environ["LSTM_TSP_NO_NATIVE"]
-        native._load_attempted = False
-        native._lib = None
 
 
 def test_native_non_ascii_falls_back():
@@ -136,22 +148,14 @@ def test_native_control_char_whitespace_parity():
 def test_literal_special_token_maps_to_unk():
     """A literal '<pad>'/'<unk>' string in raw text maps to unk on BOTH the
     native and fallback word paths (reserved ids unreachable from text)."""
-    import os
 
     from lstm_tensorspark_tpu.data import native
 
     text = "alpha beta alpha <pad> <unk> beta"
     wv = build_word_vocab("alpha beta alpha beta")
     got_native = wv.encode_text(text, "word")
-    os.environ["LSTM_TSP_NO_NATIVE"] = "1"
-    try:
-        native._load_attempted = False
-        native._lib = None
+    with force_python_native():
         got_py = wv.encode_text(text, "word")
-    finally:
-        del os.environ["LSTM_TSP_NO_NATIVE"]
-        native._load_attempted = False
-        native._lib = None
     np.testing.assert_array_equal(got_native, got_py)
     unk = wv.stoi["<unk>"]
     np.testing.assert_array_equal(got_py[3:5], [unk, unk])
@@ -171,7 +175,6 @@ def test_nul_in_vocab_token_falls_back():
 def test_native_vocab_build_parity():
     """C++ most_common_words must equal Counter.most_common exactly,
     including count-tie ordering (first occurrence wins) and max_size."""
-    import os
     from collections import Counter
 
     from lstm_tensorspark_tpu.data import native
@@ -189,15 +192,8 @@ def test_native_vocab_build_parity():
     # non-ASCII falls back, same result
     assert native.most_common_words("café x café") == oracle("café x café")
     # forced fallback parity
-    os.environ["LSTM_TSP_NO_NATIVE"] = "1"
-    try:
-        native._load_attempted = False
-        native._lib = None
+    with force_python_native():
         assert native.most_common_words(text, 50) == oracle(text, 50)
-    finally:
-        del os.environ["LSTM_TSP_NO_NATIVE"]
-        native._load_attempted = False
-        native._lib = None
 
 
 def test_native_vocab_edge_cases():
@@ -280,7 +276,6 @@ def test_native_csv_decimal_comma_parity(tmp_path):
     byte-identical arrays on the LD2011_2014 format, including the edge
     rows: empty values (-> 0.0), CRLF line ends, short rows (skipped),
     scientific notation, and signs."""
-    import os
 
     from lstm_tensorspark_tpu.data import native
     from lstm_tensorspark_tpu.data.datasets import _uci_real
@@ -298,15 +293,8 @@ def test_native_csv_decimal_comma_parity(tmp_path):
         pytest.skip("native library unavailable")
     got = _uci_real(str(f), num_series=2)
 
-    os.environ["LSTM_TSP_NO_NATIVE"] = "1"
-    try:
-        native._load_attempted = False
-        native._lib = None
+    with force_python_native():
         want = _uci_real(str(f), num_series=2)
-    finally:
-        del os.environ["LSTM_TSP_NO_NATIVE"]
-        native._load_attempted = False
-        native._lib = None
 
     for k in ("train", "valid", "test"):
         np.testing.assert_array_equal(got[k], want[k])
@@ -344,3 +332,88 @@ def test_native_csv_python_grammar_divergences_fall_back(tmp_path):
         f.write_text(f'"";"MT_001"\n"t0";{bad}\n"t1";1,5\n')
         with pytest.raises(ValueError):
             _uci_real(str(f), num_series=1)
+
+
+def test_native_csv_randomized_parity_sweep(tmp_path):
+    """Randomized property sweep: random row counts, column counts, value
+    formats (decimal comma, scientific, signs, empty fields, short rows,
+    CRLF) — the native parse must be byte-identical to the Python loop on
+    every sample."""
+    import pytest
+
+    from lstm_tensorspark_tpu.data import native
+    from lstm_tensorspark_tpu.data.datasets import _uci_real
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.RandomState(11)
+    for case in range(8):
+        cols = int(rng.randint(1, 6))
+        n = int(rng.randint(12, 40))
+        lines = [";".join(['""'] + [f'"MT_{i}"' for i in range(cols)])]
+        for r in range(n):
+            fields = []
+            for c in range(cols):
+                style = rng.randint(0, 5)
+                v = float(rng.randn() * 10 ** rng.randint(-3, 4))
+                if style == 0:
+                    fields.append(f"{v:.6f}".replace(".", ","))
+                elif style == 1:
+                    fields.append(f"{v:.3e}".replace(".", ","))
+                elif style == 2:
+                    fields.append("")  # empty -> 0.0
+                elif style == 3:
+                    fields.append(f"+{abs(v):.2f}".replace(".", ","))
+                else:
+                    fields.append(f"{int(v)}")
+            row = f'"t{r}";' + ";".join(fields)
+            if rng.rand() < 0.1:
+                row = row.rsplit(";", 1)[0]  # short row: skipped
+            lines.append(row)
+        end = "\r\n" if case % 2 else "\n"
+        f = tmp_path / "LD2011_2014.txt"
+        f.write_bytes((end.join(lines) + end).encode())
+
+        got = _uci_real(str(f), num_series=cols)
+        with force_python_native():
+            want = _uci_real(str(f), num_series=cols)
+        for k in ("train", "valid", "test"):
+            np.testing.assert_array_equal(got[k], want[k], err_msg=f"case {case}")
+
+
+def test_uci_cr_only_line_endings_still_load(tmp_path):
+    """Classic-Mac CR-only files loaded via the text-mode loop's universal
+    newlines before the native kernel existed; the header sniff and the
+    native skip-path must preserve that (the kernel sees no \\n, parses 0
+    rows, and the text fallback handles the file as it always did)."""
+    from lstm_tensorspark_tpu.data.datasets import _uci_real
+
+    lines = ['"";"MT_001";"MT_002"'] + [
+        f'"t{i}";{i},5;{2 * i},25' for i in range(20)]
+    f = tmp_path / "LD2011_2014.txt"
+    f.write_bytes("\r".join(lines).encode() + b"\r")
+    ds = _uci_real(str(f), num_series=5)
+    assert ds["num_features"] == 2  # header sniff saw 2 columns, not 40+
+    assert len(ds["train"]) == 16
+
+
+def test_uci_mixed_line_endings_native_parity(tmp_path):
+    """A \\r-terminated header with \\n-terminated body rows: the native
+    header skip must stop at the FIRST terminator (a binary readline would
+    eat the header AND the first data row) — native == fallback."""
+    import pytest
+
+    from lstm_tensorspark_tpu.data import native
+    from lstm_tensorspark_tpu.data.datasets import _uci_real
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rows = "\n".join(f'"t{i}";{i},5;{2 * i},0' for i in range(20))
+    f = tmp_path / "LD2011_2014.txt"
+    f.write_bytes(('"";"MT_001";"MT_002"\r' + rows + "\n").encode())
+    got = _uci_real(str(f), num_series=2)
+    with force_python_native():
+        want = _uci_real(str(f), num_series=2)
+    for k in ("train", "valid", "test"):
+        np.testing.assert_array_equal(got[k], want[k])
+    assert sum(len(got[k]) for k in ("train", "valid", "test")) == 20
