@@ -226,23 +226,26 @@ def _uci_real(path: str, *, num_series: int):
     take = min(num_series, ncols) if ncols else num_series
     data = None
     if available() and take > 0:
-        # the fallback path streams line-by-line and must not hold ~700 MB
-        # of raw bytes alive alongside its row list, so the whole file is
-        # read only here, for the kernel
         with open(path, "rb") as fb:
-            raw = fb.read()
-        # skip the header up to the FIRST line terminator of any style —
-        # matching the text-mode sniff above (a binary readline would eat
-        # the first data row of a \r-header/\n-body mixed file). CR-only
-        # bodies then parse 0 rows (the kernel splits on \n) or hit the
-        # -2 sentinel, and the text fallback handles them as it always did.
-        i_r, i_n = raw.find(b"\r"), raw.find(b"\n")
-        ends = [i for i in (i_r, i_n) if i >= 0]
-        if ends:
-            i = min(ends)
-            i += 2 if raw[i:i + 2] == b"\r\n" else 1
-            data = parse_decimal_comma_csv(raw[i:], take)
-        del raw
+            # locate the end of the header in a small prefix, then seek
+            # and read ONLY the body — one copy of the ~700 MB file, for
+            # the kernel alone (the fallback path streams line-by-line).
+            # The skip stops at the FIRST line terminator of any style,
+            # matching the text-mode sniff above (a binary readline would
+            # eat the first data row of a \r-header/\n-body mixed file);
+            # CR-only bodies then parse 0 rows (the kernel splits on \n)
+            # or hit the -2 sentinel, and the text fallback handles them
+            # as it always did.
+            prefix = fb.read(1 << 20)  # headers are ~KBs; 1 MiB is ample
+            i_r, i_n = prefix.find(b"\r"), prefix.find(b"\n")
+            ends = [i for i in (i_r, i_n) if i >= 0]
+            if ends:
+                i = min(ends)
+                i += 2 if prefix[i:i + 2] == b"\r\n" else 1
+                fb.seek(i)
+                body = fb.read()
+                data = parse_decimal_comma_csv(body, take)
+                del body
     if data is not None and not len(data):
         data = None  # empty parse: let the fallback raise the format error
     if data is None:

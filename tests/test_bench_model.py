@@ -1,0 +1,63 @@
+"""CPU-testable pieces of the benchmark harness (bench.py): the
+strategy-aware implementation bound must track the runtime's own backward
+gate for every table config."""
+
+import sys
+import os
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_impl_bound_tracks_runtime_strategy_per_config():
+    """impl_bwd_strategy comes from chosen_bwd_strategy at each config's
+    layer-0 shape; the serialized pass count is layers x dirs x (1 + the
+    strategy's in-chain multiplier). Pin today's five configs so a cost-
+    model change that silently flips a plan shows up here, not only in a
+    stale BENCH_TABLE."""
+    import bench
+
+    rl = {"chain_sec": 1e-4, "chain_flops": 1e9}
+    rec = {"train_flops_step": 1e10}
+    want = {
+        "ptb_char": ("resident", 2),       # L=1, uni, stored-z bwd
+        "imdb_bilstm": ("residentx", 6),   # L=1, bi, recompute-z (T=400)
+        "wikitext2": ("tiled", 4),         # L=2, uni, U^T streamed
+        "uci_seq2seq": ("resident", 4),    # L=2 (dU hoist refit resident)
+        "wikitext103": ("tiled", 8),       # L=4, uni
+    }
+    for name, (strategy, passes) in want.items():
+        out = bench._impl_bound(name, dict(rl), rec, measured=1e-3)
+        assert out["impl_bwd_strategy"] == strategy, (name, out)
+        assert out["impl_serial_passes"] == passes, (name, out)
+        # bound = passes * chain + parallel remainder, vs UNROUNDED measured
+        parallel = max(1e10 - passes * 1e9, 0.0) / (bench.PEAK_TFLOPS * 1e12)
+        assert out["impl_bound_sec_per_step"] == pytest.approx(
+            passes * 1e-4 + parallel, abs=1.5e-6)
+
+
+def test_fail_json_contract_matches_success_metric():
+    """The wedge/liveness failure line must carry the SAME metric/unit
+    strings as the success line so the driver records a 0-value datapoint
+    of the tracked series, not an unknown metric."""
+    import json
+    import subprocess
+    import sys as _sys
+
+    out = subprocess.run(
+        [_sys.executable, "-c",
+         "import bench, os\n"
+         "os._exit = lambda c: (_ for _ in ()).throw(SystemExit(c))\n"
+         "try:\n"
+         "    bench._fail_json('test-error')\n"
+         "except SystemExit:\n"
+         "    pass\n"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "ptb_char_lstm_train_seq_per_sec_per_chip"
+    assert line["unit"] == "seq/sec"
+    assert line["value"] == 0.0
+    assert "test-error" in line["error"]

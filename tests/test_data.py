@@ -19,16 +19,23 @@ from lstm_tensorspark_tpu.data.corpus import synthetic_text
 @contextlib.contextmanager
 def force_python_native():
     """Disable the native library inside the block (and reset the load
-    cache on BOTH edges so neither direction leaks into other tests)."""
+    cache on BOTH edges so neither direction leaks into other tests).
+    Restores the operator's own LSTM_TSP_NO_NATIVE value on exit — a bare
+    del would re-enable the .so for the rest of a suite run the operator
+    launched with the variable set."""
     from lstm_tensorspark_tpu.data import native
 
+    prior = os.environ.get("LSTM_TSP_NO_NATIVE")
     os.environ["LSTM_TSP_NO_NATIVE"] = "1"
     native._load_attempted = False
     native._lib = None
     try:
         yield
     finally:
-        del os.environ["LSTM_TSP_NO_NATIVE"]
+        if prior is None:
+            del os.environ["LSTM_TSP_NO_NATIVE"]
+        else:
+            os.environ["LSTM_TSP_NO_NATIVE"] = prior
         native._load_attempted = False
         native._lib = None
 
