@@ -5,7 +5,11 @@ from .mesh import (
     make_mesh,
     slice_groups,
 )
-from .zero import make_zero1_opt_init, make_zero1_train_step
+from .zero import (
+    make_zero1_opt_init,
+    make_zero1_train_step,
+    zero1_tp_opt_specs,
+)
 from .data_parallel import make_dp_train_step, make_dp_eval_step, shard_batch
 from .sequence_parallel import sp_lstm_scan
 from .tensor_parallel import (
@@ -30,6 +34,7 @@ __all__ = [
     "make_mesh",
     "make_zero1_opt_init",
     "make_zero1_train_step",
+    "zero1_tp_opt_specs",
     "slice_groups",
     "local_device_count",
     "distributed_init",
