@@ -4,4 +4,49 @@ unlike tests/conftest.py which pins the 8-device CPU mesh).
 Run: ``python -m pytest tests_tpu/ -x -q`` on a machine with a TPU attached.
 Every module skips itself when no TPU is present, so this directory is safe
 to include in any environment.
+
+WEDGE-PROOF COLLECTION: each module's skip check calls
+``jax.default_backend()`` at import time, which blocks forever inside
+backend init when the tunneled chip is wedged (observed repeatedly on this
+environment) — a plain ``pytest tests_tpu/`` would hang before a single
+skip could fire. So this conftest first probes the backend in a SUBPROCESS
+with a hard timeout; on timeout it ignores every test module (collection
+finds nothing, the run exits in ~60 s). A cleanly-failing TPU init is NOT
+ignored here: jax falls back to CPU, the probe completes, and the modules'
+own ``default_backend() != "tpu"`` marks skip them the normal, visible way.
 """
+
+import os
+import subprocess
+import sys
+import warnings
+
+
+def _backend_init_completes(timeout_s: float = 60.0) -> bool:
+    probe = ("import jax, jax.numpy as jnp; "
+             "x = jnp.ones((8, 8)); float((x @ x).sum())")
+    child = subprocess.Popen(
+        [sys.executable, "-c", probe],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        child.wait(timeout=timeout_s)  # polls WNOHANG: D-state safe
+        return True
+    except subprocess.TimeoutExpired:
+        child.kill()
+        try:
+            child.wait(timeout=5)  # reap a normal child; bounded so a
+        except subprocess.TimeoutExpired:  # D-state one cannot block us
+            pass
+        return False
+
+
+collect_ignore_glob: list = []
+if os.environ.get("LSTM_TSP_SKIP_TPU_PROBE") != "1" and (
+        not _backend_init_completes()):
+    warnings.warn(
+        "tests_tpu: backend init did not complete within 60s — the TPU "
+        "looks WEDGED; ignoring all on-TPU test modules so collection "
+        "does not hang. Re-run when the chip recovers."
+    )
+    collect_ignore_glob = ["test_*.py"]
