@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""One-shot runner for DESIGN.md's CHIP-RECOVERY QUEUE (round-3 wedge #3).
+
+Run after the tunneled chip comes back:
+
+    python3 tools/chip_recovery.py
+
+Steps, in order (each prints its result; the script stops on the first
+failure so a regression is investigated before the table is refreshed):
+
+1. liveness probe (subprocess, 90 s — a wedged chip exits here fast);
+2. tests_tpu/ on hardware (re-validates the dU-hoist kernels on-chip);
+3. configs 2/4 throughput vs the pre-hoist baselines measured same-day on
+   the quiet chip (19,661 / 65,165 seq/s) — the dU-hoist before/after;
+4. full bench.py (K=512 headline, impl_bound roofline fields, post-hoist
+   rows) -> fresh BENCH_TABLE.json.
+
+Then regenerate the README performance table from the new BENCH_TABLE.json
+by hand (rows + K-note), per the queue's step 3.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# pre-hoist same-day baselines (quiet chip); regression = materially below
+_BASELINES = {"imdb_bilstm": 19661.0, "uci_seq2seq": 65165.0}
+
+
+def _run(argv, timeout, label):
+    print(f"== {label}", flush=True)
+    try:
+        rc = subprocess.run(argv, cwd=_DIR, timeout=timeout).returncode
+    except subprocess.TimeoutExpired:
+        print(f"FAIL: {label} exceeded {timeout}s (chip wedged again?)")
+        sys.exit(2)
+    if rc != 0:
+        print(f"FAIL: {label} rc={rc}")
+        sys.exit(rc)
+
+
+def main() -> int:
+    _run([sys.executable, "-c",
+          "import jax, jax.numpy as jnp; "
+          "x = jnp.ones((128, 128)); print(float((x @ x).sum()))"],
+         timeout=90, label="liveness probe")
+    _run([sys.executable, "-m", "pytest", "tests_tpu/", "-q"],
+         timeout=900, label="tests_tpu on hardware")
+
+    print("== configs 2/4 throughput (dU-hoist before/after)", flush=True)
+    regressed = []
+    for name, base in _BASELINES.items():
+        # subprocess + timeout like every other step: a chip that passes
+        # the probe can STILL wedge mid-measurement (a jit dispatch that
+        # never returns), and bench's watchdog only arms in bench.main()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import json, bench; "
+                 f"r = bench.measure_config({name!r}); "
+                 "print(json.dumps(r))"],
+                cwd=_DIR, timeout=900, capture_output=True, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"FAIL: measure_config({name}) exceeded 900s "
+                  "(chip wedged again?)")
+            return 2
+        if out.returncode != 0:
+            print(f"FAIL: measure_config({name}) rc={out.returncode}:\n"
+                  f"{out.stderr[-1000:]}")
+            return out.returncode
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        got = rec["seq_per_sec"]
+        delta = (got / base - 1.0) * 100.0
+        print(f"{name}: {got:,.0f} seq/s vs pre-hoist {base:,.0f} "
+              f"({delta:+.1f}%), MFU {rec['mfu_vs_bf16_peak']:.1%}")
+        if got < 0.97 * base:  # >3% below: not chip noise — investigate
+            regressed.append(name)
+    if regressed:
+        print(f"FAIL: regression vs pre-hoist baselines on {regressed}; "
+              "investigate before refreshing the table (DESIGN.md queue "
+              "step 4)")
+        return 3
+
+    _run([sys.executable, "bench.py"], timeout=2700, label="full bench.py")
+    table = json.load(open(os.path.join(_DIR, "BENCH_TABLE.json")))
+    print(f"fresh table: headline {table['headline_seq_per_sec']:,.0f} "
+          f"seq/s, {table['vs_cpu_baseline']:.0f}x CPU")
+    print("NOW: regenerate the README performance table from "
+          "BENCH_TABLE.json and commit both (queue step 3).")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
