@@ -416,55 +416,92 @@ def measure_roofline(name: str, *, chains: int = 256, reps: int = 3) -> dict:
     }
 
 
+def _config_scans(name: str) -> list:
+    """(T, input_width, has_mask) for EVERY sequential scan one optimizer
+    step of this config runs — the per-scan inventory `_impl_bound` plans
+    over. LM: embed output (width H) feeds layer 0, H feeds deeper layers
+    (models/lstm_lm.py). Classifier: two directions per layer; embed
+    (width H) feeds layer 0, the 2H direction-concat feeds deeper layers
+    (models/classifier.py:61). Seq2seq: encoder scans at T then decoder
+    scans at horizon, F feeding both layer 0s (models/seq2seq.py:48-51)."""
+    c = CONFIGS[name]
+    kind, H_, L_ = c["kind"], c["H"], c["L"]
+    if kind == "lm":
+        return [(c["T"], H_, False)] * L_
+    if kind == "classifier":
+        scans = []
+        for layer in range(L_):
+            D = H_ if layer == 0 else 2 * H_
+            scans += [(c["T"], D, True)] * 2  # fwd + reversed directions
+        return scans
+    if kind == "seq2seq":
+        def width(layer):
+            return c["F"] if layer == 0 else H_
+        return ([(c["T"], width(l), False) for l in range(L_)]
+                + [(c["horizon"], width(l), False) for l in range(L_)])
+    raise ValueError(kind)
+
+
 def _impl_bound(name: str, rl: dict, rec: dict, measured: float) -> dict:
     """Strategy-aware serialized-chain bound for one measured config.
 
-    Counts the sequential-kernel passes THIS implementation runs per
-    optimizer step, each costing ~chain_sec (every in-chain MXU op —
-    ``h@U``, z recompute, ``dz@U^T`` — moves the same 8BH² FLOPs per
-    step, so chain latency is the right unit): layers × directions
-    forward, times the backward strategy's in-chain multiplier. dU/dW/dxs
-    are OUTSIDE the chain (contracted from streamed dz) and so stay in
-    the parallel term. ``measured`` is the UNROUNDED s/step (the rounded
-    copy in ``rl`` would skew the fraction by up to 0.6% at config-1
-    step times). The strategy label comes from the runtime's own
-    `chosen_bwd_strategy` evaluated at the LAYER-0 scan's shape — the
-    same gate the runtime runs, but ONE label for all L×dirs scans. The
-    five table configs are homogeneous today (L=1, or Dp=None where
-    deeper layers share the no-xproj shape); a future config whose
-    deeper layers plan differently (e.g. a stacked classifier at
-    T >= _FUSEDX_MIN_T, whose layer-1 input width is 2H) would need a
-    per-scan derivation here before the single label is trustworthy."""
+    Counts the sequential in-chain steps THIS implementation runs per
+    optimizer step, each costing ~chain_sec/T_chain (every in-chain MXU
+    op — ``h@U``, z recompute, ``dz@U^T`` — moves the same 8BH² FLOPs
+    per step, so per-step chain latency is the right unit): each scan
+    contributes its OWN length times (1 + its backward strategy's
+    in-chain multiplier). dU/dW/dxs are OUTSIDE the chain (contracted
+    from streamed dz) and so stay in the parallel term. ``measured`` is
+    the UNROUNDED s/step (the rounded copy in ``rl`` would skew the
+    fraction by up to 0.6% at config-1 step times).
+
+    Per-scan derivation (ADVICE r3): the strategy comes from the
+    runtime's own `chosen_bwd_strategy` evaluated at EACH scan's
+    (T, input width) — a heterogeneous config (seq2seq's short-horizon
+    decoder, a stacked classifier whose layer-1 input is 2H) no longer
+    inherits the layer-0 label. When every scan plans the same strategy
+    the legacy `impl_bwd_strategy` string is that name; otherwise it is
+    "mixed" and `impl_bwd_strategies` carries the per-strategy scan
+    counts."""
     from lstm_tensorspark_tpu.ops.pallas_lstm import (
         _FUSEDX_MIN_T, _pad_to_lane, chosen_bwd_strategy,
     )
 
     c = CONFIGS[name]
-    B_, H_, L_, T_ = c["B"], c["H"], c["L"], c["T"]
+    B_, H_ = c["B"], c["H"]
     kind = c["kind"]
-    dirs = 2 if kind == "classifier" else 1  # the bi-LSTM runs both
-    has_mask = kind == "classifier"
-    D = c.get("F", H_)  # layer-0 input width: embed defaults to hidden
     Hp = _pad_to_lane(H_)
-    Dp = _pad_to_lane(D) if T_ >= _FUSEDX_MIN_T else None
     # pbytes from the config's compute dtype, exactly as the runtime gate
     # derives it from the fused kernel dtype (all table configs are bf16
     # today; an f32 row would flip the VMEM plans at 4 bytes)
     pbytes = 2 if c.get("compute_dtype", "bfloat16") == "bfloat16" else 4
-    strategy = chosen_bwd_strategy(B_, T_, Hp, pbytes,
-                                   has_mask=has_mask, Dp=Dp)
-    mult = {"residentx": 2, "resident": 1, "tiled": 1, "recompute": 2}[strategy]
-    passes = L_ * dirs * (1 + mult)
+    MULT = {"residentx": 2, "resident": 1, "tiled": 1, "recompute": 2}
+    serial_steps = 0
+    strategy_counts: dict = {}
+    for T_s, D_s, has_mask in _config_scans(name):
+        Dp = _pad_to_lane(D_s) if T_s >= _FUSEDX_MIN_T else None
+        s = chosen_bwd_strategy(B_, T_s, Hp, pbytes,
+                                has_mask=has_mask, Dp=Dp)
+        serial_steps += T_s * (1 + MULT[s])
+        strategy_counts[s] = strategy_counts.get(s, 0) + 1
+    # chain-latency units: the roofline's chain covers T_chain steps
+    T_chain = c["T"] + (c["horizon"] if kind == "seq2seq" else 0)
+    passes = serial_steps / T_chain
     parallel = max(
         rec["train_flops_step"] - passes * rl["chain_flops"], 0.0
     ) / (PEAK_TFLOPS * 1e12)
     bound = passes * rl["chain_sec"] + parallel
-    return {
-        "impl_serial_passes": passes,
-        "impl_bwd_strategy": strategy,
+    out = {
+        "impl_serial_steps": serial_steps,
+        "impl_serial_passes": round(passes, 4),
+        "impl_bwd_strategy": (next(iter(strategy_counts))
+                              if len(strategy_counts) == 1 else "mixed"),
         "impl_bound_sec_per_step": round(bound, 6),
         "fraction_of_impl_bound": round(bound / measured, 4),
     }
+    if len(strategy_counts) > 1:
+        out["impl_bwd_strategies"] = strategy_counts
+    return out
 
 
 def measure_generation(*, new_tokens: int = 512, batch: int = 64,

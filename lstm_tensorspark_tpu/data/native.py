@@ -158,7 +158,11 @@ def parse_decimal_comma_csv(body: bytes, take: int) -> np.ndarray | None:
     lib = _load()
     if lib is None or take <= 0:
         return None
-    max_rows = body.count(b"\n") + 1
+    # capacity bound must count every terminator the kernel honors:
+    # '\n', lone '\r', and '\r\n' (which would be double-counted by the
+    # two substring counts, hence the subtraction)
+    max_rows = (body.count(b"\n") + body.count(b"\r")
+                - body.count(b"\r\n") + 1)
     out = np.empty((max_rows, take), np.float32)
     rows = lib.csv_decimal_comma(
         body, len(body), take,

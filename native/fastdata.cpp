@@ -166,9 +166,17 @@ int64_t csv_decimal_comma(const char* buf, int64_t len, int32_t take,
   char field[64];
   while (i < len) {
     const int64_t line_start = i;
-    while (i < len && buf[i] != '\n') ++i;
-    const int64_t line_end = i;  // excl. '\n'
-    if (i < len) ++i;            // skip '\n'
+    // Universal-newline row structure, matching the Python fallback's
+    // text-mode read: '\n', '\r\n', and LONE '\r' all terminate a line
+    // (ADVICE r3: '\n'-only splitting diverged on stray '\r's, and a
+    // CRLF row with an empty last field carried a '\r' into the field,
+    // kicking the whole file onto the slow path).
+    while (i < len && buf[i] != '\n' && buf[i] != '\r') ++i;
+    const int64_t line_end = i;  // excl. terminator
+    if (i < len) {               // skip terminator ('\r\n' counts as one)
+      if (buf[i] == '\r' && i + 1 < len && buf[i + 1] == '\n') i += 2;
+      else ++i;
+    }
     // count fields (separator count + 1 on a non-empty split result —
     // Python "".split(";") -> [""] has 1 field)
     int64_t nfields = 1;
@@ -185,7 +193,8 @@ int64_t csv_decimal_comma(const char* buf, int64_t len, int32_t take,
       while (q < line_end && buf[q] != ';') ++q;
       const int64_t raw_flen = q - p;
       int64_t flen = raw_flen;
-      // strip whitespace the way float() does (incl. the \r of CRLF rows)
+      // strip whitespace the way float() does (CRLF '\r' never reaches a
+      // field now — lines terminate on it — this handles in-field blanks)
       while (flen > 0 && is_ws(buf[p])) { ++p; --flen; }
       while (flen > 0 && is_ws(buf[p + flen - 1])) --flen;
       float v = 0.0f;

@@ -37,6 +37,28 @@ def test_impl_bound_tracks_runtime_strategy_per_config():
             passes * 1e-4 + parallel, abs=1.5e-6)
 
 
+def test_impl_bound_heterogeneous_scans_report_mixed(monkeypatch):
+    """ADVICE r3: a config whose scans plan DIFFERENT strategies must not
+    inherit the layer-0 label. A long-context seq2seq (encoder T >= the
+    fusedx threshold, horizon 24) plans residentx encoders + resident
+    decoders: the label goes 'mixed', per-strategy counts are published,
+    and the serialized steps weight each scan by its own length."""
+    import bench
+
+    cfgs = dict(bench.CONFIGS)
+    cfgs["long_seq2seq"] = dict(kind="seq2seq", F=370, H=256, L=2, B=64,
+                                T=300, horizon=24)
+    monkeypatch.setattr(bench, "CONFIGS", cfgs)
+    out = bench._impl_bound(
+        "long_seq2seq", {"chain_sec": 1e-4, "chain_flops": 1e9},
+        {"train_flops_step": 1e10}, measured=1e-3)
+    assert out["impl_bwd_strategy"] == "mixed"
+    assert out["impl_bwd_strategies"] == {"residentx": 2, "resident": 2}
+    # 2 encoder scans: 300*(1+2); 2 decoder scans: 24*(1+1)
+    assert out["impl_serial_steps"] == 2 * 300 * 3 + 2 * 24 * 2
+    assert out["impl_serial_passes"] == pytest.approx(1896 / 324, abs=1e-4)
+
+
 def test_fail_json_contract_matches_success_metric():
     """The wedge/liveness failure line must carry the SAME metric/unit
     strings as the success line so the driver records a 0-value datapoint

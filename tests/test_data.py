@@ -310,6 +310,61 @@ def test_native_csv_decimal_comma_parity(tmp_path):
     assert total == 5
 
 
+def test_native_csv_crlf_empty_last_field_stays_native(tmp_path):
+    """ADVICE r3: a CRLF row whose LAST field is empty ('...;\\r\\n') must
+    parse natively as 0.0 — previously the '\\r' landed inside the field,
+    the kernel returned -2, and the entire file silently re-parsed on the
+    slow Python path."""
+    import pytest
+
+    from lstm_tensorspark_tpu.data import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    body = b'"t0";1,5;\r\n"t1";2,5;3,0\r\n'
+    got = native.parse_decimal_comma_csv(body, 2)
+    assert got is not None, "CRLF empty-last-field row fell off the fast path"
+    np.testing.assert_array_equal(
+        got, np.array([[1.5, 0.0], [2.5, 3.0]], np.float32))
+
+
+def test_native_csv_lone_cr_universal_newline_parity(tmp_path):
+    """ADVICE r3: a LONE '\\r' is a line terminator in the Python
+    fallback's text-mode read; the kernel must see the same row structure
+    so load behavior doesn't depend on whether the .so is present."""
+    import pytest
+
+    from lstm_tensorspark_tpu.data import native
+    from lstm_tensorspark_tpu.data.datasets import _uci_real
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    # direct kernel check: '\r' splits rows exactly like '\n' and '\r\n'
+    body = b'"t0";1,5;2,0\r"t1";3,5;4,0\n"t2";5,5;6,0\r\n"t3";7,5;8,0'
+    got = native.parse_decimal_comma_csv(body, 2)
+    assert got is not None
+    np.testing.assert_array_equal(
+        got,
+        np.array([[1.5, 2.0], [3.5, 4.0], [5.5, 6.0], [7.5, 8.0]],
+                 np.float32))
+
+    # end-to-end parity through the loader, mixed terminators incl. the
+    # ADVICE example shape (stray '\r' creating an extra short row)
+    header = '"";"MT_001";"MT_002"'
+    rows = ['"t0";1,5;2,0', '"t1";3,5;4,0', '"t2";5,5;6,0',
+            '"t3";7,5;8,0', '"t4";9,5;10,0']
+    f = tmp_path / "LD2011_2014.txt"
+    f.write_bytes((header + "\n" + rows[0] + "\r" + rows[1] + "\r\n"
+                   + rows[2] + "\r" + "\r" + rows[3] + "\n"
+                   + rows[4] + "\r").encode())
+    got = _uci_real(str(f), num_series=2)
+    with force_python_native():
+        want = _uci_real(str(f), num_series=2)
+    for k in ("train", "valid", "test"):
+        np.testing.assert_array_equal(got[k], want[k])
+    assert sum(len(got[k]) for k in ("train", "valid", "test")) == 5
+
+
 def test_native_csv_garbage_falls_back_to_python_error(tmp_path):
     """A value float() would reject makes the C kernel return -2; the
     loader falls back to the pure loop, which raises the SAME ValueError
