@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from ..ops.embedding import embed_lookup
 from ..ops.lstm_cell import init_lstm_params
 from ..ops.masking import dropout, sequence_mask
-from ..ops.scan import auto_lstm_scan
+from ..ops.scan import bidir_lstm_scan
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,12 +85,10 @@ def classifier_forward(
     xs = embed_lookup(params["embedding"], tokens)
     h_fwd = h_bwd = None
     for i, (pf, pb) in enumerate(zip(params["fwd"], params["bwd"])):
-        (h_fwd, _), ys_f = auto_lstm_scan(
-            pf, xs, mask=mask, compute_dtype=cdtype,
-            remat_chunk=cfg.remat_chunk, use_pallas=cfg.use_pallas,
-        )
-        (h_bwd, _), ys_b = auto_lstm_scan(
-            pb, xs, mask=mask, reverse=True, compute_dtype=cdtype,
+        # both directions in one dispatch: the stacked-direction fused
+        # kernel when its plan fits, else two auto_lstm_scan calls
+        ((h_fwd, _), ys_f), ((h_bwd, _), ys_b) = bidir_lstm_scan(
+            pf, pb, xs, mask=mask, compute_dtype=cdtype,
             remat_chunk=cfg.remat_chunk, use_pallas=cfg.use_pallas,
         )
         xs = jnp.concatenate([ys_f, ys_b], axis=-1)

@@ -6,7 +6,8 @@ from .lstm_cell import (
     lstm_step_unfused,
 )
 from .embedding import embed_lookup, selected_logits
-from .scan import auto_lstm_scan, lstm_scan, stacked_lstm_scan
+from .scan import (auto_lstm_scan, bidir_lstm_scan, lstm_scan,
+                   stacked_lstm_scan)
 from .masking import sequence_mask, masked_mean, reverse_sequences
 
 __all__ = [
@@ -16,6 +17,7 @@ __all__ = [
     "lstm_step",
     "lstm_step_unfused",
     "auto_lstm_scan",
+    "bidir_lstm_scan",
     "embed_lookup",
     "selected_logits",
     "lstm_scan",

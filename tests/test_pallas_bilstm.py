@@ -1,0 +1,168 @@
+"""Stacked-direction bi-LSTM kernel (ops/pallas_bilstm.py): interpret-mode
+parity on CPU against the two-call reference (`lstm_scan` forward +
+reverse), gradients through the custom VJP, masked variable-length
+batches, lane padding, and the `bidir_lstm_scan` dispatch gate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lstm_tensorspark_tpu.ops import init_lstm_params, lstm_scan
+from lstm_tensorspark_tpu.ops.pallas_bilstm import (
+    bilstm_supported, pallas_bilstm_scan,
+)
+from lstm_tensorspark_tpu.ops.scan import bidir_lstm_scan
+
+B, T, D, H = 8, 10, 16, 128
+
+
+def _setup(h=H, d=D):
+    pf = init_lstm_params(jax.random.PRNGKey(0), d, h)
+    pb = init_lstm_params(jax.random.PRNGKey(1), d, h)
+    xs = jax.random.normal(jax.random.PRNGKey(2), (B, T, d))
+    return pf, pb, xs
+
+
+def _reference(pf, pb, xs, mask=None):
+    out_f = lstm_scan(pf, xs, mask=mask)
+    out_b = lstm_scan(pb, xs, mask=mask, reverse=True)
+    return out_f, out_b
+
+
+def _assert_pair_close(got, want, **kw):
+    (gc, gys), (wc, wys) = got[0], want[0]
+    np.testing.assert_allclose(gys, wys, **kw)
+    np.testing.assert_allclose(gc[0], wc[0], **kw)
+    np.testing.assert_allclose(gc[1], wc[1], **kw)
+    (gc, gys), (wc, wys) = got[1], want[1]
+    np.testing.assert_allclose(gys, wys, **kw)
+    np.testing.assert_allclose(gc[0], wc[0], **kw)
+    np.testing.assert_allclose(gc[1], wc[1], **kw)
+
+
+def test_forward_parity():
+    pf, pb, xs = _setup()
+    got = pallas_bilstm_scan(pf, pb, xs, interpret=True)
+    want = _reference(pf, pb, xs)
+    _assert_pair_close(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_masked_parity():
+    """Right-padded variable lengths: the reverse rows walk padding first
+    with a frozen zero carry — final states must equal the two-call
+    reference's reversed masked scan."""
+    pf, pb, xs = _setup()
+    lengths = jnp.array([10, 7, 3, 1, 10, 5, 8, 2])
+    mask = jnp.arange(T)[None, :] < lengths[:, None]
+    got = pallas_bilstm_scan(pf, pb, xs, mask=mask, interpret=True)
+    want = _reference(pf, pb, xs, mask=mask)
+    _assert_pair_close(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_grad_parity():
+    """All cotangent paths at once — ys of both directions, final carries
+    of both directions — through the stacked custom VJP vs the reference
+    BPTT, for BOTH directions' params and xs."""
+    pf, pb, xs = _setup()
+    lengths = jnp.array([10, 7, 3, 1, 10, 5, 8, 2])
+    mask = jnp.arange(T)[None, :] < lengths[:, None]
+
+    def loss(run):
+        def f(pf, pb, xs):
+            ((hf, cf), ysf), ((hb, cb), ysb) = run(pf, pb, xs)
+            return (jnp.mean(ysf ** 2) + 2.0 * jnp.mean(ysb ** 2)
+                    + jnp.mean(hf * 0.5) + jnp.mean(cf ** 2)
+                    + jnp.mean(hb ** 2) + jnp.mean(cb * 0.25))
+        return f
+
+    run_p = lambda pf, pb, xs: pallas_bilstm_scan(  # noqa: E731
+        pf, pb, xs, mask=mask, interpret=True)
+    run_r = lambda pf, pb, xs: _reference(pf, pb, xs, mask=mask)  # noqa: E731
+    g1 = jax.grad(loss(run_p), argnums=(0, 1, 2))(pf, pb, xs)
+    g2 = jax.grad(loss(run_r), argnums=(0, 1, 2))(pf, pb, xs)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5),
+        g1, g2,
+    )
+
+
+def test_unmasked_grad_parity():
+    pf, pb, xs = _setup()
+
+    def loss(run):
+        def f(pf, pb, xs):
+            ((hf, _), ysf), ((_, cb), ysb) = run(pf, pb, xs)
+            return jnp.mean(ysf ** 2) + jnp.mean(ysb ** 2) + jnp.mean(hf + cb)
+        return f
+
+    run_p = lambda pf, pb, xs: pallas_bilstm_scan(  # noqa: E731
+        pf, pb, xs, interpret=True)
+    run_r = lambda pf, pb, xs: _reference(pf, pb, xs)  # noqa: E731
+    g1 = jax.grad(loss(run_p), argnums=(0, 1, 2))(pf, pb, xs)
+    g2 = jax.grad(loss(run_r), argnums=(0, 1, 2))(pf, pb, xs)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5),
+        g1, g2,
+    )
+
+
+def test_lane_padded_hidden():
+    """H=100 pads to 128 internally; outputs slice back exactly."""
+    pf, pb, xs = _setup(h=100)
+    got = pallas_bilstm_scan(pf, pb, xs, interpret=True)
+    want = _reference(pf, pb, xs)
+    _assert_pair_close(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_compute_parity():
+    """bf16 matmuls, f32 state — matches the reference scan at the same
+    compute dtype to bf16-scale tolerance."""
+    pf, pb, xs = _setup()
+    got = pallas_bilstm_scan(pf, pb, xs, compute_dtype=jnp.bfloat16,
+                             interpret=True)
+    out_f = lstm_scan(pf, xs, compute_dtype=jnp.bfloat16)
+    out_b = lstm_scan(pb, xs, compute_dtype=jnp.bfloat16, reverse=True)
+    _assert_pair_close(got, (out_f, out_b), rtol=2e-2, atol=2e-2)
+
+
+def test_supported_gating():
+    # CPU: never (real kernel path only; interpret is explicit in tests)
+    assert not bilstm_supported(64, 256, 256, 400, platform="cpu",
+                                param_dtype_bytes=2, has_mask=True)
+    # config 2's exact shape on TPU: supported
+    assert bilstm_supported(64, 256, 256, 400, platform="tpu",
+                            param_dtype_bytes=2, has_mask=True)
+    # short sequences keep the single-direction hoisted-xproj kernels
+    assert not bilstm_supported(64, 256, 256, 64, platform="tpu",
+                                param_dtype_bytes=2, has_mask=True)
+    # sublane misalignment
+    assert not bilstm_supported(7, 256, 256, 400, platform="tpu",
+                                param_dtype_bytes=2, has_mask=True)
+
+
+def test_dispatch_falls_back_off_tpu():
+    """On the CPU mesh `bidir_lstm_scan` must take the two-call fallback
+    (bilstm_supported is platform-gated) and agree with the reference."""
+    pf, pb, xs = _setup()
+    got = bidir_lstm_scan(pf, pb, xs, use_pallas=True)
+    want = _reference(pf, pb, xs)
+    _assert_pair_close(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_env_disable_lever(monkeypatch):
+    """LSTM_TSP_NO_BIDIR_FUSE=1 must short-circuit the stacked path even
+    where it would be supported (A/B lever). Exercised by making
+    bilstm_supported explode if consulted."""
+    import lstm_tensorspark_tpu.ops.scan as scan_mod
+
+    monkeypatch.setenv("LSTM_TSP_NO_BIDIR_FUSE", "1")
+
+    def boom(*a, **k):  # pragma: no cover - would fail the test if called
+        raise AssertionError("stacked path consulted despite disable lever")
+
+    monkeypatch.setattr(
+        "lstm_tensorspark_tpu.ops.pallas_bilstm.bilstm_supported", boom)
+    pf, pb, xs = _setup()
+    got = scan_mod.bidir_lstm_scan(pf, pb, xs, use_pallas=True)
+    want = _reference(pf, pb, xs)
+    _assert_pair_close(got, want, rtol=1e-6, atol=1e-6)
