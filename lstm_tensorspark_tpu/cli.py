@@ -208,7 +208,9 @@ def main(argv=None) -> int:
         raise SystemExit(f"--eval-batches must be >= 1, got {args.eval_batches}")
     # one shared gate for every task runner: the fused kernel cannot run on
     # a "model"-axis-sharded hidden dim (GSPMD cannot partition pallas_call);
-    # it DOES compose with --pipeline-stages (collective-free stage interiors)
+    # it DOES compose with --pipeline-stages AND --seq-parallel (their
+    # wavefront bodies are collective-free per chunk; both steps make every
+    # mesh axis manual when the kernel is live)
     if args.use_pallas and args.tensor_parallel > 1:
         raise SystemExit("--use-pallas is not supported with --tensor-parallel "
                          "(the GSPMD-sharded hidden dim cannot enter the fused "
@@ -984,10 +986,11 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     if pp > 1 and sp > 1:
         raise SystemExit("--pipeline-stages cannot combine with --seq-parallel "
                          "(both schedule the wavefront; tp composes with either)")
-    if args.use_pallas and sp > 1:
-        raise SystemExit("--use-pallas is not supported with --seq-parallel "
-                         "(the wavefront splits the time axis the kernel "
-                         "needs whole); it composes with --pipeline-stages")
+    # --use-pallas composes with --seq-parallel since r4: each wavefront
+    # chunk runs the fused kernel at the local [b, T/S, D] shard (no
+    # collectives inside a chunk; the step's shard_map goes all-manual —
+    # parallel/train_step.py). The remaining exclusion is TP, already
+    # rejected by the shared gate above (GSPMD cannot partition the kernel).
     if args.microbatches is not None and args.microbatches < 1:
         raise SystemExit(f"--microbatches must be >= 1, got {args.microbatches}")
     n = jax.device_count()

@@ -52,6 +52,8 @@ def sp_lstm_scan(
     remat_chunk: int | None = None,
     unroll: int = 1,
     uniform: bool = False,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ) -> jax.Array:
     """Wavefront LSTM scan over a sequence-sharded batch.
 
@@ -59,7 +61,19 @@ def sp_lstm_scan(
     ``xs_local`` is this device's time-chunk ``[B, C, D]`` (C = T/S).
     Returns the local outputs ``ys`` ``[B, C, H]`` (hidden per local step).
     Zero initial carry (sequence starts on device 0).
-    """
+
+    ``use_pallas`` runs each local chunk through the fused kernel
+    (ops/pallas_lstm.py) at the per-microbatch shard shape [b, C, D] —
+    legal with the SAME condition as the PP wavefront (VERDICT r3 item
+    4): the chunk contains no collectives (the only inter-device traffic
+    is the carry ppermute between ticks), so the kernel sits entirely in
+    this device's manual shard — but the caller's shard_map must make
+    EVERY mesh axis manual (Mosaic refuses a pallas_call under a
+    partially-manual shard_map), which make_sharded_lm_train_step does
+    exactly when "model" is unused. Falls back to the plain scan when
+    the kernel's cost model rejects the shard shape.
+    ``pallas_interpret`` forces the kernel in interpret mode (CPU parity
+    tests of the kernel-in-wavefront composition)."""
     S = lax.axis_size(axis)
     s = lax.axis_index(axis)
     B, C, _ = xs_local.shape
@@ -69,9 +83,23 @@ def sp_lstm_scan(
     b = B // M
     H = params.hidden_size
     fused = fuse_params(params, compute_dtype=compute_dtype)
+    use_kernel = False
+    if use_pallas:
+        from ..ops.pallas_lstm import pallas_lstm_scan, supported
+
+        pbytes = 2 if compute_dtype == jnp.bfloat16 else 4
+        use_kernel = pallas_interpret or supported(
+            b, H, param_dtype_bytes=pbytes)
 
     def chunk_scan(carry, x_chunk):
         """One microbatch's pass over the local chunk: [b, C, D] -> [b, C, H]."""
+        if use_kernel:
+            new_carry, ys = pallas_lstm_scan(
+                params, x_chunk, carry, compute_dtype=compute_dtype,
+                remat_chunk=remat_chunk, unroll=unroll,
+                interpret=pallas_interpret,
+            )
+            return new_carry, ys
         xs_t = jnp.moveaxis(x_chunk, 0, 1)  # [C, b, D]
 
         def step(c, x):

@@ -33,7 +33,8 @@ from .tensor_parallel import lm_param_specs
 
 
 def sp_lm_loss(params, batch, cfg: LMConfig, *, seq_axis: str = "seq",
-               microbatches: int = 1, dropout_rng=None):
+               microbatches: int = 1, dropout_rng=None,
+               use_pallas: bool = False):
     """LM loss over a sequence-sharded batch (called inside shard_map).
 
     batch: {"inputs","targets"} each [b_local, C] (B sharded over "data",
@@ -59,6 +60,9 @@ def sp_lm_loss(params, batch, cfg: LMConfig, *, seq_axis: str = "seq",
             # "model" is an auto axis here: GSPMD inserts TP collectives
             # inside the scan, so ticks must execute in lockstep
             uniform=True,
+            # fused kernel per local chunk — only when the caller made
+            # every mesh axis manual (no TP; see make_sharded_lm_train_step)
+            use_pallas=use_pallas,
         )
         if use_dropout and idx < n - 1:
             from ..ops.masking import dropout_with_key
@@ -95,8 +99,11 @@ def make_sharded_lm_eval_step(
     deterministic; loss pmean'd over the manual axes; reports the global
     token count so evaluate() token-weights exactly."""
 
+    use_pallas = cfg.use_pallas and mesh.shape.get("model", 1) == 1
+
     def eval_body(params, batch):
-        loss, _ = sp_lm_loss(params, batch, cfg, microbatches=microbatches)
+        loss, _ = sp_lm_loss(params, batch, cfg, microbatches=microbatches,
+                             use_pallas=use_pallas)
         loss = jax.lax.pmean(loss, ("data", "seq"))
         tokens = jax.lax.psum(
             jnp.asarray(batch["targets"].size, jnp.float32), ("data", "seq")
@@ -108,7 +115,11 @@ def make_sharded_lm_eval_step(
         mesh=mesh,
         in_specs=(P(), {"inputs": P("data", "seq"), "targets": P("data", "seq")}),
         out_specs=P(),
-        axis_names={"data", "seq"},
+        # Mosaic refuses a pallas_call inside a PARTIALLY-manual shard_map;
+        # with the fused kernel live (no TP ⇒ "model"/"pipe" are size 1)
+        # make every mesh axis manual — semantically identical, Mosaic-legal
+        # (the same trick as the PP wavefront, pipeline_parallel.py).
+        axis_names=(set(mesh.axis_names) if use_pallas else {"data", "seq"}),
         check_vma=False,
     )
     param_shardings = jax.tree.map(
@@ -135,11 +146,16 @@ def make_sharded_lm_train_step(
     """Build the DP x TP x SP train step. Batch: {"inputs","targets"} [B, T]
     with B % (data axis) == 0 and T % (seq axis) == 0."""
 
-    manual = {"data", "seq"}
+    use_pallas = cfg.use_pallas and mesh.shape.get("model", 1) == 1
+    # all-manual when the fused kernel is live (Mosaic refuses pallas_call
+    # under a partially-manual shard_map; "model"/"pipe" are size 1 here so
+    # the program is semantically identical) — the PP wavefront's trick
+    manual = set(mesh.axis_names) if use_pallas else {"data", "seq"}
 
     def loss_fn(params, batch, rng):
         return sp_lm_loss(
             params, batch, cfg, microbatches=microbatches, dropout_rng=rng,
+            use_pallas=use_pallas,
         )
 
     def body(state: TrainState, batch):

@@ -86,3 +86,93 @@ def test_sp_with_remat(setup):
     ys_sp = fn(params, xs)
     _, ys = lstm_scan(params, xs)
     np.testing.assert_allclose(ys_sp, ys, rtol=1e-5, atol=1e-6)
+
+
+def test_sp_pallas_interpret_matches_serial():
+    """The fused kernel INSIDE the wavefront (VERDICT r3 item 4): each
+    device's chunk runs pallas_lstm_scan (interpret mode on CPU) with the
+    carry handed between devices via ppermute — outputs must match the
+    serial scan exactly like the plain-scan wavefront does."""
+    params = init_lstm_params(jax.random.PRNGKey(2), D, 128)
+    xs = jax.random.normal(jax.random.PRNGKey(3), (8, T, D))
+    mesh = make_mesh(dp=1, tp=1, sp=8)
+    fn = jax.jit(
+        shard_map(
+            lambda p, x: sp_lstm_scan(p, x, microbatches=1, use_pallas=True,
+                                      pallas_interpret=True),
+            mesh=mesh,
+            in_specs=(P(), P(None, "seq")),
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        )
+    )
+    ys_sp = fn(params, xs)
+    _, ys = lstm_scan(params, xs)
+    np.testing.assert_allclose(ys_sp, ys, rtol=1e-5, atol=1e-5)
+
+
+def test_sp_pallas_interpret_grads_match_serial():
+    """BPTT through kernel-chunk wavefront: the custom VJP runs per chunk
+    and the carry cotangents ride the transposed ppermute chain."""
+    params = init_lstm_params(jax.random.PRNGKey(4), D, 128)
+    xs = jax.random.normal(jax.random.PRNGKey(5), (8, T, D))
+    mesh = make_mesh(dp=1, tp=1, sp=8)
+
+    def sp_loss(p, x):
+        ys = shard_map(
+            lambda p_, x_: sp_lstm_scan(p_, x_, microbatches=2,
+                                        use_pallas=True,
+                                        pallas_interpret=True),
+            mesh=mesh,
+            in_specs=(P(), P(None, "seq")),
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        )(p, x)
+        return jnp.mean(ys**2)
+
+    def serial_loss(p, x):
+        _, ys = lstm_scan(p, x)
+        return jnp.mean(ys**2)
+
+    l1, g1 = jax.value_and_grad(sp_loss)(params, xs)
+    l2, g2 = jax.value_and_grad(serial_loss)(params, xs)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b_: np.testing.assert_allclose(a, b_, rtol=2e-4, atol=1e-5),
+        g1, g2,
+    )
+
+
+def test_sp_train_step_all_manual_with_pallas_cfg():
+    """make_sharded_lm_train_step with cfg.use_pallas=True (no TP) goes
+    ALL-manual (every mesh axis) — on the CPU mesh the kernel itself
+    falls back per the platform gate, so this checks the all-manual
+    shard_map construction compiles and matches the partially-manual
+    program step for step."""
+    import optax
+
+    from lstm_tensorspark_tpu.models import LMConfig, init_lm
+    from lstm_tensorspark_tpu.parallel.train_step import (
+        make_sharded_lm_train_step,
+    )
+    from lstm_tensorspark_tpu.train.loop import init_train_state
+
+    mesh = make_mesh(dp=4, tp=1, sp=2)
+    data = jax.random.randint(jax.random.PRNGKey(6), (8, 33), 0, 50)
+    batch = {"inputs": data[:, :-1], "targets": data[:, 1:]}
+
+    def run(use_pallas):
+        cfg = LMConfig(vocab_size=50, hidden_size=16, num_layers=1,
+                       use_pallas=use_pallas)
+        params = init_lm(jax.random.PRNGKey(7), cfg)
+        opt = optax.sgd(0.3)
+        step = make_sharded_lm_train_step(cfg, opt, mesh, params,
+                                          microbatches=2, donate=False)
+        state = init_train_state(params, opt, jax.random.PRNGKey(8))
+        losses = []
+        for _ in range(4):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6, atol=1e-6)

@@ -430,3 +430,44 @@ def test_mosaic_bilstm_stacked_directions_parity():
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3, atol=2e-3),
         g1, g2,
     )
+
+
+def test_sp_wavefront_with_pallas_compiles_on_chip():
+    """SP x Pallas (VERDICT r3 item 4): the fused kernel inside the
+    sequence-parallel wavefront's ALL-manual shard_map must Mosaic-compile
+    and train. One chip => sp=1 mesh: the wavefront machinery runs (manual
+    axes, ppermute elided at S=1), isolating the kernel-inside-shard_map
+    surface that scales to real sp>1 meshes unchanged (chunks are
+    collective-free)."""
+    import optax
+
+    from lstm_tensorspark_tpu.models import LMConfig, init_lm
+    from lstm_tensorspark_tpu.parallel import make_mesh
+    from lstm_tensorspark_tpu.parallel.train_step import (
+        make_sharded_lm_train_step,
+    )
+    from lstm_tensorspark_tpu.train.loop import init_train_state
+
+    V, B, T = 50, 16, 32
+    mesh = make_mesh(dp=1, tp=1, sp=1, devices=jax.devices()[:1])
+    data = jax.random.randint(jax.random.PRNGKey(40), (B, T + 1), 0, V)
+    batch = {"inputs": data[:, :-1], "targets": data[:, 1:]}
+
+    def run(use_pallas):
+        cfg = LMConfig(vocab_size=V, hidden_size=128, num_layers=1,
+                       use_pallas=use_pallas)
+        params = init_lm(jax.random.PRNGKey(41), cfg)
+        opt = optax.sgd(0.3)
+        step = make_sharded_lm_train_step(cfg, opt, mesh, params,
+                                          microbatches=2, donate=False)
+        state = init_train_state(params, opt, jax.random.PRNGKey(42))
+        losses = []
+        for _ in range(6):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    lp = run(True)
+    lr = run(False)
+    np.testing.assert_allclose(lp, lr, rtol=2e-3, atol=2e-3)
+    assert lp[-1] < lp[0]
