@@ -944,13 +944,15 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
     no host gather; only post-training generation pulls params to host
     (sequential small-batch decode).
     """
-    if getattr(args, "zero1", False):
+    if getattr(args, "zero1", False) and args.pipeline_stages <= 1:
         raise SystemExit(
-            "--zero1 is not supported with the LM's --tensor-parallel/"
-            "--seq-parallel/--pipeline-stages steps (manual {data,seq} "
-            "axes; PP shards the moments per stage already). It DOES "
-            "compose with the classifier/forecaster --tensor-parallel "
-            "runners (GSPMD weight-update sharding, parallel/zero.py).")
+            "--zero1 with the LM's --tensor-parallel/--seq-parallel steps "
+            "is not supported (their update runs inside a manual "
+            "{data,seq} shard_map, where the GSPMD weight-update-sharding "
+            "form cannot pin the moments). It DOES compose with "
+            "--pipeline-stages (stage x data sharded moments) and with "
+            "the classifier/forecaster --tensor-parallel runners "
+            "(parallel/zero.py).")
     from .data import lm_batch_stream, lm_epoch_batches
     from .models import init_lm
     from .parallel import (
@@ -1012,10 +1014,12 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
 
     optimizer = make_cli_optimizer(args)
     params = init_lm(jax.random.PRNGKey(args.seed), cfg)
+    zero1 = bool(getattr(args, "zero1", False)) and pp > 1
     if pp > 1:
         stacked = stack_lm_params(params)
         train_step = make_pp_lm_train_step(
-            cfg, optimizer, mesh, stacked, microbatches=mb, tp=tp > 1
+            cfg, optimizer, mesh, stacked, microbatches=mb, tp=tp > 1,
+            zero1=zero1,
         )
         placed = place_pp_lm_params(stacked, mesh, tp=tp > 1)
     else:
@@ -1024,6 +1028,18 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
         )
         placed = place_lm_params(params, mesh)
     state = init_train_state(placed, optimizer, jax.random.PRNGKey(args.seed + 1))
+    if zero1:
+        # place the moments on their stage x data shards up front — no
+        # device ever materializes a pipe-only (data-replicated) copy
+        from .parallel.pipeline_parallel import pp_lm_param_shardings
+        from .parallel.tensor_parallel import place_params
+        from .parallel.zero import zero1_tp_opt_specs
+
+        opt_specs = zero1_tp_opt_specs(
+            optimizer, stacked, pp_lm_param_shardings(stacked, tp=tp > 1),
+            mesh)
+        state = state._replace(
+            opt_state=place_params(state.opt_state, opt_specs, mesh))
 
     restored, checkpoint_fn = _wire_checkpoint(
         args, logger, lambda: jax.device_get(state)

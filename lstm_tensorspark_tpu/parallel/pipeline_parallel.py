@@ -359,6 +359,7 @@ def make_pp_lm_train_step(
     microbatches: int | None = None,
     donate: bool | None = None,
     tp: bool = False,
+    zero1: bool = False,
 ):
     """Build the DP x PP (x TP with ``tp=True``) train step on stacked params.
 
@@ -367,6 +368,17 @@ def make_pp_lm_train_step(
     state). Grad/update happen at the jit level: shard_map's transpose
     produces correct grads (psum'd for replicated embedding/head, local for
     the stage-sharded layers), and jit propagates P("pipe") to opt state.
+
+    ``zero1`` composes ZeRO-1 with the stage sharding (VERDICT r3 item 6):
+    the optimizer-state moment leaves get their param's spec EXTENDED with
+    the "data" axis on an unsharded divisible dimension
+    (`zero.zero1_tp_opt_specs` — the same GSPMD weight-update-sharding
+    spec tree the TP task runners use, applied to the STACKED
+    stage-sharded specs), and the step's in/out shardings PIN them there.
+    Each chip then stores 1/(pipe*data) of the moments — the
+    memory-relevant pairing for stacked-LSTM scale (config 5). Leaves
+    keep full logical shapes, so checkpoints reshard across any later
+    dp x pp like plain PP state.
 
     TP composition is hybrid manual/auto (the train_step.py pattern): the
     shard_map is MANUAL over {"pipe", "data"} only; "model" stays an AUTO
@@ -420,10 +432,23 @@ def make_pp_lm_train_step(
         pp_lm_param_shardings(params_stacked, tp=tp),
         is_leaf=lambda x: isinstance(x, P),
     )
+    if zero1:
+        from .zero import zero1_tp_opt_specs
+
+        opt_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            zero1_tp_opt_specs(
+                optimizer, params_stacked,
+                pp_lm_param_shardings(params_stacked, tp=tp), mesh,
+            ),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+    else:
+        opt_shardings = None  # propagated from params by XLA
     state_shardings = TrainState(
         step=NamedSharding(mesh, P()),
         params=param_shardings,
-        opt_state=None,  # propagated from params by XLA
+        opt_state=opt_shardings,
         rng=NamedSharding(mesh, P()),
         carries=None,
     )
