@@ -147,11 +147,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--keep-best", action="store_true",
                    help="additionally track the BEST-eval checkpoint "
-                        "(best.msgpack + best.json in --checkpoint-dir, "
-                        "overwritten on each improvement of the task's "
-                        "eval metric: LM perplexity / classifier accuracy "
-                        "/ forecast MSE) — outside the keep-N rotation; "
-                        "requires --checkpoint-dir and --eval-every")
+                        "(best.msgpack + best.json in --checkpoint-dir; "
+                        "multi-process runs write sharded "
+                        "best_<step>.proc<k> files + a best.complete "
+                        "marker instead), overwritten on each improvement "
+                        "of the task's eval metric: LM perplexity / "
+                        "classifier accuracy / forecast MSE — outside the "
+                        "keep-N rotation; requires --checkpoint-dir and "
+                        "--eval-every")
     p.add_argument("--async-checkpoint", action="store_true",
                    help="overlap checkpoint serialization + file IO with "
                         "training: save() blocks only for the device-to-"
@@ -237,10 +240,9 @@ def main(argv=None) -> int:
         raise SystemExit("--keep-best needs --checkpoint-dir (where "
                          "best.msgpack lives) and --eval-every > 0 (the "
                          "metric it tracks)")
-    if args.keep_best and (args.num_processes or 1) > 1:
-        raise SystemExit("--keep-best is single-process only (multi-host "
-                         "best tracking would need the sharded checkpoint "
-                         "writer)")
+    # --keep-best composes with multi-process runs since r4: save_best
+    # routes through the sharded writer (best_<step>.proc<k> files + a
+    # best.complete marker — train/checkpoint.py)
     if args.resume_best and not args.checkpoint_dir:
         raise SystemExit("--resume-best needs --checkpoint-dir (where the "
                          "producing run's best.msgpack lives) — without it "

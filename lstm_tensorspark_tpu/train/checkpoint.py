@@ -170,19 +170,23 @@ class Checkpointer:
         return os.path.join(self.directory, "best.msgpack")
 
     def save_best(self, state, value: float) -> str:
-        """Write/overwrite the best-eval checkpoint. ONE atomic artifact
-        (``best.msgpack``: {step, value, state-bytes}) so the metadata can
-        never describe different weights than the file holds; ``best.json``
+        """Write/overwrite the best-eval checkpoint.
+
+        Single-process: ONE atomic artifact (``best.msgpack``: {step,
+        value, state-bytes}) so the metadata can never describe different
+        weights than the file holds. Multi-process (VERDICT r3 item 7):
+        the SAME sharded-writer machinery as ``save()`` — one
+        ``best_<step>.proc<k>.msgpack`` per process, then a
+        ``best.complete`` marker from process 0 carrying {writers, step,
+        value}; the step-stamped filenames mean a crash mid-save can
+        never mix old and new shard files under one marker (the old
+        marker keeps pointing at the old, complete set). ``best.json``
         is a derived convenience view written after (advisory only).
         Called by the train loop only on metric improvement, so it stays
-        synchronous (rare) and independent of the step_N rotation — keep-N
-        cleanup never deletes it. Single-process runs only (multi-process
-        best tracking would need the sharded writer; not wired — cli.main
-        rejects the combination up front)."""
+        synchronous (rare) and independent of the step_N rotation —
+        keep-N cleanup never deletes it."""
         if jax.process_count() > 1:
-            raise NotImplementedError(
-                "best-checkpoint tracking is single-process only"
-            )
+            return self._save_best_sharded(state, value)
         self.wait()  # never interleave with an in-flight async write
         host = jax.device_get(state)
         payload = {
@@ -202,6 +206,48 @@ class Checkpointer:
                                  "value": payload["value"]}
         return self._best_path
 
+    _BEST_PROC_PAT = re.compile(r"best_(\d+)\.proc(\d+)\.msgpack$")
+
+    @property
+    def _best_marker(self) -> str:
+        return os.path.join(self.directory, "best.complete")
+
+    def _save_best_sharded(self, state, value: float) -> str:
+        step = int(jax.device_get(state.step))
+        pid = jax.process_index()
+        # clear leftovers of a crashed attempt AT THIS STEP (other steps'
+        # files may be the live best — only the marker says which)
+        if pid == 0:
+            for name in os.listdir(self.directory):
+                m = self._BEST_PROC_PAT.match(name)
+                if m and int(m.group(1)) == step:
+                    os.remove(os.path.join(self.directory, name))
+        _sync(f"best_clean_{step}")
+        payload = self._local_shards_payload(state, step)
+        payload["value"] = float(value)
+        path = os.path.join(self.directory,
+                            f"best_{step}.proc{pid}.msgpack")
+        self._atomic_write(path, serialization.msgpack_serialize(payload))
+        # every process must finish before the marker flips the live best
+        _sync(f"best_save_{step}")
+        if pid == 0:
+            meta = {"writers": jax.process_count(), "step": step,
+                    "value": float(value)}
+            self._atomic_write(self._best_marker,
+                               json.dumps(meta).encode())
+            self._atomic_write(
+                os.path.join(self.directory, "best.json"),
+                json.dumps({"step": step, "value": float(value)}).encode(),
+            )
+            # the marker now points at this step's set: older sets are dead
+            for name in os.listdir(self.directory):
+                m = self._BEST_PROC_PAT.match(name)
+                if m and int(m.group(1)) != step:
+                    os.remove(os.path.join(self.directory, name))
+        _sync(f"best_done_{step}")
+        self._best_meta_cache = {"step": step, "value": float(value)}
+        return path
+
     def best_meta(self) -> dict | None:
         """{step, value} of the saved best checkpoint (from the
         AUTHORITATIVE artifact, not the advisory sidecar; cached after the
@@ -212,23 +258,46 @@ class Checkpointer:
         if self._best_meta_cache is not None:
             return dict(self._best_meta_cache)
         self.wait()
-        if not os.path.exists(self._best_path):
+        if os.path.exists(self._best_path):
+            with open(self._best_path, "rb") as f:
+                payload = serialization.msgpack_restore(f.read())
+            self._best_meta_cache = {"step": int(payload["step"]),
+                                     "value": float(payload["value"])}
+        elif os.path.exists(self._best_marker):
+            # sharded best: the marker IS authoritative (it names the one
+            # complete shard set and was written after all of it)
+            with open(self._best_marker) as f:
+                meta = json.loads(f.read())
+            self._best_meta_cache = {"step": int(meta["step"]),
+                                     "value": float(meta["value"])}
+        else:
             return None
-        with open(self._best_path, "rb") as f:
-            payload = serialization.msgpack_restore(f.read())
-        self._best_meta_cache = {"step": int(payload["step"]),
-                                 "value": float(payload["value"])}
         return dict(self._best_meta_cache)
 
     def restore_best(self, template):
-        """Restore the best-metric checkpoint (None if never saved)."""
+        """Restore the best-metric checkpoint (None if never saved).
+        Handles both artifact kinds: the single-process ``best.msgpack``
+        and the sharded ``best_<step>.proc<k>`` set named by
+        ``best.complete`` — a sharded best restores (resharded onto the
+        template) even under a LATER different process count, like any
+        sharded step checkpoint."""
         self.wait()
-        if not os.path.exists(self._best_path):
+        if os.path.exists(self._best_path):
+            with open(self._best_path, "rb") as f:
+                payload = serialization.msgpack_restore(f.read())
+            restored = serialization.from_bytes(template, payload["state"])
+            return self._reshard_like(template, restored)
+        if not os.path.exists(self._best_marker):
             return None
-        with open(self._best_path, "rb") as f:
-            payload = serialization.msgpack_restore(f.read())
-        restored = serialization.from_bytes(template, payload["state"])
-        return self._reshard_like(template, restored)
+        with open(self._best_marker) as f:
+            meta = json.loads(f.read())
+        step, writers = int(meta["step"]), int(meta["writers"])
+        paths = []
+        for name in sorted(os.listdir(self.directory)):
+            m = self._BEST_PROC_PAT.match(name)
+            if m and int(m.group(1)) == step and int(m.group(2)) < writers:
+                paths.append(os.path.join(self.directory, name))
+        return self._assemble_from_procs(template, paths, step)
 
     @staticmethod
     def _atomic_write(path: str, data: bytes) -> None:
@@ -244,17 +313,13 @@ class Checkpointer:
         self._atomic_write(path, serialization.to_bytes(host_state))
         return path
 
-    def _save_sharded(self, state) -> str:
-        # state.step is replicated → locally readable on every process
-        step = int(jax.device_get(state.step))
+    def _local_shards_payload(self, state, step: int) -> dict:
+        """This process's contribution to a sharded checkpoint: for each
+        leaf, the addressable shards it uniquely owns (``replica_id == 0``
+        dedupe — exactly one writer per global index across the job);
+        host-side leaves belong to process 0. Shared by the step and best
+        sharded writers."""
         pid = jax.process_index()
-        # Clear any leftovers for this step from a previously crashed save
-        # (possibly with a DIFFERENT process count): stale proc files would
-        # otherwise merge into a later restore and corrupt it.
-        if pid == 0:
-            for f in self._files_for_step(step):
-                os.remove(f)
-        _sync(f"ckpt_clean_{step}")
         leaves = jax.tree.leaves(state)
         payload: dict = {"step": step, "leaves": {}}
         for i, leaf in enumerate(leaves):
@@ -282,6 +347,20 @@ class Checkpointer:
                     })
             if recs:
                 payload["leaves"][str(i)] = recs
+        return payload
+
+    def _save_sharded(self, state) -> str:
+        # state.step is replicated → locally readable on every process
+        step = int(jax.device_get(state.step))
+        pid = jax.process_index()
+        # Clear any leftovers for this step from a previously crashed save
+        # (possibly with a DIFFERENT process count): stale proc files would
+        # otherwise merge into a later restore and corrupt it.
+        if pid == 0:
+            for f in self._files_for_step(step):
+                os.remove(f)
+        _sync(f"ckpt_clean_{step}")
+        payload = self._local_shards_payload(state, step)
         path = os.path.join(self.directory, f"step_{step}.proc{pid}.msgpack")
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
@@ -329,14 +408,23 @@ class Checkpointer:
                 n_writers = int(f.read().strip() or 0)
         except (OSError, ValueError):
             n_writers = None  # legacy "ok" marker: accept all proc files
-        merged: dict[int, list] = {}
+        paths = []
         for name in sorted(os.listdir(self.directory)):
             m = self._PROC_PAT.match(name)
             if not m or int(m.group(1)) != step:
                 continue
             if n_writers is not None and int(m.group(2)) >= n_writers:
                 continue  # stale file from an older, larger job
-            with open(os.path.join(self.directory, name), "rb") as f:
+            paths.append(os.path.join(self.directory, name))
+        return self._assemble_from_procs(template, paths, step)
+
+    def _assemble_from_procs(self, template, paths: list, step: int):
+        """Merge per-process shard files and reassemble every template
+        leaf, resharding onto the template's shardings (shared by the
+        step and best restore paths)."""
+        merged: dict[int, list] = {}
+        for p in paths:
+            with open(p, "rb") as f:
                 payload = serialization.msgpack_restore(f.read())
             for k, recs in payload["leaves"].items():
                 merged.setdefault(int(k), []).extend(recs)
