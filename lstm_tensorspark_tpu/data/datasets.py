@@ -32,15 +32,35 @@ def _lm_dataset(
     synthetic_tokens: int,
     max_vocab: int | None = None,
     seed: int = 0,
+    synthetic_vocab: int | None = None,
+    synthetic_noise: float = 0.05,
 ):
     files = resolve_split_files(data_path or "", basenames)
     synthetic = files is None
     if synthetic:
-        texts = {
-            "train": synthetic_text(synthetic_tokens, seed),
-            "valid": synthetic_text(synthetic_tokens // 10, seed + 1),
-            "test": synthetic_text(synthetic_tokens // 10, seed + 2),
-        }
+        if synthetic_vocab is not None:
+            # controlled-entropy stand-in (word LMs): the splits share the
+            # SAME chain (same seed) — valid/test measure generalization
+            # over held-out samples of one process, like real corpora
+            from .corpus import synthetic_word_corpus
+
+            # one long stream, sliced — cheaper than three generations
+            stream = synthetic_word_corpus(
+                int(synthetic_tokens * 1.2), synthetic_vocab, seed=seed,
+                noise=synthetic_noise,
+            ).split()
+            n, tenth = synthetic_tokens, synthetic_tokens // 10
+            texts = {
+                "train": " ".join(stream[:n]),
+                "valid": " ".join(stream[n:n + tenth]),
+                "test": " ".join(stream[n + tenth:n + 2 * tenth]),
+            }
+        else:
+            texts = {
+                "train": synthetic_text(synthetic_tokens, seed),
+                "valid": synthetic_text(synthetic_tokens // 10, seed + 1),
+                "test": synthetic_text(synthetic_tokens // 10, seed + 2),
+            }
     else:
         texts = {s: load_text(p) for s, p in files.items()}
 
@@ -63,7 +83,13 @@ def ptb_char(data_path=None, **kw):
 
 
 def wikitext2_word(data_path=None, **kw):
-    """BASELINE.md config 3: WikiText-2 word-level."""
+    """BASELINE.md config 3: WikiText-2 word-level. Synthetic stand-in:
+    controlled-entropy 1,000-word chain (synthetic_word_corpus) so the
+    eval-ppl curve declines across hundreds of steps — the old
+    seed-paragraph chain (~113 words) saturated by step ~20 and quality
+    races measured launch costs (VERDICT r3 weak 2)."""
+    kw.setdefault("synthetic_vocab", 1_000)
+    kw.setdefault("synthetic_noise", 0.05)
     return _lm_dataset(
         data_path, ["wiki", "wikitext-2"], "word",
         synthetic_tokens=400_000, max_vocab=33_278, **kw
@@ -72,7 +98,10 @@ def wikitext2_word(data_path=None, **kw):
 
 def wikitext103_word(data_path=None, **kw):
     """BASELINE.md config 5: WikiText-103 word-level (synthetic stand-in is
-    deliberately larger)."""
+    deliberately larger: a controlled-entropy 5,000-word chain — see
+    wikitext2_word's note)."""
+    kw.setdefault("synthetic_vocab", 5_000)
+    kw.setdefault("synthetic_noise", 0.1)
     return _lm_dataset(
         data_path, ["wiki", "wikitext-103"], "word",
         synthetic_tokens=2_000_000, max_vocab=50_000, **kw
@@ -142,14 +171,20 @@ def _imdb_real(root: str, *, max_len: int, max_vocab: int = 25_000,
     }
 
 
-def imdb(data_path=None, *, num_examples: int | None = None, max_len: int = 400, seed: int = 0):
+def imdb(data_path=None, *, num_examples: int | None = None, max_len: int = 400,
+         seed: int = 0, signal: float = 0.25):
     """BASELINE.md config 2: binary sentiment over variable-length sequences.
 
     Real data: point ``data_path`` at the aclImdb directory (or its parent) —
     standard ``{train,test}/{pos,neg}/*.txt`` layout. Synthetic stand-in
     otherwise: two word distributions shifted by class, lengths drawn
     log-uniform in [20, max_len] — learnable by a bi-LSTM, label balance
-    exact.
+    exact. ``signal`` is the class-specific token fraction (the SNR knob):
+    the old 0.7 made a seq-400 example carry ~hundreds of informative
+    tokens, the model saturated accuracy 1.0 by step ~40, and the quality
+    race measured launch costs instead of training (VERDICT r3 weak 2);
+    0.25 leaves ~5-100 informative tokens per example (length-dependent)
+    so the accuracy curve climbs over hundreds of steps.
 
     ``num_examples`` bounds BOTH paths (per split, balanced); the default
     loads everything real / 2000 synthetic.
@@ -170,7 +205,7 @@ def imdb(data_path=None, *, num_examples: int | None = None, max_len: int = 400,
         label = i % 2
         length = int(np.exp(rng.uniform(np.log(20), np.log(max_len))))
         base = pos_words if label else neg_words
-        mix = rng.rand(length) < 0.7  # 70% class-specific, 30% shared noise
+        mix = rng.rand(length) < signal  # class-specific vs shared noise
         seq = np.where(
             mix, base[rng.randint(len(base), size=length)],
             rng.randint(2, V, size=length),
