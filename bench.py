@@ -416,6 +416,86 @@ def measure_roofline(name: str, *, chains: int = 256, reps: int = 3) -> dict:
     }
 
 
+def measure_hbm_bw(mb: int = 128, iters: int = 8, reps: int = 3) -> dict:
+    """Measured HBM bandwidth: an elementwise pass over a ``mb``-MiB f32
+    array, ``iters``-chained inside ONE jitted fori_loop (each iteration
+    reads + writes the full array — the carry dependency stops XLA fusing
+    across iterations, so every pass is real HBM traffic). `_two_point`
+    strips the tunnel's fixed dispatch+fetch latency as everywhere else.
+    This is the denominator of the r4 bandwidth bound — measured on THIS
+    chip, not a datasheet number."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = mb * 2**20 // 4
+    x = jnp.arange(n, dtype=jnp.float32) * 1e-6  # not constant-foldable
+
+    def body(_, a):
+        return a * 1.0000001 + 1.0
+
+    run = jax.jit(lambda a: lax.fori_loop(0, iters, body, a))
+    y = run(x)
+    float(y[0])  # warm + true barrier (tunneled-TPU honesty)
+
+    def probe(k):
+        out = x
+        for _ in range(k):
+            out = run(out)
+        float(out[0])
+
+    _, d = _two_point(probe, 4, reps=reps)
+    if d is None:
+        return {"error": "calibration collapsed (tunnel latency jitter)"}
+    moved = 2.0 * n * 4 * iters  # read + write per iteration
+    return {
+        "array_mib": mb,
+        "iters": iters,
+        "gb_per_sec": round(moved / d / 1e9, 2),
+    }
+
+
+def _scan_stream_bytes(strategy: str, T_s: int, D_s: int, B: int, H: int,
+                       pbytes: int) -> float:
+    """Estimated HBM bytes ONE optimizer step moves for ONE sequential
+    scan under ``strategy`` — the numerator of the r4 bandwidth bound.
+
+    Inventory (A = T_s*B rows; r = stream-dtype bytes, 4 = f32):
+    resident/tiled — fwd: xs read (f32, by the xproj producer), xproj
+    write+read (r), ys write, z write (r), cs write; bwd kernel: z read
+    (r), dys + cs reads, dz write (r); outside: dz read 4x (dU, dW, db,
+    dxs — separate contractions), ys read (h_prev for dU), xs read
+    (dW), dxs write. tiled additionally RE-STREAMS U every step (fwd)
+    and U^T (bwd) — the strategy's defining cost at H where U exceeds
+    VMEM. residentx — no xproj/z anywhere: xs streamed once per kernel
+    (r) in fwd AND bwd (z recomputed in-kernel), cs the only residual;
+    same dz and outside traffic. Estimates deliberately EXCLUDE the
+    non-scan model (embedding/head/optimizer) — those FLOPs-side costs
+    sit in the impl bound's parallel term; mask streams are negligible
+    (LANE wide). An estimate, not a meter: good to ~10-20%, enough to
+    say which side of the bandwidth roof a config sits on."""
+    from lstm_tensorspark_tpu.ops.pallas_lstm import _pad_to_lane, _rbytes
+
+    r = _rbytes(pbytes)
+    A = T_s * B
+    Hp = _pad_to_lane(H)
+    z4 = A * 4 * Hp  # elements of one [T,B,4H] stream
+    s1 = A * Hp      # elements of one [T,B,H] stream
+    xs_f32 = A * D_s * 4
+    dz_outside = 4 * z4 * r + s1 * 4 + xs_f32 + A * D_s * 4  # dU/dW/db/dxs
+    if strategy == "residentx":
+        xs_r = A * _pad_to_lane(D_s) * r
+        fwd = xs_r + s1 * 4 * 2            # xs in; ys + cs out
+        bwd = xs_r + s1 * 4 * 2 + z4 * r   # xs + dys + cs in; dz out
+        return fwd + bwd + dz_outside
+    fwd = xs_f32 + z4 * r * 2 + s1 * 4 + z4 * r + s1 * 4  # xproj w+r, ys, z, cs
+    bwd = z4 * r + s1 * 4 * 2 + z4 * r                    # z, dys, cs in; dz out
+    total = fwd + bwd + dz_outside
+    if strategy == "tiled":
+        total += T_s * 2 * 4 * Hp * Hp * pbytes  # U fwd + U^T bwd re-streamed
+    return total
+
+
 def _config_scans(name: str) -> list:
     """(T, input_width, has_mask) for EVERY sequential scan one optimizer
     step of this config runs — the per-scan inventory `_impl_bound` plans
@@ -477,12 +557,14 @@ def _impl_bound(name: str, rl: dict, rec: dict, measured: float) -> dict:
     pbytes = 2 if c.get("compute_dtype", "bfloat16") == "bfloat16" else 4
     MULT = {"residentx": 2, "resident": 1, "tiled": 1, "recompute": 2}
     serial_steps = 0
+    stream_bytes = 0.0
     strategy_counts: dict = {}
     for T_s, D_s, has_mask in _config_scans(name):
         Dp = _pad_to_lane(D_s) if T_s >= _FUSEDX_MIN_T else None
         s = chosen_bwd_strategy(B_, T_s, Hp, pbytes,
                                 has_mask=has_mask, Dp=Dp)
         serial_steps += T_s * (1 + MULT[s])
+        stream_bytes += _scan_stream_bytes(s, T_s, D_s, B_, H_, pbytes)
         strategy_counts[s] = strategy_counts.get(s, 0) + 1
     # chain-latency units: the roofline's chain covers T_chain steps
     T_chain = c["T"] + (c["horizon"] if kind == "seq2seq" else 0)
@@ -498,6 +580,10 @@ def _impl_bound(name: str, rl: dict, rec: dict, measured: float) -> dict:
                               if len(strategy_counts) == 1 else "mixed"),
         "impl_bound_sec_per_step": round(bound, 6),
         "fraction_of_impl_bound": round(bound / measured, 4),
+        # numerator of the r4 bandwidth bound (estimate; see
+        # _scan_stream_bytes) — main() divides by the MEASURED HBM BW and
+        # publishes the max(compute-bound, bandwidth-bound) floor
+        "stream_bytes_per_step": int(stream_bytes),
     }
     if len(strategy_counts) > 1:
         out["impl_bwd_strategies"] = strategy_counts
@@ -745,6 +831,10 @@ def _liveness_probe(timeout_s: float = 60.0,
 def main() -> int:
     _liveness_probe()
     baseline = cpu_baseline()
+    try:
+        hbm = measure_hbm_bw()
+    except Exception as e:  # the BW probe failing must not kill the bench
+        hbm = {"error": f"{type(e).__name__}: {e}"}
     value = measure(
         "bfloat16", STEPS * K, WARMUP * K,
         unroll=UNROLL, reps=REPS, steps_per_call=K, device_data=DEVICE_DATA,
@@ -794,6 +884,25 @@ def main() -> int:
                 # (theoretical) prize for overlapping layers/directions.
                 try:
                     rl.update(_impl_bound(name, rl, rec, measured))
+                    # r4 bandwidth floor: a step can be slower than its
+                    # serialized-chain bound simply because its residual
+                    # streams saturate HBM. The COMBINED floor is the max
+                    # of the two; fraction ≈ 1 against it means the step
+                    # runs at the speed of its own structure AND traffic.
+                    if "gb_per_sec" in hbm:
+                        bw_sec = (rl["stream_bytes_per_step"]
+                                  / (hbm["gb_per_sec"] * 1e9))
+                        bound2 = max(rl["impl_bound_sec_per_step"], bw_sec)
+                        rl.update(
+                            bw_bound_sec_per_step=round(bw_sec, 6),
+                            bound_binding=("bandwidth"
+                                           if bw_sec
+                                           > rl["impl_bound_sec_per_step"]
+                                           else "serial-chain"),
+                            impl_bound2_sec_per_step=round(bound2, 6),
+                            fraction_of_impl_bound2=round(
+                                bound2 / measured, 4),
+                        )
                 except Exception as e:
                     rl["impl_bound_error"] = f"{type(e).__name__}: {e}"
             rec["roofline"] = rl
@@ -819,6 +928,7 @@ def main() -> int:
     with open(TABLE, "w") as f:
         json.dump({
             "peak_tflops_bf16": PEAK_TFLOPS,
+            "hbm_bandwidth": hbm,
             "headline_seq_per_sec": round(value, 2),
             "vs_cpu_baseline": round(value / baseline, 2),
             "configs": table,

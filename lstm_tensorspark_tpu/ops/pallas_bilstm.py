@@ -42,7 +42,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import pallas_lstm as _pl
 from .lstm_cell import LSTMParams, fuse_params
-from .pallas_lstm import _LANE, _chunk_for, _pad_params_lane, _pad_to_lane
+from .pallas_lstm import (_LANE, _chunk_for, _pad_params_lane, _pad_to_lane,
+                          _residual_dtype)
 
 
 def _bi_fwd_vmem(B2: int, H: int, Dp: int, pbytes: int, save_c: bool,
@@ -239,7 +240,7 @@ def _bi_bwdx_kernel(*refs, hidden: int, dpad: int, chunk: int, batch: int,
         df = dc_new * c_prev * f * (1.0 - f)
         dg = dc_new * i * (1.0 - g * g)
         dz = jnp.concatenate([di, df, dg, do], axis=1)  # [2B, 4H] f32
-        dz_ref[s] = dz
+        dz_ref[s] = dz.astype(dz_ref.dtype)  # stream dtype
         dh = jnp.concatenate(
             [jnp.dot(
                 dz[d * B:(d + 1) * B].astype(ut_ref.dtype), ut_ref[d],
@@ -293,7 +294,8 @@ def _bi_forward(fused_f, fused_b, xs2, h0, c0, mask_tbl=None, *,
         raise ValueError(f"no stacked bilstm plan for B={B}, H={H}, D={D}")
     C = _chunk_for(T, cap)
 
-    xs_t = jnp.moveaxis(xs2, 0, 1).astype(jnp.float32)  # [T, 2B, D]
+    sdtype = _residual_dtype(fused_f.kernel.dtype)
+    xs_t = jnp.moveaxis(xs2, 0, 1).astype(sdtype)  # [T, 2B, D]
     if Dp != D:
         xs_t = jnp.pad(xs_t, ((0, 0), (0, 0), (0, Dp - D)))
     w2, b2, u2 = _stack_weights(fused_f, fused_b, Dp)
@@ -376,7 +378,8 @@ def _bi_backward(fused_f, fused_b, params_f, params_b, xs2, h0, c0,
     c_prev = jnp.concatenate(
         [c0.astype(jnp.float32)[None], cs[:-1]], axis=0)
     dys_t = jnp.moveaxis(dys2.astype(jnp.float32), 0, 1)
-    xs_t = jnp.moveaxis(xs2, 0, 1).astype(jnp.float32)
+    sdtype = _residual_dtype(dtype)
+    xs_t = jnp.moveaxis(xs2, 0, 1).astype(sdtype)
     if Dp != D:
         xs_t_pad = jnp.pad(xs_t, ((0, 0), (0, 0), (0, Dp - D)))
     else:
@@ -409,7 +412,7 @@ def _bi_backward(fused_f, fused_b, params_f, params_b, xs2, h0, c0,
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((T, B2, 4 * H), jnp.float32),
+            jax.ShapeDtypeStruct((T, B2, 4 * H), sdtype),  # dz stream
             jax.ShapeDtypeStruct((B2, H), jnp.float32),
             jax.ShapeDtypeStruct((B2, H), jnp.float32),
         ],
@@ -433,7 +436,7 @@ def _bi_backward(fused_f, fused_b, params_f, params_b, xs2, h0, c0,
                         preferred_element_type=jnp.float32)
         dW = jnp.einsum("tbd,tbk->dk", xs_t[:, rows].astype(dtype), dz_c,
                         preferred_element_type=jnp.float32)
-        db = jnp.sum(dz_d, axis=(0, 1))
+        db = jnp.sum(dz_d, axis=(0, 1), dtype=jnp.float32)
         dxs_parts.append(jnp.moveaxis(
             jnp.einsum("tbk,dk->tbd", dz_c, fused.kernel,
                        preferred_element_type=jnp.float32),

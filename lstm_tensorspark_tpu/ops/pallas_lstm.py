@@ -102,6 +102,35 @@ def _pad_to_lane(h: int) -> int:
     return h + (-h % _LANE)
 
 
+def _residual_dtype(kernel_dtype):
+    """Dtype of the big [*, 4H] HBM streams (xproj in, z residual, dz out).
+
+    r4 bandwidth analysis (DESIGN.md): at config-1 class shapes one
+    optimizer step moves ~40 copies of T·B·H·4 bytes through HBM when
+    every stream is f32 — more than the chip's HBM bandwidth over the
+    measured step time, i.e. these configs are STREAM-bound, not
+    chain-bound, and that is the missing ~2x between the measured step
+    and the chain-latency roofline. Storing the 4H-wide streams in the
+    compute dtype halves the dominant traffic. The cell state (cs),
+    carries, and ys stay f32 (the recurrence trajectory's precision);
+    gate math still runs f32 in-kernel — only the STORED copies round.
+    f32 compute keeps f32 streams (bit-exact parity tests unchanged);
+    LSTM_TSP_RESIDUAL_F32=1 forces f32 streams under bf16 compute (the
+    A/B lever for measuring the saving)."""
+    if (kernel_dtype == jnp.bfloat16
+            and os.environ.get("LSTM_TSP_RESIDUAL_F32") != "1"):
+        return jnp.bfloat16
+    return jnp.float32
+
+
+def _rbytes(pbytes: int) -> int:
+    """Cost-model mirror of `_residual_dtype` (pbytes encodes the kernel
+    dtype: 2 = bf16, 4 = f32)."""
+    if pbytes == 2 and os.environ.get("LSTM_TSP_RESIDUAL_F32") != "1":
+        return 2
+    return 4
+
+
 # ---------------------------------------------------------------------------
 # Unified VMEM cost model. Every supported()/strategy decision reads these
 # four functions; there is no second, implicit accounting (ADVICE.md #1).
@@ -118,10 +147,11 @@ def _residentx_fwd_vmem(B: int, H: int, Dp: int, pbytes: int,
     arrays the hoisted variants round-trip through HBM do not exist.
     ``c`` is the time chunk — the planner shrinks it when the streamed
     blocks would not fit at 8."""
+    r = _rbytes(pbytes)
     v = 4 * H * H * pbytes  # U resident
     v += Dp * 4 * H * pbytes  # W resident
     v += 4 * H * 4  # bias
-    v += 2 * c * B * Dp * 4  # xs blocks (double-buffered)
+    v += 2 * c * B * Dp * r  # xs blocks (double-buffered, stream dtype)
     v += c * B * 4 * H * 4  # in-kernel zx chunk (live value)
     v += 2 * c * B * H * 4  # ys out blocks
     v += 6 * B * H * 4  # h0/c0 in, hT/cT out, h/c scratch
@@ -137,9 +167,10 @@ def _residentx_bwd_vmem(B: int, H: int, Dp: int, pbytes: int,
     """Recompute-z fused BPTT: z_t is rebuilt in-kernel from the streamed
     xs/h_prev (W, U resident) instead of being read back from HBM — the
     forward never saved it. ``c`` as in `_residentx_fwd_vmem`."""
+    r = _rbytes(pbytes)
     streamed = (
-        c * B * Dp * 4  # xs blocks
-        + c * B * 4 * H * 4  # dz out blocks
+        c * B * Dp * r  # xs blocks (stream dtype)
+        + c * B * 4 * H * r  # dz out blocks (stream dtype)
         + c * B * H * 4 * 3  # dys/c_prev/h_prev blocks
     )
     if has_mask:
@@ -157,22 +188,24 @@ def _residentx_bwd_vmem(B: int, H: int, Dp: int, pbytes: int,
 def _resident_fwd_vmem(B: int, H: int, pbytes: int, save_residuals: bool,
                        has_mask: bool = False) -> int:
     c = 8  # worst-case time chunk (_time_chunk)
+    r = _rbytes(pbytes)
     v = 4 * H * H * pbytes  # U resident
-    v += 2 * c * B * 4 * H * 4  # xproj blocks (double-buffered)
+    v += 2 * c * B * 4 * H * r  # xproj blocks (double-buffered, stream dtype)
     v += 2 * c * B * H * 4  # ys out blocks
     v += 6 * B * H * 4  # h0/c0 in, hT/cT out, h/c scratch
     if has_mask:
         v += 2 * c * B * _LANE * 4  # mask blocks
     if save_residuals:
-        v += 2 * c * B * 4 * H * 4  # z out blocks
+        v += 2 * c * B * 4 * H * r  # z out blocks (stream dtype)
         v += 2 * c * B * H * 4  # cs out blocks
     return v
 
 
 def _resident_bwd_vmem(B: int, H: int, pbytes: int,
                        has_mask: bool = False) -> int:
+    r = _rbytes(pbytes)
     streamed = (
-        8 * B * 4 * H * 4 * 2  # z in + dz out blocks (chunk<=8)
+        8 * B * 4 * H * r * 2  # z in + dz out blocks (chunk<=8, stream dtype)
         + 8 * B * H * 4 * 2  # dys/c_prev blocks (c_t recomputed; h_prev
                              # not read — dU is contracted outside)
     )
@@ -187,8 +220,9 @@ def _resident_bwd_vmem(B: int, H: int, pbytes: int,
 
 def _tiled_fwd_vmem(B: int, H: int, pbytes: int, save_residuals: bool,
                     htile: int, has_mask: bool = False) -> int:
+    r = _rbytes(pbytes)
     v = 2 * htile * 4 * H * pbytes  # U row-tile (streamed every step)
-    v += 2 * B * 4 * H * 4  # xproj block
+    v += 2 * B * 4 * H * r  # xproj block (stream dtype)
     v += B * 4 * H * 4  # z accumulator scratch (f32)
     v += 2 * B * H * 4  # h tiles scratch + c scratch
     v += 2 * B * H * 4  # ys out block
@@ -196,17 +230,18 @@ def _tiled_fwd_vmem(B: int, H: int, pbytes: int, save_residuals: bool,
     if has_mask:
         v += 2 * B * _LANE * 4  # mask block
     if save_residuals:
-        v += 2 * B * 4 * H * 4  # z out block
+        v += 2 * B * 4 * H * r  # z out block (stream dtype)
         v += 2 * B * H * 4  # cs out block
     return v
 
 
 def _tiled_bwd_vmem(B: int, H: int, pbytes: int, ttile: int,
                     has_mask: bool = False) -> int:
+    r = _rbytes(pbytes)
     v = 2 * ttile * H * pbytes  # U^T row-tile
-    v += 2 * B * 4 * H * 4  # z in block
+    v += 2 * B * 4 * H * r  # z in block (stream dtype)
     v += 2 * 2 * B * H * 4  # dys/c_prev in blocks (c_t recomputed)
-    v += 2 * B * 4 * H * 4  # dz out block
+    v += 2 * B * 4 * H * r  # dz out block (stream dtype)
     v += B * 4 * H * 4  # dz tiles scratch
     v += 3 * B * H * 4  # dh/dc/dh-accumulator scratch
     v += 4 * B * H * 4  # dhT/dcT in, dh0/dc0 out
@@ -260,10 +295,12 @@ def _plan_bwd(B: int, H: int, pbytes: int, has_mask: bool = False,
     return None
 
 
-def _residual_bytes(T: int, B: int, H: int, bwd_strategy: str = "resident") -> int:
+def _residual_bytes(T: int, B: int, H: int, bwd_strategy: str = "resident",
+                    pbytes: int = 4) -> int:
     if bwd_strategy == "residentx":
-        return T * B * H * 4  # cs only (z recomputed in-kernel)
-    return T * B * 5 * H * 4  # z [T,B,4H] + cs [T,B,H], both f32
+        return T * B * H * 4  # cs only (z recomputed in-kernel), f32
+    # z [T,B,4H] in the stream dtype + cs [T,B,H] f32
+    return T * B * H * (4 * _rbytes(pbytes) + 4)
 
 
 def chosen_bwd_strategy(B: int, T: int, H: int, pbytes: int, *,
@@ -286,7 +323,7 @@ def chosen_bwd_strategy(B: int, T: int, H: int, pbytes: int, *,
         return "recompute"
     fusedx = plan_b[0] == "residentx"
     ok = (
-        _residual_bytes(T, B, H, plan_b[0]) <= _RESIDUAL_HBM_BUDGET
+        _residual_bytes(T, B, H, plan_b[0], pbytes) <= _RESIDUAL_HBM_BUDGET
         and _plan_fwd(B, H, pbytes, save_residuals=True, has_mask=has_mask,
                       Dp=Dp if fusedx else None) is not None
     )
@@ -450,7 +487,7 @@ def _lstm_bwdx_kernel(*refs, hidden: int, dpad: int, chunk: int,
         df = dc_new * c_prev * f * (1.0 - f)
         dg = dc_new * i * (1.0 - g * g)
         dz = jnp.concatenate([di, df, dg, do], axis=1)  # [B, 4H] f32
-        dz_ref[s] = dz
+        dz_ref[s] = dz.astype(dz_ref.dtype)  # stored in the stream dtype
         dh = jnp.dot(dz.astype(ut_ref.dtype), ut_ref[:],
                      preferred_element_type=jnp.float32)
         dc = dc_new * f
@@ -503,11 +540,11 @@ def _lstm_kernel(*refs, hidden: int, chunk: int, save_residuals: bool,
     # per-grid-step overhead (block index bookkeeping, DMA setup) amortises
     # over the chunk while h/c stay in registers/VMEM between sub-steps.
     for s in range(chunk):
-        z = xproj_ref[s] + jnp.dot(
+        z = xproj_ref[s].astype(jnp.float32) + jnp.dot(
             h.astype(u_ref.dtype), u_ref[:], preferred_element_type=jnp.float32
         )
         if save_residuals:
-            z_ref[s] = z
+            z_ref[s] = z.astype(z_ref.dtype)  # stored in the stream dtype
         i = jax.nn.sigmoid(z[:, :H])
         f = jax.nn.sigmoid(z[:, H : 2 * H])
         g = jnp.tanh(z[:, 2 * H : 3 * H])
@@ -575,7 +612,7 @@ def _lstm_bwd_kernel(*refs, hidden: int, chunk: int, has_mask: bool):
     dh = dh_scr[:]
     dc = dc_scr[:]
     for s in range(chunk - 1, -1, -1):
-        z = z_ref[s]
+        z = z_ref[s].astype(jnp.float32)
         i = jax.nn.sigmoid(z[:, :H])
         f = jax.nn.sigmoid(z[:, H : 2 * H])
         g = jnp.tanh(z[:, 2 * H : 3 * H])
@@ -597,7 +634,7 @@ def _lstm_bwd_kernel(*refs, hidden: int, chunk: int, has_mask: bool):
         df = dc_new * c_prev * f * (1.0 - f)
         dg = dc_new * i * (1.0 - g * g)
         dz = jnp.concatenate([di, df, dg, do], axis=1)  # [B, 4H] f32
-        dz_ref[s] = dz
+        dz_ref[s] = dz.astype(dz_ref.dtype)  # stored in the stream dtype
         dh = jnp.dot(dz.astype(ut_ref.dtype), ut_ref[:],
                      preferred_element_type=jnp.float32)
         dc = dc_new * f
@@ -653,7 +690,7 @@ def _lstm_tiled_kernel(*refs, hidden: int, htile: int, save_residuals: bool,
 
     @pl.when(k == 0)
     def _():
-        z_scr[:] = xproj_ref[0]
+        z_scr[:] = xproj_ref[0].astype(jnp.float32)
 
     z_scr[:] = z_scr[:] + jnp.dot(
         h_tiles[k].astype(u_ref.dtype), u_ref[:],
@@ -682,7 +719,7 @@ def _lstm_tiled_kernel(*refs, hidden: int, htile: int, save_residuals: bool,
         c_scr[:] = c
         ys_ref[0] = h
         if save_residuals:
-            z_out_ref[0] = z
+            z_out_ref[0] = z.astype(z_out_ref.dtype)  # stream dtype
             cs_ref[0] = c
         for j in range(K):
             h_tiles[j] = h[:, j * htile : (j + 1) * htile]
@@ -724,7 +761,7 @@ def _lstm_bwd_tiled_kernel(*refs, hidden: int, ttile: int, has_mask: bool):
 
     @pl.when(k == 0)
     def _():
-        z = z_ref[0]
+        z = z_ref[0].astype(jnp.float32)
         i = jax.nn.sigmoid(z[:, :H])
         f = jax.nn.sigmoid(z[:, H : 2 * H])
         g = jnp.tanh(z[:, 2 * H : 3 * H])
@@ -746,7 +783,7 @@ def _lstm_bwd_tiled_kernel(*refs, hidden: int, ttile: int, has_mask: bool):
         df = dc_new * c_prev * f * (1.0 - f)
         dg = dc_new * i * (1.0 - g * g)
         dz = jnp.concatenate([di, df, dg, do], axis=1)  # [B, 4H] f32
-        dz_ref[0] = dz
+        dz_ref[0] = dz.astype(dz_ref.dtype)  # stream dtype
         for j in range(K):
             dz_tiles[j] = dz[:, j * ttile : (j + 1) * ttile]
         if has_mask:
@@ -778,11 +815,13 @@ def _lstm_bwd_tiled_kernel(*refs, hidden: int, ttile: int, has_mask: bool):
 # ---------------------------------------------------------------------------
 
 
-def _pad_inputs_lane(xs, kernel, Dp: int):
-    """Time-major f32 xs and W with the input width zero-padded to ``Dp``
-    (shared by the residentx forward AND backward, which must recompute z
-    from bit-identical inputs). Zero W rows multiply zero xs lanes: exact."""
-    xs_t = jnp.moveaxis(xs, 0, 1).astype(jnp.float32)  # [T, B, D]
+def _pad_inputs_lane(xs, kernel, Dp: int, sdtype=jnp.float32):
+    """Time-major xs (in the STREAM dtype ``sdtype`` — `_residual_dtype`)
+    and W with the input width zero-padded to ``Dp`` (shared by the
+    residentx forward AND backward, which must recompute z from
+    bit-identical inputs — both call this with the same sdtype). Zero W
+    rows multiply zero xs lanes: exact."""
+    xs_t = jnp.moveaxis(xs, 0, 1).astype(sdtype)  # [T, B, D]
     D = xs_t.shape[-1]
     if Dp != D:
         xs_t = jnp.pad(xs_t, ((0, 0), (0, 0), (0, Dp - D)))
@@ -825,7 +864,7 @@ def _pallas_forward(fused, xs, h0, c0, mask_tbl=None, *,
 
     if strategy == "residentx":
         Dp = _pad_to_lane(D)
-        xs_t, w = _pad_inputs_lane(xs, fused.kernel, Dp)
+        xs_t, w = _pad_inputs_lane(xs, fused.kernel, Dp, _residual_dtype(dtype))
         in_specs = [
             pl.BlockSpec((C, B, Dp), lambda t, *k: (t, 0, 0),
                          memory_space=pltpu.VMEM),  # xs
@@ -878,14 +917,17 @@ def _pallas_forward(fused, xs, h0, c0, mask_tbl=None, *,
             return ys, out[1], out[2], None, out[3]
         return ys, out[1], out[2]
 
-    # one big MXU matmul for every step's input projection
+    # one big MXU matmul for every step's input projection, accumulated
+    # f32 then STORED in the stream dtype (the r4 bandwidth analysis: the
+    # [T,B,4H] xproj round-trip is a dominant HBM stream)
+    sdtype = _residual_dtype(dtype)
     xproj = (
         jnp.einsum(
             "btd,dk->btk", xs.astype(dtype), fused.kernel,
             preferred_element_type=jnp.float32,
         )
         + fused.bias
-    )  # [B, T, 4H] f32
+    ).astype(sdtype)  # [B, T, 4H]
     xproj = jnp.moveaxis(xproj, 0, 1)  # [T, B, 4H]
 
     out_specs = [
@@ -907,7 +949,7 @@ def _pallas_forward(fused, xs, h0, c0, mask_tbl=None, *,
                          memory_space=pltpu.VMEM),
         ]
         out_shape += [
-            jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
+            jax.ShapeDtypeStruct((T, B, 4 * H), sdtype),  # z: stream dtype
             jax.ShapeDtypeStruct((T, B, H), jnp.float32),
         ]
 
@@ -977,6 +1019,7 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
     H = fused.hidden_size
     dtype = fused.kernel.dtype
     pbytes = 2 if dtype == jnp.bfloat16 else 4
+    sdtype = _residual_dtype(dtype)  # dtype of the z/dz/xs HBM streams
     has_mask = mask_tbl is not None
     # z is None ⇔ the forward ran residentx and saved cs only — the
     # recompute-z backward is then the ONLY strategy whose residual
@@ -998,7 +1041,7 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
         C = _chunk_for(T, parg)
         n = T // C
         rev = lambda t: (n - 1 - t, 0, 0)  # reverse-time grid
-        xs_t, w = _pad_inputs_lane(xs, fused.kernel, Dp)
+        xs_t, w = _pad_inputs_lane(xs, fused.kernel, Dp, sdtype)
         in_specs = [
             pl.BlockSpec((C, B, Dp), rev, memory_space=pltpu.VMEM),  # xs
             pl.BlockSpec((C, B, H), rev, memory_space=pltpu.VMEM),   # dys
@@ -1033,7 +1076,7 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
                 pl.BlockSpec(memory_space=pltpu.VMEM),                   # dc0
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
+                jax.ShapeDtypeStruct((T, B, 4 * H), sdtype),  # dz stream
                 jax.ShapeDtypeStruct((B, H), jnp.float32),
                 jax.ShapeDtypeStruct((B, H), jnp.float32),
             ],
@@ -1076,7 +1119,7 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
                 pl.BlockSpec(memory_space=pltpu.VMEM),                   # dc0
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
+                jax.ShapeDtypeStruct((T, B, 4 * H), sdtype),  # dz stream
                 jax.ShapeDtypeStruct((B, H), jnp.float32),
                 jax.ShapeDtypeStruct((B, H), jnp.float32),
             ],
@@ -1127,7 +1170,7 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
                 pl.BlockSpec(memory_space=pltpu.VMEM),                   # dc0
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
+                jax.ShapeDtypeStruct((T, B, 4 * H), sdtype),  # dz stream
                 jax.ShapeDtypeStruct((B, H), jnp.float32),
                 jax.ShapeDtypeStruct((B, H), jnp.float32),
             ],
@@ -1149,7 +1192,7 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
     dW = jnp.einsum(
         "tbd,tbk->dk", xs_t, dz_c, preferred_element_type=jnp.float32
     )
-    db = jnp.sum(dz, axis=(0, 1))
+    db = jnp.sum(dz, axis=(0, 1), dtype=jnp.float32)
     dxs = jnp.moveaxis(
         jnp.einsum(
             "tbk,dk->tbd", dz_c, fused.kernel,

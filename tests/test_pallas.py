@@ -509,3 +509,54 @@ def test_du_hoist_loosens_resident_bwd_plan():
     assert _plan_bwd(64, 256, 2, False, None)[0] == "resident"
     assert _plan_bwd(64, 768, 2, False, None)[0] == "tiled"
     assert _plan_bwd(32, 1024, 2, False, None)[0] == "tiled"
+
+
+def test_bf16_stream_residuals_grad_tolerance(monkeypatch):
+    """r4 bandwidth fix: under bf16 compute the z/dz/xproj HBM streams
+    are STORED bf16 (gate math stays f32 in-kernel). Gradients through
+    the fused backward must stay within bf16-scale tolerance of the f32
+    reference, and LSTM_TSP_RESIDUAL_F32=1 must restore the old f32
+    streams exactly."""
+    import functools
+
+    import lstm_tensorspark_tpu.ops.pallas_lstm as pallas_mod
+
+    params, xs = _setup()
+
+    def loss(run):
+        def f(p, x):
+            (hT, cT), ys = run(p, x)
+            return jnp.mean(ys ** 2) + jnp.mean(hT) + jnp.mean(cT ** 2)
+        return f
+
+    run_p = functools.partial(pallas_lstm_scan, compute_dtype=jnp.bfloat16,
+                              interpret=True)
+    run_r = functools.partial(lstm_scan, compute_dtype=jnp.bfloat16)
+    g_bf16 = jax.grad(loss(run_p), argnums=(0, 1))(params, xs)
+    g_ref = jax.grad(loss(run_r), argnums=(0, 1))(params, xs)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-2, atol=5e-3),
+        g_bf16, g_ref,
+    )
+
+    # kill-switch: f32 streams under bf16 compute (the A/B lever)
+    monkeypatch.setenv("LSTM_TSP_RESIDUAL_F32", "1")
+    assert pallas_mod._rbytes(2) == 4
+    g_f32s = jax.grad(loss(run_p), argnums=(0, 1))(params, xs)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-2, atol=5e-3),
+        g_f32s, g_ref,
+    )
+
+
+def test_f32_compute_keeps_f32_streams():
+    """f32 compute must keep bit-exact f32 residual streams — the exact
+    interpret-mode parities above depend on it."""
+    from lstm_tensorspark_tpu.ops.pallas_lstm import (
+        _rbytes, _residual_dtype,
+    )
+
+    assert _residual_dtype(jnp.float32) == jnp.float32
+    assert _rbytes(4) == 4
+    assert _residual_dtype(jnp.bfloat16) == jnp.bfloat16
+    assert _rbytes(2) == 2
