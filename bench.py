@@ -497,28 +497,47 @@ def _scan_stream_bytes(strategy: str, T_s: int, D_s: int, B: int, H: int,
 
 
 def _config_scans(name: str) -> list:
-    """(T, input_width, has_mask) for EVERY sequential scan one optimizer
-    step of this config runs — the per-scan inventory `_impl_bound` plans
-    over. LM: embed output (width H) feeds layer 0, H feeds deeper layers
-    (models/lstm_lm.py). Classifier: two directions per layer; embed
-    (width H) feeds layer 0, the 2H direction-concat feeds deeper layers
-    (models/classifier.py:61). Seq2seq: encoder scans at T then decoder
-    scans at horizon, F feeding both layer 0s (models/seq2seq.py:48-51)."""
+    """(T, input_width, has_mask, dirs) for EVERY sequential scan one
+    optimizer step of this config runs — the per-scan inventory
+    `_impl_bound` plans over. ``dirs=2`` marks a scan the runtime runs
+    through the stacked-direction kernel (both bi-LSTM chains advance in
+    ONE serialized pass; traffic of two). LM: embed output (width H)
+    feeds layer 0, H feeds deeper layers (models/lstm_lm.py).
+    Classifier: two directions per layer; embed (width H) feeds layer 0,
+    the 2H direction-concat feeds deeper layers (models/classifier.py:61).
+    Seq2seq: encoder scans at T then decoder scans at horizon, F feeding
+    both layer 0s (models/seq2seq.py:48-51)."""
     c = CONFIGS[name]
     kind, H_, L_ = c["kind"], c["H"], c["L"]
     if kind == "lm":
-        return [(c["T"], H_, False)] * L_
+        return [(c["T"], H_, False, 1)] * L_
     if kind == "classifier":
+        # mirror the runtime's dispatch (ops/scan.py bidir_lstm_scan): a
+        # layer whose shape fits the stacked-direction kernel advances
+        # BOTH chains in one pass — one serialized scan, but the traffic
+        # of two (the stacked entry below carries dirs=2 for the
+        # bandwidth accounting). Honors the same A/B lever.
+        import os
+
+        from lstm_tensorspark_tpu.ops.pallas_bilstm import bilstm_supported
+
+        pbytes = 2 if c.get("compute_dtype", "bfloat16") == "bfloat16" else 4
+        fuse_ok = os.environ.get("LSTM_TSP_NO_BIDIR_FUSE") != "1"
         scans = []
         for layer in range(L_):
             D = H_ if layer == 0 else 2 * H_
-            scans += [(c["T"], D, True)] * 2  # fwd + reversed directions
+            if fuse_ok and bilstm_supported(
+                    c["B"], H_, D, c["T"], platform="tpu",
+                    param_dtype_bytes=pbytes, has_mask=True):
+                scans.append((c["T"], D, True, 2))  # stacked: dirs share
+            else:
+                scans += [(c["T"], D, True, 1)] * 2  # two serialized scans
         return scans
     if kind == "seq2seq":
         def width(layer):
             return c["F"] if layer == 0 else H_
-        return ([(c["T"], width(l), False) for l in range(L_)]
-                + [(c["horizon"], width(l), False) for l in range(L_)])
+        return ([(c["T"], width(l), False, 1) for l in range(L_)]
+                + [(c["horizon"], width(l), False, 1) for l in range(L_)])
     raise ValueError(kind)
 
 
@@ -559,12 +578,20 @@ def _impl_bound(name: str, rl: dict, rec: dict, measured: float) -> dict:
     serial_steps = 0
     stream_bytes = 0.0
     strategy_counts: dict = {}
-    for T_s, D_s, has_mask in _config_scans(name):
-        Dp = _pad_to_lane(D_s) if T_s >= _FUSEDX_MIN_T else None
-        s = chosen_bwd_strategy(B_, T_s, Hp, pbytes,
-                                has_mask=has_mask, Dp=Dp)
+    for T_s, D_s, has_mask, dirs in _config_scans(name):
+        if dirs == 2:
+            # stacked-direction kernel (ops/pallas_bilstm.py): residentx
+            # pair by construction — ONE serialized chain of T steps for
+            # both directions, traffic of two residentx scans (2B rows)
+            s = "residentx"
+            stream_bytes += 2 * _scan_stream_bytes(s, T_s, D_s, B_, H_,
+                                                   pbytes)
+        else:
+            Dp = _pad_to_lane(D_s) if T_s >= _FUSEDX_MIN_T else None
+            s = chosen_bwd_strategy(B_, T_s, Hp, pbytes,
+                                    has_mask=has_mask, Dp=Dp)
+            stream_bytes += _scan_stream_bytes(s, T_s, D_s, B_, H_, pbytes)
         serial_steps += T_s * (1 + MULT[s])
-        stream_bytes += _scan_stream_bytes(s, T_s, D_s, B_, H_, pbytes)
         strategy_counts[s] = strategy_counts.get(s, 0) + 1
     # chain-latency units: the roofline's chain covers T_chain steps
     T_chain = c["T"] + (c["horizon"] if kind == "seq2seq" else 0)
@@ -804,8 +831,16 @@ def _liveness_probe(timeout_s: float = 60.0,
     the pre-r4 fast-fail behavior). On exhaustion, the LAST failure
     reason and the attempt count go into the 0-value contract line."""
     if window_s is None:
-        window_s = float(os.environ.get(
-            "LSTM_TSP_BENCH_LIVENESS_WINDOW_S", 720))
+        raw = os.environ.get("LSTM_TSP_BENCH_LIVENESS_WINDOW_S", "720")
+        try:
+            window_s = float(raw)
+        except ValueError:
+            # a typo'd override must not crash the bench before the JSON
+            # contract line can be emitted — ignore it, keep the default
+            print(f"bench: ignoring malformed "
+                  f"LSTM_TSP_BENCH_LIVENESS_WINDOW_S={raw!r}",
+                  file=sys.stderr)
+            window_s = 720.0
     window_s = max(window_s, 0.0)
     deadline = time.monotonic() + window_s
     attempts = 0

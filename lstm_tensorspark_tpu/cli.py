@@ -1031,17 +1031,10 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
         placed = place_lm_params(params, mesh)
     state = init_train_state(placed, optimizer, jax.random.PRNGKey(args.seed + 1))
     if zero1:
-        # place the moments on their stage x data shards up front — no
-        # device ever materializes a pipe-only (data-replicated) copy
-        from .parallel.pipeline_parallel import pp_lm_param_shardings
-        from .parallel.tensor_parallel import place_params
-        from .parallel.zero import zero1_tp_opt_specs
+        from .parallel.pipeline_parallel import place_pp_zero1_opt_state
 
-        opt_specs = zero1_tp_opt_specs(
-            optimizer, stacked, pp_lm_param_shardings(stacked, tp=tp > 1),
-            mesh)
-        state = state._replace(
-            opt_state=place_params(state.opt_state, opt_specs, mesh))
+        state = state._replace(opt_state=place_pp_zero1_opt_state(
+            state.opt_state, optimizer, stacked, mesh, tp=tp > 1))
 
     restored, checkpoint_fn = _wire_checkpoint(
         args, logger, lambda: jax.device_get(state)

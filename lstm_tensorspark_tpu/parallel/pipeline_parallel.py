@@ -145,6 +145,33 @@ def place_pp_lm_params(params_stacked, mesh: Mesh, *, tp: bool = False):
     )
 
 
+def pp_zero1_opt_specs(optimizer, params_stacked, mesh: Mesh, *,
+                       tp: bool = False):
+    """The ZeRO-1 x PP optimizer-state spec tree — the ONE derivation
+    every consumer shares (the train step's shardings pin, the CLI's and
+    dryrun's initial placement, tests): each moment leaf's stage-sharded
+    spec extended with the data axis (zero.zero1_tp_opt_specs applied to
+    the stacked param specs)."""
+    from .zero import zero1_tp_opt_specs
+
+    return zero1_tp_opt_specs(
+        optimizer, params_stacked,
+        pp_lm_param_shardings(params_stacked, tp=tp), mesh,
+    )
+
+
+def place_pp_zero1_opt_state(opt_state, optimizer, params_stacked,
+                             mesh: Mesh, *, tp: bool = False):
+    """Place a fresh/restored optimizer state on its stage x data shards
+    up front — no device ever materializes a data-replicated copy."""
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        opt_state,
+        pp_zero1_opt_specs(optimizer, params_stacked, mesh, tp=tp),
+        is_leaf=lambda x: isinstance(x, jax.Array) or x is None,
+    )
+
+
 def pp_lm_loss(
     params,
     batch,
@@ -433,14 +460,9 @@ def make_pp_lm_train_step(
         is_leaf=lambda x: isinstance(x, P),
     )
     if zero1:
-        from .zero import zero1_tp_opt_specs
-
         opt_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s),
-            zero1_tp_opt_specs(
-                optimizer, params_stacked,
-                pp_lm_param_shardings(params_stacked, tp=tp), mesh,
-            ),
+            pp_zero1_opt_specs(optimizer, params_stacked, mesh, tp=tp),
             is_leaf=lambda x: isinstance(x, P),
         )
     else:

@@ -202,6 +202,14 @@ class Checkpointer:
             json.dumps({"step": payload["step"],
                         "value": payload["value"]}).encode(),
         )
+        # a single-process best supersedes any earlier SHARDED best: drop
+        # its marker + shard files so the two artifact kinds never coexist
+        # past a save (see _best_artifact for the crash-window tiebreak)
+        if os.path.exists(self._best_marker):
+            os.remove(self._best_marker)
+        for name in os.listdir(self.directory):
+            if self._BEST_PROC_PAT.match(name):
+                os.remove(os.path.join(self.directory, name))
         self._best_meta_cache = {"step": payload["step"],
                                  "value": payload["value"]}
         return self._best_path
@@ -239,14 +247,46 @@ class Checkpointer:
                 os.path.join(self.directory, "best.json"),
                 json.dumps({"step": step, "value": float(value)}).encode(),
             )
-            # the marker now points at this step's set: older sets are dead
+            # the marker now points at this step's set: older sets AND any
+            # single-process best.msgpack from an earlier 1-process run are
+            # dead (a stale best.msgpack must not shadow this best)
             for name in os.listdir(self.directory):
                 m = self._BEST_PROC_PAT.match(name)
                 if m and int(m.group(1)) != step:
                     os.remove(os.path.join(self.directory, name))
+            if os.path.exists(self._best_path):
+                os.remove(self._best_path)
         _sync(f"best_done_{step}")
         self._best_meta_cache = {"step": step, "value": float(value)}
         return path
+
+    def _best_artifact(self):
+        """(kind, meta) of the live best artifact, or (None, None).
+
+        Each save deletes the OTHER kind, so both coexist only in the
+        tiny crash window between writing the new artifact and unlinking
+        the old — arbitrate by step, newer wins (tie → the single-file
+        artifact: it is self-contained). Without the tiebreak a stale
+        best.msgpack from an earlier 1-process run would permanently
+        shadow every later sharded best."""
+        single = sharded = None
+        if os.path.exists(self._best_path):
+            with open(self._best_path, "rb") as f:
+                payload = serialization.msgpack_restore(f.read())
+            single = {"step": int(payload["step"]),
+                      "value": float(payload["value"])}
+        if os.path.exists(self._best_marker):
+            with open(self._best_marker) as f:
+                meta = json.loads(f.read())
+            sharded = {"step": int(meta["step"]),
+                       "value": float(meta["value"]),
+                       "writers": int(meta["writers"])}
+        if single is not None and (sharded is None
+                                   or sharded["step"] <= single["step"]):
+            return "single", single
+        if sharded is not None:
+            return "sharded", sharded
+        return None, None
 
     def best_meta(self) -> dict | None:
         """{step, value} of the saved best checkpoint (from the
@@ -258,20 +298,10 @@ class Checkpointer:
         if self._best_meta_cache is not None:
             return dict(self._best_meta_cache)
         self.wait()
-        if os.path.exists(self._best_path):
-            with open(self._best_path, "rb") as f:
-                payload = serialization.msgpack_restore(f.read())
-            self._best_meta_cache = {"step": int(payload["step"]),
-                                     "value": float(payload["value"])}
-        elif os.path.exists(self._best_marker):
-            # sharded best: the marker IS authoritative (it names the one
-            # complete shard set and was written after all of it)
-            with open(self._best_marker) as f:
-                meta = json.loads(f.read())
-            self._best_meta_cache = {"step": int(meta["step"]),
-                                     "value": float(meta["value"])}
-        else:
+        kind, meta = self._best_artifact()
+        if kind is None:
             return None
+        self._best_meta_cache = {"step": meta["step"], "value": meta["value"]}
         return dict(self._best_meta_cache)
 
     def restore_best(self, template):
@@ -282,16 +312,15 @@ class Checkpointer:
         template) even under a LATER different process count, like any
         sharded step checkpoint."""
         self.wait()
-        if os.path.exists(self._best_path):
+        kind, meta = self._best_artifact()
+        if kind is None:
+            return None
+        if kind == "single":
             with open(self._best_path, "rb") as f:
                 payload = serialization.msgpack_restore(f.read())
             restored = serialization.from_bytes(template, payload["state"])
             return self._reshard_like(template, restored)
-        if not os.path.exists(self._best_marker):
-            return None
-        with open(self._best_marker) as f:
-            meta = json.loads(f.read())
-        step, writers = int(meta["step"]), int(meta["writers"])
+        step, writers = meta["step"], meta["writers"]
         paths = []
         for name in sorted(os.listdir(self.directory)):
             m = self._BEST_PROC_PAT.match(name)

@@ -324,3 +324,51 @@ def test_resume_best_fails_fast_without_best(tmp_path):
     with pytest.raises(SystemExit):  # dir exists but never had --keep-best
         main(["--dataset", "ptb_char", "--num-steps", "2", "--resume-best",
               "--checkpoint-dir", str(tmp_path)])
+
+
+def test_best_artifact_kinds_never_shadow(tmp_path):
+    """A stale single-process best.msgpack must not shadow a newer
+    sharded best, and vice versa: each save deletes the other kind, and
+    the crash-window arbitration picks the newer step (code-review r4).
+
+    `_save_best_sharded` degenerates cleanly at process_count()==1 (the
+    sync barriers no-op, pid 0 writes everything), standing in for the
+    multi-process writer."""
+    loss_fn, opt, state, batch = _setup()
+    step = make_train_step(loss_fn, opt)
+    state1, _ = step(state, batch)    # step 1
+    state2, _ = step(state1, batch)   # step 2
+    state3, _ = step(state2, batch)   # step 3
+
+    ck = Checkpointer(str(tmp_path))
+    # 1-process best at step 1, then a "multi-process" best at step 2:
+    ck.save_best(state1, 3.0)
+    assert os.path.exists(os.path.join(str(tmp_path), "best.msgpack"))
+    ck._save_best_sharded(state2, 0.5)
+    ck._best_meta_cache = None
+    # the old best.msgpack is gone; meta and restore follow the shards
+    assert not os.path.exists(os.path.join(str(tmp_path), "best.msgpack"))
+    assert ck.best_meta() == {"step": 2, "value": 0.5}
+    restored = ck.restore_best(jax.device_get(state2))
+    np.testing.assert_array_equal(np.asarray(restored.step), 2)
+
+    # and back: a newer single-process best removes the sharded set
+    ck.save_best(state3, 0.25)
+    ck._best_meta_cache = None
+    assert ck.best_meta() == {"step": 3, "value": 0.25}
+    left = [n for n in os.listdir(str(tmp_path))
+            if n.startswith("best_") or n == "best.complete"]
+    assert left == [], left
+
+    # crash-window arbitration: both kinds on disk at once (a crash
+    # between writing one and unlinking the other) -> newer step wins
+    with open(os.path.join(str(tmp_path), "best.complete"), "w") as f:
+        json.dump({"writers": 1, "step": 1, "value": 9.9}, f)
+    ck._best_meta_cache = None
+    assert ck._best_artifact()[0] == "single"   # single step 3 > sharded 1
+    assert ck.best_meta() == {"step": 3, "value": 0.25}
+    with open(os.path.join(str(tmp_path), "best.complete"), "w") as f:
+        json.dump({"writers": 1, "step": 7, "value": 0.1}, f)
+    ck._best_meta_cache = None
+    assert ck._best_artifact()[0] == "sharded"  # sharded step 7 > single 3
+    assert ck.best_meta() == {"step": 7, "value": 0.1}

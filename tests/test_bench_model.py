@@ -22,7 +22,9 @@ def test_impl_bound_tracks_runtime_strategy_per_config():
     rec = {"train_flops_step": 1e10}
     want = {
         "ptb_char": ("resident", 2),       # L=1, uni, stored-z bwd
-        "imdb_bilstm": ("residentx", 6),   # L=1, bi, recompute-z (T=400)
+        # L=1, bi: BOTH directions advance in the stacked-direction kernel
+        # (ops/pallas_bilstm.py) — one serialized residentx chain
+        "imdb_bilstm": ("residentx", 3),
         "wikitext2": ("tiled", 4),         # L=2, uni, U^T streamed
         "uci_seq2seq": ("resident", 4),    # L=2 (dU hoist refit resident)
         "wikitext103": ("tiled", 8),       # L=4, uni
@@ -35,6 +37,20 @@ def test_impl_bound_tracks_runtime_strategy_per_config():
         parallel = max(1e10 - passes * 1e9, 0.0) / (bench.PEAK_TFLOPS * 1e12)
         assert out["impl_bound_sec_per_step"] == pytest.approx(
             passes * 1e-4 + parallel, abs=1.5e-6)
+
+
+def test_impl_bound_bidir_fuse_lever(monkeypatch):
+    """LSTM_TSP_NO_BIDIR_FUSE=1 must restore the two-serialized-scans
+    model for the classifier — the bound follows the SAME lever the
+    runtime dispatch honors, so A/B numbers get matching bounds."""
+    import bench
+
+    monkeypatch.setenv("LSTM_TSP_NO_BIDIR_FUSE", "1")
+    out = bench._impl_bound(
+        "imdb_bilstm", {"chain_sec": 1e-4, "chain_flops": 1e9},
+        {"train_flops_step": 1e10}, measured=1e-3)
+    assert out["impl_bwd_strategy"] == "residentx"
+    assert out["impl_serial_passes"] == 6
 
 
 def test_impl_bound_heterogeneous_scans_report_mixed(monkeypatch):

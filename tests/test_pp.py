@@ -54,14 +54,11 @@ def _pp_run(cfg, params, batches, opt, *, dp, pp, microbatches, tp=1,
     s = init_train_state(placed, opt, jax.random.PRNGKey(1))
     if zero1:
         from lstm_tensorspark_tpu.parallel.pipeline_parallel import (
-            pp_lm_param_shardings,
+            place_pp_zero1_opt_state,
         )
-        from lstm_tensorspark_tpu.parallel.tensor_parallel import place_params
-        from lstm_tensorspark_tpu.parallel.zero import zero1_tp_opt_specs
 
-        opt_specs = zero1_tp_opt_specs(
-            opt, stacked, pp_lm_param_shardings(stacked, tp=tp > 1), mesh)
-        s = s._replace(opt_state=place_params(s.opt_state, opt_specs, mesh))
+        s = s._replace(opt_state=place_pp_zero1_opt_state(
+            s.opt_state, opt, stacked, mesh, tp=tp > 1))
     losses = []
     for b in batches:
         s, m = step(s, b)
