@@ -1,5 +1,6 @@
 #!/usr/bin/env python3
-"""One-shot runner for DESIGN.md's CHIP-RECOVERY QUEUE (round-3 wedge #3).
+"""One-shot runner for DESIGN.md's CHIP-RECOVERY QUEUE (wedge #3, plus the
+round-4 additions queued while the wedge outlasted the session).
 
 Run after the tunneled chip comes back:
 
@@ -9,14 +10,28 @@ Steps, in order (each prints its result; the script stops on the first
 failure so a regression is investigated before the table is refreshed):
 
 1. liveness probe (subprocess, 90 s — a wedged chip exits here fast);
-2. tests_tpu/ on hardware (re-validates the dU-hoist kernels on-chip);
-3. configs 2/4 throughput vs the pre-hoist baselines measured same-day on
-   the quiet chip (19,661 / 65,165 seq/s) — the dU-hoist before/after;
-4. full bench.py (K=512 headline, impl_bound roofline fields, post-hoist
-   rows) -> fresh BENCH_TABLE.json.
+2. tests_tpu/ on hardware — re-validates the dU-hoist kernels AND the
+   round-4 Mosaic surfaces (stacked-direction bi-LSTM kernel, SP x
+   Pallas all-manual shard_map, bf16 residual streams);
+3. configs 2/4 throughput vs the pre-hoist r3 baselines (19,661 /
+   65,165 seq/s, same-day quiet chip) — NOTE config 2 now also carries
+   the stacked-direction kernel and bf16 streams, so a big positive
+   delta is expected, not suspicious;
+4. A/B levers on their target configs:
+   - stacked-direction kernel (config 2): LSTM_TSP_NO_BIDIR_FUSE=1 off
+     vs on;
+   - bf16 residual streams (configs 1/4): LSTM_TSP_RESIDUAL_F32=1 off
+     vs on (the r4 bandwidth analysis predicts the biggest relative win
+     on config 1);
+5. full bench.py (K=512 headline, impl_bound + r4 bandwidth-floor
+   fields) -> fresh BENCH_TABLE.json;
+6. bench_quality.py — the r4 discriminating tasks invalidated the
+   committed curves for configs 2/3/5 (OPTIONAL here: ~40-60 min; skip
+   with --skip-quality and run it separately).
 
-Then regenerate the README performance table from the new BENCH_TABLE.json
-by hand (rows + K-note), per the queue's step 3.
+Then regenerate the README performance table from the new
+BENCH_TABLE.json by hand (rows + K-note + the bound_binding /
+fraction_of_impl_bound2 prose), per the queue.
 """
 
 import json
@@ -25,8 +40,14 @@ import subprocess
 import sys
 
 _DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-# pre-hoist same-day baselines (quiet chip); regression = materially below
+# pre-hoist same-day r3 baselines (quiet chip); regression = materially below
 _BASELINES = {"imdb_bilstm": 19661.0, "uci_seq2seq": 65165.0}
+# r4 A/B levers: {env_var: (configs, label)}
+_AB_LEVERS = {
+    "LSTM_TSP_NO_BIDIR_FUSE": (["imdb_bilstm"], "stacked-direction kernel"),
+    "LSTM_TSP_RESIDUAL_F32": (["ptb_char", "uci_seq2seq"],
+                              "bf16 residual streams"),
+}
 
 
 def _run(argv, timeout, label):
@@ -41,37 +62,46 @@ def _run(argv, timeout, label):
         sys.exit(rc)
 
 
+def _measure(name, env=None, timeout=900):
+    """measure_config in a subprocess (a chip that passes the probe can
+    STILL wedge mid-measurement; bench's watchdog only arms in main()).
+    Returns the record dict, or exits on failure."""
+    run_env = dict(os.environ)
+    if env:
+        run_env.update(env)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import json, bench; "
+             f"r = bench.measure_config({name!r}); "
+             "print(json.dumps(r))"],
+            cwd=_DIR, timeout=timeout, capture_output=True, text=True,
+            env=run_env,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"FAIL: measure_config({name}) exceeded {timeout}s "
+              "(chip wedged again?)")
+        sys.exit(2)
+    if out.returncode != 0:
+        print(f"FAIL: measure_config({name}) rc={out.returncode}:\n"
+              f"{out.stderr[-1000:]}")
+        sys.exit(out.returncode)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def main() -> int:
+    skip_quality = "--skip-quality" in sys.argv[1:]
     _run([sys.executable, "-c",
           "import jax, jax.numpy as jnp; "
           "x = jnp.ones((128, 128)); print(float((x @ x).sum()))"],
          timeout=90, label="liveness probe")
     _run([sys.executable, "-m", "pytest", "tests_tpu/", "-q"],
-         timeout=900, label="tests_tpu on hardware")
+         timeout=1200, label="tests_tpu on hardware")
 
-    print("== configs 2/4 throughput (dU-hoist before/after)", flush=True)
+    print("== configs 2/4 throughput vs pre-hoist r3 baselines", flush=True)
     regressed = []
     for name, base in _BASELINES.items():
-        # subprocess + timeout like every other step: a chip that passes
-        # the probe can STILL wedge mid-measurement (a jit dispatch that
-        # never returns), and bench's watchdog only arms in bench.main()
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c",
-                 "import json, bench; "
-                 f"r = bench.measure_config({name!r}); "
-                 "print(json.dumps(r))"],
-                cwd=_DIR, timeout=900, capture_output=True, text=True,
-            )
-        except subprocess.TimeoutExpired:
-            print(f"FAIL: measure_config({name}) exceeded 900s "
-                  "(chip wedged again?)")
-            return 2
-        if out.returncode != 0:
-            print(f"FAIL: measure_config({name}) rc={out.returncode}:\n"
-                  f"{out.stderr[-1000:]}")
-            return out.returncode
-        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        rec = _measure(name)
         got = rec["seq_per_sec"]
         delta = (got / base - 1.0) * 100.0
         print(f"{name}: {got:,.0f} seq/s vs pre-hoist {base:,.0f} "
@@ -80,16 +110,44 @@ def main() -> int:
             regressed.append(name)
     if regressed:
         print(f"FAIL: regression vs pre-hoist baselines on {regressed}; "
-              "investigate before refreshing the table (DESIGN.md queue "
-              "step 4)")
+              "investigate before refreshing the table (DESIGN.md queue)")
         return 3
+
+    print("== r4 A/B levers", flush=True)
+    for var, (names, label) in _AB_LEVERS.items():
+        for name in names:
+            on = _measure(name)  # lever off = the new default path
+            off = _measure(name, env={var: "1"})  # lever on = old behavior
+            speedup = on["seq_per_sec"] / max(off["seq_per_sec"], 1e-9)
+            print(f"{label} on {name}: {off['seq_per_sec']:,.0f} -> "
+                  f"{on['seq_per_sec']:,.0f} seq/s ({speedup:.2f}x; "
+                  f"{var}=1 is the old path)")
+            if speedup < 0.97:
+                print(f"WARN: {label} REGRESSES {name} — consider gating "
+                      "it off for this config and record the negative "
+                      "result in DESIGN.md")
 
     _run([sys.executable, "bench.py"], timeout=2700, label="full bench.py")
     table = json.load(open(os.path.join(_DIR, "BENCH_TABLE.json")))
     print(f"fresh table: headline {table['headline_seq_per_sec']:,.0f} "
           f"seq/s, {table['vs_cpu_baseline']:.0f}x CPU")
+    hbm = table.get("hbm_bandwidth", {})
+    if "gb_per_sec" in hbm:
+        print(f"measured HBM bandwidth: {hbm['gb_per_sec']:,.0f} GB/s")
+    for name, rec in table.get("configs", {}).items():
+        rl = rec.get("roofline", {}) if isinstance(rec, dict) else {}
+        if "bound_binding" in rl:
+            print(f"  {name}: binding={rl['bound_binding']}, "
+                  f"fraction_of_impl_bound2={rl['fraction_of_impl_bound2']}")
+
+    if not skip_quality:
+        _run([sys.executable, "bench_quality.py"], timeout=7200,
+             label="bench_quality.py (r4 discriminating tasks)")
+    else:
+        print("skipped bench_quality.py (--skip-quality); run it before "
+              "committing BASELINE_MEASURED.json")
     print("NOW: regenerate the README performance table from "
-          "BENCH_TABLE.json and commit both (queue step 3).")
+          "BENCH_TABLE.json and commit the refreshed artifacts.")
     return 0
 
 
