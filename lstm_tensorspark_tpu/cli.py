@@ -253,10 +253,9 @@ def main(argv=None) -> int:
                          "supervisor converts --resume-best to --resume on "
                          "relaunch so a crashed fine-tune continues its own "
                          "lineage)")
-    if args.resume_best and (args.num_processes or 1) > 1:
-        raise SystemExit("--resume-best is single-process only (the rewind "
-                         "fences checkpoint files; multi-host would race "
-                         "the deletes)")
+    # --resume-best composes with multi-process runs since r4: the rewind's
+    # fence deletes on process 0 behind barriers (train/checkpoint.py
+    # fence_after), restore/re-save use the sharded writer machinery
 
     if args.compilation_cache:
         # cache EVERY executable (the defaults skip sub-second compiles,

@@ -105,11 +105,19 @@ class Checkpointer:
         """Delete every step_N checkpoint NEWER than ``step`` — the
         --resume-best rewind: the abandoned lineage's later checkpoints
         must not be restorable, or a subsequent --resume would silently
-        continue the diverged weights the user rewound away from."""
-        for s in self._steps():
-            if s > step:
-                for f in self._files_for_step(s):
-                    os.remove(f)
+        continue the diverged weights the user rewound away from.
+
+        Multi-process: process 0 deletes, everyone barriers on both
+        edges — concurrent unlinks of the same shared-fs files would
+        race, and no process may proceed to re-save until the fence is
+        fully down."""
+        _sync(f"ckpt_fence_enter_{step}")
+        if jax.process_index() == 0:
+            for s in self._steps():
+                if s > step:
+                    for f in self._files_for_step(s):
+                        os.remove(f)
+        _sync(f"ckpt_fence_done_{step}")
 
     # -- save --------------------------------------------------------------
 

@@ -691,6 +691,20 @@ jax.tree.map(check, state2.opt_state, restored.opt_state)
 restored3, m3 = step(restored, batch)
 state3, want = step(state2, batch)
 assert abs(float(m3["loss"]) - float(want["loss"])) < 1e-6
+
+# --resume-best REWIND flow, multi-process (r4): train past the best,
+# checkpoint the diverged lineage, then rewind — fence the newer steps
+# (pid-0 deletes behind barriers) and re-save the rewound point; a later
+# restore_latest must land on the best, not the abandoned lineage.
+state3, _ = step(state2, batch)      # step 3 (diverged lineage)
+ck.save(state3)
+assert ck.latest_step() == 3
+rewound = ck.restore_best(template)
+ck.fence_after(int(jax.device_get(rewound.step)))
+ck.save(rewound)
+assert ck.latest_step() == 2
+relatest = ck.restore_latest(template)
+jax.tree.map(check, rewound.params, relatest.params)
 print(f"proc {pid}: sharded best checkpoint ok", flush=True)
 '''
 
