@@ -29,9 +29,9 @@ failure so a regression is investigated before the table is refreshed):
    committed curves for configs 2/3/5 (OPTIONAL here: ~40-60 min; skip
    with --skip-quality and run it separately).
 
-Then regenerate the README performance table from the new
-BENCH_TABLE.json by hand (rows + K-note + the bound_binding /
-fraction_of_impl_bound2 prose), per the queue.
+The README's five-config table is regenerated automatically
+(tools/readme_table.py); only the surrounding perf PROSE still needs a
+manual re-check against the new numbers.
 """
 
 import json
@@ -140,14 +140,18 @@ def main() -> int:
             print(f"  {name}: binding={rl['bound_binding']}, "
                   f"fraction_of_impl_bound2={rl['fraction_of_impl_bound2']}")
 
+    _run([sys.executable, "tools/readme_table.py"], timeout=60,
+         label="README table regen from fresh BENCH_TABLE.json")
+
     if not skip_quality:
         _run([sys.executable, "bench_quality.py"], timeout=7200,
              label="bench_quality.py (r4 discriminating tasks)")
     else:
         print("skipped bench_quality.py (--skip-quality); run it before "
               "committing BASELINE_MEASURED.json")
-    print("NOW: regenerate the README performance table from "
-          "BENCH_TABLE.json and commit the refreshed artifacts.")
+    print("NOW: re-check the README perf PROSE against the new table "
+          "(the table itself is regenerated) and commit the refreshed "
+          "artifacts.")
     return 0
 
 

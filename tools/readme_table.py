@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Regenerate README.md's five-config performance table from
+BENCH_TABLE.json — the manual tail of the chip-recovery queue that went
+stale in round 3 (the README carried a pre-refresh 756k row against the
+table's 796k headline). Mechanical from here on:
+
+    python3 tools/readme_table.py          # rewrite README.md in place
+    python3 tools/readme_table.py --check  # exit 1 if README is stale
+
+The generator owns ONLY the table block between the markers below (the
+surrounding prose stays hand-written); it emits the r4 bound column
+(`fraction_of_impl_bound2` against max(serial-chain, bandwidth) when
+present, else the r3 `fraction_of_bound`).
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+README = os.path.join(_DIR, "README.md")
+TABLE = os.path.join(_DIR, "BENCH_TABLE.json")
+
+_LABELS = {
+    "ptb_char": ("1 — PTB char", lambda d: f"1×{d['H']}, V={d['V']}"),
+    "imdb_bilstm": ("2 — IMDB bi-LSTM",
+                    lambda d: f"1×2×{d['H']}, V={d['V'] // 1000}k"),
+    "wikitext2": ("3 — WikiText-2 word",
+                  lambda d: f"{d['L']}×{d['H']}, V={d['V']:,}"),
+    "uci_seq2seq": ("4 — UCI seq2seq",
+                    lambda d: f"{d['L']}×{d['H']}, F={d['F']}"),
+    "wikitext103": ("5 — WikiText-103 word",
+                    lambda d: f"{d['L']}×{d['H']}, V={d['V']:,}"),
+}
+
+
+def _fmt_rate(x: float) -> str:
+    if x >= 1e6:
+        return f"{x / 1e6:.2f}M"
+    if x >= 10_000:
+        return f"{x / 1e3:.1f}k"
+    if x >= 1_000:
+        return f"{x / 1e3:.2f}k"
+    return f"{x:.0f}"
+
+
+def _batch(d: dict, kind: str) -> str:
+    if kind == "seq2seq":
+        return f"{d['B']}×{d['T']}→{d['horizon']}"
+    return f"{d['B']}×{d['T']}"
+
+
+def render(table: dict) -> str:
+    rows = [
+        "| Config | Model | Batch | Throughput | Model FLOPs | MFU "
+        "| of bound |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    best_mfu = max(
+        (r.get("mfu_vs_bf16_peak", 0.0)
+         for r in table["configs"].values() if "error" not in r),
+        default=0.0,
+    )
+    for name, (label, model_fmt) in _LABELS.items():
+        rec = table["configs"].get(name)
+        if rec is None or "error" in rec:
+            rows.append(f"| {label} | — | — | (not measured: "
+                        f"{(rec or {}).get('error', 'missing')}) | — | — "
+                        f"| — |")
+            continue
+        d = rec["dims"]
+        rl = rec.get("roofline", {})
+        frac = rl.get("fraction_of_impl_bound2",
+                      rl.get("fraction_of_bound"))
+        frac_s = f"{frac:.0%}" if isinstance(frac, (int, float)) else "—"
+        binding = rl.get("bound_binding")
+        if binding == "bandwidth":
+            frac_s += " (bw)"
+        mfu = rec["mfu_vs_bf16_peak"]
+        mfu_s = f"**{mfu:.1%}**" if mfu == best_mfu else f"{mfu:.1%}"
+        seq = _fmt_rate(rec["seq_per_sec"])
+        tok = rec["tokens_per_sec"] / 1e6
+        thr = f"{seq} seq/s · {tok:.2f} M tok/s"
+        if name == "ptb_char":
+            thr = f"**{thr}**"
+        rows.append(
+            f"| {label} | {model_fmt(d)} | {_batch(d, rec['kind'])} "
+            f"| {thr} | {rec['model_tflops_per_sec']:.1f} TF/s "
+            f"| {mfu_s} | {frac_s} |"
+        )
+    return "\n".join(rows)
+
+
+_BLOCK = re.compile(
+    r"(\| Config \| Model \| Batch \| Throughput \| Model FLOPs \| MFU "
+    r"\| of bound \|\n)(?:\|.*\n)+"
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if README's table is stale, change nothing")
+    args = ap.parse_args()
+
+    with open(TABLE) as f:
+        table = json.load(f)
+    with open(README) as f:
+        readme = f.read()
+    m = _BLOCK.search(readme)
+    if not m:
+        print("README table block not found (markers changed?)",
+              file=sys.stderr)
+        return 2
+    new_block = render(table) + "\n"
+    if readme[m.start():m.end()] == new_block:
+        print("README table is in sync with BENCH_TABLE.json")
+        return 0
+    if args.check:
+        print("README table is STALE vs BENCH_TABLE.json "
+              "(run tools/readme_table.py)", file=sys.stderr)
+        return 1
+    with open(README, "w") as f:
+        f.write(readme[:m.start()] + new_block + readme[m.end():])
+    print("README table regenerated from BENCH_TABLE.json")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
