@@ -166,3 +166,51 @@ def test_env_disable_lever(monkeypatch):
     got = scan_mod.bidir_lstm_scan(pf, pb, xs, use_pallas=True)
     want = _reference(pf, pb, xs)
     _assert_pair_close(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_classifier_training_with_stacked_kernel_interpret(monkeypatch):
+    """Full-model integration: classifier training (embed -> bi-layer ->
+    concat -> head -> xent) with the stacked-direction kernel forced past
+    the platform gate (interpret mode) must reproduce the plain-scan
+    trajectory step for step."""
+    import functools
+
+    import lstm_tensorspark_tpu.ops.pallas_bilstm as bilstm_mod
+    from lstm_tensorspark_tpu.models import (
+        ClassifierConfig, classifier_loss, init_classifier,
+    )
+    from lstm_tensorspark_tpu.train import make_optimizer, make_train_step
+    from lstm_tensorspark_tpu.train.loop import init_train_state
+
+    V, Bc, Tc = 20, 8, 12
+    rng = np.random.RandomState(0)
+    batch = {
+        "tokens": rng.randint(0, V, (Bc, Tc)).astype(np.int32),
+        "lengths": rng.randint(3, Tc + 1, (Bc,)).astype(np.int32),
+        "labels": rng.randint(0, 2, (Bc,)).astype(np.int32),
+        "valid": np.ones((Bc,), np.float32),
+    }
+
+    def run(use_pallas):
+        cfg = ClassifierConfig(vocab_size=V, hidden_size=16, num_layers=2,
+                               use_pallas=use_pallas)
+        params = init_classifier(jax.random.PRNGKey(3), cfg)
+        opt = make_optimizer("adam", 1e-2)
+        step = make_train_step(
+            lambda p, b, r: classifier_loss(p, b, cfg), opt)
+        state = init_train_state(params, opt, jax.random.PRNGKey(4))
+        losses = []
+        for _ in range(3):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    plain = run(False)
+    monkeypatch.setattr(bilstm_mod, "bilstm_supported",
+                        lambda *a, **k: True)
+    monkeypatch.setattr(
+        bilstm_mod, "pallas_bilstm_scan",
+        functools.partial(bilstm_mod.pallas_bilstm_scan, interpret=True),
+    )
+    got = run(True)
+    np.testing.assert_allclose(got, plain, rtol=1e-4, atol=1e-5)
