@@ -24,6 +24,39 @@ from .corpus import (
 )
 
 
+def _cached_word_stream(n_tokens: int, vocab_size: int, seed: int,
+                        noise: float, generate) -> list:
+    """Token list of ``generate(n_tokens, vocab_size, seed=, noise=)``,
+    cached as plain text under the system temp dir, keyed by every
+    generation parameter. A missing/corrupt/short cache regenerates
+    silently — the cache is an optimization, never a correctness
+    dependency (atomic tmp+rename write; concurrent legs at worst both
+    generate and one rename wins)."""
+    import tempfile
+
+    cache_dir = os.path.join(tempfile.gettempdir(), "lstm_tsp_corpus_cache")
+    path = os.path.join(
+        cache_dir, f"words_{n_tokens}_{vocab_size}_{seed}_{noise}.txt")
+    if os.path.exists(path):
+        try:
+            with open(path, "r", encoding="ascii") as f:
+                stream = f.read().split()
+            if len(stream) == n_tokens:
+                return stream
+        except OSError:
+            pass  # regenerate below
+    text = generate(n_tokens, vocab_size, seed=seed, noise=noise)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w", encoding="ascii") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # cache write failure is not an error
+    return text.split()
+
+
 def _lm_dataset(
     data_path: str | None,
     basenames: list[str],
@@ -41,14 +74,18 @@ def _lm_dataset(
         if synthetic_vocab is not None:
             # controlled-entropy stand-in (word LMs): the splits share the
             # SAME chain (same seed) — valid/test measure generalization
-            # over held-out samples of one process, like real corpora
+            # over held-out samples of one process, like real corpora.
+            # The stream is cached on disk (keyed by every generation
+            # parameter): the 2M-token chain costs ~1.2 s per process
+            # launch, a pure fixed cost in the launch-to-quality races
+            # that both platforms would otherwise re-pay every leg.
             from .corpus import synthetic_word_corpus
 
             # one long stream, sliced — cheaper than three generations
-            stream = synthetic_word_corpus(
-                int(synthetic_tokens * 1.2), synthetic_vocab, seed=seed,
-                noise=synthetic_noise,
-            ).split()
+            stream = _cached_word_stream(
+                int(synthetic_tokens * 1.2), synthetic_vocab, seed,
+                synthetic_noise, synthetic_word_corpus,
+            )
             n, tenth = synthetic_tokens, synthetic_tokens // 10
             texts = {
                 "train": " ".join(stream[:n]),
