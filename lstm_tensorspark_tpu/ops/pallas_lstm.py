@@ -186,8 +186,13 @@ def _residentx_bwd_vmem(B: int, H: int, Dp: int, pbytes: int,
 
 
 def _resident_fwd_vmem(B: int, H: int, pbytes: int, save_residuals: bool,
-                       has_mask: bool = False) -> int:
-    c = 8  # worst-case time chunk (_time_chunk)
+                       has_mask: bool = False, c: int = 8) -> int:
+    """``c`` is the time chunk — r4: the planner shrinks it when the
+    streamed blocks would not fit at 8 (previously resident was
+    evaluated at the worst-case chunk only, so H=650/1024 fell through
+    to the tiled strategy and paid its per-timestep U re-stream — the
+    dominant cost the bandwidth analysis exposed; a smaller chunk trades
+    some grid-step overhead for keeping U resident)."""
     r = _rbytes(pbytes)
     v = 4 * H * H * pbytes  # U resident
     v += 2 * c * B * 4 * H * r  # xproj blocks (double-buffered, stream dtype)
@@ -202,15 +207,16 @@ def _resident_fwd_vmem(B: int, H: int, pbytes: int, save_residuals: bool,
 
 
 def _resident_bwd_vmem(B: int, H: int, pbytes: int,
-                       has_mask: bool = False) -> int:
+                       has_mask: bool = False, c: int = 8) -> int:
+    """``c`` as in `_resident_fwd_vmem` (r4 chunk-flexible planning)."""
     r = _rbytes(pbytes)
     streamed = (
-        8 * B * 4 * H * r * 2  # z in + dz out blocks (chunk<=8, stream dtype)
-        + 8 * B * H * 4 * 2  # dys/c_prev blocks (c_t recomputed; h_prev
+        c * B * 4 * H * r * 2  # z in + dz out blocks (stream dtype)
+        + c * B * H * 4 * 2  # dys/c_prev blocks (c_t recomputed; h_prev
                              # not read — dU is contracted outside)
     )
     if has_mask:
-        streamed += 8 * B * _LANE * 4  # mask blocks
+        streamed += c * B * _LANE * 4  # mask blocks
     return (
         4 * H * H * pbytes  # U^T resident
         + streamed * 2  # double-buffered pipelining
@@ -265,8 +271,13 @@ def _plan_fwd(B: int, H: int, pbytes: int, *, save_residuals: bool,
             if _residentx_fwd_vmem(B, H, Dp, pbytes, save_residuals,
                                    has_mask, c) <= _VMEM_BUDGET:
                 return ("residentx", c)
-    if _resident_fwd_vmem(B, H, pbytes, save_residuals, has_mask) <= _VMEM_BUDGET:
-        return ("resident", 0)
+    # resident at ANY feasible chunk before tiled (r4): a chunk-1 resident
+    # kernel reads U once per pallas_call; tiled re-streams U every
+    # timestep — T x 4H x H x pbytes of pure HBM traffic per scan
+    for c in (8, 4, 2, 1):
+        if _resident_fwd_vmem(B, H, pbytes, save_residuals, has_mask,
+                              c) <= _VMEM_BUDGET:
+            return ("resident", c)
     for htile in (512, 256, 128):
         if H % htile == 0 and _tiled_fwd_vmem(
                 B, H, pbytes, save_residuals, htile, has_mask) <= _VMEM_BUDGET:
@@ -286,8 +297,13 @@ def _plan_bwd(B: int, H: int, pbytes: int, has_mask: bool = False,
             if _residentx_bwd_vmem(B, H, Dp, pbytes, has_mask,
                                    c) <= _VMEM_BUDGET:
                 return ("residentx", c)
-    if _resident_bwd_vmem(B, H, pbytes, has_mask) <= _VMEM_BUDGET:
-        return ("resident", 0)
+    # resident at any feasible chunk before tiled (see _plan_fwd's note);
+    # the MATCHING residual-saving forward must also fit, else the pair
+    # would plan inconsistently (fwd tiled + bwd resident is fine — both
+    # consume/produce the same z/cs streams — but prefer coherent pairs)
+    for c in (8, 4, 2, 1):
+        if _resident_bwd_vmem(B, H, pbytes, has_mask, c) <= _VMEM_BUDGET:
+            return ("resident", c)
     for ttile in (1024, 512, 256, 128):
         if (4 * H) % ttile == 0 and _tiled_bwd_vmem(
                 B, H, pbytes, ttile, has_mask) <= _VMEM_BUDGET:
@@ -578,11 +594,6 @@ def _chunk_for(T: int, cap: int) -> int:
     return 1
 
 
-def _time_chunk(T: int) -> int:
-    """Largest chunk (≤8) dividing T — python-unrolled inside the kernel."""
-    return _chunk_for(T, 8)
-
-
 def _lstm_bwd_kernel(*refs, hidden: int, chunk: int, has_mask: bool):
     """Fused BPTT: reverse sequential grid; dh/dc carries live in VMEM
     scratch across grid steps. Per time-step: gate recompute from saved z
@@ -852,11 +863,9 @@ def _pallas_forward(fused, xs, h0, c0, mask_tbl=None, *,
     if plan is None:  # callers gate via supported(); belt-and-braces
         raise ValueError(f"no pallas forward plan for B={B}, H={H}")
     strategy, parg = plan
-    htile = parg  # (tiled strategy; for residentx parg is the chunk cap)
-    if strategy == "residentx":
+    htile = parg  # (tiled strategy; for resident[x] parg is the chunk cap)
+    if strategy in ("residentx", "resident"):
         C = _chunk_for(T, parg)
-    elif strategy == "resident":
-        C = _time_chunk(T)
     else:
         C = 1
     mask_spec = pl.BlockSpec((C, B, _LANE), lambda t, *k: (t, 0, 0),
@@ -1087,7 +1096,7 @@ def _pallas_backward(fused, params, xs, h0, c0, mask_tbl, ys, z, cs,
             interpret=interpret,
         )(*operands)
     elif strategy == "resident":
-        C = _time_chunk(T)
+        C = _chunk_for(T, parg)
         n = T // C
         rev = lambda t: (n - 1 - t, 0, 0)  # reverse-time grid
         kernel = functools.partial(_lstm_bwd_kernel, hidden=H, chunk=C,

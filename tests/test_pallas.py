@@ -507,8 +507,15 @@ def test_du_hoist_loosens_resident_bwd_plan():
     from lstm_tensorspark_tpu.ops.pallas_lstm import _plan_bwd
 
     assert _plan_bwd(64, 256, 2, False, None)[0] == "resident"
-    assert _plan_bwd(64, 768, 2, False, None)[0] == "tiled"
-    assert _plan_bwd(32, 1024, 2, False, None)[0] == "tiled"
+    # r4 chunk-flexible planning + bf16 streams: big-H bf16 shapes now fit
+    # the U-resident backward at a SMALLER time chunk instead of paying
+    # tiled's per-timestep U^T re-stream
+    assert _plan_bwd(64, 768, 2, False, None) == ("resident", 2)
+    assert _plan_bwd(32, 1024, 2, False, None) == ("resident", 2)
+    # f32 streams keep big-H on the tiled strategy (U alone ~16.8 MB f32
+    # at H=1024 exceeds the VMEM budget)
+    assert _plan_bwd(64, 768, 4, False, None)[0] == "tiled"
+    assert _plan_bwd(32, 1024, 4, False, None)[0] == "tiled"
 
 
 def test_bf16_stream_residuals_grad_tolerance(monkeypatch):
@@ -560,3 +567,37 @@ def test_f32_compute_keeps_f32_streams():
     assert _rbytes(4) == 4
     assert _residual_dtype(jnp.bfloat16) == jnp.bfloat16
     assert _rbytes(2) == 2
+
+
+def test_chunk2_resident_bf16_bigh_parity():
+    """The r4 plan flip: H=650-class bf16 shapes run the U-RESIDENT pair
+    at time chunk 2 (instead of tiled's per-timestep U re-stream). Pin
+    the plan and check fwd+grad parity through the chunk-2 kernels in
+    interpret mode at bf16 tolerance."""
+    from lstm_tensorspark_tpu.ops.pallas_lstm import _plan_bwd, _plan_fwd
+
+    Bc, Tc, Dc, Hc = 64, 6, 16, 650  # padded H = 768
+    assert _plan_fwd(Bc, 768, 2, save_residuals=True) == ("resident", 2)
+    assert _plan_bwd(Bc, 768, 2, False, None) == ("resident", 2)
+
+    params = init_lstm_params(jax.random.PRNGKey(7), Dc, Hc)
+    xs = jax.random.normal(jax.random.PRNGKey(8), (Bc, Tc, Dc))
+    (hT, cT), ys = pallas_lstm_scan(params, xs, compute_dtype=jnp.bfloat16,
+                                    interpret=True)
+    (hT2, cT2), ys2 = lstm_scan(params, xs, compute_dtype=jnp.bfloat16)
+    np.testing.assert_allclose(ys, ys2, rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(hT, hT2, rtol=2e-2, atol=2e-2)
+
+    def lp(p):
+        return jnp.mean(pallas_lstm_scan(
+            p, xs, compute_dtype=jnp.bfloat16, interpret=True)[1] ** 2)
+
+    def lr(p):
+        return jnp.mean(lstm_scan(p, xs, compute_dtype=jnp.bfloat16)[1] ** 2)
+
+    g1 = jax.grad(lp)(params)
+    g2 = jax.grad(lr)(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=8e-2, atol=8e-3),
+        g1, g2,
+    )
