@@ -751,19 +751,61 @@ def cpu_baseline() -> float:
     return value
 
 
+def _last_good() -> dict | None:
+    """Last complete measurement's {value, captured_at, commit} — so a
+    wedged round's failure record carries evidence instead of a bare zero
+    (VERDICT r4). Value and provenance stay COHERENT: when git history is
+    available the value is read from the committed blob the commit/date
+    describe (`git show`); without git — or when that blob is unusable —
+    it falls back to the on-disk table with no provenance attached.
+    Never raises."""
+    name = os.path.basename(TABLE)
+    commit = captured_at = value = None
+    try:
+        rec = subprocess.run(
+            ["git", "log", "-1", "--format=%H %cI", "--", name],
+            capture_output=True, text=True, cwd=_DIR, timeout=30,
+        ).stdout.split()
+        if len(rec) == 2:
+            commit, captured_at = rec
+            text = subprocess.run(
+                ["git", "show", f"{commit}:{name}"],
+                capture_output=True, text=True, cwd=_DIR, timeout=30,
+            ).stdout
+            value = float(json.loads(text)["headline_seq_per_sec"])
+    except Exception:
+        commit = captured_at = value = None  # blob unusable: try the disk
+    if value is None:
+        try:
+            with open(TABLE) as f:
+                value = float(json.load(f)["headline_seq_per_sec"])
+        except Exception:
+            return None
+    out = {"value": value, "unit": "seq/sec"}
+    if commit:
+        out["commit"], out["captured_at"] = commit, captured_at
+    return out
+
+
 def _fail_json(error: str) -> None:
     """The driver's zero-value failure contract — SAME metric/unit strings
     as the success line (main), so the failure is recorded as a 0-value
-    datapoint of the tracked metric, not an unknown one. ONE copy, used by
-    the start-of-run liveness probe and the whole-run watchdog."""
-    print(json.dumps({
+    datapoint of the tracked metric, not an unknown one (value stays an
+    honest 0.0 / rc 3; `last_good` carries the stale-but-real number).
+    ONE copy, used by the start-of-run liveness probe and the whole-run
+    watchdog."""
+    record = {
         "metric": "ptb_char_lstm_train_seq_per_sec_per_chip",
         "value": 0.0,
         "unit": "seq/sec",
         "vs_baseline": 0.0,
         "error": f"{error}; see BENCH_TABLE.json for the last complete "
                  "measurement",
-    }), flush=True)
+    }
+    last = _last_good()
+    if last is not None:
+        record["last_good"] = last
+    print(json.dumps(record), flush=True)
     os._exit(3)
 
 

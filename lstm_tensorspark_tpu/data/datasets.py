@@ -24,11 +24,21 @@ from .corpus import (
 )
 
 
+# Version tag for the synthetic word-corpus CACHE FORMAT+ALGORITHM. Bump on
+# any change to synthetic_word_corpus (or its defaults) so stale caches with
+# a matching token count are never reused across generator versions.
+_CORPUS_FMT = "v1"
+
+
 def _cached_word_stream(n_tokens: int, vocab_size: int, seed: int,
                         noise: float, generate) -> list:
     """Token list of ``generate(n_tokens, vocab_size, seed=, noise=)``,
     cached as plain text under the system temp dir, keyed by every
-    generation parameter. A missing/corrupt/short cache regenerates
+    generation parameter plus a corpus-format version tag (bump
+    ``_CORPUS_FMT`` whenever the generator algorithm changes, or a stale
+    cache whose token count still matches silently skews cross-version
+    quality-race comparisons — ADVICE r4). A missing/corrupt/short cache
+    regenerates
     silently — the cache is an optimization, never a correctness
     dependency (atomic tmp+rename write; concurrent legs at worst both
     generate and one rename wins)."""
@@ -36,7 +46,8 @@ def _cached_word_stream(n_tokens: int, vocab_size: int, seed: int,
 
     cache_dir = os.path.join(tempfile.gettempdir(), "lstm_tsp_corpus_cache")
     path = os.path.join(
-        cache_dir, f"words_{n_tokens}_{vocab_size}_{seed}_{noise}.txt")
+        cache_dir,
+        f"words_{_CORPUS_FMT}_{n_tokens}_{vocab_size}_{seed}_{noise}.txt")
     if os.path.exists(path):
         try:
             with open(path, "r", encoding="ascii") as f:
@@ -48,6 +59,16 @@ def _cached_word_stream(n_tokens: int, vocab_size: int, seed: int,
     text = generate(n_tokens, vocab_size, seed=seed, noise=noise)
     try:
         os.makedirs(cache_dir, exist_ok=True)
+        # drop cache files from other format versions (incl. pre-tag
+        # names): each holds a multi-MB stream that would otherwise be
+        # orphaned forever by a _CORPUS_FMT bump
+        for stale in os.listdir(cache_dir):
+            if (stale.startswith("words_")
+                    and not stale.startswith(f"words_{_CORPUS_FMT}_")):
+                try:
+                    os.remove(os.path.join(cache_dir, stale))
+                except OSError:
+                    pass
         tmp = path + f".tmp{os.getpid()}"
         with open(tmp, "w", encoding="ascii") as f:
             f.write(text)

@@ -229,6 +229,13 @@ class Checkpointer:
         return os.path.join(self.directory, "best.complete")
 
     def _save_best_sharded(self, state, value: float) -> str:
+        # defensive fence for save-path symmetry with save_best (ADVICE
+        # r4): today async step saves only start on the single-process
+        # branch, so this is a no-op under current routing — it exists so
+        # the "never interleave with an in-flight async write" contract
+        # survives if a sharded async path is ever added (wait() also
+        # re-raises a failed writer's exception, same as save_best)
+        self.wait()
         step = int(jax.device_get(state.step))
         pid = jax.process_index()
         # clear leftovers of a crashed attempt AT THIS STEP (other steps'

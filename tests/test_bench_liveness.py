@@ -84,6 +84,46 @@ def test_success_on_first_probe_skips_retry(monkeypatch, fail_capture):
     assert not fail_capture
 
 
+def test_fail_record_carries_last_good_evidence():
+    """VERDICT r4: a wedged round's failure line must embed the last
+    complete measurement (value + provenance) from BENCH_TABLE.json while
+    keeping value=0.0 and rc=3 honest — so the driver's record carries
+    evidence instead of a bare zero."""
+    import json
+    import os
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [_sys.executable, "-c",
+         "import bench, os\n"
+         "os._exit = lambda c: (_ for _ in ()).throw(SystemExit(c))\n"
+         "try:\n"
+         "    bench._fail_json('wedge-test')\n"
+         "except SystemExit:\n"
+         "    pass\n"],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+    )
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["value"] == 0.0  # honesty contract unchanged
+    assert "wedge-test" in line["error"]
+    lg = line["last_good"]
+    assert lg["value"] > 0
+    assert lg["unit"] == "seq/sec"
+    if "commit" in lg:
+        # git history available: value must come from THAT commit's blob
+        committed = json.loads(subprocess.run(
+            ["git", "show", f"{lg['commit']}:BENCH_TABLE.json"],
+            capture_output=True, text=True, cwd=repo, timeout=30).stdout)
+        assert lg["value"] == pytest.approx(committed["headline_seq_per_sec"])
+        assert lg["captured_at"][:2] == "20"  # ISO date
+    else:
+        # degraded (no git): falls back to the on-disk table, no provenance
+        table = json.load(open(os.path.join(repo, "BENCH_TABLE.json")))
+        assert lg["value"] == pytest.approx(table["headline_seq_per_sec"])
+
+
 def test_env_override_sets_window(monkeypatch, fail_capture):
     monkeypatch.setenv("LSTM_TSP_BENCH_LIVENESS_WINDOW_S", "0")
     calls = []

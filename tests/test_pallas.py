@@ -556,6 +556,41 @@ def test_bf16_stream_residuals_grad_tolerance(monkeypatch):
     )
 
 
+def test_bf16_tiled_bigh_grad_parity():
+    """ADVICE r4: the bf16 stored-z rounding (forward computes gates from
+    f32 z, backward recomputes them from the bf16-rounded STORED z) must
+    stay within bf16 tolerance on the TILED path too, not just
+    resident/residentx — H=1536 bf16 is the smallest shape that spills
+    past every resident chunk and plans tiled for both passes."""
+    from lstm_tensorspark_tpu.ops.pallas_lstm import (
+        _plan_bwd, _plan_fwd, chosen_bwd_strategy,
+    )
+
+    Bt, Tt, Dt, Ht = 8, 4, 16, 1536
+    assert _plan_fwd(Bt, Ht, 2, save_residuals=True)[0] == "tiled"
+    assert _plan_bwd(Bt, Ht, 2, False, None)[0] == "tiled"
+    assert chosen_bwd_strategy(Bt, Tt, Ht, 2) == "tiled"
+
+    params = init_lstm_params(jax.random.PRNGKey(11), Dt, Ht)
+    xs = jax.random.normal(jax.random.PRNGKey(12), (Bt, Tt, Dt))
+
+    def lp(p):
+        return jnp.mean(pallas_lstm_scan(
+            p, xs, compute_dtype=jnp.bfloat16, interpret=True)[1] ** 2)
+
+    def lr(p):
+        return jnp.mean(lstm_scan(p, xs, compute_dtype=jnp.bfloat16)[1] ** 2)
+
+    np.testing.assert_allclose(
+        jax.jit(lp)(params), jax.jit(lr)(params), rtol=2e-2, atol=2e-3)
+    g1 = jax.grad(lp)(params)
+    g2 = jax.grad(lr)(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=8e-2, atol=8e-3),
+        g1, g2,
+    )
+
+
 def test_f32_compute_keeps_f32_streams():
     """f32 compute must keep bit-exact f32 residual streams — the exact
     interpret-mode parities above depend on it."""
