@@ -1002,12 +1002,26 @@ def main() -> int:
         gen_rec = measure_generation()
     except Exception as e:
         gen_rec = {"error": f"{type(e).__name__}: {e}"}
+    import datetime
+
+    try:
+        head = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, cwd=_DIR, timeout=30).stdout.strip() or None
+    except Exception:
+        head = None
     with open(TABLE, "w") as f:
         json.dump({
             "peak_tflops_bf16": PEAK_TFLOPS,
             "hbm_bandwidth": hbm,
             "headline_seq_per_sec": round(value, 2),
             "vs_cpu_baseline": round(value / baseline, 2),
+            # self-describing provenance: readme_table._vintage reads these
+            # (git history would misattribute a fresh uncommitted table to
+            # the PREVIOUS measurement's commit)
+            "captured_at": datetime.datetime.now(
+                datetime.timezone.utc).isoformat(timespec="seconds"),
+            "measured_at_commit": head,
             "configs": table,
             "pp_pallas_config5": pp_rec,
             "generation": gen_rec,

@@ -101,11 +101,13 @@ def test_fail_record_carries_last_good_evidence():
          "os._exit = lambda c: (_ for _ in ()).throw(SystemExit(c))\n"
          "try:\n"
          "    bench._fail_json('wedge-test')\n"
-         "except SystemExit:\n"
-         "    pass\n"],
+         "except SystemExit as e:\n"
+         "    print('EXIT_CODE=' + str(e.code))\n"],
         capture_output=True, text=True, timeout=120, cwd=repo,
     )
-    line = json.loads(out.stdout.strip().splitlines()[-1])
+    lines = out.stdout.strip().splitlines()
+    assert lines[-1] == "EXIT_CODE=3"  # rc=3 contract unchanged
+    line = json.loads(lines[-2])
     assert line["value"] == 0.0  # honesty contract unchanged
     assert "wedge-test" in line["error"]
     lg = line["last_good"]

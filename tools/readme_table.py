@@ -52,6 +52,35 @@ def _batch(d: dict, kind: str) -> str:
     return f"{d['B']}×{d['T']}"
 
 
+def _vintage(table: dict) -> str:
+    """Measurement-provenance line (VERDICT r4 #8): when+where the table's
+    numbers were captured, so every number in the block carries its
+    vintage. Prefers the table's OWN captured_at/measured_at_commit stamp
+    (bench.py writes it at measurement time — git history would attribute
+    a fresh uncommitted table to the PREVIOUS measurement's commit); falls
+    back to git history for pre-r5 tables without the stamp."""
+    when = (table.get("captured_at") or "")[:10]
+    commit = table.get("measured_at_commit")
+    if not when:
+        import subprocess
+
+        try:
+            rec = subprocess.run(
+                ["git", "log", "-1", "--format=%h %cs", "--",
+                 os.path.basename(TABLE)],
+                capture_output=True, text=True, cwd=_DIR, timeout=30,
+            ).stdout.split()
+        except Exception:
+            rec = []
+        if len(rec) != 2:
+            return ""
+        commit, when = rec
+    line = f"*Measured on one TPU v5 lite chip, {when}"
+    if commit:
+        line += f" (tree `{commit}`)"
+    return line + ".*\n\n"
+
+
 def render(table: dict) -> str:
     rows = [
         "| Config | Model | Batch | Throughput | Model FLOPs | MFU "
@@ -94,6 +123,7 @@ def render(table: dict) -> str:
 
 
 _BLOCK = re.compile(
+    r"(?:\*Measured on one TPU[^\n]*\n\n)?"
     r"(\| Config \| Model \| Batch \| Throughput \| Model FLOPs \| MFU "
     r"\| of bound \|\n)(?:\|.*\n)+"
 )
@@ -114,7 +144,7 @@ def main() -> int:
         print("README table block not found (markers changed?)",
               file=sys.stderr)
         return 2
-    new_block = render(table) + "\n"
+    new_block = _vintage(table) + render(table) + "\n"
     if readme[m.start():m.end()] == new_block:
         print("README table is in sync with BENCH_TABLE.json")
         return 0
