@@ -337,6 +337,11 @@ def main(only: list[str] | None = None, *, mode: str = "full",
             if mode == "full" and run_this:
                 print(f"[bench_quality] {name} on {platform} ...", flush=True)
                 cold_jsonl = run_leg(name, platform)
+                if platform == "tpu":
+                    # a fresh TPU measurement resolves any r5
+                    # task-change invalidation marker (the marker means
+                    # "the TPU half predates the current task")
+                    results[name].pop("invalidated", None)
             if os.path.exists(cold_jsonl):
                 results[name][platform] = time_to_targets(
                     cold_jsonl, spec["metric"], spec["mode"], spec["targets"]

@@ -25,9 +25,11 @@ failure so a regression is investigated before the table is refreshed):
      on config 1);
 5. full bench.py (K=512 headline, impl_bound + r4 bandwidth-floor
    fields) -> fresh BENCH_TABLE.json;
-6. bench_quality.py — the r4 discriminating tasks invalidated the
-   committed curves for configs 2/3/5 (OPTIONAL here: ~40-60 min; skip
-   with --skip-quality and run it separately).
+6. bench_quality.py TPU legs — the r4 discriminating tasks invalidated
+   the committed curves for configs 2/3/5; their CPU halves were
+   re-banked during round 5's wedge window, so only the TPU legs run
+   here (OPTIONAL: ~20-30 min; skip with --skip-quality and run
+   separately).
 
 The README's five-config table is regenerated automatically
 (tools/readme_table.py); only the surrounding perf PROSE still needs a
@@ -144,8 +146,15 @@ def main() -> int:
          label="README table regen from fresh BENCH_TABLE.json")
 
     if not skip_quality:
-        _run([sys.executable, "bench_quality.py"], timeout=7200,
-             label="bench_quality.py (r4 discriminating tasks)")
+        # TPU legs only: the CPU halves for the r4 discriminating tasks
+        # (configs 2/3/5) were re-measured and banked during round 5's
+        # wedge window on a quiet machine (configs 1/4 CPU curves were
+        # never invalidated); running them again here would just burn an
+        # hour of the recovery window re-proving the slow leg
+        _run([sys.executable, "bench_quality.py", "--platform", "tpu"],
+             timeout=7200,
+             label="bench_quality.py TPU legs (r4 discriminating tasks; "
+                   "CPU legs banked r5)")
     else:
         print("skipped bench_quality.py (--skip-quality); run it before "
               "committing BASELINE_MEASURED.json")
