@@ -337,6 +337,12 @@ def main(only: list[str] | None = None, *, mode: str = "full",
             if mode == "full" and run_this:
                 print(f"[bench_quality] {name} on {platform} ...", flush=True)
                 cold_jsonl = run_leg(name, platform)
+                # per-leg vintage: tools/readme_quality.py renders it so
+                # every published number carries when it was measured
+                import datetime
+
+                results[name][platform + "_measured_at"] = (
+                    datetime.date.today().isoformat())
                 if platform == "tpu":
                     # a fresh TPU measurement resolves any r5
                     # task-change invalidation marker (the marker means

@@ -155,6 +155,8 @@ def main() -> int:
              timeout=7200,
              label="bench_quality.py TPU legs (r4 discriminating tasks; "
                    "CPU legs banked r5)")
+        _run([sys.executable, "tools/readme_quality.py"], timeout=60,
+             label="README quality-table regen from BASELINE_MEASURED.json")
     else:
         print("skipped bench_quality.py (--skip-quality); run it before "
               "committing BASELINE_MEASURED.json")
