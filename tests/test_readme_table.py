@@ -1,0 +1,76 @@
+"""tools/readme_table.py: the generated five-config perf table — vintage
+line prefers the table's own provenance stamp, rendering is stable, and
+the committed README is in sync with BENCH_TABLE.json."""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import readme_table  # noqa: E402
+
+
+def test_vintage_prefers_table_stamp():
+    """A freshly-written (possibly uncommitted) table must be attributed
+    to ITS OWN captured_at/measured_at_commit, not to the git history of
+    the previous measurement."""
+    line = readme_table._vintage({
+        "captured_at": "2026-08-02T10:00:00+00:00",
+        "measured_at_commit": "abc1234",
+    })
+    assert "2026-08-02" in line
+    assert "abc1234" in line
+
+
+def test_vintage_stampless_table_falls_back_to_git(monkeypatch):
+    """Pre-r5 tables without the stamp fall back to the committed file's
+    git history — deterministic via a stubbed `git log` so a broken
+    fallback can't hide behind the empty no-git return."""
+    import subprocess  # _vintage imports the module locally — same object
+
+    class _Out:
+        stdout = "abc1234 2026-01-01\n"
+
+    monkeypatch.setattr(subprocess, "run", lambda *a, **k: _Out())
+    line = readme_table._vintage({})
+    assert "abc1234" in line and "2026-01-01" in line
+
+    # and a failing git still degrades to the empty line, not a crash
+    def _boom(*a, **k):
+        raise OSError("no git")
+
+    monkeypatch.setattr(subprocess, "run", _boom)
+    assert readme_table._vintage({}) == ""
+
+
+def test_render_marks_unmeasured_configs():
+    table = {
+        "configs": {
+            "ptb_char": {
+                "kind": "lm",
+                "dims": {"V": 50, "H": 128, "L": 1, "B": 64, "T": 64},
+                "seq_per_sec": 756308.69, "tokens_per_sec": 48403755.9,
+                "model_tflops_per_sec": 39.925, "mfu_vs_bf16_peak": 0.2027,
+                "roofline": {"fraction_of_bound": 0.5027},
+            },
+            "wikitext2": {"error": "wedged"},
+        },
+    }
+    out = readme_table.render(table)
+    row1 = next(l for l in out.splitlines() if "PTB char" in l)
+    assert "756.3k seq/s" in row1 and "20.3%" in row1 and "50%" in row1
+    row3 = next(l for l in out.splitlines() if "WikiText-2" in l)
+    assert "not measured" in row3 and "wedged" in row3
+
+
+def test_committed_readme_in_sync():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "tools/readme_table.py", "--check"],
+        capture_output=True, text=True, cwd=repo, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    json.load(open(os.path.join(repo, "BENCH_TABLE.json")))
