@@ -20,6 +20,7 @@ from .tensor_parallel import (
 from .pipeline_parallel import (
     make_pp_lm_train_step,
     place_pp_lm_params,
+    place_pp_zero1_opt_state,
     stack_lm_params,
     unstack_lm_params,
 )
@@ -28,6 +29,7 @@ from .train_step import make_sharded_lm_train_step
 __all__ = [
     "make_pp_lm_train_step",
     "place_pp_lm_params",
+    "place_pp_zero1_opt_state",
     "stack_lm_params",
     "unstack_lm_params",
     "make_hybrid_mesh",
