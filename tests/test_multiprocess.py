@@ -509,10 +509,13 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 pid = int(sys.argv[1]); port = sys.argv[2]
+# optional 3rd arg: process count (default 2) — the 4-process case runs
+# the SAME program with the scatter/gather decomposing over 4 Gloo peers
+nprocs = int(sys.argv[3]) if len(sys.argv) > 3 else 2
 
 from lstm_tensorspark_tpu.parallel import distributed_init
-distributed_init(f"127.0.0.1:{port}", 2, pid)
-assert jax.process_count() == 2
+distributed_init(f"127.0.0.1:{port}", nprocs, pid)
+assert jax.process_count() == nprocs
 
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -530,7 +533,7 @@ cfg = LMConfig(vocab_size=V, hidden_size=H, num_layers=2)
 def loss_fn(p, b, r): return lm_loss(p, b, cfg)
 opt = make_optimizer("adam", 1e-2)
 params = init_lm(jax.random.PRNGKey(0), cfg)
-mesh = make_hybrid_mesh(dp=4)
+mesh = make_hybrid_mesh(dp=2 * nprocs)
 
 rng = np.random.RandomState(0)
 batch_host = {
@@ -577,8 +580,8 @@ for a, b in zip(jax.tree.leaves(jax.device_get(state.params)),
                 jax.tree.leaves(jax.device_get(s2.params))):
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                rtol=2e-5, atol=1e-6)
-print(f"proc {pid}: zero1-2proc loss={loss:.6f} matches single={ref:.6f}",
-      flush=True)
+print(f"proc {pid}: zero1-{nprocs}proc loss={loss:.6f} "
+      f"matches single={ref:.6f}", flush=True)
 '''
 
 
@@ -589,7 +592,18 @@ def test_two_process_zero1_training_parity():
     and the parameter all-gather both cross Gloo; each process updates
     disjoint slices of the raveled params with its own adam-moment shards,
     and the result must match the single-process full-batch program."""
-    _run_two_procs(_ZERO1_WORKER, expect="matches single")
+    _run_two_procs(_ZERO1_WORKER, expect="zero1-2proc loss")
+
+
+@pytest.mark.skipif(os.environ.get("LSTM_TSP_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess smoke disabled")
+def test_four_process_zero1_training_parity():
+    """ZeRO-1 at FOUR Gloo domains (dp=8 over 4 procs x 2 devices): the
+    gradient reduce-scatter and parameter all-gather decompose over four
+    process boundaries; each process updates disjoint slices of the
+    raveled params, and loss AND rebuilt params must still match the
+    single-process full-batch program."""
+    _run_procs(_ZERO1_WORKER, "4", expect="zero1-4proc loss", n=4)
 
 
 _ZERO1_TP_WORKER = r'''
