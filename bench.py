@@ -16,6 +16,7 @@ not change the compute. MFU uses matmul-only model FLOPs (the standard
 accounting: train = 3x forward) against the chip's published bf16 peak.
 """
 
+import datetime
 import json
 import os
 import subprocess
@@ -1002,8 +1003,6 @@ def main() -> int:
         gen_rec = measure_generation()
     except Exception as e:
         gen_rec = {"error": f"{type(e).__name__}: {e}"}
-    import datetime
-
     try:
         head = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"], capture_output=True,

@@ -54,6 +54,7 @@ a subprocess with the platform forced before any device query).
 
 from __future__ import annotations
 
+import datetime
 import json
 import os
 import shutil
@@ -339,8 +340,6 @@ def main(only: list[str] | None = None, *, mode: str = "full",
                 cold_jsonl = run_leg(name, platform)
                 # per-leg vintage: tools/readme_quality.py renders it so
                 # every published number carries when it was measured
-                import datetime
-
                 results[name][platform + "_measured_at"] = (
                     datetime.date.today().isoformat())
                 if platform == "tpu":
