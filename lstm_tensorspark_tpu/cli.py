@@ -27,6 +27,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="lstm_tensorspark_tpu",
         description="TPU-native LSTM training (LSTM-TensorSpark capabilities, no Spark)",
+        epilog="Inference serving is a subcommand with its own flags: "
+               "`... serve {--selftest | --loadgen | --http}` — run "
+               "`... serve --help` (dispatched before this parser, so "
+               "`serve` must be the first argument).",
     )
     # --- reference flag surface (SURVEY.md §1 L5) ---
     p.add_argument("--data-path", type=str, default=None, help="corpus directory (falls back to synthetic stand-in)")
@@ -200,6 +204,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        import sys
+
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        return _run_serve(argv[1:])
     args = build_parser().parse_args(argv)
     if args.temperature <= 0.0:
         raise SystemExit(f"--temperature must be > 0, got {args.temperature}")
@@ -1105,6 +1115,275 @@ def _run_lm_advanced(args, logger, cfg, data, seq_len) -> int:
             params_host = unstack_lm_params(params_host)
         _generate_text(args, logger, cfg, data, params_host)
     return 0
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    """``serve`` subcommand: the inference engine's CLI surface (serve/)."""
+    p = argparse.ArgumentParser(
+        prog="lstm_tensorspark_tpu serve",
+        description="continuous-batching LM inference (serve/): HTTP "
+                    "endpoint, --selftest parity check, --loadgen "
+                    "latency/throughput report",
+    )
+    mode = p.add_mutually_exclusive_group()
+    mode.add_argument("--selftest", action="store_true",
+                      help="decode a batch of concurrent sessions and "
+                           "verify greedy output is token-identical to "
+                           "models/generate.py; rc 0 on PASS")
+    mode.add_argument("--loadgen", action="store_true",
+                      help="offline load generation: p50/p99 latency, "
+                           "tokens/sec, concurrency sweep (--compare)")
+    mode.add_argument("--http", action="store_true",
+                      help="run the JSON HTTP endpoint (default mode)")
+    # --- model (must match the producing training run) ---
+    p.add_argument("--vocab-size", type=int, default=89)
+    p.add_argument("--hidden-units", type=int, default=64)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--tie-embeddings", action="store_true")
+    p.add_argument("--compute-dtype", type=str, default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint-dir", type=str, default=None,
+                   help="restore trained params (template built from the "
+                        "model flags + --optimizer, which must match the "
+                        "training run); random init otherwise")
+    p.add_argument("--optimizer", type=str, default="sgd",
+                   choices=["sgd", "momentum", "adam", "adamw", "rmsprop"],
+                   help="checkpoint-template optimizer (restore only)")
+    p.add_argument("--learning-rate", type=float, default=1.0)
+    # --- engine / batcher (docs/OPERATIONS.md "Serving") ---
+    p.add_argument("--num-slots", type=int, default=64,
+                   help="state-cache slots (= max resident sessions)")
+    p.add_argument("--prefill-buckets", type=str, default="8,16,32,64,128",
+                   help="prompt-length pad buckets; the largest is the "
+                        "prompt-length admission limit")
+    p.add_argument("--batch-buckets", type=str, default="1,2,4,8,16",
+                   help="batch-size pad buckets; the largest bounds one "
+                        "packed step")
+    p.add_argument("--max-active", type=int, default=16,
+                   help="concurrent decode sessions (<= --num-slots)")
+    p.add_argument("--queue-size", type=int, default=64,
+                   help="bounded submit queue; beyond it requests are "
+                        "rejected (HTTP 429)")
+    # --- sampling defaults (selftest is always greedy) ---
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--greedy", action="store_true")
+    # --- loadgen workload ---
+    p.add_argument("--sessions", type=int, default=8)
+    p.add_argument("--requests-per-session", type=int, default=4)
+    p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--mode", type=str, default="closed",
+                   choices=["closed", "open"])
+    p.add_argument("--rate", type=float, default=None,
+                   help="open-loop arrival rate (req/s)")
+    p.add_argument("--compare", type=str, default="1,8",
+                   help="closed-loop concurrency sweep levels (empty "
+                        "string: single run at --sessions)")
+    # --- endpoint / observability ---
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--trace", type=str, default=None,
+                   help="host-side span trace output (Chrome trace JSON)")
+    return p
+
+
+def _parse_buckets(spec: str, flag: str) -> tuple[int, ...]:
+    try:
+        buckets = tuple(int(x) for x in spec.split(",") if x.strip())
+    except ValueError:
+        raise SystemExit(f"{flag}: expected comma-separated ints, got {spec!r}")
+    if not buckets or any(b < 1 for b in buckets):
+        raise SystemExit(f"{flag}: need at least one positive bucket")
+    return buckets
+
+
+def _build_serve_stack(args):
+    """(params, cfg, started-server) from the serve flags."""
+    from .models import LMConfig, init_lm
+    from .serve import ServeEngine, ServeServer
+
+    cfg = LMConfig(
+        vocab_size=args.vocab_size,
+        hidden_size=args.hidden_units,
+        num_layers=args.num_layers,
+        tie_embeddings=args.tie_embeddings,
+        compute_dtype=args.compute_dtype,
+    )
+    params = init_lm(jax.random.PRNGKey(args.seed), cfg)
+    if args.checkpoint_dir:
+        from .train import make_optimizer
+        from .train.checkpoint import Checkpointer
+        from .train.loop import init_train_state
+
+        ckpt = Checkpointer(args.checkpoint_dir)
+        if not ckpt.has_checkpoint():
+            raise SystemExit(f"no checkpoint in {args.checkpoint_dir}")
+        optimizer = make_optimizer(args.optimizer, args.learning_rate)
+        template = init_train_state(params, optimizer,
+                                    jax.random.PRNGKey(args.seed))
+        state = ckpt.restore_latest(template)
+        params = jax.device_get(state.params)
+    engine = ServeEngine(
+        params, cfg,
+        num_slots=args.num_slots,
+        prefill_buckets=_parse_buckets(args.prefill_buckets,
+                                       "--prefill-buckets"),
+        batch_buckets=_parse_buckets(args.batch_buckets, "--batch-buckets"),
+        rng_seed=args.seed,
+    )
+    server = ServeServer(engine, max_active=args.max_active,
+                         queue_size=args.queue_size)
+    return params, cfg, server
+
+
+def _serve_sampling(args):
+    from .serve import SamplingParams
+
+    return SamplingParams(temperature=args.temperature, top_k=args.top_k,
+                          top_p=args.top_p, greedy=args.greedy)
+
+
+def _serve_selftest(args) -> int:
+    """Acceptance check: a batch of concurrent sessions decoded through the
+    full server path must be token-identical to `models/generate.py` with
+    the same params/prompt (greedy)."""
+    import json
+    import threading
+
+    from .models import make_generate_fn
+    from .serve import InprocessClient
+
+    params, cfg, server = _build_serve_stack(args)
+    rng = np.random.RandomState(args.seed)
+    lengths = [3, 5, 8, 13, 2, 7][: max(args.sessions, 2)]
+    while len(lengths) < args.sessions:
+        lengths.append(int(rng.randint(2, min(21, server.engine.max_prompt_len))))
+    prompts = [rng.randint(0, cfg.vocab_size, size=t).astype(np.int32)
+               for t in lengths]
+    n_new = args.max_new_tokens
+
+    got: list[list[int] | None] = [None] * len(prompts)
+    errors: list[str] = []
+    client = InprocessClient(server)
+
+    def run_one(i):
+        try:
+            got[i] = client.generate(prompts[i], max_new_tokens=n_new)
+        except Exception as e:  # surface, don't hang the join
+            errors.append(f"session {i}: {type(e).__name__}: {e}")
+
+    with server:
+        threads = [threading.Thread(target=run_one, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    if errors:
+        print("\n".join(errors))
+        print("serve selftest: FAIL (request errors)")
+        return 1
+    gen = make_generate_fn(cfg, max_new_tokens=n_new, greedy=True)
+    bad = 0
+    for i, prompt in enumerate(prompts):
+        ref = np.asarray(gen(params, prompt[None, :],
+                             jax.random.PRNGKey(args.seed)))[0, prompt.size:]
+        if not np.array_equal(np.asarray(got[i], np.int32), ref):
+            bad += 1
+            print(f"session {i}: MISMATCH serve={got[i]} ref={ref.tolist()}")
+    print(json.dumps({
+        "note": "serve_selftest", "sessions": len(prompts),
+        "tokens_per_session": n_new, "mismatches": bad,
+        "compiles_prefill": server.engine.num_compiles("prefill"),
+        "compiles_decode": server.engine.num_compiles("decode"),
+        **server.stats()["batcher"],
+    }))
+    print(f"serve selftest: {'PASS' if bad == 0 else 'FAIL'}")
+    return 0 if bad == 0 else 1
+
+
+def _serve_loadgen(args) -> int:
+    import json
+
+    from .serve import run_loadgen
+    from .serve.loadgen import concurrency_sweep
+
+    _, cfg, server = _build_serve_stack(args)
+    sampling = _serve_sampling(args)
+    with server:
+        if args.compare and args.mode == "closed":
+            levels = tuple(
+                sorted({int(x) for x in args.compare.split(",") if x.strip()}
+                       | {args.sessions})
+            )
+            out = concurrency_sweep(
+                server, vocab_size=cfg.vocab_size, levels=levels,
+                requests_per_session=args.requests_per_session,
+                prompt_len=args.prompt_len,
+                max_new_tokens=args.max_new_tokens,
+                sampling=sampling, seed=args.seed,
+            )
+        else:
+            out = run_loadgen(
+                server, vocab_size=cfg.vocab_size, sessions=args.sessions,
+                requests_per_session=args.requests_per_session,
+                prompt_len=args.prompt_len,
+                max_new_tokens=args.max_new_tokens,
+                sampling=sampling, mode=args.mode, rate=args.rate,
+                seed=args.seed,
+            )
+    out["engine"] = {
+        "compiles_prefill": server.engine.num_compiles("prefill"),
+        "compiles_decode": server.engine.num_compiles("decode"),
+        **server.engine.cache.stats(),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def _serve_http(args) -> int:
+    from .serve.server import make_http_server
+
+    _, _, server = _build_serve_stack(args)
+    httpd = make_http_server(server, args.host, args.port)
+    host, port = httpd.server_address[:2]
+    print(f"serving on http://{host}:{port} (POST /v1/generate, "
+          "GET /healthz, GET /v1/stats) — ctrl-C to stop", flush=True)
+    with server:
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.server_close()
+    return 0
+
+
+def _run_serve(argv) -> int:
+    args = build_serve_parser().parse_args(argv)
+    from .utils import Tracer, set_tracer
+
+    tracer = None
+    if args.trace:
+        tracer = Tracer()
+        set_tracer(tracer)
+    try:
+        if args.selftest:
+            return _serve_selftest(args)
+        if args.loadgen:
+            return _serve_loadgen(args)
+        return _serve_http(args)
+    finally:
+        if tracer is not None:
+            set_tracer(None)
+            try:
+                tracer.save(args.trace)
+            except OSError as e:
+                print(f"warning: could not write --trace file: {e}")
 
 
 def _run_classifier(args, logger) -> int:

@@ -30,45 +30,85 @@ from .corpus import (
 _CORPUS_FMT = "v1"
 
 
+# Stale-cache sweep age gate: entries from OTHER format versions are only
+# deleted once untouched this long. A concurrent checkout of a different
+# version (cross-version quality race) keeps refreshing its own entries'
+# mtimes, so two live versions no longer delete and regenerate each
+# other's multi-MB corpora on every leg (ADVICE r5 finding 3); genuinely
+# orphaned versions still get cleaned up after the window passes.
+_CACHE_STALE_AGE_S = 7 * 24 * 3600
+
+
+def _sweep_stale_corpus_cache(cache_root: str) -> None:
+    """Delete cache entries that belong to other format versions AND have
+    not been touched for ``_CACHE_STALE_AGE_S``: version subdirectories
+    other than the current ``_CORPUS_FMT`` one, plus legacy flat
+    ``words_*`` files from the pre-namespaced layout."""
+    import time
+
+    cutoff = time.time() - _CACHE_STALE_AGE_S
+    try:
+        entries = os.listdir(cache_root)
+    except OSError:
+        return
+    for name in entries:
+        if name == _CORPUS_FMT:
+            continue
+        p = os.path.join(cache_root, name)
+        try:
+            if os.path.isdir(p):
+                for f in os.listdir(p):
+                    fp = os.path.join(p, f)
+                    if os.path.getmtime(fp) < cutoff:
+                        os.remove(fp)
+                if not os.listdir(p):
+                    os.rmdir(p)
+            elif name.startswith("words_") and os.path.getmtime(p) < cutoff:
+                os.remove(p)
+        except OSError:
+            pass  # sweeping is best-effort housekeeping
+
+
 def _cached_word_stream(n_tokens: int, vocab_size: int, seed: int,
                         noise: float, generate) -> list:
     """Token list of ``generate(n_tokens, vocab_size, seed=, noise=)``,
     cached as plain text under the system temp dir, keyed by every
-    generation parameter plus a corpus-format version tag (bump
-    ``_CORPUS_FMT`` whenever the generator algorithm changes, or a stale
-    cache whose token count still matches silently skews cross-version
-    quality-race comparisons — ADVICE r4). A missing/corrupt/short cache
-    regenerates
-    silently — the cache is an optimization, never a correctness
-    dependency (atomic tmp+rename write; concurrent legs at worst both
-    generate and one rename wins)."""
+    generation parameter, inside a per-``_CORPUS_FMT`` subdirectory (bump
+    the tag whenever the generator algorithm changes, or a stale cache
+    whose token count still matches silently skews cross-version
+    quality-race comparisons — ADVICE r4). Namespacing by version means
+    checkouts of different versions each keep their own cache instead of
+    sweeping each other's (ADVICE r5 finding 3); other versions' entries
+    are only removed once old (`_sweep_stale_corpus_cache`). A
+    missing/corrupt/short cache regenerates silently — the cache is an
+    optimization, never a correctness dependency (atomic tmp+rename
+    write; concurrent legs at worst both generate and one rename wins)."""
     import tempfile
 
-    cache_dir = os.path.join(tempfile.gettempdir(), "lstm_tsp_corpus_cache")
+    cache_root = os.path.join(tempfile.gettempdir(), "lstm_tsp_corpus_cache")
+    cache_dir = os.path.join(cache_root, _CORPUS_FMT)
     path = os.path.join(
-        cache_dir,
-        f"words_{_CORPUS_FMT}_{n_tokens}_{vocab_size}_{seed}_{noise}.txt")
+        cache_dir, f"words_{n_tokens}_{vocab_size}_{seed}_{noise}.txt")
     if os.path.exists(path):
         try:
             with open(path, "r", encoding="ascii") as f:
                 stream = f.read().split()
             if len(stream) == n_tokens:
+                try:
+                    # a HIT must refresh mtime: reads alone don't, and the
+                    # age-gated sweep keys liveness off mtime — without
+                    # this, a daily-used foreign-version cache would still
+                    # look stale after the window and get swept
+                    os.utime(path, None)
+                except OSError:
+                    pass
                 return stream
         except OSError:
             pass  # regenerate below
     text = generate(n_tokens, vocab_size, seed=seed, noise=noise)
     try:
         os.makedirs(cache_dir, exist_ok=True)
-        # drop cache files from other format versions (incl. pre-tag
-        # names): each holds a multi-MB stream that would otherwise be
-        # orphaned forever by a _CORPUS_FMT bump
-        for stale in os.listdir(cache_dir):
-            if (stale.startswith("words_")
-                    and not stale.startswith(f"words_{_CORPUS_FMT}_")):
-                try:
-                    os.remove(os.path.join(cache_dir, stale))
-                except OSError:
-                    pass
+        _sweep_stale_corpus_cache(cache_root)
         tmp = path + f".tmp{os.getpid()}"
         with open(tmp, "w", encoding="ascii") as f:
             f.write(text)

@@ -62,14 +62,17 @@ def sample_logits(
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-def _fuse_layers(params, cfg: LMConfig):
+def fuse_layers(params, cfg: LMConfig):
     """Fuse every layer's gate matrices ONCE (outside the decode scan) — per
-    lstm_cell.py's contract that fusing happens once per forward pass."""
+    lstm_cell.py's contract that fusing happens once per forward pass.
+
+    Shared with the serving engine (serve/engine.py), which fuses once at
+    engine construction and reuses the result for every decode batch."""
     cdtype = None if cfg.cdtype == jnp.float32 else cfg.cdtype
     return [fuse_params(layer, compute_dtype=cdtype) for layer in params["layers"]]
 
 
-def _decode_one(params, fused_layers, cfg: LMConfig, carries, token: jax.Array):
+def decode_one(params, fused_layers, cfg: LMConfig, carries, token: jax.Array):
     """One decode step: token [B] int32 → (logits [B, V], new carries).
 
     Shares the exact cell math with training (`lstm_step` on fused kernels) —
@@ -126,11 +129,11 @@ def generate(
         top_p=top_p, greedy=greedy,
     )
 
-    fused_layers = _fuse_layers(params, cfg)
+    fused_layers = fuse_layers(params, cfg)
 
     def step(carry, _):
         rng, token, carries = carry
-        logits, carries = _decode_one(params, fused_layers, cfg, carries, token)
+        logits, carries = decode_one(params, fused_layers, cfg, carries, token)
         rng, sub = jax.random.split(rng)
         nxt = sample_logits(
             sub, logits, temperature=temperature, top_k=top_k,

@@ -104,10 +104,17 @@ def lm_backbone(
     cfg: LMConfig,
     *,
     carries=None,
+    mask: jax.Array | None = None,
     dropout_rng: jax.Array | None = None,
     deterministic: bool = True,
 ):
-    """tokens [B, T] int32 → (pre-head activations [B, T, H], finals)."""
+    """tokens [B, T] int32 → (per-layer final carries, pre-head
+    activations [B, T, H]).
+
+    ``mask`` [B, T] bool (optional) freezes the recurrent carries at False
+    steps (ops/scan.py), so right-padded batches end with each row's true
+    final state — the serving engine's bucket-padded prefill (serve/).
+    """
     cdtype = cfg.cdtype
     # embed_lookup: gather forward; at small V the gradient is an MXU
     # matmul, not a scatter (ops/embedding.py — measured 28 us/step saved
@@ -117,6 +124,7 @@ def lm_backbone(
         params["layers"],
         xs,
         carries,
+        mask=mask,
         dropout_rate=cfg.dropout,
         dropout_rng=dropout_rng,
         deterministic=deterministic,

@@ -1,0 +1,38 @@
+"""Serving subsystem: continuous-batching LM inference on the training cell.
+
+An LSTM's per-session decode state is a fixed-size ``(h, c)`` pair per
+layer — the portable O(1) autoregressive cache (PAPERS.md, "Compiler-First
+State Space Duality and Portable O(1) Autoregressive Caching"). This
+package turns the repo's training LM + one-shot sampler (models/generate.py)
+into a serving engine:
+
+- ``state_cache``: slot-based device-resident cache of per-session carries
+  (LRU eviction, explicit detach/restore);
+- ``engine``: bucketed jitted prefill/decode programs over the cache —
+  compile count bounded per (phase, bucket), never per batch composition;
+- ``batcher``: continuous-batching scheduler (admission control, bounded
+  queue backpressure, round-robin decode fairness);
+- ``server``: stdlib ThreadingHTTPServer JSON endpoint + in-process client;
+- ``loadgen``: closed/open-loop load generator (p50/p99 latency, tokens/s).
+
+CLI: ``python -m lstm_tensorspark_tpu.cli serve --selftest`` (see cli.py).
+"""
+
+from .state_cache import CacheFullError, StateCache
+from .engine import SamplingParams, ServeEngine
+from .batcher import Batcher, QueueFullError, Request
+from .server import InprocessClient, ServeServer
+from .loadgen import run_loadgen
+
+__all__ = [
+    "Batcher",
+    "CacheFullError",
+    "InprocessClient",
+    "QueueFullError",
+    "Request",
+    "SamplingParams",
+    "ServeEngine",
+    "ServeServer",
+    "StateCache",
+    "run_loadgen",
+]

@@ -1,0 +1,335 @@
+"""Continuous-batching scheduler over the serve engine.
+
+One scheduler iteration (:meth:`Batcher.step`) does two things, in order:
+
+1. **admission** — pop queued requests FIFO into one bucketed prefill
+   batch (same sampling config; capped by ``max_active`` and the engine's
+   batch bucket), allocate/pin their cache slots, run prefill → each new
+   session's first token;
+2. **decode** — advance EVERY active session by exactly one token, packed
+   into bucketed decode batches grouped by sampling config.
+
+Because step 2 covers all active sessions each iteration, per-token
+fairness is structural (no session can starve another), and because step 1
+runs every iteration, a short request submitted late finishes while longer
+earlier sessions are still decoding — the continuous-batching property
+(tests/test_serve_batcher.py).
+
+Backpressure: the submit queue is bounded; a full queue raises
+:class:`QueueFullError` immediately (the HTTP layer maps it to 429). The
+active set is bounded by ``max_active`` (≤ cache slots, so admission can
+always pin a slot without evicting another active session).
+
+The scheduler is single-threaded by design — `step()` is driven either by
+the server's background thread (`run`) or directly by tests (`drain`);
+`submit` may be called from any thread.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from .engine import GREEDY, SamplingParams, ServeEngine
+
+
+class QueueFullError(RuntimeError):
+    """Admission control: the bounded submit queue is full (HTTP 429)."""
+
+
+class Request:
+    """One generation request; the result fields are filled by the
+    scheduler and published by setting ``done``."""
+
+    _ids = itertools.count()
+
+    def __init__(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        sampling: SamplingParams = GREEDY,
+        session_id: str | None = None,
+        keep_session: bool = False,
+        eos_id: int | None = None,
+    ):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        self.max_new_tokens = int(max_new_tokens)
+        self.sampling = sampling
+        self.session_id = session_id
+        self.keep_session = keep_session
+        self.eos_id = eos_id
+        self.id = next(Request._ids)
+        self.tokens: list[int] = []
+        self.error: str | None = None
+        self.cancelled = False  # set by an abandoning client (timeout)
+        self.done = threading.Event()
+        self.t_submit: float | None = None
+        self.t_first_token: float | None = None
+        self.t_done: float | None = None
+
+
+class _Session:
+    __slots__ = ("req", "sid", "slot", "remaining", "last_token")
+
+    def __init__(self, req: Request, sid: str, slot: int):
+        self.req = req
+        self.sid = sid
+        self.slot = slot
+        self.remaining = req.max_new_tokens
+        self.last_token = 0
+
+
+class Batcher:
+    def __init__(
+        self,
+        engine: ServeEngine,
+        *,
+        max_active: int = 16,
+        queue_size: int = 64,
+    ):
+        if max_active < 1:
+            raise ValueError(f"max_active must be >= 1, got {max_active}")
+        if max_active > engine.cache.num_slots:
+            raise ValueError(
+                f"max_active {max_active} exceeds the cache's "
+                f"{engine.cache.num_slots} slots — active sessions must "
+                "always be able to hold a pinned slot"
+            )
+        if queue_size < 1:
+            raise ValueError(f"queue_size must be >= 1, got {queue_size}")
+        self.engine = engine
+        self.max_active = max_active
+        self.queue_size = queue_size
+        self._queue: deque[Request] = deque()
+        self._active: list[_Session] = []
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._sid_counter = itertools.count()
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.failed = 0
+        self.tokens_generated = 0
+
+    # ---- client side ---------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        """Enqueue a request, or raise :class:`QueueFullError` (bounded
+        queue — the backpressure boundary)."""
+        if req.prompt.size > self.engine.max_prompt_len:
+            raise ValueError(
+                f"prompt length {req.prompt.size} exceeds the engine's "
+                f"largest prefill bucket {self.engine.max_prompt_len}"
+            )
+        with self._lock:
+            if len(self._queue) >= self.queue_size:
+                self.rejected += 1
+                raise QueueFullError(
+                    f"submit queue full ({self.queue_size} pending)"
+                )
+            req.t_submit = time.perf_counter()
+            self._queue.append(req)
+            self.submitted += 1
+            self._work.notify()
+
+    # ---- scheduler side ------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduler iteration (admission + one decode token for every
+        active session). Returns True when any work was done."""
+        did = self._admit()
+        did = self._decode_all() or did
+        return did
+
+    def _admit(self) -> bool:
+        admit: list[Request] = []
+        with self._lock:
+            busy_sids = {s.sid for s in self._active}
+            capacity = min(
+                self.max_active - len(self._active), self.engine.max_batch
+            )
+            while self._queue and len(admit) < capacity:
+                head = self._queue[0]
+                if head.cancelled:
+                    # abandoned by its client (timeout): drop instead of
+                    # spending decode steps on tokens nobody reads
+                    self._queue.popleft()
+                    self._fail(head, "cancelled before admission")
+                    continue
+                # one prefill batch = one sampling config (compile key);
+                # strict FIFO at the head keeps admission starvation-free
+                if admit and head.sampling.key() != admit[0].sampling.key():
+                    break
+                admit.append(self._queue.popleft())
+        if not admit:
+            return False
+
+        sessions, items = [], []
+        for req in admit:
+            sid = req.session_id
+            if sid is None:
+                # auto ids share a namespace with client-chosen ones:
+                # skip any id the cache already holds, or an anonymous
+                # request could silently inherit (and overwrite) a kept
+                # session's carries
+                sid = f"s{next(self._sid_counter)}"
+                while sid in self.engine.cache:
+                    sid = f"s{next(self._sid_counter)}"
+            if sid in busy_sids:
+                # two in-flight requests on one session would share a cache
+                # slot and corrupt each other's carries — reject the
+                # newcomer loudly; the client serialises its own session
+                self._fail(req, f"session {sid!r} is busy (another request "
+                                "on it is still decoding)")
+                continue
+            busy_sids.add(sid)
+            try:
+                slot, fresh = self.engine.cache.acquire(sid)
+            except Exception as e:  # cache exhausted by pinned slots
+                self._fail(req, f"{type(e).__name__}: {e}")
+                continue
+            if req.session_id is not None and fresh:
+                # explicit continuation of a session the cache no longer
+                # holds (evicted or never created): silently decoding from
+                # zero state would return wrong tokens — fail loudly
+                self.engine.cache.release(sid)
+                self._fail(req, f"unknown session {sid!r} (expired or "
+                                "never created; re-send the full prompt)")
+                continue
+            self.engine.cache.pin(sid)
+            sessions.append(_Session(req, sid, slot))
+            items.append((slot, fresh, req.prompt))
+
+        if not items:
+            return True  # all admissions failed; queue drained some
+        try:
+            first = self.engine.prefill(items, admit[0].sampling)
+        except Exception as e:
+            for s in sessions:
+                self.engine.cache.release(s.sid)
+                self._fail(s.req, f"prefill failed: {type(e).__name__}: {e}")
+            return True
+        now = time.perf_counter()
+        for s, tok in zip(sessions, first):
+            s.req.t_first_token = now
+            self._append_token(s, int(tok))
+            if s.remaining == 0:
+                self._finish(s)
+            else:
+                with self._lock:
+                    self._active.append(s)
+        return True
+
+    def _decode_all(self) -> bool:
+        with self._lock:
+            active = list(self._active)
+        if not active:
+            return False
+        for s in active:
+            if s.req.cancelled:  # abandoned mid-decode: free the slot now
+                self._retire(s)
+                self.engine.cache.release(s.sid)
+                self._fail(s.req, "cancelled mid-decode")
+        active = [s for s in active if not s.req.cancelled]
+        if not active:
+            return True
+        # pack by sampling config, chunk to the engine's largest batch
+        # bucket; iteration order == admission order (fairness: every
+        # active session advances exactly one token per step)
+        groups: dict[tuple, list[_Session]] = {}
+        for s in active:
+            groups.setdefault(s.req.sampling.key(), []).append(s)
+        for group in groups.values():
+            for i in range(0, len(group), self.engine.max_batch):
+                chunk = group[i : i + self.engine.max_batch]
+                slots = [s.slot for s in chunk]
+                toks = [s.last_token for s in chunk]
+                try:
+                    nxt = self.engine.decode(slots, toks, chunk[0].req.sampling)
+                except Exception as e:
+                    for s in chunk:
+                        self._retire(s)
+                        self.engine.cache.release(s.sid)
+                        self._fail(s.req,
+                                   f"decode failed: {type(e).__name__}: {e}")
+                    continue
+                for s, tok in zip(chunk, nxt):
+                    self._append_token(s, int(tok))
+                    if s.remaining == 0:
+                        self._retire(s)
+                        self._finish(s)
+        return True
+
+    def _append_token(self, s: _Session, tok: int) -> None:
+        s.req.tokens.append(tok)
+        s.last_token = tok
+        s.remaining -= 1
+        self.tokens_generated += 1
+        if s.req.eos_id is not None and tok == s.req.eos_id:
+            s.remaining = 0
+
+    def _retire(self, s: _Session) -> None:
+        with self._lock:
+            try:
+                self._active.remove(s)
+            except ValueError:
+                pass
+
+    def _finish(self, s: _Session) -> None:
+        if s.req.keep_session:
+            # keep the carries cached (unpinned → LRU-evictable) so a
+            # follow-up request with this session_id continues in place
+            self.engine.cache.unpin(s.sid)
+            s.req.session_id = s.sid
+        else:
+            self.engine.cache.release(s.sid)
+        s.req.t_done = time.perf_counter()
+        self.completed += 1
+        s.req.done.set()
+
+    def _fail(self, req: Request, error: str) -> None:
+        req.error = error
+        req.t_done = time.perf_counter()
+        self.failed += 1
+        req.done.set()
+
+    # ---- drivers -------------------------------------------------------
+
+    def drain(self) -> None:
+        """Drive the scheduler until no work remains (test/offline use)."""
+        while self.step():
+            pass
+
+    def run(self, stop_event: threading.Event, idle_wait: float = 0.05) -> None:
+        """Scheduler loop for the server's background thread: step while
+        there is work, block on the submit condition when idle."""
+        while not stop_event.is_set():
+            if self.step():
+                continue
+            with self._work:
+                if not self._queue and not self._active:
+                    self._work.wait(timeout=idle_wait)
+
+    def stats(self) -> dict:
+        with self._lock:
+            queued, active = len(self._queue), len(self._active)
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "failed": self.failed,
+            "tokens_generated": self.tokens_generated,
+            "queued": queued,
+            "active": active,
+            "max_active": self.max_active,
+            "queue_size": self.queue_size,
+        }

@@ -1,0 +1,327 @@
+"""Bucketed jitted prefill/decode programs over the recurrent-state cache.
+
+The decode step for a packed batch is ONE compiled program: gather each
+row's ``(h, c)`` from the cache by slot index, run the shared training cell
+(`models.generate.decode_one` → `ops.lstm_cell.lstm_step` on pre-fused
+kernels), sample with `models.generate.sample_logits`, scatter the new
+carries back. Prefill is the same shape of program around the masked
+`lm_backbone` scan (carry-freeze at padded steps), so a right-padded prompt
+ends with exactly the state an unpadded run would produce and the first
+sampled token is token-identical to `models/generate.py`.
+
+Recompile discipline (the XLA-on-TPU cost that kills naive serving): every
+host-visible batch is padded to a **bucket** —
+
+- prompts pad to the smallest length bucket that fits (``prefill_buckets``);
+- batches pad to the smallest batch bucket (``batch_buckets``), dead rows
+  pointing at the cache's scratch slot;
+
+so XLA compiles at most once per (phase, batch-bucket[, length-bucket],
+sampling-config), never per batch composition. `compile_counts` records
+actual traces (incremented at trace time) and is asserted in
+tests/test_serve_batcher.py.
+
+Sampling parameters are compile-time constants (they specialize the sampled
+program, exactly as in `make_generate_fn`); the batcher groups requests by
+`SamplingParams.key()` so one batch is one sampling config. Non-greedy
+sampling draws from an engine-global rng chain — reproducible for a fixed
+submission order, but not per-session; greedy decode is deterministic and
+is the parity-tested mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generate import decode_one, fuse_layers, sample_logits
+from ..models.lstm_lm import LMConfig, _head_kernel, lm_backbone
+from .state_cache import DetachedState, StateCache
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling config — static at trace time (one compiled
+    program per distinct config, same contract as `make_generate_fn`)."""
+
+    temperature: float = 1.0
+    top_k: int | None = None
+    top_p: float | None = None
+    greedy: bool = False
+
+    def key(self) -> tuple:
+        return (self.temperature, self.top_k, self.top_p, self.greedy)
+
+
+GREEDY = SamplingParams(greedy=True)
+
+
+def _bucket_for(value: int, buckets: tuple[int, ...], what: str) -> int:
+    for b in buckets:
+        if value <= b:
+            return b
+    raise ValueError(f"{what} {value} exceeds the largest bucket {buckets[-1]}")
+
+
+class ServeEngine:
+    """Owns params, the fused kernels, the state cache, and the per-bucket
+    compiled programs. Thread-safe: one lock serialises device dispatch
+    (the cache arrays are threaded through jit functionally — concurrent
+    steps would race on `cache.swap`)."""
+
+    def __init__(
+        self,
+        params,
+        cfg: LMConfig,
+        *,
+        num_slots: int = 64,
+        prefill_buckets: tuple[int, ...] = (8, 16, 32, 64, 128),
+        batch_buckets: tuple[int, ...] = (1, 2, 4, 8, 16),
+        max_sampling_configs: int = 16,
+        rng_seed: int = 0,
+    ):
+        # serving never rematerialises (same override as generate())
+        if cfg.remat_chunk is not None:
+            cfg = dataclasses.replace(cfg, remat_chunk=None)
+        self.cfg = cfg
+        self.params = params
+        self.fused_layers = fuse_layers(params, cfg)  # once, at init
+        self.prefill_buckets = tuple(sorted(prefill_buckets))
+        self.batch_buckets = tuple(sorted(batch_buckets))
+        self.cache = StateCache(cfg.num_layers, num_slots, cfg.hidden_size)
+        # sampling params are compile keys and client-controlled at the
+        # HTTP boundary: bound how many distinct configs this engine will
+        # ever compile, or a client sweeping temperatures could thrash
+        # XLA (~20-40 s per TPU compile) and grow the program cache
+        # without limit
+        self.max_sampling_configs = max_sampling_configs
+        self._sampling_keys: set[tuple] = set()
+        self.compile_counts: dict[tuple, int] = defaultdict(int)
+        self._prefill_fns: dict[tuple, callable] = {}
+        self._decode_fns: dict[tuple, callable] = {}
+        self._rng = jax.random.PRNGKey(rng_seed)
+        self._dummy_rng = jax.random.PRNGKey(0)
+        self._lock = threading.RLock()
+
+    # ---- limits --------------------------------------------------------
+
+    @property
+    def max_prompt_len(self) -> int:
+        return self.prefill_buckets[-1]
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_buckets[-1]
+
+    # ---- compiled programs --------------------------------------------
+
+    def _admit_sampling(self, sampling: SamplingParams) -> None:
+        key = sampling.key()
+        if key in self._sampling_keys:
+            return
+        if len(self._sampling_keys) >= self.max_sampling_configs:
+            raise ValueError(
+                f"engine already compiled {self.max_sampling_configs} "
+                "distinct sampling configs; rejecting a new one (raise "
+                "max_sampling_configs if this workload is legitimate)"
+            )
+        self._sampling_keys.add(key)
+
+    def _next_rng(self, sampling: SamplingParams):
+        if sampling.greedy:
+            return self._dummy_rng  # greedy ignores the key: skip the split
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _get_prefill_fn(self, batch_b: int, len_b: int, sampling: SamplingParams):
+        key = (batch_b, len_b, sampling.key())
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        count_key = ("prefill", batch_b, len_b, sampling.key())
+
+        def prefill_fn(params, h_cache, c_cache, slots, fresh, prompts,
+                       lengths, rng):
+            # trace-time side effect: one bump per XLA compile of this shape
+            self.compile_counts[count_key] += 1
+            h_in = h_cache[:, slots, :]  # [L, B, H]
+            c_in = c_cache[:, slots, :]
+            # fresh rows start from zero state — no device-side slot
+            # zeroing on acquire, the zero ride along in this program
+            live = ~fresh[None, :, None]
+            h_in = jnp.where(live, h_in, 0.0)
+            c_in = jnp.where(live, c_in, 0.0)
+            carries = [(h_in[l], c_in[l]) for l in range(cfg.num_layers)]
+            mask = jnp.arange(len_b)[None, :] < lengths[:, None]  # [B, T]
+            finals, ys = lm_backbone(params, prompts, cfg, carries=carries,
+                                     mask=mask)
+            # logits at each row's true last position (same head math, same
+            # ldtype as lm_forward — near-tied logits must argmax alike)
+            last = jnp.take_along_axis(
+                ys, (lengths - 1)[:, None, None], axis=1
+            )[:, 0, :]  # [B, H]
+            kernel, bias = _head_kernel(params, cfg)
+            logits = (
+                jnp.dot(last.astype(kernel.dtype), kernel,
+                        preferred_element_type=cfg.ldtype)
+                + bias.astype(cfg.ldtype)
+            )
+            token = sample_logits(
+                rng, logits, temperature=sampling.temperature,
+                top_k=sampling.top_k, top_p=sampling.top_p,
+                greedy=sampling.greedy,
+            )
+            new_h = jnp.stack([f[0] for f in finals])  # [L, B, H]
+            new_c = jnp.stack([f[1] for f in finals])
+            h_cache = h_cache.at[:, slots, :].set(new_h.astype(jnp.float32))
+            c_cache = c_cache.at[:, slots, :].set(new_c.astype(jnp.float32))
+            return h_cache, c_cache, token
+
+        fn = jax.jit(prefill_fn)
+        self._prefill_fns[key] = fn
+        return fn
+
+    def _get_decode_fn(self, batch_b: int, sampling: SamplingParams):
+        key = (batch_b, sampling.key())
+        fn = self._decode_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        count_key = ("decode", batch_b, sampling.key())
+
+        def decode_fn(params, fused, h_cache, c_cache, slots, tokens, rng):
+            self.compile_counts[count_key] += 1
+            h_in = h_cache[:, slots, :]
+            c_in = c_cache[:, slots, :]
+            carries = [(h_in[l], c_in[l]) for l in range(cfg.num_layers)]
+            logits, new_carries = decode_one(params, fused, cfg, carries,
+                                             tokens)
+            nxt = sample_logits(
+                rng, logits, temperature=sampling.temperature,
+                top_k=sampling.top_k, top_p=sampling.top_p,
+                greedy=sampling.greedy,
+            )
+            new_h = jnp.stack([nc[0] for nc in new_carries])
+            new_c = jnp.stack([nc[1] for nc in new_carries])
+            h_cache = h_cache.at[:, slots, :].set(new_h.astype(jnp.float32))
+            c_cache = c_cache.at[:, slots, :].set(new_c.astype(jnp.float32))
+            return h_cache, c_cache, nxt
+
+        fn = jax.jit(decode_fn)
+        self._decode_fns[key] = fn
+        return fn
+
+    # ---- host-facing steps --------------------------------------------
+
+    def prefill(self, items, sampling: SamplingParams = GREEDY) -> np.ndarray:
+        """Run one bucketed prefill batch.
+
+        ``items``: list of ``(slot, fresh, prompt)`` with ``prompt`` a 1-D
+        int array (1 <= len <= max_prompt_len). Rows are padded up to the
+        batch bucket (dead rows target the scratch slot) and prompts are
+        right-padded to the length bucket (carry-freeze mask). Returns the
+        first sampled token per item, ``[len(items)]`` int32.
+        """
+        n = len(items)
+        if n == 0:
+            return np.zeros((0,), np.int32)
+        lengths = [int(np.asarray(p).size) for _, _, p in items]
+        for t in lengths:
+            if t < 1:
+                raise ValueError("empty prompt")
+        self._admit_sampling(sampling)
+        batch_b = _bucket_for(n, self.batch_buckets, "prefill batch")
+        len_b = _bucket_for(max(lengths), self.prefill_buckets, "prompt length")
+
+        slots = np.full((batch_b,), self.cache.scratch_slot, np.int32)
+        fresh = np.ones((batch_b,), bool)
+        prompts = np.zeros((batch_b, len_b), np.int32)
+        lens = np.ones((batch_b,), np.int32)
+        for i, (slot, is_fresh, prompt) in enumerate(items):
+            p = np.asarray(prompt, np.int32).reshape(-1)
+            slots[i] = slot
+            fresh[i] = bool(is_fresh)
+            prompts[i, : p.size] = p
+            lens[i] = p.size
+
+        with self._lock:
+            fn = self._get_prefill_fn(batch_b, len_b, sampling)
+            rng = self._next_rng(sampling)
+            h, c, tok = fn(self.params, self.cache.h, self.cache.c,
+                           jnp.asarray(slots), jnp.asarray(fresh),
+                           jnp.asarray(prompts), jnp.asarray(lens), rng)
+            self.cache.swap(h, c)
+        return np.asarray(tok)[:n]
+
+    def decode(self, slots, tokens, sampling: SamplingParams = GREEDY) -> np.ndarray:
+        """Advance each session one token: gather carries by ``slots`` [B],
+        feed ``tokens`` [B], return the next token per row ``[B]`` int32.
+        Pads to the batch bucket (dead rows at the scratch slot)."""
+        n = len(slots)
+        if n == 0:
+            return np.zeros((0,), np.int32)
+        self._admit_sampling(sampling)
+        batch_b = _bucket_for(n, self.batch_buckets, "decode batch")
+        slots_p = np.full((batch_b,), self.cache.scratch_slot, np.int32)
+        slots_p[:n] = np.asarray(slots, np.int32)
+        tokens_p = np.zeros((batch_b,), np.int32)
+        tokens_p[:n] = np.asarray(tokens, np.int32)
+
+        with self._lock:
+            fn = self._get_decode_fn(batch_b, sampling)
+            rng = self._next_rng(sampling)
+            h, c, tok = fn(self.params, self.fused_layers, self.cache.h,
+                           self.cache.c, jnp.asarray(slots_p),
+                           jnp.asarray(tokens_p), rng)
+            self.cache.swap(h, c)
+        return np.asarray(tok)[:n]
+
+    def warmup(self, sampling: SamplingParams = GREEDY,
+               prompt_lens: tuple[int, ...] = (1,),
+               batch_sizes: tuple[int, ...] | None = None) -> int:
+        """Pre-compile the bucket lattice a workload will touch (every
+        batch bucket x the length buckets covering ``prompt_lens``, both
+        phases) by running dummy steps against the scratch slot — so the
+        first real traffic burst is never charged the compiles. Returns
+        the number of (phase, bucket) programs now cached."""
+        batch_sizes = tuple(batch_sizes or self.batch_buckets)
+        len_buckets = sorted({
+            _bucket_for(t, self.prefill_buckets, "prompt length")
+            for t in prompt_lens
+        })
+        scratch = self.cache.scratch_slot
+        for b in batch_sizes:
+            bb = _bucket_for(b, self.batch_buckets, "batch")
+            for t in len_buckets:
+                items = [(scratch, True, np.zeros((t,), np.int32))] * bb
+                self.prefill(items, sampling)
+            self.decode([scratch] * bb, [0] * bb, sampling)
+        return len(self._prefill_fns) + len(self._decode_fns)
+
+    # ---- session lifecycle (thin wrappers over the cache) -------------
+
+    def detach_session(self, session_id: str) -> DetachedState:
+        with self._lock:
+            return self.cache.detach(session_id)
+
+    def restore_session(self, session_id: str, state: DetachedState) -> int:
+        with self._lock:
+            return self.cache.restore(session_id, state)
+
+    def num_compiles(self, phase: str | None = None) -> int:
+        items = self.compile_counts.items()
+        return sum(v for k, v in items if phase is None or k[0] == phase)
+
+    def stats(self) -> dict:
+        return {
+            "cache": self.cache.stats(),
+            "compiles": {repr(k): v for k, v in self.compile_counts.items()},
+            "prefill_buckets": self.prefill_buckets,
+            "batch_buckets": self.batch_buckets,
+        }

@@ -1,0 +1,198 @@
+"""Offline load generator for the serving engine (CPU-runnable).
+
+Two standard modes:
+
+- **closed-loop**: N session threads, each issuing its requests
+  back-to-back through the in-process client — models N always-busy
+  clients; throughput scales with continuous batching until the decode
+  bucket saturates;
+- **open-loop**: requests arrive at a fixed rate regardless of completion
+  (the arrival process does not slow down when the server does), exposing
+  queueing delay and backpressure (429s are counted, not retried).
+
+The report carries request latency p50/p99/mean, time-to-first-token
+p50/p99, aggregate tokens/sec and requests/sec. Phases are wrapped in
+`utils.tracing` spans, so ``--trace`` on the CLI captures the run.
+
+`concurrency_sweep` runs the same closed-loop workload at increasing
+session counts on one warm server — the headline check that batched
+decode beats sequential serving (ISSUE acceptance: >= 8 concurrent
+sessions must out-throughput 1 session).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..utils import span
+from .batcher import QueueFullError
+from .engine import GREEDY, SamplingParams
+from .server import InprocessClient, ServeServer
+
+
+def _percentile(sorted_vals: list[float], pct: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = int(round(pct / 100.0 * (len(sorted_vals) - 1)))
+    return sorted_vals[min(max(idx, 0), len(sorted_vals) - 1)]
+
+
+def _random_prompts(n: int, prompt_len: int, vocab_size: int, seed: int):
+    rng = np.random.RandomState(seed)
+    return [
+        rng.randint(0, vocab_size, size=prompt_len).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+def _report(results: list[dict], rejected: int, failed: int, wall_s: float,
+            mode: str, sessions: int) -> dict:
+    lat = sorted(r["latency_s"] for r in results)
+    ttft = sorted(r["ttft_s"] for r in results if r["ttft_s"] is not None)
+    tokens = sum(r["tokens"] for r in results)
+    return {
+        "mode": mode,
+        "sessions": sessions,
+        "requests": len(results) + rejected + failed,
+        "completed": len(results),
+        "rejected": rejected,
+        "failed": failed,
+        "wall_s": round(wall_s, 4),
+        "p50_latency_ms": round(_percentile(lat, 50) * 1e3, 3),
+        "p99_latency_ms": round(_percentile(lat, 99) * 1e3, 3),
+        "mean_latency_ms": round(
+            (sum(lat) / len(lat) if lat else float("nan")) * 1e3, 3),
+        "p50_ttft_ms": round(_percentile(ttft, 50) * 1e3, 3),
+        "p99_ttft_ms": round(_percentile(ttft, 99) * 1e3, 3),
+        "tokens_generated": tokens,
+        "tokens_per_sec": round(tokens / wall_s, 2) if wall_s > 0 else 0.0,
+        "requests_per_sec": round(len(results) / wall_s, 2)
+        if wall_s > 0 else 0.0,
+    }
+
+
+def run_loadgen(
+    server: ServeServer,
+    *,
+    vocab_size: int,
+    sessions: int = 8,
+    requests_per_session: int = 4,
+    prompt_len: int = 8,
+    max_new_tokens: int = 16,
+    sampling: SamplingParams = GREEDY,
+    mode: str = "closed",
+    rate: float | None = None,
+    seed: int = 0,
+    timeout: float = 300.0,
+) -> dict:
+    """Drive a started :class:`ServeServer`; returns the report dict."""
+    if mode not in ("closed", "open"):
+        raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
+    client = InprocessClient(server)
+    total = sessions * requests_per_session
+    prompts = _random_prompts(total, prompt_len, vocab_size, seed)
+    results: list[dict] = []
+    rejected = [0]
+    failed = [0]
+    lock = threading.Lock()
+
+    def one_request(prompt) -> None:
+        t0 = time.perf_counter()
+        try:
+            req = server.generate(
+                prompt, max_new_tokens=max_new_tokens, sampling=sampling,
+                timeout=timeout,
+            )
+        except QueueFullError:
+            with lock:
+                rejected[0] += 1
+            return
+        except Exception:
+            # a timeout or scheduler-side failure must not kill the worker
+            # thread (its remaining requests would silently vanish from
+            # the report) — count it and keep the loop going
+            with lock:
+                failed[0] += 1
+            return
+        rec = {
+            "latency_s": time.perf_counter() - t0,
+            "ttft_s": (req.t_first_token - req.t_submit)
+            if req.t_first_token and req.t_submit else None,
+            "tokens": len(req.tokens),
+        }
+        with lock:
+            results.append(rec)
+
+    with span("loadgen", mode=mode, sessions=sessions, total=total):
+        t_start = time.perf_counter()
+        if mode == "closed":
+            def worker(wid: int) -> None:
+                for r in range(requests_per_session):
+                    one_request(prompts[wid * requests_per_session + r])
+
+            threads = [
+                threading.Thread(target=worker, args=(i,), daemon=True)
+                for i in range(sessions)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        else:  # open loop: fixed arrival rate, completion measured async
+            if not rate or rate <= 0:
+                raise ValueError("open-loop mode needs rate > 0 (req/s)")
+            threads = []
+            for i, prompt in enumerate(prompts):
+                target = t_start + i / rate
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                t = threading.Thread(
+                    target=one_request, args=(prompt,), daemon=True
+                )
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()
+        wall = time.perf_counter() - t_start
+    report = _report(results, rejected[0], failed[0], wall, mode, sessions)
+    if rate:
+        report["offered_rate_rps"] = rate
+    return report
+
+
+def concurrency_sweep(
+    server: ServeServer,
+    *,
+    vocab_size: int,
+    levels: tuple[int, ...] = (1, 8),
+    requests_per_session: int = 4,
+    prompt_len: int = 8,
+    max_new_tokens: int = 16,
+    sampling: SamplingParams = GREEDY,
+    seed: int = 0,
+) -> dict:
+    """Closed-loop throughput at each concurrency level on ONE warm server
+    (the engine pre-compiles the full bucket lattice before timing, so no
+    level is charged XLA compiles mid-run). Returns
+    ``{"levels": {n: report}, "speedup_max_vs_1": x}``."""
+    with span("loadgen_warmup"):
+        server.engine.warmup(sampling, prompt_lens=(prompt_len,))
+    reports = {}
+    for n in levels:
+        reports[n] = run_loadgen(
+            server, vocab_size=vocab_size, sessions=n,
+            requests_per_session=requests_per_session,
+            prompt_len=prompt_len, max_new_tokens=max_new_tokens,
+            sampling=sampling, seed=seed + n,
+        )
+    out = {"levels": reports}
+    if 1 in reports:
+        base = reports[1]["tokens_per_sec"] or 1e-9
+        out["speedup_max_vs_1"] = round(
+            max(r["tokens_per_sec"] for r in reports.values()) / base, 3
+        )
+    return out

@@ -1,0 +1,216 @@
+"""Serving front-ends: in-process synchronous client + stdlib HTTP server.
+
+:class:`ServeServer` owns the engine + batcher and a background scheduler
+thread; :meth:`ServeServer.generate` is the synchronous request path used
+by both front-ends:
+
+- :class:`InprocessClient` — the test/loadgen client: same admission,
+  batching and backpressure semantics as HTTP, no sockets;
+- :func:`make_http_server` — a stdlib ``ThreadingHTTPServer`` JSON
+  endpoint (no new dependencies):
+
+  - ``POST /v1/generate``  body ``{"prompt": [ids], "max_new_tokens": N,
+    "greedy": true, "temperature": t, "top_k": k, "top_p": p,
+    "session_id": "...", "keep_session": false, "eos_id": null}`` →
+    ``{"tokens": [...], "session_id": "...", "latency_ms": ...}``;
+  - ``GET /healthz`` → liveness; ``GET /v1/stats`` → batcher/engine/cache
+    counters.
+
+  Backpressure maps to HTTP: full queue → 429, bad request → 400,
+  scheduler failure → 500, timeout → 504.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .batcher import Batcher, QueueFullError, Request
+from .engine import GREEDY, SamplingParams, ServeEngine
+
+
+class ServeServer:
+    """Engine + batcher + scheduler thread, with a synchronous submit path."""
+
+    def __init__(self, engine: ServeEngine, batcher: Batcher | None = None,
+                 **batcher_kw):
+        self.engine = engine
+        self.batcher = batcher or Batcher(engine, **batcher_kw)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def start(self) -> "ServeServer":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self.batcher.run, args=(self._stop,),
+            name="serve-scheduler", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def __enter__(self) -> "ServeServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- request path --------------------------------------------------
+
+    def generate(
+        self,
+        prompt,
+        *,
+        max_new_tokens: int,
+        sampling: SamplingParams = GREEDY,
+        session_id: str | None = None,
+        keep_session: bool = False,
+        eos_id: int | None = None,
+        timeout: float = 120.0,
+    ) -> Request:
+        """Submit and block until the request completes; returns the filled
+        :class:`Request` (``.tokens``, ``.session_id``, timestamps).
+        Raises :class:`QueueFullError` (backpressure), ``TimeoutError``, or
+        ``RuntimeError`` on a scheduler-side failure."""
+        req = Request(
+            prompt, max_new_tokens, sampling=sampling,
+            session_id=session_id, keep_session=keep_session, eos_id=eos_id,
+        )
+        self.batcher.submit(req)
+        if not req.done.wait(timeout):
+            # tell the scheduler to stop working for a client that left —
+            # otherwise abandoned requests hold queue/slot capacity and
+            # decode tokens nobody reads (504 + retry = load amplification)
+            req.cancelled = True
+            raise TimeoutError(
+                f"request {req.id} not completed within {timeout:.0f}s"
+            )
+        if req.error is not None:
+            raise RuntimeError(req.error)
+        return req
+
+    def stats(self) -> dict:
+        return {"batcher": self.batcher.stats(), **self.engine.stats()}
+
+
+class InprocessClient:
+    """Synchronous in-process client: the HTTP semantics without sockets."""
+
+    def __init__(self, server: ServeServer):
+        self._server = server
+
+    def generate(self, prompt, *, max_new_tokens: int,
+                 sampling: SamplingParams = GREEDY, **kw) -> list[int]:
+        req = self._server.generate(
+            prompt, max_new_tokens=max_new_tokens, sampling=sampling, **kw
+        )
+        return list(req.tokens)
+
+    def stats(self) -> dict:
+        return self._server.stats()
+
+
+def _sampling_from_body(body: dict) -> SamplingParams:
+    # sampling params are COMPILE KEYS (engine.py): quantize the floats so
+    # clients sending temperature=0.70000001 vs 0.7 share one compiled
+    # program; the engine's max_sampling_configs bounds the rest
+    top_k = body.get("top_k")
+    top_p = body.get("top_p")
+    return SamplingParams(
+        temperature=round(float(body.get("temperature", 1.0)), 2),
+        top_k=None if top_k is None else int(top_k),
+        top_p=None if top_p is None else round(float(top_p), 2),
+        greedy=bool(body.get("greedy", False)),
+    )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "lstm-tsp-serve/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # keep serving logs structured
+        pass
+
+    @property
+    def _serve(self) -> ServeServer:
+        return self.server.serve  # type: ignore[attr-defined]
+
+    def _reply(self, code: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:
+        if self.path == "/healthz":
+            self._reply(200, {"ok": True})
+        elif self.path == "/v1/stats":
+            self._reply(200, self._serve.stats())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:
+        if self.path != "/v1/generate":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            prompt = body["prompt"]
+            max_new = int(body.get("max_new_tokens", 16))
+            sampling = _sampling_from_body(body)
+            timeout = float(body.get("timeout", 120.0))
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
+            # TypeError included: {"max_new_tokens": null} etc. must be a
+            # 400, not a handler crash that resets the connection
+            self._reply(400, {"error": f"bad request: {e}"})
+            return
+        t0 = time.perf_counter()
+        try:
+            req = self._serve.generate(
+                prompt, max_new_tokens=max_new, sampling=sampling,
+                session_id=body.get("session_id"),
+                keep_session=bool(body.get("keep_session", False)),
+                eos_id=body.get("eos_id"),
+                timeout=timeout,
+            )
+        except QueueFullError as e:
+            self._reply(429, {"error": str(e)})
+            return
+        except (ValueError, TypeError, RuntimeError) as e:
+            # TypeError: a null/wrong-typed prompt surfaces from
+            # np.asarray inside Request — still the client's fault
+            code = 500 if isinstance(e, RuntimeError) else 400
+            self._reply(code, {"error": f"{type(e).__name__}: {e}"})
+            return
+        except TimeoutError as e:
+            self._reply(504, {"error": str(e)})
+            return
+        self._reply(200, {
+            "tokens": list(req.tokens),
+            "session_id": req.session_id,
+            "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        })
+
+
+def make_http_server(serve: ServeServer, host: str = "127.0.0.1",
+                     port: int = 0) -> ThreadingHTTPServer:
+    """Bind the JSON endpoint (port 0 → ephemeral; see
+    ``httpd.server_address``). Caller drives ``serve_forever`` (typically
+    on a thread) and pairs it with ``serve.start()``/``serve.stop()``."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.serve = serve  # type: ignore[attr-defined]
+    return httpd

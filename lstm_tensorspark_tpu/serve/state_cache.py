@@ -1,0 +1,199 @@
+"""Slot-based device-resident cache of per-session recurrent state.
+
+An LSTM session's entire decode state is ``(h, c)`` per layer — fixed-size,
+independent of how many tokens the session has consumed (the O(1)
+autoregressive cache; contrast a transformer's O(T) KV cache). The cache
+stores it as two stacked device arrays ``[L, S+1, H]`` (layers x slots x
+hidden, float32 — `lstm_step` computes carries in f32, so storage is exact)
+plus a host-side session table:
+
+- sessions map to integer **slots**; the jitted engine programs
+  (serve/engine.py) gather carries by slot index, run the step, and
+  scatter results back — the cache arrays are threaded through jit
+  functionally and replaced via :meth:`swap`;
+- slot ``S`` (the last row) is a **scratch slot**: decode batches padded
+  up to a bucket size point their dead rows at it, so padding writes
+  never corrupt a live session;
+- **LRU eviction** frees the least-recently-used unpinned slot when the
+  cache is full; the batcher pins slots while their session is active in
+  a batch, so eviction only ever hits idle (kept-alive) sessions;
+- **detach/restore**: `detach` pulls a session's carries to host numpy
+  (releasing the slot), `restore` re-admits them later — the round trip
+  is exact (tests/test_serve_cache.py proves continued decode is
+  token-identical to an uninterrupted run).
+
+Host-side bookkeeping is lock-protected; device reads/writes are plain
+jnp gather/scatter ops (one compile each per batch-shape, amortised).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class CacheFullError(RuntimeError):
+    """No free slot and every occupied slot is pinned."""
+
+
+class DetachedState(NamedTuple):
+    """Host-resident session state: h, c each ``[L, H]`` float32 numpy."""
+
+    h: np.ndarray
+    c: np.ndarray
+
+
+class StateCache:
+    def __init__(self, num_layers: int, num_slots: int, hidden_size: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_layers = num_layers
+        self.num_slots = num_slots
+        self.hidden_size = hidden_size
+        # +1: the scratch slot for padded batch rows (index == num_slots)
+        self.h = jnp.zeros((num_layers, num_slots + 1, hidden_size), jnp.float32)
+        self.c = jnp.zeros((num_layers, num_slots + 1, hidden_size), jnp.float32)
+        self._lock = threading.RLock()
+        self._slots: OrderedDict[str, int] = OrderedDict()  # LRU: oldest first
+        self._free: list[int] = list(range(num_slots))
+        self._pinned: set[str] = set()
+        self.evictions = 0
+
+    @property
+    def scratch_slot(self) -> int:
+        return self.num_slots
+
+    # ---- session table -------------------------------------------------
+
+    def lookup(self, session_id: str) -> int | None:
+        """Slot for a live session (refreshes LRU recency), else None."""
+        with self._lock:
+            if session_id not in self._slots:
+                return None
+            self._slots.move_to_end(session_id)
+            return self._slots[session_id]
+
+    def acquire(self, session_id: str) -> tuple[int, bool]:
+        """Return ``(slot, fresh)`` for the session, allocating if needed.
+
+        ``fresh`` is True when the slot holds no prior state for this
+        session (new allocation) — the engine's prefill zeroes the initial
+        carries for fresh rows instead of trusting the slot contents, so
+        acquire never needs a device-side zeroing dispatch.
+        """
+        with self._lock:
+            if session_id in self._slots:
+                self._slots.move_to_end(session_id)
+                return self._slots[session_id], False
+            if self._free:
+                slot = self._free.pop()
+            else:
+                slot = self._evict_lru_locked()
+            self._slots[session_id] = slot
+            return slot, True
+
+    def _evict_lru_locked(self) -> int:
+        for sid in self._slots:  # oldest-recency first
+            if sid not in self._pinned:
+                slot = self._slots.pop(sid)
+                self.evictions += 1
+                return slot
+        raise CacheFullError(
+            f"all {self.num_slots} slots pinned by active sessions"
+        )
+
+    def release(self, session_id: str) -> None:
+        """Drop the session (its slot returns to the free list). No-op for
+        unknown sessions — release after eviction must be safe."""
+        with self._lock:
+            self._pinned.discard(session_id)
+            slot = self._slots.pop(session_id, None)
+            if slot is not None:
+                self._free.append(slot)
+
+    def pin(self, session_id: str) -> None:
+        with self._lock:
+            if session_id not in self._slots:
+                raise KeyError(f"cannot pin unknown session {session_id!r}")
+            self._pinned.add(session_id)
+
+    def unpin(self, session_id: str) -> None:
+        with self._lock:
+            self._pinned.discard(session_id)
+
+    def __contains__(self, session_id: str) -> bool:
+        with self._lock:
+            return session_id in self._slots
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    # ---- device state --------------------------------------------------
+
+    def swap(self, h: jnp.ndarray, c: jnp.ndarray) -> None:
+        """Install updated cache arrays (the jitted step's outputs)."""
+        self.h, self.c = h, c
+
+    def read_slots(self, slots) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Gather carries for ``slots`` [B] → (h, c) each ``[L, B, H]``."""
+        idx = jnp.asarray(slots, jnp.int32)
+        return self.h[:, idx, :], self.c[:, idx, :]
+
+    def write_slots(self, slots, h, c) -> None:
+        """Scatter (h, c) each ``[L, B, H]`` into ``slots`` [B]."""
+        idx = jnp.asarray(slots, jnp.int32)
+        self.h = self.h.at[:, idx, :].set(h)
+        self.c = self.c.at[:, idx, :].set(c)
+
+    # ---- detach / restore ---------------------------------------------
+
+    def detach(self, session_id: str) -> DetachedState:
+        """Pull a session's carries to host and release its slot.
+
+        The returned :class:`DetachedState` is exact (f32 both ways) —
+        restoring it and continuing decode is bit-identical to never
+        having detached.
+        """
+        with self._lock:
+            if session_id not in self._slots:
+                raise KeyError(f"cannot detach unknown session {session_id!r}")
+            slot = self._slots[session_id]
+            state = DetachedState(
+                h=np.asarray(self.h[:, slot, :]),
+                c=np.asarray(self.c[:, slot, :]),
+            )
+            self.release(session_id)
+            return state
+
+    def restore(self, session_id: str, state: DetachedState) -> int:
+        """Re-admit a detached session; returns its (new) slot."""
+        if state.h.shape != (self.num_layers, self.hidden_size):
+            raise ValueError(
+                f"detached state shape {state.h.shape} does not match cache "
+                f"({self.num_layers}, {self.hidden_size})"
+            )
+        with self._lock:
+            if session_id in self._slots:
+                raise ValueError(f"session {session_id!r} already live")
+            slot, _ = self.acquire(session_id)
+            self.write_slots(
+                np.asarray([slot]),
+                jnp.asarray(state.h)[:, None, :],
+                jnp.asarray(state.c)[:, None, :],
+            )
+            return slot
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "slots": self.num_slots,
+                "live_sessions": len(self._slots),
+                "pinned": len(self._pinned),
+                "free": len(self._free),
+                "evictions": self.evictions,
+            }

@@ -521,3 +521,45 @@ def test_imdb_synthetic_signal_knob():
     mixed = sum(
         len(set(int(t) % 2 for t in seq)) == 2 for seq in seqs[:20])
     assert mixed >= 18  # shared-noise tokens dominate both parities
+
+
+def test_corpus_cache_namespaced_by_version_and_age_gated(tmp_path, monkeypatch):
+    """The synthetic word-corpus cache lives under a per-_CORPUS_FMT
+    subdirectory, and the stale sweep only deletes OTHER versions' entries
+    once old — two concurrently-live checkouts no longer thrash each
+    other's caches (ADVICE r5 finding 3)."""
+    import tempfile
+    import time
+
+    from lstm_tensorspark_tpu.data import datasets
+
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    root = tmp_path / "lstm_tsp_corpus_cache"
+    # a "foreign version" checkout's cache: one fresh entry, one ancient
+    foreign = root / "v0"
+    foreign.mkdir(parents=True)
+    fresh = foreign / "words_10_5_0_0.0.txt"
+    fresh.write_text("x " * 10)
+    old = foreign / "words_99_5_0_0.0.txt"
+    old.write_text("y " * 99)
+    ancient = time.time() - datasets._CACHE_STALE_AGE_S - 60
+    os.utime(old, (ancient, ancient))
+    # legacy pre-namespace flat file, also ancient
+    legacy = root / "words_v0_7_5_0_0.05.txt"
+    legacy.write_text("z " * 7)
+    os.utime(legacy, (ancient, ancient))
+
+    def gen(n, v, seed, noise):
+        return " ".join(str(i % v) for i in range(n))
+
+    out = datasets._cached_word_stream(12, 5, 0, 0.05, gen)
+    assert len(out) == 12
+    # entry cached under the CURRENT version's namespace
+    cached = root / datasets._CORPUS_FMT / "words_12_5_0_0.05.txt"
+    assert cached.is_file()
+    # cache hit: identical result without regenerating
+    assert datasets._cached_word_stream(12, 5, 0, 0.05, gen) == out
+    # the foreign version's FRESH entry survived; only the old ones went
+    assert fresh.is_file()
+    assert not old.exists()
+    assert not legacy.exists()

@@ -1,0 +1,187 @@
+"""State-cache tests (serve/state_cache.py): slot lifecycle, LRU eviction,
+pinning, and the detach/restore round trip — continued decode after a
+detach must be token-identical to an uninterrupted run.
+
+The jit-touching tests share one module-scoped engine (and one reference
+`make_generate_fn` program) so the file pays each XLA compile once —
+tier-1 wall-clock discipline."""
+
+import jax
+import numpy as np
+import pytest
+
+from lstm_tensorspark_tpu.models import LMConfig, init_lm, make_generate_fn
+from lstm_tensorspark_tpu.serve import (
+    Batcher,
+    CacheFullError,
+    Request,
+    ServeEngine,
+    StateCache,
+)
+
+
+def test_slot_reuse_after_release():
+    cache = StateCache(num_layers=1, num_slots=2, hidden_size=4)
+    slot_a, fresh = cache.acquire("a")
+    assert fresh
+    cache.release("a")
+    slot_b, fresh = cache.acquire("b")
+    assert fresh
+    assert slot_b == slot_a  # released slot recycled
+    # re-acquire of a live session is not fresh and keeps its slot
+    slot_b2, fresh = cache.acquire("b")
+    assert (slot_b2, fresh) == (slot_b, False)
+
+
+def test_lru_eviction_order():
+    cache = StateCache(num_layers=1, num_slots=2, hidden_size=4)
+    cache.acquire("a")
+    cache.acquire("b")
+    cache.lookup("a")  # refresh a → b becomes least-recently-used
+    cache.acquire("c")  # full: must evict b
+    assert "b" not in cache and "a" in cache and "c" in cache
+    assert cache.evictions == 1
+
+
+def test_pinned_slots_never_evicted():
+    cache = StateCache(num_layers=1, num_slots=2, hidden_size=4)
+    cache.acquire("a")
+    cache.acquire("b")
+    cache.pin("a")
+    cache.pin("b")
+    with pytest.raises(CacheFullError):
+        cache.acquire("c")
+    cache.unpin("b")
+    cache.acquire("c")  # now b (unpinned LRU) is evictable
+    assert "b" not in cache and "a" in cache
+
+
+def test_scratch_slot_is_outside_the_slot_space():
+    cache = StateCache(num_layers=2, num_slots=3, hidden_size=4)
+    assert cache.scratch_slot == 3
+    assert cache.h.shape == (2, 4, 4)  # num_slots + 1 rows
+
+
+def test_detach_restore_preserves_values():
+    cache = StateCache(num_layers=2, num_slots=2, hidden_size=3)
+    slot, _ = cache.acquire("s")
+    h = np.arange(6, dtype=np.float32).reshape(2, 1, 3)
+    c = -h
+    cache.write_slots(np.asarray([slot]), h, c)
+    state = cache.detach("s")
+    assert "s" not in cache
+    np.testing.assert_array_equal(state.h, h[:, 0, :])
+    np.testing.assert_array_equal(state.c, c[:, 0, :])
+    cache.acquire("other")  # may take the old slot: restore must still work
+    new_slot = cache.restore("s", state)
+    got_h, got_c = cache.read_slots(np.asarray([new_slot]))
+    np.testing.assert_array_equal(np.asarray(got_h), h)
+    np.testing.assert_array_equal(np.asarray(got_c), c)
+
+
+def test_restore_rejects_wrong_shape():
+    cache = StateCache(num_layers=2, num_slots=2, hidden_size=3)
+    bad = np.zeros((1, 3), np.float32)
+    from lstm_tensorspark_tpu.serve.state_cache import DetachedState
+
+    with pytest.raises(ValueError):
+        cache.restore("x", DetachedState(h=bad, c=bad))
+
+
+# ---- decode-parity tests: one shared engine + one reference program -----
+
+_CFG = LMConfig(vocab_size=37, hidden_size=16, num_layers=2)
+_PROMPT = np.array([3, 5, 7, 2, 11], np.int32)
+_N_TOTAL = 10
+
+
+@pytest.fixture(scope="module")
+def stack():
+    params = init_lm(jax.random.PRNGKey(0), _CFG)
+    engine = ServeEngine(
+        params, _CFG, num_slots=8,
+        prefill_buckets=(8, 16), batch_buckets=(1, 2, 4),
+    )
+    return params, engine
+
+
+@pytest.fixture(scope="module")
+def ref_tokens(stack):
+    """Uninterrupted greedy reference: _N_TOTAL tokens for _PROMPT."""
+    params, _ = stack
+    return np.asarray(
+        make_generate_fn(_CFG, max_new_tokens=_N_TOTAL, greedy=True)(
+            params, _PROMPT[None, :], jax.random.PRNGKey(0)
+        )
+    )[0, _PROMPT.size:]
+
+
+def test_detach_restore_roundtrip_equals_uncached_decode(stack, ref_tokens):
+    """Split a greedy decode at token k, detach the session to host,
+    restore, continue — the concatenation must equal one uninterrupted
+    models/generate.py run."""
+    _, engine = stack
+    batcher = Batcher(engine, max_active=4, queue_size=8)
+    k = 4
+
+    first = Request(_PROMPT, k, keep_session=True)
+    batcher.submit(first)
+    batcher.drain()
+    assert first.error is None
+    sid = first.session_id
+    assert sid is not None and sid in engine.cache
+
+    detached = engine.detach_session(sid)
+    assert sid not in engine.cache
+    # churn the cache while the session lives on host: other sessions are
+    # free to take (and dirty) its old slot
+    churn = Request(np.array([1, 2, 3], np.int32), 3)
+    batcher.submit(churn)
+    batcher.drain()
+
+    engine.restore_session(sid, detached)
+    # continuation feeds the last generated token; carries resume exactly
+    second = Request(np.array([first.tokens[-1]], np.int32), _N_TOTAL - k,
+                     session_id=sid)
+    batcher.submit(second)
+    batcher.drain()
+    assert second.error is None
+    engine.cache.release(sid)
+
+    got = np.asarray(first.tokens + second.tokens, np.int32)
+    np.testing.assert_array_equal(got, ref_tokens)
+
+
+def test_kept_session_continues_without_detach(stack, ref_tokens):
+    """keep_session alone (no detach) also continues exactly."""
+    _, engine = stack
+    batcher = Batcher(engine, max_active=4, queue_size=8)
+    a = Request(_PROMPT, 2, keep_session=True)
+    batcher.submit(a)
+    batcher.drain()
+    b = Request(np.array([a.tokens[-1]], np.int32), 4, session_id=a.session_id)
+    batcher.submit(b)
+    batcher.drain()
+    np.testing.assert_array_equal(np.asarray(a.tokens + b.tokens),
+                                  ref_tokens[:6])
+    engine.cache.release(a.session_id)
+
+
+def test_evicted_session_continuation_fails_loudly():
+    cfg = LMConfig(vocab_size=37, hidden_size=16, num_layers=2)
+    params = init_lm(jax.random.PRNGKey(0), cfg)
+    engine = ServeEngine(params, cfg, num_slots=1,
+                         prefill_buckets=(8,), batch_buckets=(1,))
+    batcher = Batcher(engine, max_active=1, queue_size=8)
+    a = Request(np.array([1, 2], np.int32), 2, keep_session=True)
+    batcher.submit(a)
+    batcher.drain()
+    # the only slot gets recycled by a new session → a's state is evicted
+    b = Request(np.array([3, 4], np.int32), 2)
+    batcher.submit(b)
+    batcher.drain()
+    cont = Request(np.array([a.tokens[-1]], np.int32), 2,
+                   session_id=a.session_id)
+    batcher.submit(cont)
+    batcher.drain()
+    assert cont.error is not None and "expired" in cont.error

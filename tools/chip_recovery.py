@@ -6,6 +6,10 @@ Run after the tunneled chip comes back:
 
     python3 tools/chip_recovery.py
 
+Exit codes: 0 queue complete; 75 (WEDGE_RC) the chip re-wedged — the
+watcher resumes probing; 70 (CHILD_FAIL_RC) a step failed persistently;
+3 the throughput-regression gate. See the constants below.
+
 Steps, in order (each prints its result; the script stops on the first
 failure so a regression is investigated before the table is refreshed):
 
@@ -42,6 +46,25 @@ import subprocess
 import sys
 
 _DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Exit-code contract with chip_watch.sh (ADVICE.md r5 findings 1+2):
+#   WEDGE_RC (75, EX_TEMPFAIL) — the chip re-wedged mid-queue (a step
+#     timed out, or bench's liveness contract fired): the watcher resumes
+#     probing so a later recovery window isn't lost. Dedicated sentinel —
+#     never reused for anything else (the old code reused 2, which pytest
+#     also emits for usage errors, so a persistent failure could loop the
+#     heavy queue forever).
+#   CHILD_FAIL_RC (70, EX_SOFTWARE) — a child step failed for a
+#     non-wedge reason (its own rc is printed in the log): persistent,
+#     the watcher STOP-marks and exits.
+#   3 — this script's own throughput-regression gate: also persistent.
+WEDGE_RC = 75
+CHILD_FAIL_RC = 70
+# bench.py's liveness contract (_fail_json) exits 3 — the same code as
+# the regression gate — but its JSON record always carries this marker;
+# scanning for it is how a wedge-shaped bench failure is told apart.
+_WEDGE_MARKER = "unreachable/wedged"
+
 # pre-hoist same-day r3 baselines (quiet chip); regression = materially below
 _BASELINES = {"imdb_bilstm": 19661.0, "uci_seq2seq": 65165.0}
 # r4 A/B levers: {env_var: (configs, label)}
@@ -52,16 +75,44 @@ _AB_LEVERS = {
 }
 
 
-def _run(argv, timeout, label):
+def _run(argv, timeout, label, scan_wedge=False):
+    """Run one queue step. Timeouts exit WEDGE_RC; child failures exit
+    CHILD_FAIL_RC (the child's own rc goes to the log only — propagating
+    it raw let a child's rc collide with the watcher's sentinel space).
+    With ``scan_wedge`` the child's output is captured and scanned for
+    bench's liveness-contract marker, so a bench that exits 3 because the
+    chip re-wedged mid-queue maps to WEDGE_RC, not to a persistent
+    failure (ADVICE.md r5 finding 1)."""
     print(f"== {label}", flush=True)
     try:
-        rc = subprocess.run(argv, cwd=_DIR, timeout=timeout).returncode
-    except subprocess.TimeoutExpired:
+        if scan_wedge:
+            out = subprocess.run(argv, cwd=_DIR, timeout=timeout,
+                                 capture_output=True, text=True)
+            # re-emit for the watcher log (capture is for the scan only)
+            sys.stdout.write(out.stdout)
+            sys.stderr.write(out.stderr)
+            sys.stdout.flush()
+            rc = out.returncode
+            if rc != 0 and _WEDGE_MARKER in out.stdout + out.stderr:
+                print(f"FAIL: {label} rc={rc} with a {_WEDGE_MARKER} "
+                      "liveness record (chip wedged again?)")
+                sys.exit(WEDGE_RC)
+        else:
+            rc = subprocess.run(argv, cwd=_DIR, timeout=timeout).returncode
+    except subprocess.TimeoutExpired as e:
+        # capture mode buffers the child's output: re-emit what the
+        # exception carries, or a wedged 45-min bench leaves no forensics
+        # in the watcher log at all
+        for chunk in (e.stdout, e.stderr):
+            if chunk:
+                sys.stdout.write(chunk if isinstance(chunk, str)
+                                 else chunk.decode(errors="replace"))
+        sys.stdout.flush()
         print(f"FAIL: {label} exceeded {timeout}s (chip wedged again?)")
-        sys.exit(2)
+        sys.exit(WEDGE_RC)
     if rc != 0:
         print(f"FAIL: {label} rc={rc}")
-        sys.exit(rc)
+        sys.exit(CHILD_FAIL_RC)
 
 
 def _measure(name, env=None, timeout=900):
@@ -80,14 +131,20 @@ def _measure(name, env=None, timeout=900):
             cwd=_DIR, timeout=timeout, capture_output=True, text=True,
             env=run_env,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        for chunk in (e.stdout, e.stderr):
+            if chunk:
+                sys.stdout.write(chunk if isinstance(chunk, str)
+                                 else chunk.decode(errors="replace"))
+        sys.stdout.flush()
         print(f"FAIL: measure_config({name}) exceeded {timeout}s "
               "(chip wedged again?)")
-        sys.exit(2)
+        sys.exit(WEDGE_RC)
     if out.returncode != 0:
         print(f"FAIL: measure_config({name}) rc={out.returncode}:\n"
               f"{out.stderr[-1000:]}")
-        sys.exit(out.returncode)
+        sys.exit(WEDGE_RC if _WEDGE_MARKER in out.stdout + out.stderr
+                 else CHILD_FAIL_RC)
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
@@ -129,7 +186,11 @@ def main() -> int:
                       "it off for this config and record the negative "
                       "result in DESIGN.md")
 
-    _run([sys.executable, "bench.py"], timeout=2700, label="full bench.py")
+    # scan_wedge: bench's liveness contract exits 3 — same code as OUR
+    # regression gate — so the wedge marker in its output is what routes
+    # a mid-queue re-wedge back to the watcher's resume path
+    _run([sys.executable, "bench.py"], timeout=2700, label="full bench.py",
+         scan_wedge=True)
     table = json.load(open(os.path.join(_DIR, "BENCH_TABLE.json")))
     print(f"fresh table: headline {table['headline_seq_per_sec']:,.0f} "
           f"seq/s, {table['vs_cpu_baseline']:.0f}x CPU")

@@ -12,15 +12,36 @@
 # platform (a cleanly-failing TPU init that silently falls back to CPU
 # must NOT count as recovery — docs/OPERATIONS.md pathology 1).
 #
-# Exit policy after a recovery attempt:
+# Single-instance + STOP discipline (ADVICE.md r5 finding 4): a flock on
+# $LOG.lock refuses a second concurrent watcher (two queues would contend
+# for the chip and skew the banked measurements), and an existing
+# $LOG.STOP marker refuses to start at all — a restart must not re-burn
+# recovery windows on an already-diagnosed persistent failure. Remove the
+# marker after investigating to re-arm.
+#
+# Exit policy after a recovery attempt (chip_recovery.py's contract):
 #   rc=0   queue complete — exit.
-#   rc=2   wedge-shaped (a queue step timed out: the chip re-wedged) —
-#          resume probing so a later window isn't lost.
-#   other  PERSISTENT failure (e.g. rc=3 = throughput regression gate):
-#          re-running the heavy queue would burn every future window on
-#          the same failure — stop loudly (STOP marker next to the log).
+#   rc=75  wedge sentinel (a queue step timed out or bench's liveness
+#          contract fired: the chip re-wedged) — resume probing so a
+#          later window isn't lost. Dedicated code: child failures can
+#          no longer collide with it (ADVICE.md r5 findings 1+2).
+#   other  PERSISTENT failure (70 = a step failed on its own, 3 = the
+#          throughput regression gate): re-running the heavy queue would
+#          burn every future window on the same failure — stop loudly
+#          (STOP marker next to the log).
 LOG="${1:-/tmp/chip_recovery.log}"
+WEDGE_RC=75  # keep in sync with tools/chip_recovery.py WEDGE_RC
 cd "$(dirname "$0")/.."
+if [ -e "$LOG.STOP" ]; then
+  echo "refusing to start: $LOG.STOP exists (investigate, then remove it)" >&2
+  exit 1
+fi
+exec 9>"$LOG.lock"
+if ! flock -n 9; then
+  echo "refusing to start: another watcher holds $LOG.lock" >&2
+  exit 1
+fi
+echo "$$" >&9  # forensic: which pid holds the lock
 while true; do
   python3 -c "
 import bench
@@ -34,7 +55,7 @@ raise SystemExit(0 if err is None else 1)" >/dev/null 2>&1
     qrc=$?
     echo "$(date -u +%F' '%H:%M:%S) chip_recovery exited rc=$qrc" >> "$LOG"
     if [ "$qrc" -eq 0 ]; then exit 0; fi
-    if [ "$qrc" -ne 2 ]; then
+    if [ "$qrc" -ne "$WEDGE_RC" ]; then
       echo "persistent chip_recovery failure rc=$qrc at $(date -u +%F' '%H:%M:%S) — investigate ($LOG)" > "$LOG.STOP"
       exit "$qrc"
     fi
