@@ -66,6 +66,7 @@ TABLE = os.path.join(_DIR, "BENCH_TABLE.json")
 
 # FLOPs accounting + bf16 peak: ONE source shared with the runtime's
 # --log-flops (lstm_tensorspark_tpu/utils/flops.py).
+from lstm_tensorspark_tpu.resilience.exit_codes import LIVENESS_RC  # noqa: E402
 from lstm_tensorspark_tpu.utils.flops import (  # noqa: E402
     PEAK_TFLOPS,
     TRAIN_FLOPS_MULTIPLIER,
@@ -792,9 +793,12 @@ def _fail_json(error: str) -> None:
     """The driver's zero-value failure contract — SAME metric/unit strings
     as the success line (main), so the failure is recorded as a 0-value
     datapoint of the tracked metric, not an unknown one (value stays an
-    honest 0.0 / rc 3; `last_good` carries the stale-but-real number).
-    ONE copy, used by the start-of-run liveness probe and the whole-run
-    watchdog."""
+    honest 0.0; `last_good` carries the stale-but-real number). Exits
+    LIVENESS_RC (resilience/exit_codes.py) — a DEDICATED code, so
+    tools/chip_recovery.py routes a wedge-shaped bench failure on the rc
+    alone instead of scanning stdout for a marker string (the old rc=3
+    collided with the regression gate). ONE copy, used by the start-of-run
+    liveness probe and the whole-run watchdog."""
     record = {
         "metric": "ptb_char_lstm_train_seq_per_sec_per_chip",
         "value": 0.0,
@@ -807,7 +811,7 @@ def _fail_json(error: str) -> None:
     if last is not None:
         record["last_good"] = last
     print(json.dumps(record), flush=True)
-    os._exit(3)
+    os._exit(LIVENESS_RC)
 
 
 def _probe_once(timeout_s: float = 60.0) -> str | None:

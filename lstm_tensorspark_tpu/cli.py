@@ -146,6 +146,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "record — matmul-only accounting, train = 3x "
                         "forward, same formulas as bench.py")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--anomaly-limit", type=int, default=0,
+                   help="abort with the dedicated anomaly exit code "
+                        "(resilience/exit_codes.py) after K CONSECUTIVE "
+                        "non-finite (NaN/Inf) steps, so the supervisor "
+                        "restarts from checkpoint; the guard itself (skip "
+                        "the update, count the step) is always on — this "
+                        "only adds the abort watchdog, at the cost of one "
+                        "host sync per step while enabled (0 = off)")
+    p.add_argument("--faults", type=str, default=None,
+                   help="ARM FAULT INJECTION (chaos drills only): a "
+                        "schedule like 'crash@50;nan_grads@30x2;"
+                        "ckpt_corrupt@40' — see resilience/faults.py for "
+                        "the grammar; exported as LSTM_TSP_FAULTS to "
+                        "children; one-shot faults record their firing "
+                        "under --checkpoint-dir/.faults so supervised "
+                        "restarts don't re-fire them")
     p.add_argument("--jsonl", type=str, default=None, help="metrics JSONL path")
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
@@ -277,6 +293,11 @@ def main(argv=None) -> int:
     from .parallel import distributed_init
     distributed_init(args.coordinator, args.num_processes, args.process_id)
 
+    from .resilience import faults
+    # --faults wins (and is exported to children); a supervised drill arms
+    # the CHILDREN via the env var instead
+    faults.arm_from_flag_or_env(args.faults, state_dir=args.checkpoint_dir)
+
     from .train.metrics import MetricsLogger
     logger = MetricsLogger(args.jsonl)
 
@@ -285,6 +306,8 @@ def main(argv=None) -> int:
     if args.trace:
         tracer = Tracer()
         set_tracer(tracer)
+
+    from .train.loop import AnomalousTrainingError
 
     try:
         if args.dataset in LM_DATASETS:
@@ -298,6 +321,15 @@ def main(argv=None) -> int:
             rc = _run_classifier(args, logger)
         else:
             rc = _run_forecaster(args, logger)
+    except AnomalousTrainingError as e:
+        # dedicated exit code: the supervisor relaunches with --resume and
+        # restores the last (clean — updates were skipped) checkpoint
+        import sys
+
+        from .resilience.exit_codes import ANOMALY_RC
+
+        print(f"anomaly abort: {e} (exit {ANOMALY_RC})", file=sys.stderr)
+        rc = ANOMALY_RC
     finally:
         if tracer is not None:
             set_tracer(None)  # uninstall first: a failed save must not leak it
@@ -598,6 +630,13 @@ def _wire_checkpoint(args, logger, template_fn):
                              f"{args.checkpoint_dir} (was --keep-best on "
                              "in the producing run?)")
         restored = ckpt.restore_best(template_fn())
+        if restored is None:
+            # restore_best quarantines a corrupt best and reports None
+            # (train/checkpoint.py): abort BEFORE the fence below, which
+            # would destroy the run's valid newer step checkpoints
+            raise SystemExit("--resume-best: the best checkpoint in "
+                             f"{args.checkpoint_dir} is corrupt (now "
+                             "quarantined); no rewind performed")
         # the rewind is a commitment: fence the abandoned lineage (its
         # later step_N checkpoints must not win a future restore_latest)
         # and make the rewound point itself durable as a step checkpoint —
@@ -611,6 +650,28 @@ def _wire_checkpoint(args, logger, template_fn):
         restored = ckpt.restore_latest(template_fn())
         if restored is not None:
             logger.log({"note": f"resumed at step {int(restored.step)}"})
+        else:
+            # checkpoints EXISTED but every one failed verification and
+            # was quarantined (train/checkpoint.py): silently training
+            # from random init would discard the run's progress without
+            # anyone noticing — abort loudly instead (an empty dir, by
+            # contrast, is a legitimate fresh start under --resume: the
+            # supervisor injects the flag before the first save exists)
+            raise SystemExit(
+                f"--resume: every checkpoint in {args.checkpoint_dir} "
+                "failed verification (now quarantined); refusing to "
+                "silently restart from step 0 — inspect the "
+                "*.quarantined files")
+    elif args.resume and ckpt.has_quarantined():
+        # the refusal must PERSIST across a supervisor relaunch: after the
+        # quarantine above, has_checkpoint() is False on the next attempt,
+        # and without this gate the relaunch would fresh-start from step 0
+        # — exactly the silent outcome the abort exists to prevent
+        raise SystemExit(
+            f"--resume: {args.checkpoint_dir} holds no valid checkpoint "
+            "but contains *.quarantined files (a previous attempt found "
+            "them corrupt); refusing to silently restart from step 0 — "
+            "inspect or clear the quarantined files first")
 
     def checkpoint_fn(state):
         return ckpt.save(state)
@@ -671,6 +732,15 @@ def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
         # each loop iteration is one K-step dispatch; round up so the step
         # budget is never undershot
         total = -(-total // k)
+    from .resilience import faults
+    plane = faults.active()
+    if plane is not None:
+        # chaos drills: crash/data_error faults fire from the batch feed,
+        # windowed in GLOBAL step coordinates (resume-stable) — one wrap
+        # point covers every task runner and feed kind
+        batches = plane.wrap_batches(
+            batches, start_step=int(state.step), steps_per_call=k
+        )
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
     try:
@@ -694,6 +764,7 @@ def _make_logged_loop(args, state, train_step, batches, steps_per_epoch, logger,
             best_metric=best_metric,
             best_mode=best_mode,
             best_init=best_init,
+            anomaly_limit=getattr(args, "anomaly_limit", 0) or 0,
         )
     finally:
         if args.profile_dir:
@@ -1187,6 +1258,11 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--trace", type=str, default=None,
                    help="host-side span trace output (Chrome trace JSON)")
+    p.add_argument("--faults", type=str, default=None,
+                   help="ARM FAULT INJECTION (chaos drills only): e.g. "
+                        "'serve_error@2' raises from the 2nd decode call "
+                        "— resilience/faults.py grammar, same flag as the "
+                        "training CLI; also armable via LSTM_TSP_FAULTS")
     return p
 
 
@@ -1225,6 +1301,12 @@ def _build_serve_stack(args):
         template = init_train_state(params, optimizer,
                                     jax.random.PRNGKey(args.seed))
         state = ckpt.restore_latest(template)
+        if state is None:
+            # every checkpoint failed verification and was quarantined
+            # (train/checkpoint.py) — refuse to serve random init
+            raise SystemExit(
+                f"every checkpoint in {args.checkpoint_dir} is corrupt "
+                "(now quarantined); refusing to serve an untrained model")
         params = jax.device_get(state.params)
     engine = ServeEngine(
         params, cfg,
@@ -1349,6 +1431,15 @@ def _serve_http(args) -> int:
     from .serve.server import make_http_server
 
     _, _, server = _build_serve_stack(args)
+    # pre-compile the bucket lattice for the default sampling config BEFORE
+    # taking traffic: on TPU a compile is ~20-40 s, which would both time
+    # out first requests and starve the scheduler heartbeat long enough to
+    # flip /healthz 503 on a healthy warming server (an orchestrator would
+    # then kill-loop it). Selftest/loadgen warm implicitly; --http must too.
+    print("serve: warming the compile lattice...", flush=True)
+    n = server.engine.warmup(_serve_sampling(args),
+                             prompt_lens=tuple(server.engine.prefill_buckets))
+    print(f"serve: {n} programs compiled", flush=True)
     httpd = make_http_server(server, args.host, args.port)
     host, port = httpd.server_address[:2]
     print(f"serving on http://{host}:{port} (POST /v1/generate, "
@@ -1365,6 +1456,10 @@ def _serve_http(args) -> int:
 
 def _run_serve(argv) -> int:
     args = build_serve_parser().parse_args(argv)
+    from .resilience import faults
+
+    # serve chaos drills (serve_error@N): flag wins, env is the fallback
+    faults.arm_from_flag_or_env(args.faults)
     from .utils import Tracer, set_tracer
 
     tracer = None

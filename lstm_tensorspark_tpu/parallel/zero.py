@@ -153,6 +153,9 @@ def make_zero1_train_step(
         (loss, aux), grads = jax.value_and_grad(
             lambda p: loss_fn(p, batch, sub), has_aux=True
         )(state.params)
+        from ..resilience import faults as _faults
+
+        grads = _faults.tamper_grads(grads, state.step)  # identity unarmed
 
         n, chunk = _flat_meta(state.params, dp)
         g_flat, _ = ravel_pytree(grads)
@@ -173,13 +176,26 @@ def make_zero1_train_step(
         p_local = _local_slice(p_flat, chunk, axis)
 
         updates, opt_state = optimizer.update(g_local, state.opt_state, p_local)
-        p_local = optax.apply_updates(p_local, updates)
+        p_new = optax.apply_updates(p_local, updates)
+
+        loss = lax.pmean(loss, axis)
+        # Non-finite guard (same contract as train/loop.py step_body): skip
+        # the sliced update AND the moment update when loss/grad-norm is
+        # NaN/Inf. Both predicates are collective results (pmean'd loss,
+        # psum'd norm), so every shard takes the same branch and the
+        # all-gather below rebuilds consistent params either way.
+        finite = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+        p_local = jnp.where(finite, p_new, p_local)
+        opt_state = jax.tree.map(
+            lambda new, old: jnp.where(finite, new, old),
+            opt_state, state.opt_state,
+        )
 
         p_flat = lax.all_gather(p_local, axis, tiled=True)[:n].astype(p_dtype)
         params = unravel(p_flat)
 
-        loss = lax.pmean(loss, axis)
-        metrics = {"loss": loss, "grad_norm": gnorm}
+        metrics = {"loss": loss, "grad_norm": gnorm,
+                   "anomalous": (~finite).astype(jnp.float32)}
         return (
             TrainState(state.step + 1, params, opt_state, rng, state.carries),
             metrics,

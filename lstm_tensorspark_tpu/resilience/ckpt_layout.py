@@ -1,0 +1,27 @@
+"""Checkpoint filename layout — the ONE naming authority, jax-free.
+
+``train/checkpoint.py`` (the writer/reader) and ``supervise.py`` (the
+forward-progress poison detector) both route on these patterns, but the
+supervisor must stay import-light (no jax/backend init), so the patterns
+live here — next to ``exit_codes.py``, the same shared-contract precedent.
+Change the layout HERE and both sides move together.
+
+Layout (see train/checkpoint.py for semantics):
+
+- ``step_<N>.msgpack``            single-process checkpoint
+- ``step_<N>.proc<K>.msgpack``    one process's shards of a sharded step
+- ``step_<N>.complete``           marker: sharded step N is restorable
+- ``<file>.sha256``               integrity sidecar of a state file
+- ``<file>.quarantined``          corrupt file set aside by restore
+"""
+
+import re
+
+STEP_PAT = re.compile(r"step_(\d+)\.msgpack$")
+PROC_PAT = re.compile(r"step_(\d+)\.proc(\d+)\.msgpack$")
+DONE_PAT = re.compile(r"step_(\d+)\.complete$")
+
+# A RESTORABLE step for progress accounting: a single-file checkpoint, or
+# a sharded step's completion marker. (Sidecars and quarantined files are
+# excluded by the ``$`` anchors.)
+RESTORABLE_PAT = re.compile(r"step_(\d+)\.(?:msgpack|complete)$")

@@ -1,0 +1,48 @@
+"""The process exit-code table — ONE authority for every failure class.
+
+These codes are a cross-process protocol: the training CLI, the supervisor,
+bench.py, tools/chip_recovery.py and tools/chip_watch.sh all route on them,
+so they live in a module with NO third-party imports (the supervisor and
+shell tooling must be able to read them without initialising a backend).
+
+History (why a table, not inline literals): bench.py's liveness contract
+used to exit 3 — the same code as chip_recovery.py's throughput-regression
+gate — forcing the recovery tooling to scan stdout for a marker string to
+tell a wedged chip from a real regression (ADVICE r5 finding 1). Dedicated,
+documented codes make the routing structural.
+
+| code | name            | meaning                                          | retry? |
+|------|-----------------|--------------------------------------------------|--------|
+| 2    | USAGE_RC        | argparse/flag-validation error (deterministic)   | no     |
+| 3    | REGRESSION_RC   | chip_recovery.py's throughput-regression gate    | no     |
+| 70   | CHILD_FAIL_RC   | recovery-queue child failed for a non-wedge      | no     |
+|      |                 | reason (EX_SOFTWARE)                             |        |
+| 75   | WEDGE_RC        | chip wedged / re-wedged (EX_TEMPFAIL): the       | yes    |
+|      |                 | watcher resumes probing                          |        |
+| 76   | LIVENESS_RC     | bench.py liveness contract fired (probe window   | yes    |
+|      |                 | exhausted or whole-run watchdog) — the 0-value   |        |
+|      |                 | JSON record precedes it                          |        |
+| 77   | ANOMALY_RC      | train loop aborted after K consecutive           | yes    |
+|      |                 | non-finite (NaN/Inf) steps: restart from         |        |
+|      |                 | checkpoint (updates were skipped, params clean)  |        |
+| 78   | POISON_RC       | supervisor gave up: restarts are not advancing   | no     |
+|      |                 | the restored checkpoint step (crash loop)        |        |
+| 81   | FAULT_CRASH_RC  | injected process crash (resilience/faults.py     | yes    |
+|      |                 | drill) — retryable by construction               |        |
+
+``RETRYABLE_RCS`` is the set the supervisor must relaunch even when the
+child died fast (its sub-second "deterministic failure" heuristic must not
+eat them): these codes are emitted deliberately by code that EXPECTS a
+restart-from-checkpoint to make progress.
+"""
+
+USAGE_RC = 2
+REGRESSION_RC = 3
+CHILD_FAIL_RC = 70
+WEDGE_RC = 75
+LIVENESS_RC = 76
+ANOMALY_RC = 77
+POISON_RC = 78
+FAULT_CRASH_RC = 81
+
+RETRYABLE_RCS = frozenset({WEDGE_RC, LIVENESS_RC, ANOMALY_RC, FAULT_CRASH_RC})

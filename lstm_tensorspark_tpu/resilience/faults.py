@@ -1,0 +1,308 @@
+"""Deterministic, seeded fault-injection plane (chaos drills).
+
+The recovery machinery — non-finite-gradient guards, checksum-verified
+checkpoints with quarantine-and-fall-back, the restarting supervisor — is
+only trustworthy if its failure modes can be PROVOKED on demand, the same
+way DrJAX argues the distributed-execution plane should be an explicit,
+testable program construct rather than ambient behavior. This module is
+that provocation plane: arm it with an env var or CLI flag and a scripted
+schedule of faults fires at exact step numbers, so chaos tests can assert
+the whole crash→restart→resume cycle deterministically on CPU.
+
+Arming (either form; the CLI flag also exports the env var so child
+processes inherit the schedule)::
+
+    LSTM_TSP_FAULTS="crash@5;nan_grads@3x2;ckpt_corrupt@4" python -m ...
+    python -m lstm_tensorspark_tpu.cli --faults "crash@5" ...
+
+Spec grammar — semicolon-separated ``kind@arg`` clauses:
+
+- ``crash@N``        hard process exit (``FAULT_CRASH_RC``) before step N;
+- ``nan_grads@N[xK]`` NaN gradients for the K steps N..N+K-1 (default 1);
+- ``ckpt_corrupt@N`` truncate the checkpoint file written at step N,
+  AFTER its write completes (a torn write the checksum must catch);
+- ``data_error@N``   raise :class:`InjectedFault` from the batch feed
+  before step N;
+- ``serve_error@N``  raise :class:`InjectedFault` from the Nth
+  ``ServeEngine.decode`` call of the process;
+- ``seed@S``         seed for the corruption byte schedule (default 0).
+
+Step numbers are the 1-based global optimizer step about to be computed —
+resume-stable, so a restarted child reasons in the same coordinates.
+
+One-shot semantics: ``crash``/``data_error``/``ckpt_corrupt`` faults fire
+ONCE per schedule, recorded as marker files under ``<state_dir>/.faults/``
+(the checkpoint directory, when the CLI arms the plane). Without the
+marker a restarted child would resume below step N, re-reach it, and
+re-fire forever — a synthetic crash loop the supervisor would (correctly)
+classify as poison. ``nan_grads`` deliberately re-fires on replay: it is a
+pure function of the step number, and the guard must skip it identically
+every time. ``serve_error`` is call-count based and fires once per
+process.
+
+No jax at import time: the supervisor imports this package, and plane
+checks on hot paths are a ``None`` test when unarmed.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from .exit_codes import FAULT_CRASH_RC
+
+ENV_VAR = "LSTM_TSP_FAULTS"
+
+_KINDS = ("crash", "nan_grads", "ckpt_corrupt", "data_error", "serve_error",
+          "seed")
+
+
+class InjectedFault(RuntimeError):
+    """An exception raised BY the fault plane (never by real code): chaos
+    tests assert on this type to prove the failure they saw was the one
+    they scheduled."""
+
+
+def _crash() -> None:
+    """The injected hard crash — ``os._exit`` skips atexit/finally blocks,
+    like a real OOM-kill would. Module-level so in-process tests can
+    monkeypatch it into a raise."""
+    os._exit(FAULT_CRASH_RC)
+
+
+class FaultPlane:
+    """A parsed, armed fault schedule. Construct via :func:`arm` (module
+    singleton) or directly in tests."""
+
+    _CLAUSE = re.compile(r"^(\w+)@(\d+)(?:x(\d+))?$")
+
+    def __init__(self, spec: str, *, state_dir: str | None = None):
+        self.spec = spec
+        self.state_dir = state_dir
+        self.seed = 0
+        self.crash_steps: set[int] = set()
+        self.nan_grad_steps: tuple[int, ...] = ()
+        self.ckpt_corrupt_steps: set[int] = set()
+        self.data_error_steps: set[int] = set()
+        self.serve_error_calls: set[int] = set()
+        self._serve_calls = 0
+        self._fired_mem: set[str] = set()
+        nan: list[int] = []
+        for raw in spec.split(";"):
+            clause = raw.strip()
+            if not clause:
+                continue
+            m = self._CLAUSE.match(clause)
+            if not m:
+                raise ValueError(
+                    f"bad fault clause {clause!r} (expected kind@N or "
+                    f"kind@NxK; kinds: {', '.join(_KINDS)})"
+                )
+            kind, n, k = m.group(1), int(m.group(2)), m.group(3)
+            if kind not in _KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} (kinds: {', '.join(_KINDS)})"
+                )
+            if k is not None and kind != "nan_grads":
+                raise ValueError(f"{clause!r}: xK burst only with nan_grads")
+            if kind == "seed":
+                self.seed = n
+            elif kind == "crash":
+                self.crash_steps.add(n)
+            elif kind == "nan_grads":
+                nan.extend(range(n, n + int(k or 1)))
+            elif kind == "ckpt_corrupt":
+                self.ckpt_corrupt_steps.add(n)
+            elif kind == "data_error":
+                self.data_error_steps.add(n)
+            elif kind == "serve_error":
+                self.serve_error_calls.add(n)
+        self.nan_grad_steps = tuple(sorted(set(nan)))
+
+    # ---- one-shot bookkeeping -----------------------------------------
+
+    def _marker_path(self, fault_id: str) -> str | None:
+        if self.state_dir is None:
+            return None
+        return os.path.join(self.state_dir, ".faults", fault_id + ".fired")
+
+    def fired(self, fault_id: str) -> bool:
+        if fault_id in self._fired_mem:
+            return True
+        path = self._marker_path(fault_id)
+        return path is not None and os.path.exists(path)
+
+    def mark_fired(self, fault_id: str) -> None:
+        """Record BEFORE the fault takes effect: a crash between the effect
+        and the record would re-fire on restart — the exact loop the
+        markers exist to prevent."""
+        self._fired_mem.add(fault_id)
+        path = self._marker_path(fault_id)
+        if path is not None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                f.write(self.spec + "\n")
+
+    def _announce(self, msg: str) -> None:
+        # stderr + flush: the supervisor's stall watchdog merges streams,
+        # and a crash fault must leave its forensics before os._exit
+        print(f"fault-injection: {msg}", file=sys.stderr, flush=True)
+
+    # ---- train-path hooks ---------------------------------------------
+
+    def wrap_batches(self, batches, *, start_step: int = 0,
+                     steps_per_call: int = 1):
+        """Wrap the training batch feed: fire ``crash``/``data_error``
+        faults whose step falls inside the window the next dispatch will
+        compute (steps ``[i*K+1, (i+1)*K]`` past ``start_step``)."""
+        if not (self.crash_steps or self.data_error_steps):
+            return batches
+
+        def gen():
+            step = start_step
+            for batch in batches:
+                lo, hi = step + 1, step + steps_per_call
+                for s in sorted(self.crash_steps):
+                    fid = f"crash@{s}"
+                    if lo <= s <= hi and not self.fired(fid):
+                        self.mark_fired(fid)
+                        self._announce(
+                            f"hard crash before step {s} "
+                            f"(exit {FAULT_CRASH_RC})")
+                        _crash()
+                for s in sorted(self.data_error_steps):
+                    fid = f"data_error@{s}"
+                    if lo <= s <= hi and not self.fired(fid):
+                        self.mark_fired(fid)
+                        self._announce(f"data-batch exception before step {s}")
+                        raise InjectedFault(
+                            f"injected data-batch exception before step {s}")
+                yield batch
+                step = hi
+
+        return gen()
+
+    def tamper_grads(self, grads, step):
+        """Inside-jit NaN burst: poison every gradient leaf when the step
+        being computed (``state.step + 1``) is in the schedule. The
+        schedule is baked into the compiled program as a constant — fully
+        deterministic, works under ``lax.scan`` and across resume because
+        ``state.step`` is traced."""
+        if not self.nan_grad_steps:
+            return grads
+        import jax
+        import jax.numpy as jnp
+
+        bad = jnp.isin(step + 1, jnp.asarray(self.nan_grad_steps))
+        return jax.tree.map(
+            lambda g: jnp.where(bad, jnp.asarray(jnp.nan, g.dtype), g), grads
+        )
+
+    # ---- checkpoint hook ----------------------------------------------
+
+    def maybe_corrupt_checkpoint(self, path: str, step: int) -> None:
+        """Torn-write simulation, called by the checkpointer AFTER a save
+        completes: truncate the file to half and overwrite a seeded byte
+        — the on-disk damage a crash mid-write (or bit rot) leaves, which
+        the checksum sidecar must catch at restore."""
+        for s in sorted(self.ckpt_corrupt_steps):
+            fid = f"ckpt_corrupt@{s}"
+            if s == step and not self.fired(fid):
+                self.mark_fired(fid)
+                size = os.path.getsize(path)
+                keep = size // 2
+                with open(path, "r+b") as f:
+                    f.truncate(keep)
+                    if keep > 0:
+                        pos = (self.seed * 2654435761 + s) % keep
+                        f.seek(pos)
+                        byte = f.read(1)
+                        f.seek(pos)
+                        f.write(bytes([(byte[0] ^ 0xFF) if byte else 0xFF]))
+                self._announce(
+                    f"corrupted checkpoint {os.path.basename(path)} "
+                    f"(step {step}: {size} -> {keep} bytes + byte flip)")
+
+    # ---- serve hook ----------------------------------------------------
+
+    def serve_decode_hook(self) -> None:
+        """Fire an exception out of the Nth ``ServeEngine.decode`` call of
+        this process (count-based: decode has no global step)."""
+        if not self.serve_error_calls:
+            return
+        self._serve_calls += 1
+        if self._serve_calls in self.serve_error_calls:
+            self._announce(
+                f"serve-engine exception on decode call {self._serve_calls}")
+            raise InjectedFault(
+                f"injected serve-engine exception on decode call "
+                f"{self._serve_calls}")
+
+
+# ---- module singleton ---------------------------------------------------
+
+_active: FaultPlane | None = None
+
+
+def arm(spec: str, *, state_dir: str | None = None) -> FaultPlane:
+    """Parse and install ``spec`` as the process-wide plane (replacing any
+    previous one). ``state_dir`` hosts the one-shot markers — pass the
+    checkpoint directory so restarted children share them."""
+    global _active
+    _active = FaultPlane(spec, state_dir=state_dir)
+    return _active
+
+
+def arm_from_env(*, state_dir: str | None = None) -> FaultPlane | None:
+    """Arm from ``LSTM_TSP_FAULTS`` if set (child processes of a supervised
+    drill inherit the schedule this way). With the variable unset this
+    DISARMS instead: an entrypoint that re-runs in one interpreter (tests,
+    notebooks) must not inherit a stale plane from an earlier run."""
+    spec = os.environ.get(ENV_VAR, "").strip()
+    if not spec:
+        disarm()
+        return None
+    return arm(spec, state_dir=state_dir)
+
+
+def arm_from_flag_or_env(spec: str | None, *,
+                         state_dir: str | None = None) -> FaultPlane | None:
+    """The ONE entrypoint arming sequence (training CLI main and the serve
+    subcommand share it): an explicit ``--faults`` spec wins and is
+    exported to ``LSTM_TSP_FAULTS`` so child processes inherit the
+    schedule; otherwise the env var decides (set → arm, unset → disarm any
+    stale plane from an earlier in-process run)."""
+    if spec:
+        os.environ[ENV_VAR] = spec
+        return arm(spec, state_dir=state_dir)
+    return arm_from_env(state_dir=state_dir)
+
+
+def disarm() -> None:
+    global _active
+    _active = None
+
+
+def active() -> FaultPlane | None:
+    return _active
+
+
+def tamper_grads(grads, step):
+    """Unarmed-safe hook for jitted step bodies (identity when no plane)."""
+    plane = _active
+    if plane is None:
+        return grads
+    return plane.tamper_grads(grads, step)
+
+
+def serve_decode_hook() -> None:
+    plane = _active
+    if plane is not None:
+        plane.serve_decode_hook()
+
+
+def maybe_corrupt_checkpoint(path: str, step: int) -> None:
+    plane = _active
+    if plane is not None:
+        plane.maybe_corrupt_checkpoint(path, step)

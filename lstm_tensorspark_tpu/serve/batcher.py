@@ -119,6 +119,11 @@ class Batcher:
         self.rejected = 0
         self.failed = 0
         self.tokens_generated = 0
+        # liveness heartbeat for /healthz: monotonic timestamp of the last
+        # scheduler pass (run-loop cycle or direct step()); None until the
+        # scheduler first runs. A dead/stuck scheduler thread stops
+        # advancing it — the honest signal a wedged server must emit.
+        self.last_heartbeat: float | None = None
 
     # ---- client side ---------------------------------------------------
 
@@ -146,6 +151,7 @@ class Batcher:
     def step(self) -> bool:
         """One scheduler iteration (admission + one decode token for every
         active session). Returns True when any work was done."""
+        self.last_heartbeat = time.monotonic()
         did = self._admit()
         did = self._decode_all() or did
         return did
@@ -318,6 +324,9 @@ class Batcher:
             with self._work:
                 if not self._queue and not self._active:
                     self._work.wait(timeout=idle_wait)
+            # idle cycles beat the heartbeat too: "no traffic" and "thread
+            # stuck" must look different to /healthz
+            self.last_heartbeat = time.monotonic()
 
     def stats(self) -> dict:
         with self._lock:

@@ -41,6 +41,7 @@ import numpy as np
 
 from ..models.generate import decode_one, fuse_layers, sample_logits
 from ..models.lstm_lm import LMConfig, _head_kernel, lm_backbone
+from ..resilience import faults as _faults
 from .state_cache import DetachedState, StateCache
 
 
@@ -107,6 +108,12 @@ class ServeEngine:
         self._rng = jax.random.PRNGKey(rng_seed)
         self._dummy_rng = jax.random.PRNGKey(0)
         self._lock = threading.RLock()
+        # compile_counts gets its own tiny mutex: _lock is held across
+        # entire device calls (dispatch serialization), and stats/health
+        # readers must never block behind an in-flight — possibly
+        # wedged — dispatch just to copy a counter dict
+        self._counts_lock = threading.Lock()
+        self._warming = False  # warmup decodes bypass the fault hook
 
     # ---- limits --------------------------------------------------------
 
@@ -149,7 +156,8 @@ class ServeEngine:
         def prefill_fn(params, h_cache, c_cache, slots, fresh, prompts,
                        lengths, rng):
             # trace-time side effect: one bump per XLA compile of this shape
-            self.compile_counts[count_key] += 1
+            with self._counts_lock:
+                self.compile_counts[count_key] += 1
             h_in = h_cache[:, slots, :]  # [L, B, H]
             c_in = c_cache[:, slots, :]
             # fresh rows start from zero state — no device-side slot
@@ -196,7 +204,8 @@ class ServeEngine:
         count_key = ("decode", batch_b, sampling.key())
 
         def decode_fn(params, fused, h_cache, c_cache, slots, tokens, rng):
-            self.compile_counts[count_key] += 1
+            with self._counts_lock:
+                self.compile_counts[count_key] += 1
             h_in = h_cache[:, slots, :]
             c_in = c_cache[:, slots, :]
             carries = [(h_in[l], c_in[l]) for l in range(cfg.num_layers)]
@@ -266,6 +275,14 @@ class ServeEngine:
         n = len(slots)
         if n == 0:
             return np.zeros((0,), np.int32)
+        # chaos drills: an armed serve_error fault raises InjectedFault out
+        # of the Nth decode call — the batcher must fail ONLY that chunk's
+        # requests and keep serving (tests/test_serve_health.py). Warmup's
+        # dummy decodes neither count nor fire: the drill targets traffic,
+        # and an InjectedFault inside warmup() would kill the whole server
+        # at startup instead of one mid-traffic chunk.
+        if not self._warming:
+            _faults.serve_decode_hook()
         self._admit_sampling(sampling)
         batch_b = _bucket_for(n, self.batch_buckets, "decode batch")
         slots_p = np.full((batch_b,), self.cache.scratch_slot, np.int32)
@@ -296,12 +313,16 @@ class ServeEngine:
             for t in prompt_lens
         })
         scratch = self.cache.scratch_slot
-        for b in batch_sizes:
-            bb = _bucket_for(b, self.batch_buckets, "batch")
-            for t in len_buckets:
-                items = [(scratch, True, np.zeros((t,), np.int32))] * bb
-                self.prefill(items, sampling)
-            self.decode([scratch] * bb, [0] * bb, sampling)
+        self._warming = True
+        try:
+            for b in batch_sizes:
+                bb = _bucket_for(b, self.batch_buckets, "batch")
+                for t in len_buckets:
+                    items = [(scratch, True, np.zeros((t,), np.int32))] * bb
+                    self.prefill(items, sampling)
+                self.decode([scratch] * bb, [0] * bb, sampling)
+        finally:
+            self._warming = False
         return len(self._prefill_fns) + len(self._decode_fns)
 
     # ---- session lifecycle (thin wrappers over the cache) -------------
@@ -315,13 +336,22 @@ class ServeEngine:
             return self.cache.restore(session_id, state)
 
     def num_compiles(self, phase: str | None = None) -> int:
-        items = self.compile_counts.items()
+        # snapshot under the COUNTS lock (not _lock, which is held across
+        # whole device calls): a first-time compile inserts into
+        # compile_counts at trace time, and iterating concurrently from a
+        # stats/health handler would raise "dictionary changed size
+        # during iteration" — while blocking on _lock would park the
+        # handler behind an in-flight (possibly wedged) dispatch
+        with self._counts_lock:
+            items = list(self.compile_counts.items())
         return sum(v for k, v in items if phase is None or k[0] == phase)
 
     def stats(self) -> dict:
+        with self._counts_lock:
+            compiles = dict(self.compile_counts)
         return {
             "cache": self.cache.stats(),
-            "compiles": {repr(k): v for k, v in self.compile_counts.items()},
+            "compiles": {repr(k): v for k, v in compiles.items()},
             "prefill_buckets": self.prefill_buckets,
             "batch_buckets": self.batch_buckets,
         }

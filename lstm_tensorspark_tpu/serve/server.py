@@ -13,8 +13,10 @@ by both front-ends:
     "greedy": true, "temperature": t, "top_k": k, "top_p": p,
     "session_id": "...", "keep_session": false, "eos_id": null}`` →
     ``{"tokens": [...], "session_id": "...", "latency_ms": ...}``;
-  - ``GET /healthz`` → liveness; ``GET /v1/stats`` → batcher/engine/cache
-    counters.
+  - ``GET /healthz`` → honest liveness: 200 with the scheduler thread's
+    heartbeat age while the batcher thread lives, 503 once it is dead or
+    never started (a wedged server must fail probes, not smile at them);
+    ``GET /v1/stats`` → batcher/engine/cache counters.
 
   Backpressure maps to HTTP: full queue → 429, bad request → 400,
   scheduler failure → 500, timeout → 504.
@@ -32,12 +34,20 @@ from .engine import GREEDY, SamplingParams, ServeEngine
 
 
 class ServeServer:
-    """Engine + batcher + scheduler thread, with a synchronous submit path."""
+    """Engine + batcher + scheduler thread, with a synchronous submit path.
+
+    ``health_stale_after``: seconds of scheduler-heartbeat silence before
+    ``health()`` reports not-ok even though the thread is alive — the
+    wedged-dispatch case (thread stuck inside a device call that never
+    returns) where ``is_alive()`` stays true forever. An idle scheduler
+    beats the heartbeat every ``idle_wait`` (~0.05 s), so any healthy
+    server sits far below the default."""
 
     def __init__(self, engine: ServeEngine, batcher: Batcher | None = None,
-                 **batcher_kw):
+                 health_stale_after: float = 60.0, **batcher_kw):
         self.engine = engine
         self.batcher = batcher or Batcher(engine, **batcher_kw)
+        self.health_stale_after = health_stale_after
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -103,6 +113,31 @@ class ServeServer:
     def stats(self) -> dict:
         return {"batcher": self.batcher.stats(), **self.engine.stats()}
 
+    def health(self) -> dict:
+        """Honest liveness: ``ok`` requires the scheduler THREAD to be
+        alive AND its heartbeat fresher than ``health_stale_after`` — a
+        crashed batcher fails probes (HTTP 503), and so does a WEDGED one
+        (thread alive but stuck inside a dispatch that never returns: the
+        is_alive() check alone would smile through that forever). Reports
+        ``seconds_since_last_iteration`` (scheduler heartbeat age; idle
+        cycles count as iterations, so a healthy idle server stays near
+        its poll interval) plus queue depth for probe-side context."""
+        thread = self._thread
+        alive = thread is not None and thread.is_alive()
+        hb = self.batcher.last_heartbeat
+        age = None if hb is None else max(time.monotonic() - hb, 0.0)
+        stale = age is not None and age > self.health_stale_after
+        st = self.batcher.stats()
+        return {
+            "ok": bool(alive and not stale),
+            "batcher_alive": bool(alive),
+            "batcher_stale": bool(stale),
+            "seconds_since_last_iteration":
+                None if age is None else round(age, 3),
+            "queued": st["queued"],
+            "active": st["active"],
+        }
+
 
 class InprocessClient:
     """Synchronous in-process client: the HTTP semantics without sockets."""
@@ -156,7 +191,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:
         if self.path == "/healthz":
-            self._reply(200, {"ok": True})
+            health = self._serve.health()
+            self._reply(200 if health["ok"] else 503, health)
         elif self.path == "/v1/stats":
             self._reply(200, self._serve.stats())
         else:

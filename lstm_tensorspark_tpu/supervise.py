@@ -27,15 +27,30 @@ arrives for N seconds it terminates the child (SIGTERM, then SIGKILL) and
 treats it like a signal death — retryable, relaunched with ``--resume``.
 Size N well above the longest silent phase of the run (first XLA compile +
 the --log-every cadence).
+
+Self-healing (resilience plane): restart delays back off exponentially
+with jitter (--restart-delay is the base, --max-delay the cap); known
+retryable exit codes (resilience/exit_codes.py: anomaly aborts, injected
+crash drills) always relaunch; and a forward-progress check declares the
+run POISONED (dedicated exit code) when consecutive failures stop
+advancing the latest checkpoint step — the crash-loop case a fixed retry
+budget would grind through pointlessly. Drills: arm
+``--faults``/``LSTM_TSP_FAULTS`` on the child (resilience/faults.py) or
+run tools/chaos_smoke.py.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import random
 import subprocess
 import sys
 import threading
 import time
+
+from .resilience import ckpt_layout
+from .resilience.exit_codes import POISON_RC, RETRYABLE_RCS, USAGE_RC
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -46,7 +61,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-restarts", type=int, default=3,
                    help="restarts after the first attempt (default 3)")
     p.add_argument("--restart-delay", type=float, default=1.0,
-                   help="seconds between attempts")
+                   help="BASE restart delay in seconds; attempts back off "
+                        "exponentially (base * 2^(attempt-1), capped by "
+                        "--max-delay) with up to +50%% jitter so a fleet of "
+                        "supervisors never relaunches in lockstep")
+    p.add_argument("--max-delay", type=float, default=30.0,
+                   help="exponential-backoff cap in seconds (default 30)")
+    p.add_argument("--no-progress-limit", type=int, default=2,
+                   help="give up with the poison exit code "
+                        f"({POISON_RC}) after this many CONSECUTIVE "
+                        "failures during which the latest checkpoint step "
+                        "did not advance — a crash loop that replays the "
+                        "same step forever is unrecoverable by restarting. "
+                        "Signal deaths (preemption/OOM-kill/stall-kill) "
+                        "never count: two preemptions inside one long "
+                        "checkpoint interval is bad luck, not poison. "
+                        "0 disables (needs --checkpoint-dir to measure)")
     p.add_argument("--stall-timeout", type=float, default=None,
                    help="kill + relaunch the child if it prints NOTHING for "
                         "this many seconds (hang/wedge detection; size it "
@@ -57,6 +87,64 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("cli_args", nargs=argparse.REMAINDER,
                    help="-- followed by the training CLI flags")
     return p
+
+
+def backoff_delay(base: float, attempt: int, *, cap: float = 30.0,
+                  jitter: float = 0.5, rand=None) -> float:
+    """Restart delay for ``attempt`` (1-based): exponential from ``base``
+    with up to ``+jitter`` fractional randomization, then capped — the cap
+    bounds the SLEPT delay, jitter included (an operator's --max-delay is
+    a promise, not a suggestion). Jitter de-synchronizes a fleet of
+    supervisors hammering a shared resource (filesystem, coordinator)
+    after a common-cause failure; ``rand`` is injectable for
+    deterministic tests."""
+    if base <= 0:
+        return 0.0
+    delay = base * (2.0 ** max(attempt - 1, 0))
+    r = random.random() if rand is None else rand()
+    return min(delay * (1.0 + jitter * r), cap)
+
+
+def latest_checkpoint_step(directory: str) -> int | None:
+    """Newest restorable checkpoint step in ``directory`` (None when the
+    directory is missing/empty) — the forward-progress signal: a restart
+    that cannot advance this number is a crash loop. Filename patterns
+    come from resilience/ckpt_layout.py, the jax-free naming authority
+    shared with train/checkpoint.py."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    steps = [int(m.group(1)) for n in names
+             if (m := ckpt_layout.RESTORABLE_PAT.match(n))]
+    return max(steps, default=None)
+
+
+def _deterministic_failure(rc, lifetime: float, subprocess_runner: bool) -> bool:
+    """Deterministic failures can never be fixed by a retry: argparse usage
+    errors exit 2, and flag-validation SystemExits die within well under a
+    second (before any training state exists). Retrying those burns the
+    whole restart budget on a run that cannot succeed. The lifetime
+    heuristic only applies to real child processes — injected test runners
+    return instantly by construction — never to signal deaths (rc >= 128):
+    an early OOM-kill or preemption is exactly the transient class the
+    supervisor exists to retry; and never to the KNOWN-retryable codes
+    (RETRYABLE_RCS: anomaly aborts, injected crash drills), which are
+    emitted deliberately by code that expects a restart-from-checkpoint to
+    help."""
+    if rc == USAGE_RC:
+        return True
+    return (subprocess_runner and rc is not None and 0 < rc < 128
+            and rc not in RETRYABLE_RCS and lifetime < 1.0)
+
+
+def _checkpoint_dir_of(cli_args: list[str]) -> str | None:
+    for i, a in enumerate(cli_args):
+        if a == "--checkpoint-dir" and i + 1 < len(cli_args):
+            return cli_args[i + 1]
+        if a.startswith("--checkpoint-dir="):
+            return a.split("=", 1)[1]
+    return None
 
 
 def run_with_stall_watch(cmd: list[str], stall_timeout: float) -> int:
@@ -96,20 +184,33 @@ def run_with_stall_watch(cmd: list[str], stall_timeout: float) -> int:
 
 
 def supervise(cli_args: list[str], *, max_restarts: int = 3,
-              restart_delay: float = 1.0, stall_timeout: float | None = None,
-              runner=None) -> int:
+              restart_delay: float = 1.0, max_delay: float = 30.0,
+              no_progress_limit: int = 2,
+              stall_timeout: float | None = None,
+              runner=None, rand=None) -> int:
     """Run the CLI (as a subprocess by default); relaunch with --resume on
-    failure. ``runner(argv) -> int`` is injectable for tests."""
+    failure. ``runner(argv) -> int`` is injectable for tests; ``rand``
+    feeds the backoff jitter (tests pass ``lambda: 0.0``).
+
+    Self-healing contract (resilience/exit_codes.py): restart delays back
+    off exponentially with jitter; a known-retryable child exit
+    (``RETRYABLE_RCS`` — injected crash drills, anomaly aborts) is always
+    relaunched even when the child died fast; and when ``--checkpoint-dir``
+    is visible in the child's flags, the latest checkpoint step must
+    ADVANCE between failures — ``no_progress_limit`` consecutive
+    no-progress failures end the run with ``POISON_RC`` instead of
+    replaying the same doomed step until the restart budget burns out."""
     if stall_timeout is not None and stall_timeout <= 0:
         # 0 would silently mean "no watchdog" and a negative value would
         # kill every healthy child at launch — both are operator mistakes
         raise SystemExit(
             f"--stall-timeout must be > 0, got {stall_timeout}"
         )
-    if not any(a == "--checkpoint-dir" or a.startswith("--checkpoint-dir=")
-               for a in cli_args):
+    ckpt_dir = _checkpoint_dir_of(cli_args)
+    if ckpt_dir is None:
         print("supervise: warning: no --checkpoint-dir — a crash will "
-              "restart from step 0", file=sys.stderr)
+              "restart from step 0 (and forward-progress poison detection "
+              "is off)", file=sys.stderr)
     subprocess_runner = runner is None
     if runner is None:
         def runner(argv):
@@ -119,6 +220,9 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
             return subprocess.run(cmd).returncode
 
     attempt = 0
+    _UNSET = object()
+    prev_ckpt_step = _UNSET  # latest checkpoint step at the PREVIOUS failure
+    no_progress = 0
     while True:
         argv = list(cli_args)
         if attempt > 0:
@@ -138,27 +242,50 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
                 print(f"supervise: succeeded after {attempt} restart(s)",
                       file=sys.stderr)
             return 0
-        # Deterministic failures can never be fixed by a retry: argparse
-        # usage errors exit 2, and flag-validation SystemExits die within
-        # well under a second (before any training state exists). Retrying
-        # those burns the whole restart budget on a run that cannot succeed.
-        # The lifetime heuristic only applies to real child processes —
-        # injected test runners return instantly by construction — and never
-        # to signal deaths (rc >= 128): an early OOM-kill or preemption is
-        # exactly the transient class the supervisor exists to retry.
-        if rc == 2 or (subprocess_runner and rc is not None and 0 < rc < 128
-                       and lifetime < 1.0):
+        if _deterministic_failure(rc, lifetime, subprocess_runner):
             print(f"supervise: child failed deterministically (exit {rc} "
                   f"after {lifetime:.2f}s) — not retrying", file=sys.stderr)
             return rc
+        # Forward-progress check: between consecutive FAILURES the latest
+        # restorable checkpoint step must advance, or the restarts are a
+        # crash loop replaying the same step (poisoned data window, broken
+        # model, corrupt-beyond-fallback checkpoints). Declaring poison
+        # needs `no_progress_limit` consecutive stalls — a single repeat is
+        # legitimate (e.g. a crash landing just before the next save).
+        # Signal deaths (rc >= 128: preemption, OOM-kill, the stall
+        # watchdog) never count toward poison — two preemptions landing
+        # inside one long checkpoint interval is bad luck, not a doomed
+        # step, and the transient class gets the full restart budget.
+        # Also requires an actual checkpoint to exist (cur is not None):
+        # a run that has not saved yet — first checkpoint interval still
+        # open, or --checkpoint-every 0 with the dir used only for
+        # keep-best/fault markers — has nothing to measure progress BY,
+        # and transient early crashes must get the full restart budget.
+        if (ckpt_dir is not None and no_progress_limit > 0
+                and rc is not None and rc < 128):
+            cur = latest_checkpoint_step(ckpt_dir)
+            if (prev_ckpt_step is not _UNSET and cur is not None
+                    and cur == prev_ckpt_step):
+                no_progress += 1
+                if no_progress >= no_progress_limit:
+                    print(f"supervise: POISONED — {no_progress} consecutive "
+                          f"failures without checkpoint progress (stuck at "
+                          f"step {cur}); giving up (exit {POISON_RC})",
+                          file=sys.stderr)
+                    return POISON_RC
+            else:
+                no_progress = 0
+            prev_ckpt_step = cur
         if attempt >= max_restarts:
             print(f"supervise: giving up after {attempt} restart(s) "
                   f"(last exit code {rc})", file=sys.stderr)
             return rc
         attempt += 1
+        delay = backoff_delay(restart_delay, attempt, cap=max_delay,
+                              rand=rand)
         print(f"supervise: child exited {rc}; restart {attempt}/"
-              f"{max_restarts} in {restart_delay}s", file=sys.stderr)
-        time.sleep(restart_delay)
+              f"{max_restarts} in {delay:.1f}s", file=sys.stderr)
+        time.sleep(delay)
 
 
 def main(argv=None) -> int:
@@ -172,6 +299,8 @@ def main(argv=None) -> int:
         cli_args,
         max_restarts=args.max_restarts,
         restart_delay=args.restart_delay,
+        max_delay=args.max_delay,
+        no_progress_limit=args.no_progress_limit,
         stall_timeout=args.stall_timeout,
     )
 

@@ -16,10 +16,20 @@ then writes a ``step_<N>.complete`` marker (only marked steps are
 restorable — a crash mid-save never yields a half checkpoint). Restore
 merges every process file, reassembles full host arrays, and reshards them
 onto the template's shardings via ``make_array_from_callback``.
+
+Durability + corruption story (the resilience plane's checkpoint half):
+every state-bearing file is fsync'd before the rename that makes it
+visible, carries a ``.sha256`` sidecar, and ``restore_latest`` verifies
+before trusting — a checkpoint that fails its checksum (or cannot be
+deserialized at all) is QUARANTINED (renamed ``*.quarantined``, kept for
+forensics) and restore falls back to the newest valid step instead of
+crashing the run. Provoked deterministically by the ``ckpt_corrupt`` fault
+(resilience/faults.py) in tests/test_chaos*.py.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
@@ -28,6 +38,16 @@ import threading
 import jax
 import numpy as np
 from flax import serialization
+
+from ..resilience import ckpt_layout as _ckpt_layout
+from ..resilience import faults as _faults
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint file failed its sha256 sidecar check (torn write, bit
+    rot, or an injected ``ckpt_corrupt`` drill). ``restore_latest``
+    quarantines the offending step and falls back to the newest valid
+    one; this type only escapes from paths with nothing to fall back to."""
 
 
 def _sync(name: str) -> None:
@@ -46,9 +66,11 @@ class Checkpointer:
     ``step_<N>.complete`` marker from process 0.
     """
 
-    _PAT = re.compile(r"step_(\d+)\.msgpack$")
-    _PROC_PAT = re.compile(r"step_(\d+)\.proc(\d+)\.msgpack$")
-    _DONE_PAT = re.compile(r"step_(\d+)\.complete$")
+    # filename patterns live in resilience/ckpt_layout.py (the ONE naming
+    # authority, jax-free so the supervisor can share it)
+    _PAT = _ckpt_layout.STEP_PAT
+    _PROC_PAT = _ckpt_layout.PROC_PAT
+    _DONE_PAT = _ckpt_layout.DONE_PAT
 
     def __init__(self, directory: str, *, keep: int = 3,
                  async_save: bool = False):
@@ -88,13 +110,25 @@ class Checkpointer:
         out = []
         for name in os.listdir(self.directory):
             for pat in (self._PAT, self._PROC_PAT, self._DONE_PAT):
-                m = pat.match(name)
+                m = self._match_state_file(pat, name)
                 if m and int(m.group(1)) == step:
                     out.append(os.path.join(self.directory, name))
         return out
 
     def has_checkpoint(self) -> bool:
         return bool(self._steps())
+
+    def has_quarantined(self) -> bool:
+        """True when the directory holds ``*.quarantined`` files — evidence
+        that checkpoints EXISTED and were set aside as corrupt. A --resume
+        that finds no valid checkpoint but sees this must refuse to fresh-
+        start (cli._wire_checkpoint), or the supervisor's relaunch would
+        silently defeat the corruption refusal one restart later."""
+        try:
+            return any(n.endswith(".quarantined")
+                       for n in os.listdir(self.directory))
+        except OSError:
+            return False
 
     def latest_step(self):
         """Newest restorable step, or None."""
@@ -203,7 +237,8 @@ class Checkpointer:
             "state": serialization.to_bytes(host),
         }
         self._atomic_write(self._best_path,
-                           serialization.msgpack_serialize(payload))
+                           serialization.msgpack_serialize(payload),
+                           checksum=True)
         meta = os.path.join(self.directory, "best.json")
         self._atomic_write(
             meta,
@@ -216,11 +251,19 @@ class Checkpointer:
         if os.path.exists(self._best_marker):
             os.remove(self._best_marker)
         for name in os.listdir(self.directory):
-            if self._BEST_PROC_PAT.match(name):
+            if self._match_state_file(self._BEST_PROC_PAT, name):
                 os.remove(os.path.join(self.directory, name))
         self._best_meta_cache = {"step": payload["step"],
                                  "value": payload["value"]}
         return self._best_path
+
+    @staticmethod
+    def _match_state_file(pat, name: str):
+        """Pattern-match a state filename OR its ``.sha256`` sidecar (the
+        sidecar shares every lifecycle event — cleanup, fencing,
+        quarantine — with its payload)."""
+        base = name[: -len(".sha256")] if name.endswith(".sha256") else name
+        return pat.match(base)
 
     _BEST_PROC_PAT = re.compile(r"best_(\d+)\.proc(\d+)\.msgpack$")
 
@@ -242,7 +285,7 @@ class Checkpointer:
         # files may be the live best — only the marker says which)
         if pid == 0:
             for name in os.listdir(self.directory):
-                m = self._BEST_PROC_PAT.match(name)
+                m = self._match_state_file(self._BEST_PROC_PAT, name)
                 if m and int(m.group(1)) == step:
                     os.remove(os.path.join(self.directory, name))
         _sync(f"best_clean_{step}")
@@ -250,7 +293,8 @@ class Checkpointer:
         payload["value"] = float(value)
         path = os.path.join(self.directory,
                             f"best_{step}.proc{pid}.msgpack")
-        self._atomic_write(path, serialization.msgpack_serialize(payload))
+        self._atomic_write(path, serialization.msgpack_serialize(payload),
+                           checksum=True)
         # every process must finish before the marker flips the live best
         _sync(f"best_save_{step}")
         if pid == 0:
@@ -266,17 +310,22 @@ class Checkpointer:
             # single-process best.msgpack from an earlier 1-process run are
             # dead (a stale best.msgpack must not shadow this best)
             for name in os.listdir(self.directory):
-                m = self._BEST_PROC_PAT.match(name)
+                m = self._match_state_file(self._BEST_PROC_PAT, name)
                 if m and int(m.group(1)) != step:
                     os.remove(os.path.join(self.directory, name))
-            if os.path.exists(self._best_path):
-                os.remove(self._best_path)
+            for stale in (self._best_path, self._best_path + ".sha256"):
+                if os.path.exists(stale):
+                    os.remove(stale)
         _sync(f"best_done_{step}")
         self._best_meta_cache = {"step": step, "value": float(value)}
         return path
 
     def _best_artifact(self):
-        """(kind, meta) of the live best artifact, or (None, None).
+        """(kind, meta, payload) of the live best artifact, or
+        (None, None, None). ``payload`` is the already-verified, parsed
+        best.msgpack content for the "single" kind (None for "sharded") —
+        returned so restore_best never re-reads and re-hashes a
+        potentially multi-GB file the arbitration below just processed.
 
         Each save deletes the OTHER kind, so both coexist only in the
         tiny crash window between writing the new artifact and unlinking
@@ -285,11 +334,27 @@ class Checkpointer:
         best.msgpack from an earlier 1-process run would permanently
         shadow every later sharded best."""
         single = sharded = None
+        payload = None
         if os.path.exists(self._best_path):
-            with open(self._best_path, "rb") as f:
-                payload = serialization.msgpack_restore(f.read())
-            single = {"step": int(payload["step"]),
-                      "value": float(payload["value"])}
+            # OSError propagates: transient IO is not corruption — retry,
+            # don't destroy discoverability (same policy as restore_latest)
+            try:
+                payload = self._classified_parse(
+                    self._best_path, self._read_verified(self._best_path),
+                    serialization.msgpack_restore)
+                single = {"step": int(payload["step"]),
+                          "value": float(payload["value"])}
+            except CorruptCheckpointError as e:
+                # a CORRUPT best must not crash best_meta/restore_best (or
+                # shadow a valid sharded best): set it aside and move on
+                print(f"checkpoint: QUARANTINING corrupt best.msgpack: "
+                      f"{e}", flush=True)
+                for p in (self._best_path, self._best_path + ".sha256"):
+                    try:
+                        if os.path.exists(p):
+                            os.replace(p, p + ".quarantined")
+                    except OSError:
+                        pass  # best effort; discovery will retry it
         if os.path.exists(self._best_marker):
             with open(self._best_marker) as f:
                 meta = json.loads(f.read())
@@ -298,10 +363,10 @@ class Checkpointer:
                        "writers": int(meta["writers"])}
         if single is not None and (sharded is None
                                    or sharded["step"] <= single["step"]):
-            return "single", single
+            return "single", single, payload
         if sharded is not None:
-            return "sharded", sharded
-        return None, None
+            return "sharded", sharded, None
+        return None, None, None
 
     def best_meta(self) -> dict | None:
         """{step, value} of the saved best checkpoint (from the
@@ -313,7 +378,7 @@ class Checkpointer:
         if self._best_meta_cache is not None:
             return dict(self._best_meta_cache)
         self.wait()
-        kind, meta = self._best_artifact()
+        kind, meta, _ = self._best_artifact()
         if kind is None:
             return None
         self._best_meta_cache = {"step": meta["step"], "value": meta["value"]}
@@ -327,12 +392,12 @@ class Checkpointer:
         template) even under a LATER different process count, like any
         sharded step checkpoint."""
         self.wait()
-        kind, meta = self._best_artifact()
+        kind, meta, payload = self._best_artifact()
         if kind is None:
             return None
         if kind == "single":
-            with open(self._best_path, "rb") as f:
-                payload = serialization.msgpack_restore(f.read())
+            # payload was read, verified and parsed by _best_artifact —
+            # no second multi-GB read/hash of the same file
             restored = serialization.from_bytes(template, payload["state"])
             return self._reshard_like(template, restored)
         step, writers = meta["step"], meta["writers"]
@@ -341,20 +406,117 @@ class Checkpointer:
             m = self._BEST_PROC_PAT.match(name)
             if m and int(m.group(1)) == step and int(m.group(2)) < writers:
                 paths.append(os.path.join(self.directory, name))
-        return self._assemble_from_procs(template, paths, step)
+        try:
+            if len(paths) < writers:
+                raise CorruptCheckpointError(
+                    f"best step {step}: only {len(paths)} of {writers} "
+                    "proc files present"
+                )
+            return self._assemble_from_procs(template, paths, step)
+        except CorruptCheckpointError as e:
+            # same contract as the single-file best and restore_latest:
+            # corruption quarantines the artifact and reports "no best"
+            # instead of crashing a --resume-best run
+            print(f"checkpoint: QUARANTINING corrupt sharded best "
+                  f"(step {step}): {e}", flush=True)
+            for p in [*paths, *(p + ".sha256" for p in paths),
+                      self._best_marker]:
+                if os.path.exists(p):
+                    try:
+                        os.replace(p, p + ".quarantined")
+                    except OSError:
+                        pass
+            self._best_meta_cache = None
+            return None
 
     @staticmethod
-    def _atomic_write(path: str, data: bytes) -> None:
-        """tmp-write + rename: partial writes never count (shared by the
-        single-file, best and marker writers)."""
+    def _atomic_write(path: str, data: bytes, *, checksum: bool = False) -> None:
+        """fsync + tmp-write + rename: partial writes never count, and the
+        data is durable BEFORE the rename makes it visible (rename-first
+        ordering can leave a zero-length "complete" file after power loss
+        — exactly the torn state the restore path would then trust).
+        ``checksum=True`` additionally writes a ``<path>.sha256`` sidecar
+        (state-bearing files only) that restore verifies; any PREVIOUS
+        sidecar is removed before the payload rename and the new one lands
+        after it, so a crash anywhere in the sequence leaves a payload
+        (old or new) without a sidecar — verified as legacy/unchecked,
+        never as a false mismatch. This matters for OVERWRITTEN paths
+        (best.msgpack): without the pre-remove, a crash between the two
+        renames would pair the new payload with the old file's hash and
+        restore would quarantine a perfectly valid best."""
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        if checksum and os.path.exists(path + ".sha256"):
+            os.remove(path + ".sha256")  # never pair new bytes w/ old hash
         os.replace(tmp, path)
+        if checksum:
+            side_tmp = path + ".sha256.tmp"
+            with open(side_tmp, "wb") as f:
+                f.write(hashlib.sha256(data).hexdigest().encode())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(side_tmp, path + ".sha256")
+
+    @staticmethod
+    def _classified_parse(path: str, data: bytes, parse):
+        """Parse state ``data`` read from ``path``, classifying failure by
+        the module's ONE corruption policy: checksum-VERIFIED bytes that
+        fail to parse mean a structural/config mismatch (re-raised loudly
+        — quarantining would destroy valid checkpoints), while a legacy
+        unchecksummed file that fails to parse is indistinguishable from
+        truncation (→ CorruptCheckpointError, favoring recovery)."""
+        try:
+            return parse(data)
+        except Exception as e:
+            if os.path.exists(path + ".sha256"):
+                raise  # verified bytes: not corruption — surface it
+            raise CorruptCheckpointError(
+                f"{path}: cannot deserialize legacy (unchecksummed) file: "
+                f"{type(e).__name__}: {e}"
+            ) from e
+
+    @staticmethod
+    def _read_verified(path: str) -> bytes:
+        """Read a state-bearing file, checking its sha256 sidecar when one
+        exists (pre-checksum checkpoints have none and are accepted)."""
+        with open(path, "rb") as f:
+            data = f.read()
+        side = path + ".sha256"
+        if os.path.exists(side):
+            with open(side) as f:
+                expect = f.read().strip()
+            got = hashlib.sha256(data).hexdigest()
+            if got != expect:
+                raise CorruptCheckpointError(
+                    f"{path}: sha256 mismatch (expected {expect[:12]}…, "
+                    f"got {got[:12]}…) — truncated or corrupted write"
+                )
+        return data
+
+    def _quarantine_step(self, step: int, reason: str) -> None:
+        """Rename every file of a corrupt step to ``*.quarantined`` so the
+        discovery patterns stop matching it (restore falls back to the
+        next-newest step) while the evidence stays on disk for forensics.
+        Quarantined files are exempt from keep-N cleanup."""
+        print(f"checkpoint: QUARANTINING step {step}: {reason}", flush=True)
+        for f in self._files_for_step(step):
+            try:
+                os.replace(f, f + ".quarantined")
+            except OSError:
+                pass  # best effort: a vanished file is already "gone"
 
     def _save_single(self, host_state) -> str:
-        path = self._path_for(int(host_state.step))
-        self._atomic_write(path, serialization.to_bytes(host_state))
+        step = int(host_state.step)
+        path = self._path_for(step)
+        self._atomic_write(path, serialization.to_bytes(host_state),
+                           checksum=True)
+        # chaos drills: an armed ckpt_corrupt fault tears THIS file now,
+        # after the write completed — the restore-side checksum must catch
+        # it and fall back (tests/test_chaos*.py)
+        _faults.maybe_corrupt_checkpoint(path, step)
         return path
 
     def _local_shards_payload(self, state, step: int) -> dict:
@@ -406,20 +568,22 @@ class Checkpointer:
         _sync(f"ckpt_clean_{step}")
         payload = self._local_shards_payload(state, step)
         path = os.path.join(self.directory, f"step_{step}.proc{pid}.msgpack")
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(serialization.msgpack_serialize(payload))
-        os.replace(tmp, path)
+        self._atomic_write(path, serialization.msgpack_serialize(payload),
+                           checksum=True)
+        # chaos drills fire on sharded saves too (process 0 tears its own
+        # proc file — deterministic, no cross-process marker race), so a
+        # multi-process ckpt_corrupt drill exercises _restore_sharded's
+        # corruption fallback instead of silently never firing
+        if pid == 0:
+            _faults.maybe_corrupt_checkpoint(path, step)
         # every process must finish writing before the step is marked
         # restorable (assumes a shared filesystem, the standard pod setup)
         _sync(f"ckpt_save_{step}")
         if pid == 0:
             done = os.path.join(self.directory, f"step_{step}.complete")
-            with open(done + ".tmp", "w") as f:
-                # marker records the writer count: restore only merges proc
-                # files below it (second guard against stale files)
-                f.write(str(jax.process_count()))
-            os.replace(done + ".tmp", done)
+            # marker records the writer count: restore only merges proc
+            # files below it (second guard against stale files)
+            self._atomic_write(done, str(jax.process_count()).encode())
         _sync(f"ckpt_done_{step}")
         return path
 
@@ -432,18 +596,59 @@ class Checkpointer:
         Template leaves that are sharded jax.Arrays get the restored values
         RESHARDED onto their shardings (works across a changed process
         count / mesh layout); host leaves come back as host arrays.
+
+        Corruption fallback: a newest checkpoint that is CORRUPT — checksum
+        mismatch, an undeserializable legacy (unchecksummed) file, or a
+        sharded step missing proc files — is QUARANTINED (files renamed
+        ``*.quarantined``, kept for forensics) and the next-newest step is
+        tried, until a valid one restores or none remain (→ None, with a
+        loud warning per quarantined step). A truncated write must cost
+        one checkpoint interval, not the run. Deliberately NOT swallowed:
+        OSError (transient IO is not corruption — retry, don't destroy
+        discoverability) and deserialization failures of checksum-VERIFIED
+        files (bytes are exactly what was written, so the template/model
+        config is wrong — quarantining every checkpoint would silently
+        restart the run from step 0). Each step is attempted at most once
+        per call, so a quarantine that cannot rename (read-only dir) still
+        terminates.
         """
         self.wait()  # never read around an in-flight write
-        steps = self._steps()
-        if not steps:
-            return None
-        step = steps[-1]
-        single = self._path_for(step)
-        if os.path.exists(single):
-            with open(single, "rb") as f:
-                restored = serialization.from_bytes(template, f.read())
-            return self._reshard_like(template, restored)
-        return self._restore_sharded(template, step)
+        attempted: set[int] = set()
+        while True:
+            steps = [s for s in self._steps() if s not in attempted]
+            if not steps:
+                return None
+            step = steps[-1]
+            attempted.add(step)
+            single = self._path_for(step)
+            try:
+                if os.path.exists(single):
+                    restored = self._deserialize_verified(template, single)
+                    return self._reshard_like(template, restored)
+                return self._restore_sharded(template, step)
+            except CorruptCheckpointError as e:
+                self._quarantine_step(step, str(e))
+            except FileNotFoundError as e:
+                # NOT the transient-IO class: a file that existed at
+                # discovery and is gone at read was quarantined by a PEER
+                # process racing the same corrupt step (multi-process
+                # restore). Fall back like the peer did, so every process
+                # converges on the same older step and the sync barriers
+                # stay aligned.
+                self._quarantine_step(step, f"vanished mid-read "
+                                            f"(peer quarantine?): {e}")
+
+    def _deserialize_verified(self, template, path: str):
+        """Checksum-check then deserialize one single-file checkpoint,
+        classifying failures: checksum mismatch → CorruptCheckpointError
+        (quarantine + fall back); parse failure of a VERIFIED file →
+        re-raised as-is (the bytes are intact, so the template/config is
+        wrong — a loud error, not a quarantine); parse failure of a legacy
+        unchecksummed file → CorruptCheckpointError (truncation and
+        mismatch are indistinguishable there — favor recovery)."""
+        data = self._read_verified(path)  # raises CorruptCheckpointError
+        return self._classified_parse(
+            path, data, lambda d: serialization.from_bytes(template, d))
 
     def _restore_sharded(self, template, step: int):
         done = os.path.join(self.directory, f"step_{step}.complete")
@@ -460,6 +665,13 @@ class Checkpointer:
             if n_writers is not None and int(m.group(2)) >= n_writers:
                 continue  # stale file from an older, larger job
             paths.append(os.path.join(self.directory, name))
+        if n_writers is not None and len(paths) < n_writers:
+            # a marked-complete step with vanished proc files is damage,
+            # not a config problem: fall back like any other corruption
+            raise CorruptCheckpointError(
+                f"checkpoint step {step}: only {len(paths)} of {n_writers} "
+                "proc files present"
+            )
         return self._assemble_from_procs(template, paths, step)
 
     def _assemble_from_procs(self, template, paths: list, step: int):
@@ -468,8 +680,8 @@ class Checkpointer:
         step and best restore paths)."""
         merged: dict[int, list] = {}
         for p in paths:
-            with open(p, "rb") as f:
-                payload = serialization.msgpack_restore(f.read())
+            payload = self._classified_parse(
+                p, self._read_verified(p), serialization.msgpack_restore)
             for k, recs in payload["leaves"].items():
                 merged.setdefault(int(k), []).extend(recs)
         t_leaves, treedef = jax.tree.flatten(template)

@@ -23,6 +23,26 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..resilience import faults as _faults
+
+
+class AnomalousTrainingError(RuntimeError):
+    """Raised by :func:`train_loop` after ``anomaly_limit`` CONSECUTIVE
+    non-finite steps: the model is diverged (or the data/hardware is
+    producing garbage) and continuing would only burn budget skipping
+    updates. The CLI maps it to ``resilience.exit_codes.ANOMALY_RC`` so the
+    supervisor restarts from the last checkpoint — whose params are clean,
+    because the guard skipped every anomalous update."""
+
+    def __init__(self, consecutive: int, total: int, step: int):
+        self.consecutive = consecutive
+        self.total = total
+        self.step = step
+        super().__init__(
+            f"{consecutive} consecutive non-finite steps at step {step} "
+            f"({total} anomalous total); aborting for supervisor restart"
+        )
+
 
 class TrainState(NamedTuple):
     step: jax.Array  # scalar int32
@@ -134,11 +154,31 @@ def step_body(
             has_aux=True,
         )(state.params)
         carries = jax.lax.stop_gradient(aux["carries"]) if stateful else state.carries
+    grads = _faults.tamper_grads(grads, state.step)  # identity when unarmed
     if reduce_fn is not None:
         grads, loss = reduce_fn(grads, loss)
     updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
-    metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+    gnorm = optax.global_norm(grads)
+    # Non-finite guard: a NaN/Inf loss or gradient must not poison the
+    # params/optimizer moments (one bad batch would otherwise end the run —
+    # every later step inherits the NaNs). Skip the whole update (params,
+    # moments, AND carries — a diverged forward pass taints the recurrent
+    # state too), advance step/rng so the budget and data order hold, and
+    # surface the skip as metrics["anomalous"] for the host loop to count.
+    # Under DP the guard decision is uniform across shards: loss and grads
+    # are pmean'd before the check.
+    finite = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+    keep = lambda new, old: jnp.where(finite, new, old)  # noqa: E731
+    params = jax.tree.map(keep, params, state.params)
+    opt_state = jax.tree.map(keep, opt_state, state.opt_state)
+    if stateful:
+        carries = jax.tree.map(keep, carries, state.carries)
+    metrics = {
+        "loss": loss,
+        "grad_norm": gnorm,
+        "anomalous": (~finite).astype(jnp.float32),
+    }
     return TrainState(state.step + 1, params, opt_state, rng, carries), metrics
 
 
@@ -165,12 +205,16 @@ def summarize_scan_metrics(ms) -> dict:
     """Reduce per-step metrics stacked by a K-step `lax.scan` to the logging
     contract shared by every multi-step path (multistep.py, device_step.py):
     ``loss`` = mean over the K steps, ``loss_last``/``grad_norm`` = final
-    step's."""
-    return {
+    step's, ``anomalous`` (when the body reports it) = COUNT of skipped
+    (non-finite) steps in the window."""
+    out = {
         "loss": jnp.mean(ms["loss"]),
         "loss_last": ms["loss"][-1],
         "grad_norm": ms["grad_norm"][-1],
     }
+    if "anomalous" in ms:
+        out["anomalous"] = jnp.sum(ms["anomalous"])
+    return out
 
 
 def make_train_step(
@@ -292,6 +336,7 @@ def train_loop(
     best_metric: str = "eval_loss",
     best_mode: str = "min",
     best_init: float | None = None,
+    anomaly_limit: int = 0,
 ) -> TrainState:
     """Drive the jitted step over a batch iterator, logging scalar metrics.
 
@@ -316,10 +361,23 @@ def train_loop(
     ``best_init`` seeds the best-so-far (a resumed run passes the saved
     best's value so it can never overwrite a better checkpoint with a
     worse one).
+
+    ``anomaly_limit=K`` (off at 0) aborts with
+    :class:`AnomalousTrainingError` after K CONSECUTIVE anomalous
+    (non-finite, update-skipped) steps — the supervisor restarts from
+    checkpoint with the dedicated exit code. Enabling it fetches the
+    per-step ``anomalous`` scalar, which adds one host sync per loop
+    iteration (the same cost a per-step loss fetch would have): leave it 0
+    on dispatch-bound runs that don't need the watchdog. With
+    ``steps_per_call=K'`` the fetched value is the window COUNT; a fully
+    anomalous window extends the consecutive run, a partially anomalous
+    one resets it (it contained at least one finite step).
     """
     t0 = time.perf_counter()
     window_start = t0
     last_metrics = None
+    anomalous_total = 0
+    anomalous_consec = 0
     best_val = best_init
     if num_steps is not None and num_steps <= 0:
         return state  # eval-only budget: never pull a batch from the feed
@@ -333,6 +391,21 @@ def train_loop(
         else:
             state, metrics = train_step(state, batch)
         last_metrics = metrics
+        if anomaly_limit and "anomalous" in metrics:
+            bad = int(float(metrics["anomalous"]))  # sync point (documented)
+            anomalous_total += bad
+            if bad >= steps_per_call:
+                anomalous_consec += bad
+            else:
+                anomalous_consec = 0
+            if anomalous_consec >= anomaly_limit:
+                if logger is not None:
+                    logger.log({"step": int(state.step),
+                                "note": "anomaly abort",
+                                "anomalous_steps": anomalous_total,
+                                "anomalous_consecutive": anomalous_consec})
+                raise AnomalousTrainingError(
+                    anomalous_consec, anomalous_total, int(state.step))
         if log_every and step % log_every == 0:
             loss = float(metrics["loss"])  # sync point
             now = time.perf_counter()
@@ -344,6 +417,16 @@ def train_loop(
                 "grad_norm": float(metrics["grad_norm"]),
                 "steps_per_sec": log_every * steps_per_call / dt,
             }
+            if anomaly_limit:
+                # cumulative (exact: every step was fetched above)
+                if anomalous_total:
+                    record["anomalous_steps"] = anomalous_total
+            elif "anomalous" in metrics:
+                # watchdog off: report the logged step/window's own count
+                # (no per-step fetch, so no cumulative claim)
+                bad = float(metrics["anomalous"])
+                if bad:
+                    record["anomalous"] = bad
             if tokens_per_batch:
                 tps = tokens_per_batch * log_every * steps_per_call / dt
                 record["tokens_per_sec"] = tps

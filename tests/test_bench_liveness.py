@@ -14,7 +14,7 @@ import bench
 
 
 class _FailJson(RuntimeError):
-    """Stand-in for bench._fail_json's os._exit(3)."""
+    """Stand-in for bench._fail_json's os._exit(LIVENESS_RC)."""
 
 
 @pytest.fixture()
@@ -85,14 +85,17 @@ def test_success_on_first_probe_skips_retry(monkeypatch, fail_capture):
 
 
 def test_fail_record_carries_last_good_evidence():
-    """VERDICT r4: a wedged round's failure line must embed the last
-    complete measurement (value + provenance) from BENCH_TABLE.json while
-    keeping value=0.0 and rc=3 honest — so the driver's record carries
-    evidence instead of a bare zero."""
+    """VERDICT r4 + resilience PR: a wedged round's failure line must embed
+    the last complete measurement (value + provenance) from
+    BENCH_TABLE.json while keeping value=0.0 honest, and exit with the
+    DEDICATED liveness rc (resilience/exit_codes.py: 76 — no longer 3,
+    which collided with chip_recovery's regression gate)."""
     import json
     import os
     import subprocess
     import sys as _sys
+
+    from lstm_tensorspark_tpu.resilience.exit_codes import LIVENESS_RC
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run(
@@ -106,7 +109,7 @@ def test_fail_record_carries_last_good_evidence():
         capture_output=True, text=True, timeout=120, cwd=repo,
     )
     lines = out.stdout.strip().splitlines()
-    assert lines[-1] == "EXIT_CODE=3"  # rc=3 contract unchanged
+    assert lines[-1] == f"EXIT_CODE={LIVENESS_RC}"  # dedicated liveness rc
     line = json.loads(lines[-2])
     assert line["value"] == 0.0  # honesty contract unchanged
     assert "wedge-test" in line["error"]

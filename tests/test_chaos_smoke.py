@@ -1,0 +1,22 @@
+"""The one-command chaos drill (tools/chaos_smoke.py), wired as a `-m slow`
+test: runnable on demand, off the tier-1 hot path (it launches several
+full CLI subprocesses)."""
+
+import os
+import sys
+
+import pytest
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+)
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+
+@pytest.mark.slow
+def test_chaos_smoke_drill(tmp_path):
+    import chaos_smoke
+
+    rc = chaos_smoke.main(["--steps", "12", "--keep", str(tmp_path / "work")])
+    assert rc == 0

@@ -1,0 +1,274 @@
+"""Checkpoint hardening (train/checkpoint.py): sha256 sidecars, fsync'd
+atomic writes, and restore_latest's quarantine-and-fall-back path — a
+truncated or bit-flipped newest checkpoint must cost one checkpoint
+interval, never the run."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from lstm_tensorspark_tpu.resilience import faults
+from lstm_tensorspark_tpu.train.checkpoint import Checkpointer
+from lstm_tensorspark_tpu.train.loop import init_train_state
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _state(step: int, w: float):
+    opt = optax.sgd(0.1)
+    s = init_train_state({"w": jnp.full((4,), w, jnp.float32)}, opt,
+                         jax.random.PRNGKey(0))
+    return s._replace(step=jnp.asarray(step, jnp.int32))
+
+
+def _save_steps(ckpt, steps):
+    for i, step in enumerate(steps):
+        ckpt.save(_state(step, float(i + 1)))
+
+
+def test_sidecar_written_and_restore_verifies(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    _save_steps(ckpt, [2])
+    assert os.path.exists(tmp_path / "step_2.msgpack.sha256")
+    restored = ckpt.restore_latest(_state(0, 0.0))
+    assert int(restored.step) == 2
+
+
+def test_truncated_newest_falls_back_and_quarantines(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    _save_steps(ckpt, [2, 4])
+    path = tmp_path / "step_4.msgpack"
+    data = path.read_bytes()
+    path.write_bytes(data[: len(data) // 2])  # torn write
+
+    restored = ckpt.restore_latest(_state(0, 0.0))
+    assert int(restored.step) == 2  # fell back to the newest VALID step
+    assert float(restored.params["w"][0]) == pytest.approx(1.0)
+    assert os.path.exists(tmp_path / "step_4.msgpack.quarantined")
+    assert not os.path.exists(tmp_path / "step_4.msgpack")
+    # the fallback is durable: a SECOND restore sees step 2 directly
+    again = ckpt.restore_latest(_state(0, 0.0))
+    assert int(again.step) == 2
+
+
+def test_single_bit_flip_detected_by_checksum(tmp_path):
+    """msgpack may happily parse a bit-flipped file — the sidecar is what
+    catches silent corruption, not the parser."""
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    _save_steps(ckpt, [2, 4])
+    path = tmp_path / "step_4.msgpack"
+    raw = bytearray(path.read_bytes())
+    raw[len(raw) // 2] ^= 0x01
+    path.write_bytes(bytes(raw))
+    restored = ckpt.restore_latest(_state(0, 0.0))
+    assert int(restored.step) == 2
+
+
+def test_all_corrupt_returns_none(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    _save_steps(ckpt, [2])
+    (tmp_path / "step_2.msgpack").write_bytes(b"garbage")
+    assert ckpt.restore_latest(_state(0, 0.0)) is None
+
+
+def test_legacy_checkpoint_without_sidecar_still_restores(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    _save_steps(ckpt, [2])
+    os.remove(tmp_path / "step_2.msgpack.sha256")  # pre-checksum era file
+    restored = ckpt.restore_latest(_state(0, 0.0))
+    assert int(restored.step) == 2
+
+
+def test_cleanup_removes_sidecars_with_payloads(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), keep=2)
+    _save_steps(ckpt, [2, 4, 6, 8])
+    names = set(os.listdir(tmp_path))
+    assert "step_2.msgpack" not in names and "step_4.msgpack" not in names
+    assert not any(n.startswith("step_2.") or n.startswith("step_4.")
+                   for n in names), names  # no orphaned sidecars
+    assert {"step_6.msgpack.sha256", "step_8.msgpack.sha256"} <= names
+
+
+def test_injected_ckpt_corrupt_fault_roundtrip(tmp_path):
+    """The chaos path end to end in-process: the armed fault tears the
+    step-4 file right after save; restore quarantines it and falls back."""
+    faults.arm("ckpt_corrupt@4", state_dir=str(tmp_path))
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    _save_steps(ckpt, [2, 4])
+    restored = ckpt.restore_latest(_state(0, 0.0))
+    assert int(restored.step) == 2
+    assert os.path.exists(tmp_path / "step_4.msgpack.quarantined")
+    # one-shot: re-saving step 4 is clean and restorable
+    ckpt.save(_state(4, 9.0))
+    assert int(ckpt.restore_latest(_state(0, 0.0)).step) == 4
+
+
+def test_config_mismatch_raises_instead_of_quarantining(tmp_path):
+    """A checksum-VERIFIED file that fails to deserialize means the
+    TEMPLATE is wrong (changed model config), not the file: restore must
+    surface that loudly, never quarantine every checkpoint and silently
+    restart from step 0."""
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    _save_steps(ckpt, [2])
+    opt = optax.sgd(0.1)
+    wrong_template = init_train_state(
+        {"w": jnp.zeros((4,)), "extra": jnp.zeros((3,))}, opt,
+        jax.random.PRNGKey(0))
+    with pytest.raises(Exception) as ei:
+        ckpt.restore_latest(wrong_template)
+    assert "Quarantin" not in str(ei.value)
+    assert os.path.exists(tmp_path / "step_2.msgpack")  # untouched
+
+
+def test_unrenamable_quarantine_still_terminates(tmp_path, monkeypatch):
+    """Read-only checkpoint dir: the quarantine rename fails, but each step
+    is attempted at most once per call, so restore_latest returns instead
+    of spinning on the same corrupt newest forever."""
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    _save_steps(ckpt, [2])
+    (tmp_path / "step_2.msgpack").write_bytes(b"garbage")
+    monkeypatch.setattr(Checkpointer, "_quarantine_step",
+                        lambda self, step, reason: None)  # rename impossible
+    assert ckpt.restore_latest(_state(0, 0.0)) is None  # terminates
+
+
+def test_transient_io_error_not_quarantined(tmp_path, monkeypatch):
+    """OSError during the read is transient IO, not corruption: it must
+    propagate (retry territory), not destroy checkpoint discoverability."""
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    _save_steps(ckpt, [2])
+    monkeypatch.setattr(
+        Checkpointer, "_read_verified",
+        staticmethod(lambda path: (_ for _ in ()).throw(OSError("EIO"))))
+    with pytest.raises(OSError):
+        ckpt.restore_latest(_state(0, 0.0))
+    assert os.path.exists(tmp_path / "step_2.msgpack")  # untouched
+
+
+def test_corrupt_sharded_best_returns_none(tmp_path):
+    """A sharded best set with a missing/corrupt proc file must quarantine
+    and report 'no best', not crash a --resume-best run."""
+    import json as _json
+
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    (tmp_path / "best.complete").write_text(
+        _json.dumps({"writers": 2, "step": 2, "value": 1.0}))
+    (tmp_path / "best_2.proc0.msgpack").write_bytes(b"x")  # proc1 missing
+    assert ckpt.restore_best(_state(0, 0.0)) is None
+    assert os.path.exists(tmp_path / "best.complete.quarantined")
+
+
+def test_overwrite_crash_never_pairs_new_bytes_with_old_hash(tmp_path,
+                                                             monkeypatch):
+    """Crash between the payload rename and the sidecar write of an
+    OVERWRITTEN path (best.msgpack): the old sidecar must already be gone,
+    leaving a sidecar-less payload (legacy-accepted) — never a stale-hash
+    pair that falsely quarantines a valid best."""
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    ckpt.save_best(_state(2, 1.0), value=2.0)
+    orig_replace = os.replace
+
+    def crashing_replace(src, dst):
+        orig_replace(src, dst)
+        if str(dst).endswith("best.msgpack"):
+            raise KeyboardInterrupt  # "crash" right after payload visible
+
+    monkeypatch.setattr(os, "replace", crashing_replace)
+    with pytest.raises(KeyboardInterrupt):
+        ckpt.save_best(_state(4, 9.0), value=1.0)
+    monkeypatch.setattr(os, "replace", orig_replace)
+
+    fresh = Checkpointer(str(tmp_path), keep=5)
+    assert fresh.best_meta() == {"step": 4, "value": 1.0}  # not quarantined
+    assert not os.path.exists(tmp_path / "best.msgpack.quarantined")
+    restored = fresh.restore_best(_state(0, 0.0))
+    assert int(restored.step) == 4
+
+
+def test_resume_best_corrupt_aborts_before_fencing(tmp_path):
+    """--resume-best with a corrupt best: restore_best returns None (new
+    quarantine contract) and the CLI must abort BEFORE fence_after — the
+    fence would delete the run's valid newer step checkpoints."""
+    import argparse
+    import json as _json
+
+    from lstm_tensorspark_tpu.cli import _wire_checkpoint
+
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    _save_steps(ckpt, [2, 4, 6])
+    # corrupt SHARDED best at step 2: marker claims 2 writers, 1 present
+    (tmp_path / "best.complete").write_text(
+        _json.dumps({"writers": 2, "step": 2, "value": 1.0}))
+    (tmp_path / "best_2.proc0.msgpack").write_bytes(b"x")
+    args = argparse.Namespace(checkpoint_dir=str(tmp_path), resume_best=True,
+                              resume=False, async_checkpoint=False)
+
+    class _Logger:
+        def log(self, record):
+            pass
+
+    with pytest.raises(SystemExit, match="corrupt"):
+        _wire_checkpoint(args, _Logger(), lambda: _state(0, 0.0))
+    # the abandoned-lineage fence never ran: newer steps survive
+    assert os.path.exists(tmp_path / "step_4.msgpack")
+    assert os.path.exists(tmp_path / "step_6.msgpack")
+
+
+def test_resume_all_corrupt_aborts_instead_of_fresh_start(tmp_path):
+    """--resume where checkpoints EXIST but all fail verification: the run
+    must abort loudly, not silently re-init from step 0 and discard the
+    run's progress (an empty dir stays a legitimate fresh start)."""
+    import argparse
+
+    from lstm_tensorspark_tpu.cli import _wire_checkpoint
+
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    _save_steps(ckpt, [2])
+    (tmp_path / "step_2.msgpack").write_bytes(b"garbage")
+    args = argparse.Namespace(checkpoint_dir=str(tmp_path), resume_best=False,
+                              resume=True, async_checkpoint=False)
+
+    class _Logger:
+        def log(self, record):
+            pass
+
+    with pytest.raises(SystemExit, match="failed verification"):
+        _wire_checkpoint(args, _Logger(), lambda: _state(0, 0.0))
+    # the refusal PERSISTS across a supervisor relaunch: the quarantine
+    # above left no valid checkpoints (has_checkpoint is now False), and
+    # the relaunch must NOT silently fresh-start from step 0
+    with pytest.raises(SystemExit, match="quarantined"):
+        _wire_checkpoint(args, _Logger(), lambda: _state(0, 0.0))
+
+
+def test_serve_refuses_fully_corrupt_checkpoint_dir(tmp_path):
+    """cli serve with a checkpoint dir whose only checkpoint is corrupt:
+    restore_latest quarantines it and returns None — serve must refuse
+    loudly instead of crashing (or silently serving random init)."""
+    from lstm_tensorspark_tpu.cli import main as cli_main
+
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    _save_steps(ckpt, [2])
+    (tmp_path / "step_2.msgpack").write_bytes(b"garbage")
+    with pytest.raises(SystemExit, match="corrupt"):
+        cli_main(["serve", "--selftest", "--checkpoint-dir", str(tmp_path)])
+
+
+def test_corrupt_best_is_quarantined_not_fatal(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), keep=5)
+    ckpt.save_best(_state(6, 3.0), value=1.25)
+    assert ckpt.best_meta() == {"step": 6, "value": 1.25}
+    best = tmp_path / "best.msgpack"
+    best.write_bytes(best.read_bytes()[:32])
+    fresh = Checkpointer(str(tmp_path), keep=5)  # no meta cache
+    assert fresh.best_meta() is None
+    assert fresh.restore_best(_state(0, 0.0)) is None
+    assert os.path.exists(tmp_path / "best.msgpack.quarantined")

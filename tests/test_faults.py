@@ -1,0 +1,149 @@
+"""Fault-injection plane (resilience/faults.py): spec parsing, one-shot
+marker persistence, batch-feed faults, checkpoint corruption, determinism."""
+
+import os
+
+import pytest
+
+from lstm_tensorspark_tpu.resilience import faults
+from lstm_tensorspark_tpu.resilience.exit_codes import FAULT_CRASH_RC
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.disarm()
+    yield
+    # explicit pop, not monkeypatch: the CLI EXPORTS the var mid-test
+    # (--faults -> env for children) and delenv-on-absent records no undo
+    os.environ.pop(faults.ENV_VAR, None)
+    faults.disarm()
+
+
+def test_spec_parsing_full_grammar():
+    p = faults.FaultPlane(
+        "crash@5; nan_grads@3x2; ckpt_corrupt@4; data_error@6;"
+        "serve_error@2; seed@7"
+    )
+    assert p.crash_steps == {5}
+    assert p.nan_grad_steps == (3, 4)
+    assert p.ckpt_corrupt_steps == {4}
+    assert p.data_error_steps == {6}
+    assert p.serve_error_calls == {2}
+    assert p.seed == 7
+
+
+@pytest.mark.parametrize("bad", [
+    "crash",              # no @N
+    "crash@x",            # non-numeric
+    "explode@3",          # unknown kind
+    "crash@3x2",          # burst only valid for nan_grads
+])
+def test_spec_parse_errors(bad):
+    with pytest.raises(ValueError):
+        faults.FaultPlane(bad)
+
+
+def test_arm_from_env(monkeypatch, tmp_path):
+    monkeypatch.setenv(faults.ENV_VAR, "crash@9")
+    plane = faults.arm_from_env(state_dir=str(tmp_path))
+    assert plane is faults.active()
+    assert plane.crash_steps == {9}
+    monkeypatch.delenv(faults.ENV_VAR)
+    faults.disarm()
+    assert faults.arm_from_env() is None
+
+
+def test_one_shot_markers_persist_across_planes(tmp_path):
+    """A restarted child (fresh plane, same state_dir) must see a fired
+    fault as fired — the crash-loop prevention contract."""
+    p1 = faults.FaultPlane("crash@5", state_dir=str(tmp_path))
+    assert not p1.fired("crash@5")
+    p1.mark_fired("crash@5")
+    assert p1.fired("crash@5")
+    p2 = faults.FaultPlane("crash@5", state_dir=str(tmp_path))  # "restart"
+    assert p2.fired("crash@5")
+    assert os.path.exists(tmp_path / ".faults" / "crash@5.fired")
+
+
+def test_wrap_batches_crash_fires_once(monkeypatch, tmp_path):
+    crashes = []
+    monkeypatch.setattr(faults, "_crash",
+                        lambda: (_ for _ in ()).throw(SystemExit(FAULT_CRASH_RC)))
+    plane = faults.FaultPlane("crash@3", state_dir=str(tmp_path))
+    out = []
+    with pytest.raises(SystemExit) as ei:
+        for b in plane.wrap_batches(iter(range(10)), start_step=0):
+            out.append(b)
+    assert ei.value.code == FAULT_CRASH_RC
+    assert out == [0, 1]  # steps 1, 2 ran; crash fired before step 3
+    # the "restarted" plane resumes past the marker without re-firing
+    plane2 = faults.FaultPlane("crash@3", state_dir=str(tmp_path))
+    resumed = list(plane2.wrap_batches(iter(range(2, 10)), start_step=2))
+    assert resumed == list(range(2, 10))
+    assert not crashes
+
+
+def test_wrap_batches_data_error():
+    plane = faults.FaultPlane("data_error@2")  # in-memory one-shot
+    out = []
+    with pytest.raises(faults.InjectedFault):
+        for b in plane.wrap_batches(iter(range(5)), start_step=0):
+            out.append(b)
+    assert out == [0]
+    # same plane (same process): already fired, passes through
+    assert list(plane.wrap_batches(iter(range(5)), start_step=1)) == list(range(5))
+
+
+def test_wrap_batches_steps_per_call_window():
+    """With K steps per dispatch the fault must fire when its step falls
+    anywhere inside the next window."""
+    plane = faults.FaultPlane("data_error@6")
+    out = []
+    with pytest.raises(faults.InjectedFault):
+        # windows: [1..4], [5..8] — step 6 is inside the second window
+        for b in plane.wrap_batches(iter(range(5)), start_step=0,
+                                    steps_per_call=4):
+            out.append(b)
+    assert out == [0]
+
+
+def test_wrap_batches_resume_coordinates():
+    """Step numbering is GLOBAL: a resumed feed starting at step 4 must not
+    re-enter the window of a step-3 fault."""
+    plane = faults.FaultPlane("data_error@3")
+    assert list(plane.wrap_batches(iter(range(5)), start_step=4)) == list(range(5))
+
+
+def test_maybe_corrupt_checkpoint_truncates_once(tmp_path):
+    plane = faults.FaultPlane("ckpt_corrupt@4;seed@1", state_dir=str(tmp_path))
+    f = tmp_path / "step_4.msgpack"
+    payload = bytes(range(256)) * 4
+    f.write_bytes(payload)
+    plane.maybe_corrupt_checkpoint(str(f), 4)
+    damaged = f.read_bytes()
+    assert len(damaged) == len(payload) // 2
+    assert damaged != payload[: len(damaged)]  # seeded byte flip applied
+    # wrong step: no-op; fired step: no second corruption
+    f2 = tmp_path / "step_6.msgpack"
+    f2.write_bytes(payload)
+    plane.maybe_corrupt_checkpoint(str(f2), 6)
+    assert f2.read_bytes() == payload
+    f.write_bytes(payload)
+    plane.maybe_corrupt_checkpoint(str(f), 4)
+    assert f.read_bytes() == payload
+
+
+def test_serve_hook_fires_on_nth_call():
+    plane = faults.arm("serve_error@3")
+    faults.serve_decode_hook()
+    faults.serve_decode_hook()
+    with pytest.raises(faults.InjectedFault):
+        faults.serve_decode_hook()
+    faults.serve_decode_hook()  # one-shot: call 4 is clean
+
+
+def test_unarmed_hooks_are_noops(tmp_path):
+    faults.serve_decode_hook()
+    faults.maybe_corrupt_checkpoint(str(tmp_path / "x"), 1)
+    assert faults.tamper_grads({"w": 1.0}, 0) == {"w": 1.0}

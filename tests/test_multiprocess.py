@@ -476,7 +476,9 @@ def test_two_process_pp_sharded_checkpoint(tmp_path):
     # both processes wrote their own shard file; step marked complete
     names = os.listdir(ckpt)
     assert "step_1.complete" in names
-    assert sum(1 for n in names if n.startswith("step_1.proc")) == 2
+    # payload count only: each shard file also carries a .sha256 sidecar
+    assert sum(1 for n in names if n.startswith("step_1.proc")
+               and n.endswith(".msgpack")) == 2
 
 
 @pytest.mark.skipif(os.environ.get("LSTM_TSP_SKIP_MULTIPROC") == "1",
@@ -776,7 +778,8 @@ assert meta == {"step": 2, "value": 0.5}, meta
 # exactly one live shard set remains after the overwrite (pid 0 looks
 # after save_best's final barrier)
 if pid == 0:
-    files = sorted(n for n in os.listdir(ckpt_dir) if n.startswith("best_"))
+    files = sorted(n for n in os.listdir(ckpt_dir) if n.startswith("best_")
+                   and n.endswith(".msgpack"))
     assert files == ["best_2.proc0.msgpack", "best_2.proc1.msgpack"], files
 
 # fresh-template restore: every local shard round-trips exactly

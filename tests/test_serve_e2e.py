@@ -87,7 +87,10 @@ def test_http_endpoint_roundtrip(stack, refs):
         http_thread.start()
         base = f"http://{host}:{port}"
         with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
-            assert json.loads(r.read()) == {"ok": True}
+            health = json.loads(r.read())
+            # honest-health contract (test_serve_health.py): ok + the
+            # scheduler heartbeat, not a constant smile
+            assert health["ok"] is True and health["batcher_alive"] is True
         body = json.dumps({
             "prompt": _PROMPTS[1].tolist(), "max_new_tokens": _N_NEW,
             "greedy": True,

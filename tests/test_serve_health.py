@@ -1,0 +1,141 @@
+"""Serving resilience: /healthz degrades honestly (503 on a dead scheduler
+thread) and an injected serve-engine exception mid-decode fails only the
+affected requests — the server keeps serving and keeps reporting healthy."""
+
+import os
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from lstm_tensorspark_tpu.models import LMConfig, init_lm
+from lstm_tensorspark_tpu.resilience import faults
+from lstm_tensorspark_tpu.serve import InprocessClient, ServeEngine, ServeServer
+from lstm_tensorspark_tpu.serve.server import make_http_server
+
+_CFG = LMConfig(vocab_size=29, hidden_size=16, num_layers=1)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.disarm()
+    yield
+    # explicit pop, not monkeypatch: the CLI EXPORTS the var mid-test
+    # (--faults -> env for children) and delenv-on-absent records no undo
+    os.environ.pop(faults.ENV_VAR, None)
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    """ONE engine for the whole file: the compiled prefill/decode programs
+    are the expensive part and the fault hook is read at CALL time, so
+    every test (armed or not) can share them."""
+    params = init_lm(jax.random.PRNGKey(3), _CFG)
+    return ServeEngine(params, _CFG, num_slots=4,
+                       prefill_buckets=(4, 8), batch_buckets=(1, 2))
+
+
+def _server(engine, **kw):
+    return ServeServer(engine, max_active=2, queue_size=8, **kw)
+
+
+def test_health_alive_and_heartbeat(engine):
+    server = _server(engine)
+    with server:
+        client = InprocessClient(server)
+        client.generate(np.array([1, 2, 3], np.int32), max_new_tokens=3)
+        h = server.health()
+        assert h["ok"] and h["batcher_alive"]
+        assert h["seconds_since_last_iteration"] is not None
+        assert h["seconds_since_last_iteration"] < 30.0
+    # after stop(): the scheduler thread is gone — health must say so
+    h = server.health()
+    assert not h["ok"]
+
+
+def test_health_not_ok_before_start(engine):
+    assert _server(engine).health()["ok"] is False
+
+
+def test_stale_heartbeat_flips_not_ok_while_thread_alive(engine):
+    """The wedge case: the scheduler thread is stuck inside a dispatch that
+    never returns — is_alive() stays true forever, so health must gate on
+    heartbeat AGE too, or probes would smile at a wedged server."""
+    import time
+
+    server = _server(engine, health_stale_after=0.2)
+
+    def wedged_run(stop_event, idle_wait=0.05):
+        server.batcher.last_heartbeat = time.monotonic()
+        stop_event.wait()  # "inside a device call that never returns"
+
+    server.batcher.run = wedged_run  # type: ignore[method-assign]
+    with server:
+        time.sleep(0.5)
+        h = server.health()
+        assert h["batcher_alive"] is True   # thread alive...
+        assert h["batcher_stale"] is True   # ...but silent too long
+        assert h["ok"] is False             # → probe sees 503
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_healthz_returns_503_when_batcher_thread_dies(engine):
+    """Kill the scheduler thread with an unexpected error: the HTTP probe
+    must flip to 503 instead of smiling at a wedged server."""
+    server = _server(engine)
+    boom = RuntimeError("scheduler bug")
+    server.batcher.step = lambda: (_ for _ in ()).throw(boom)  # type: ignore
+    httpd = make_http_server(server, "127.0.0.1", 0)
+    host, port = httpd.server_address[:2]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        with server:
+            server._thread.join(timeout=10)  # run() dies on first step()
+            assert not server._thread.is_alive()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://{host}:{port}/healthz", timeout=10)
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert body["ok"] is False and body["batcher_alive"] is False
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_warmup_neither_consumes_nor_fires_serve_fault(engine):
+    """warmup()'s dummy decodes must not advance the serve_error counter
+    (or a loadgen drill dies at startup): the first REAL decode after
+    warmup is still call 1 and fires."""
+    faults.arm("serve_error@1")
+    engine.warmup()  # would raise here without the bypass
+    with pytest.raises(faults.InjectedFault):
+        engine.decode([engine.cache.scratch_slot], [0])
+
+
+def test_injected_decode_error_fails_only_that_request(engine):
+    """serve_error@2: the second decode call of the plane raises inside
+    the engine. The batcher retires+fails the affected session, releases
+    its slot, and later requests (and the server's health) are unharmed."""
+    faults.arm("serve_error@2")
+    server = _server(engine)
+    with server:
+        client = InprocessClient(server)
+        prompt = np.array([1, 2, 3], np.int32)
+        with pytest.raises(RuntimeError) as ei:
+            client.generate(prompt, max_new_tokens=6)
+        assert "InjectedFault" in str(ei.value)
+        # the engine healed: a fresh request decodes to completion
+        toks = client.generate(prompt, max_new_tokens=6)
+        assert len(toks) == 6
+        h = server.health()
+        assert h["ok"] and h["active"] == 0  # no leaked slots/sessions
+        assert server.batcher.failed == 1 and server.batcher.completed == 1

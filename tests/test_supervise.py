@@ -175,6 +175,119 @@ def test_stall_timeout_must_be_positive():
                       runner=lambda argv: 0)
 
 
+def test_backoff_delay_exponential_capped_jittered():
+    from lstm_tensorspark_tpu.supervise import backoff_delay
+
+    assert backoff_delay(1.0, 1, rand=lambda: 0.0) == 1.0
+    assert backoff_delay(1.0, 3, rand=lambda: 0.0) == 4.0
+    assert backoff_delay(1.0, 10, cap=30.0, rand=lambda: 0.0) == 30.0
+    assert backoff_delay(1.0, 1, rand=lambda: 1.0) == 1.5  # +50% max jitter
+    # the cap bounds the SLEPT delay, jitter included
+    assert backoff_delay(1.0, 10, cap=30.0, rand=lambda: 1.0) == 30.0
+    assert backoff_delay(0.0, 5, rand=lambda: 1.0) == 0.0  # tests' fast path
+
+
+def test_poison_when_checkpoints_stop_advancing(tmp_path):
+    """A crash loop that never advances the latest checkpoint step must end
+    with the dedicated poison rc, not grind through the restart budget."""
+    from lstm_tensorspark_tpu.resilience.exit_codes import POISON_RC
+
+    calls = []
+
+    def runner(argv):
+        calls.append(1)
+        # checkpoints exist but are STUCK at step 2 across every failure
+        (tmp_path / "step_2.msgpack").write_bytes(b"x")
+        return 9
+
+    rc = supervise(["--checkpoint-dir", str(tmp_path)], max_restarts=10,
+                   restart_delay=0.0, runner=runner)
+    assert rc == POISON_RC
+    # baseline failure + 2 consecutive no-progress failures (default limit)
+    assert len(calls) == 3
+
+
+def test_never_checkpointed_run_is_not_poisoned(tmp_path):
+    """No checkpoint has ever been written (first interval still open, or
+    --checkpoint-every 0 with the dir only holding fault markers): there
+    is nothing to measure progress by, so transient crashes must get the
+    full restart budget, not an early poison verdict."""
+    calls = []
+
+    def runner(argv):
+        calls.append(1)
+        return 9  # fails, dir stays empty
+
+    rc = supervise(["--checkpoint-dir", str(tmp_path)], max_restarts=3,
+                   restart_delay=0.0, runner=runner)
+    assert rc == 9  # the child's own rc after the full budget
+    assert len(calls) == 4
+
+
+def test_signal_deaths_never_count_toward_poison(tmp_path):
+    """Preemption/OOM-kill/stall-kill (rc >= 128) are the transient class:
+    repeated signal deaths inside one checkpoint interval must burn the
+    normal restart budget, not trip the poison detector."""
+    calls = []
+
+    def runner(argv):
+        calls.append(1)
+        return -9  # SIGKILL every time, checkpoint never advances
+
+    rc = supervise(["--checkpoint-dir", str(tmp_path)], max_restarts=5,
+                   restart_delay=0.0, runner=runner)
+    assert rc == 137  # exhausted budget with the child's own code
+    assert len(calls) == 6  # full budget, no early poison
+
+
+def test_checkpoint_progress_resets_poison_counter(tmp_path):
+    """As long as each failure leaves a NEWER checkpoint than the last, the
+    supervisor keeps retrying to its normal budget (and then returns the
+    child's own rc, not poison)."""
+    calls = []
+
+    def runner(argv):
+        calls.append(1)
+        (tmp_path / f"step_{len(calls) * 2}.msgpack").write_bytes(b"x")
+        return 9
+
+    rc = supervise(["--checkpoint-dir", str(tmp_path)], max_restarts=3,
+                   restart_delay=0.0, runner=runner)
+    assert rc == 9
+    assert len(calls) == 4  # first attempt + full 3-restart budget
+
+
+def test_latest_checkpoint_step_scan(tmp_path):
+    from lstm_tensorspark_tpu.supervise import latest_checkpoint_step
+
+    assert latest_checkpoint_step(str(tmp_path / "missing")) is None
+    assert latest_checkpoint_step(str(tmp_path)) is None
+    (tmp_path / "step_4.msgpack").write_bytes(b"x")
+    (tmp_path / "step_8.complete").write_bytes(b"2")  # sharded marker
+    (tmp_path / "step_12.msgpack.quarantined").write_bytes(b"x")  # corrupt
+    (tmp_path / "step_6.msgpack.sha256").write_bytes(b"x")  # sidecar only
+    assert latest_checkpoint_step(str(tmp_path)) == 8
+
+
+def test_retryable_rcs_exempt_from_fast_death_heuristic():
+    """An injected-crash or anomaly-abort child can die in <1s on tiny CPU
+    runs; the deterministic-failure heuristic must still retry it."""
+    from lstm_tensorspark_tpu.resilience.exit_codes import (
+        ANOMALY_RC,
+        FAULT_CRASH_RC,
+        RETRYABLE_RCS,
+    )
+    from lstm_tensorspark_tpu.supervise import _deterministic_failure
+
+    for rc in (FAULT_CRASH_RC, ANOMALY_RC, *RETRYABLE_RCS):
+        assert not _deterministic_failure(rc, 0.1, True)
+    assert _deterministic_failure(2, 5.0, True)       # usage error: always
+    assert _deterministic_failure(1, 0.1, True)       # fast unknown death
+    assert not _deterministic_failure(1, 5.0, True)   # slow death: retry
+    assert not _deterministic_failure(137, 0.1, True)  # signal: retry
+    assert not _deterministic_failure(1, 0.1, False)  # injected runner
+
+
 def test_resume_best_converted_to_resume_on_relaunch():
     """--resume-best is a one-time rewind: relaunches must continue the
     fine-tune's own lineage via plain --resume."""

@@ -1,0 +1,168 @@
+#!/usr/bin/env python3
+"""One-command chaos drill: prove the crash→restart→resume path end to end.
+
+Runs the SAME tiny CPU training job twice —
+
+1. **baseline**: unsupervised, no faults;
+2. **chaos**: under the supervisor with a scripted fault schedule
+   (hard crash at ~2/3 of the budget + checkpoint corruption at the
+   preceding save + a 2-step NaN-gradient burst + a data-batch exception)
+   and the anomaly watchdog armed —
+
+then asserts the chaos run (a) exits 0 despite every injected fault,
+(b) reaches EXACTLY the full step budget, and (c) lands within a loss
+tolerance of the baseline (the NaN-burst steps skip their updates, so
+bit-identity is not expected; divergence is).
+
+This is the ops acceptance drill from ISSUE 2 / docs/OPERATIONS.md's
+failure-modes runbook — run it after touching the train loop, the
+checkpointer or the supervisor:
+
+    python tools/chaos_smoke.py [--steps 12] [--rtol 0.2] [--keep DIR]
+
+Exit 0 on PASS, 1 on any violated assertion. Wired as a `-m slow` test
+(tests/test_chaos_smoke.py) so it stays runnable but off the tier-1 hot
+path; tests/test_chaos.py covers the individual fault classes fast.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _DIR not in sys.path:
+    sys.path.insert(0, _DIR)
+
+
+def _base_cli(steps: int, ckpt: str, jsonl: str) -> list[str]:
+    return [
+        "--dataset", "ptb_char", "--hidden-units", "16", "--num-layers", "1",
+        "--batch-size", "8", "--seq-len", "16", "--backend", "single",
+        "--num-steps", str(steps), "--log-every", "1",
+        "--checkpoint-dir", ckpt, "--checkpoint-every", "2",
+        "--jsonl", jsonl,
+    ]
+
+
+def _run(cmd: list[str], timeout: float) -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, *cmd], cwd=_DIR, env=env,
+                          timeout=timeout)
+    return proc.returncode
+
+
+def _final_record(jsonl: str) -> dict:
+    records = [json.loads(line) for line in open(jsonl)]
+    finals = [r for r in records if r.get("note") == "final"]
+    if not finals:
+        raise AssertionError(f"no final record in {jsonl}")
+    return finals[-1]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=12,
+                   help="total step budget per run (default 12)")
+    p.add_argument("--rtol", type=float, default=0.2,
+                   help="relative final-eval-loss tolerance chaos vs "
+                        "baseline (default 0.2 — the NaN-burst steps skip "
+                        "updates, so the runs are close, not identical)")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="per-run wall-clock bound in seconds")
+    p.add_argument("--keep", type=str, default=None,
+                   help="keep the work dir at this path (default: tmp, "
+                        "deleted on exit)")
+    args = p.parse_args(argv)
+    steps = args.steps
+    if steps < 6:
+        raise SystemExit("--steps must be >= 6 (the schedule needs room "
+                         "for a crash after a completed checkpoint)")
+
+    work = args.keep or tempfile.mkdtemp(prefix="chaos_smoke_")
+    os.makedirs(work, exist_ok=True)
+    failures = []
+    try:
+        # ---- baseline ------------------------------------------------
+        base_jsonl = os.path.join(work, "baseline.jsonl")
+        rc = _run(["-m", "lstm_tensorspark_tpu.cli",
+                   *_base_cli(steps, os.path.join(work, "ckpt_base"),
+                              base_jsonl)], args.timeout)
+        if rc != 0:
+            print(f"FAIL: baseline run exited {rc}")
+            return 1
+        base = _final_record(base_jsonl)
+
+        # ---- chaos ---------------------------------------------------
+        # crash after the checkpoint at 2/3 budget; corrupt THAT
+        # checkpoint (restore must fall back one interval); NaN burst in
+        # the first third; a data-batch exception in the final third.
+        crash_at = 2 * steps // 3 + 1              # after the save below
+        corrupt_at = (crash_at - 1) // 2 * 2       # latest ckpt before crash
+        nan_at = max(steps // 4, 1)
+        data_at = min(crash_at + 1, steps)
+        schedule = (f"crash@{crash_at};ckpt_corrupt@{corrupt_at};"
+                    f"nan_grads@{nan_at}x2;data_error@{data_at}")
+        chaos_jsonl = os.path.join(work, "chaos.jsonl")
+        print(f"chaos schedule: {schedule}", flush=True)
+        rc = _run(["-m", "lstm_tensorspark_tpu.supervise",
+                   "--max-restarts", "4", "--restart-delay", "0.1",
+                   "--max-delay", "1", "--",
+                   *_base_cli(steps, os.path.join(work, "ckpt_chaos"),
+                              chaos_jsonl),
+                   "--faults", schedule, "--anomaly-limit", "50"],
+                  args.timeout)
+        if rc != 0:
+            print(f"FAIL: supervised chaos run exited {rc} (expected 0)")
+            return 1
+        chaos = _final_record(chaos_jsonl)
+
+        # ---- parity --------------------------------------------------
+        if chaos["step"] != steps:
+            failures.append(f"chaos run final step {chaos['step']} != "
+                            f"budget {steps}")
+        if base["step"] != steps:
+            failures.append(f"baseline final step {base['step']} != {steps}")
+        bl, cl = base.get("eval_loss"), chaos.get("eval_loss")
+        if bl is None or cl is None or not (bl == bl and cl == cl):
+            failures.append(f"non-finite/missing eval losses: "
+                            f"baseline={bl} chaos={cl}")
+        elif abs(cl - bl) > args.rtol * abs(bl):
+            failures.append(f"final eval loss diverged: baseline={bl:.4f} "
+                            f"chaos={cl:.4f} (rtol {args.rtol})")
+        # every fault class must actually have fired (one-shot markers)
+        fired = set(os.listdir(os.path.join(work, "ckpt_chaos", ".faults")))
+        for fid in (f"crash@{crash_at}", f"ckpt_corrupt@{corrupt_at}",
+                    f"data_error@{data_at}"):
+            if fid + ".fired" not in fired:
+                failures.append(f"fault {fid} never fired")
+        quarantined = [n for n in os.listdir(os.path.join(work, "ckpt_chaos"))
+                       if n.endswith(".quarantined")]
+        if not quarantined:
+            failures.append("corrupt checkpoint was never quarantined")
+
+        summary = {
+            "note": "chaos_smoke",
+            "steps": steps,
+            "schedule": schedule,
+            "baseline_eval_loss": bl,
+            "chaos_eval_loss": cl,
+            "quarantined": quarantined,
+            "result": "PASS" if not failures else "FAIL",
+            "failures": failures,
+        }
+        print(json.dumps(summary))
+        print(f"chaos smoke: {summary['result']}")
+        return 0 if not failures else 1
+    finally:
+        if args.keep is None:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

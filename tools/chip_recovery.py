@@ -46,24 +46,43 @@ import subprocess
 import sys
 
 _DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _DIR not in sys.path:  # runnable from any cwd (the queue children get
+    sys.path.insert(0, _DIR)  # cwd=_DIR; this script itself may not)
 
-# Exit-code contract with chip_watch.sh (ADVICE.md r5 findings 1+2):
+# Exit-code contract with chip_watch.sh — constants now live in the ONE
+# shared table, lstm_tensorspark_tpu/resilience/exit_codes.py:
 #   WEDGE_RC (75, EX_TEMPFAIL) — the chip re-wedged mid-queue (a step
 #     timed out, or bench's liveness contract fired): the watcher resumes
-#     probing so a later recovery window isn't lost. Dedicated sentinel —
-#     never reused for anything else (the old code reused 2, which pytest
-#     also emits for usage errors, so a persistent failure could loop the
-#     heavy queue forever).
+#     probing so a later recovery window isn't lost.
 #   CHILD_FAIL_RC (70, EX_SOFTWARE) — a child step failed for a
 #     non-wedge reason (its own rc is printed in the log): persistent,
 #     the watcher STOP-marks and exits.
-#   3 — this script's own throughput-regression gate: also persistent.
-WEDGE_RC = 75
-CHILD_FAIL_RC = 70
-# bench.py's liveness contract (_fail_json) exits 3 — the same code as
-# the regression gate — but its JSON record always carries this marker;
-# scanning for it is how a wedge-shaped bench failure is told apart.
+#   REGRESSION_RC (3) — this script's own throughput-regression gate:
+#     also persistent.
+#   LIVENESS_RC (76) — bench.py's liveness contract. Dedicated since the
+#     resilience PR (it used to reuse 3, colliding with the regression
+#     gate): the rc alone now routes a wedge-shaped bench failure back to
+#     the watcher. The marker-string scan below survives only as a
+#     fallback for bench builds predating the dedicated code.
+from lstm_tensorspark_tpu.resilience.exit_codes import (  # noqa: E402
+    CHILD_FAIL_RC,
+    LIVENESS_RC,
+    REGRESSION_RC,
+    WEDGE_RC,
+)
+
 _WEDGE_MARKER = "unreachable/wedged"
+
+
+def _reemit_timeout_output(e) -> None:
+    """Re-emit whatever a TimeoutExpired captured: capture mode buffers the
+    child's output, and a wedged 45-min bench would otherwise leave no
+    forensics in the watcher log at all. Shared by _run and _measure."""
+    for chunk in (e.stdout, e.stderr):
+        if chunk:
+            sys.stdout.write(chunk if isinstance(chunk, str)
+                             else chunk.decode(errors="replace"))
+    sys.stdout.flush()
 
 # pre-hoist same-day r3 baselines (quiet chip); regression = materially below
 _BASELINES = {"imdb_bilstm": 19661.0, "uci_seq2seq": 65165.0}
@@ -79,10 +98,11 @@ def _run(argv, timeout, label, scan_wedge=False):
     """Run one queue step. Timeouts exit WEDGE_RC; child failures exit
     CHILD_FAIL_RC (the child's own rc goes to the log only — propagating
     it raw let a child's rc collide with the watcher's sentinel space).
-    With ``scan_wedge`` the child's output is captured and scanned for
-    bench's liveness-contract marker, so a bench that exits 3 because the
-    chip re-wedged mid-queue maps to WEDGE_RC, not to a persistent
-    failure (ADVICE.md r5 finding 1)."""
+    With ``scan_wedge`` a liveness-shaped bench failure maps to WEDGE_RC,
+    not to a persistent failure: the DEDICATED rc (LIVENESS_RC) is the
+    primary route; the captured-output marker scan remains as a fallback
+    for bench builds that still exit 3 (closes ADVICE r5 finding 1
+    properly — the rc no longer collides with the regression gate)."""
     print(f"== {label}", flush=True)
     try:
         if scan_wedge:
@@ -93,21 +113,16 @@ def _run(argv, timeout, label, scan_wedge=False):
             sys.stderr.write(out.stderr)
             sys.stdout.flush()
             rc = out.returncode
-            if rc != 0 and _WEDGE_MARKER in out.stdout + out.stderr:
-                print(f"FAIL: {label} rc={rc} with a {_WEDGE_MARKER} "
-                      "liveness record (chip wedged again?)")
+            if rc == LIVENESS_RC or (
+                rc != 0 and _WEDGE_MARKER in out.stdout + out.stderr
+            ):
+                print(f"FAIL: {label} rc={rc} liveness contract fired "
+                      "(chip wedged again?)")
                 sys.exit(WEDGE_RC)
         else:
             rc = subprocess.run(argv, cwd=_DIR, timeout=timeout).returncode
     except subprocess.TimeoutExpired as e:
-        # capture mode buffers the child's output: re-emit what the
-        # exception carries, or a wedged 45-min bench leaves no forensics
-        # in the watcher log at all
-        for chunk in (e.stdout, e.stderr):
-            if chunk:
-                sys.stdout.write(chunk if isinstance(chunk, str)
-                                 else chunk.decode(errors="replace"))
-        sys.stdout.flush()
+        _reemit_timeout_output(e)
         print(f"FAIL: {label} exceeded {timeout}s (chip wedged again?)")
         sys.exit(WEDGE_RC)
     if rc != 0:
@@ -132,19 +147,16 @@ def _measure(name, env=None, timeout=900):
             env=run_env,
         )
     except subprocess.TimeoutExpired as e:
-        for chunk in (e.stdout, e.stderr):
-            if chunk:
-                sys.stdout.write(chunk if isinstance(chunk, str)
-                                 else chunk.decode(errors="replace"))
-        sys.stdout.flush()
+        _reemit_timeout_output(e)
         print(f"FAIL: measure_config({name}) exceeded {timeout}s "
               "(chip wedged again?)")
         sys.exit(WEDGE_RC)
     if out.returncode != 0:
         print(f"FAIL: measure_config({name}) rc={out.returncode}:\n"
               f"{out.stderr[-1000:]}")
-        sys.exit(WEDGE_RC if _WEDGE_MARKER in out.stdout + out.stderr
-                 else CHILD_FAIL_RC)
+        wedged = (out.returncode == LIVENESS_RC
+                  or _WEDGE_MARKER in out.stdout + out.stderr)
+        sys.exit(WEDGE_RC if wedged else CHILD_FAIL_RC)
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
@@ -170,7 +182,7 @@ def main() -> int:
     if regressed:
         print(f"FAIL: regression vs pre-hoist baselines on {regressed}; "
               "investigate before refreshing the table (DESIGN.md queue)")
-        return 3
+        return REGRESSION_RC
 
     print("== r4 A/B levers", flush=True)
     for var, (names, label) in _AB_LEVERS.items():
@@ -186,9 +198,9 @@ def main() -> int:
                       "it off for this config and record the negative "
                       "result in DESIGN.md")
 
-    # scan_wedge: bench's liveness contract exits 3 — same code as OUR
-    # regression gate — so the wedge marker in its output is what routes
-    # a mid-queue re-wedge back to the watcher's resume path
+    # scan_wedge: bench's liveness contract exits LIVENESS_RC (76) — the
+    # rc routes a mid-queue re-wedge back to the watcher's resume path
+    # (marker scan kept as a legacy fallback)
     _run([sys.executable, "bench.py"], timeout=2700, label="full bench.py",
          scan_wedge=True)
     table = json.load(open(os.path.join(_DIR, "BENCH_TABLE.json")))

@@ -36,11 +36,14 @@ if [ -e "$LOG.STOP" ]; then
   echo "refusing to start: $LOG.STOP exists (investigate, then remove it)" >&2
   exit 1
 fi
-exec 9>"$LOG.lock"
+# open APPEND: a refused second watcher's `exec` must not truncate the
+# running watcher's recorded pid out of the lock file
+exec 9>>"$LOG.lock"
 if ! flock -n 9; then
   echo "refusing to start: another watcher holds $LOG.lock" >&2
   exit 1
 fi
+truncate -s 0 "$LOG.lock" 2>/dev/null || true  # we hold it: fresh record
 echo "$$" >&9  # forensic: which pid holds the lock
 while true; do
   python3 -c "
