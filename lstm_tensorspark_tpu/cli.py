@@ -1236,6 +1236,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue-size", type=int, default=64,
                    help="bounded submit queue; beyond it requests are "
                         "rejected (HTTP 429)")
+    p.add_argument("--decode-window", type=str, default="auto",
+                   help="multi-token decode window: 'auto' (adaptive "
+                        "ladder 1/4/8 — large windows in steady-state "
+                        "decode, 1 whenever requests are queued), an int "
+                        "N (the ladder capped at N, N as top rung), or 1 "
+                        "to pin the per-token path (lowest inter-token "
+                        "latency; see docs/OPERATIONS.md). Every window "
+                        "size is one XLA compile key per batch bucket.")
     # --- sampling defaults (selftest is always greedy) ---
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=None)
@@ -1264,6 +1272,28 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "— resilience/faults.py grammar, same flag as the "
                         "training CLI; also armable via LSTM_TSP_FAULTS")
     return p
+
+
+def _parse_window_ladder(spec: str) -> tuple[int, ...]:
+    """--decode-window → a Batcher window ladder: 'auto' = the default
+    ladder (1, 4, 8); an int N = that ladder capped at N, with N itself
+    as the top rung (so `--decode-window 8` == auto, `6` → (1, 4, 6),
+    `1` pins the per-token path)."""
+    from .serve import Batcher
+
+    if spec.strip().lower() == "auto":
+        return Batcher.DEFAULT_WINDOW_LADDER
+    try:
+        n = int(spec)
+    except ValueError:
+        raise SystemExit(
+            f"--decode-window: expected 'auto' or a positive int, got "
+            f"{spec!r}")
+    if n < 1:
+        raise SystemExit(f"--decode-window: window must be >= 1, got {n}")
+    return tuple(sorted(
+        {1, n} | {k for k in Batcher.DEFAULT_WINDOW_LADDER if k < n}
+    ))
 
 
 def _parse_buckets(spec: str, flag: str) -> tuple[int, ...]:
@@ -1317,7 +1347,8 @@ def _build_serve_stack(args):
         rng_seed=args.seed,
     )
     server = ServeServer(engine, max_active=args.max_active,
-                         queue_size=args.queue_size)
+                         queue_size=args.queue_size,
+                         window_ladder=_parse_window_ladder(args.decode_window))
     return params, cfg, server
 
 
@@ -1382,6 +1413,7 @@ def _serve_selftest(args) -> int:
         "tokens_per_session": n_new, "mismatches": bad,
         "compiles_prefill": server.engine.num_compiles("prefill"),
         "compiles_decode": server.engine.num_compiles("decode"),
+        "compiles_decode_window": server.engine.num_compiles("decode_window"),
         **server.stats()["batcher"],
     }))
     print(f"serve selftest: {'PASS' if bad == 0 else 'FAIL'}")
@@ -1421,7 +1453,13 @@ def _serve_loadgen(args) -> int:
     out["engine"] = {
         "compiles_prefill": server.engine.num_compiles("prefill"),
         "compiles_decode": server.engine.num_compiles("decode"),
+        "compiles_decode_window": server.engine.num_compiles("decode_window"),
         **server.engine.cache.stats(),
+    }
+    bstats = server.batcher.stats()
+    out["batcher"] = {
+        k: bstats[k]
+        for k in ("window_ladder", "windows_dispatched", "windows_pipelined")
     }
     print(json.dumps(out))
     return 0
@@ -1438,7 +1476,8 @@ def _serve_http(args) -> int:
     # then kill-loop it). Selftest/loadgen warm implicitly; --http must too.
     print("serve: warming the compile lattice...", flush=True)
     n = server.engine.warmup(_serve_sampling(args),
-                             prompt_lens=tuple(server.engine.prefill_buckets))
+                             prompt_lens=tuple(server.engine.prefill_buckets),
+                             windows=server.batcher.window_ladder)
     print(f"serve: {n} programs compiled", flush=True)
     httpd = make_http_server(server, args.host, args.port)
     host, port = httpd.server_address[:2]

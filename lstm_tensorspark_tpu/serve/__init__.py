@@ -9,17 +9,23 @@ into a serving engine:
 - ``state_cache``: slot-based device-resident cache of per-session carries
   (LRU eviction, explicit detach/restore);
 - ``engine``: bucketed jitted prefill/decode programs over the cache —
-  compile count bounded per (phase, bucket), never per batch composition;
+  compile count bounded per (phase, bucket[, window], sampling), never
+  per batch composition — including ``decode_window``: K tokens per XLA
+  program with on-device per-row EOS/budget latching, returned as device
+  handles so readback can be pipelined;
 - ``batcher``: continuous-batching scheduler (admission control, bounded
-  queue backpressure, round-robin decode fairness);
+  queue backpressure, round-robin decode fairness) with an adaptive
+  decode-window ladder and dispatch-ahead async readback (window i+1 is
+  dispatched before window i's tokens are fetched);
 - ``server``: stdlib ThreadingHTTPServer JSON endpoint + in-process client;
-- ``loadgen``: closed/open-loop load generator (p50/p99 latency, tokens/s).
+- ``loadgen``: closed/open-loop load generator (p50/p99 request latency,
+  TTFT, inter-token latency, tokens/s).
 
 CLI: ``python -m lstm_tensorspark_tpu.cli serve --selftest`` (see cli.py).
 """
 
 from .state_cache import CacheFullError, StateCache
-from .engine import SamplingParams, ServeEngine
+from .engine import PAD_TOKEN, DecodeWindow, SamplingParams, ServeEngine
 from .batcher import Batcher, QueueFullError, Request
 from .server import InprocessClient, ServeServer
 from .loadgen import run_loadgen
@@ -27,7 +33,9 @@ from .loadgen import run_loadgen
 __all__ = [
     "Batcher",
     "CacheFullError",
+    "DecodeWindow",
     "InprocessClient",
+    "PAD_TOKEN",
     "QueueFullError",
     "Request",
     "SamplingParams",

@@ -6,13 +6,30 @@ One scheduler iteration (:meth:`Batcher.step`) does two things, in order:
    batch (same sampling config; capped by ``max_active`` and the engine's
    batch bucket), allocate/pin their cache slots, run prefill → each new
    session's first token;
-2. **decode** — advance EVERY active session by exactly one token, packed
-   into bucketed decode batches grouped by sampling config.
+2. **decode** — advance EVERY active session, packed into bucketed decode
+   batches grouped by sampling config. In steady state (empty queue, one
+   sampling group that fits one batch bucket) the advance is a **decode
+   window**: K tokens in one XLA program (``window_ladder``, K chosen
+   adaptively), dispatched ahead of the previous window's readback.
 
-Because step 2 covers all active sessions each iteration, per-token
-fairness is structural (no session can starve another), and because step 1
-runs every iteration, a short request submitted late finishes while longer
-earlier sessions are still decoding — the continuous-batching property
+**Adaptive windowing + async readback** (the per-token host-round-trip
+killer): K falls back to 1 whenever the submit queue is non-empty or any
+session is within K tokens of its budget — so a late request is still
+admitted within one scheduler iteration and nobody decodes padding —
+and grows to the ladder's largest rung in steady-state decode. A
+dispatched window is held as ``_pending`` device handles; the NEXT
+iteration dispatches window i+1 straight from those handles (the engine's
+``decode_window_next``) *before* calling ``fetch_window`` on window i, so
+host readback and Python token distribution overlap device compute. Rows
+that hit EOS or their budget latch dead ON DEVICE (frozen carries, PAD
+output), which is what makes running ahead safe. Greedy windowed output
+is token-identical to the K=1 path (tests/test_serve_window.py).
+
+Because step 2 covers all active sessions each iteration, fairness is
+structural (no session can starve another; within a steady-state burst
+every session advances by the same window), and because step 1 runs every
+iteration, a short request submitted late finishes while longer earlier
+sessions are still decoding — the continuous-batching property
 (tests/test_serve_batcher.py).
 
 Backpressure: the submit queue is bounded; a full queue raises
@@ -34,7 +51,7 @@ from collections import deque
 
 import numpy as np
 
-from .engine import GREEDY, SamplingParams, ServeEngine
+from .engine import GREEDY, PAD_TOKEN, DecodeWindow, SamplingParams, ServeEngine
 
 
 class QueueFullError(RuntimeError):
@@ -75,6 +92,20 @@ class Request:
         self.t_submit: float | None = None
         self.t_first_token: float | None = None
         self.t_done: float | None = None
+        # host-side arrival time of each token (one entry per token):
+        # consecutive deltas are the request's inter-token latencies. A
+        # decode window delivers its K tokens in one burst, so these make
+        # the latency cost of windowing measurable (loadgen p50/p99 ITL)
+        # instead of guessed.
+        self.t_tokens: list[float] = []
+
+    def itl_gaps(self) -> list[float]:
+        """Inter-token latencies (seconds): gaps between consecutive
+        token arrivals — the ONE definition shared by the HTTP reply's
+        ``max_itl_ms`` and loadgen's pooled percentiles. TTFT is not a
+        gap (reported separately); a window's burst contributes 0.0s
+        gaps between its tokens."""
+        return [b - a for a, b in zip(self.t_tokens, self.t_tokens[1:])]
 
 
 class _Session:
@@ -89,12 +120,17 @@ class _Session:
 
 
 class Batcher:
+    #: default decode-window ladder: every K is a compile key, so the
+    #: lattice stays tiny; (1,) disables windowing (pure K=1 path).
+    DEFAULT_WINDOW_LADDER = (1, 4, 8)
+
     def __init__(
         self,
         engine: ServeEngine,
         *,
         max_active: int = 16,
         queue_size: int = 64,
+        window_ladder: tuple[int, ...] = DEFAULT_WINDOW_LADDER,
     ):
         if max_active < 1:
             raise ValueError(f"max_active must be >= 1, got {max_active}")
@@ -106,9 +142,21 @@ class Batcher:
             )
         if queue_size < 1:
             raise ValueError(f"queue_size must be >= 1, got {queue_size}")
+        if not window_ladder or any(k < 1 for k in window_ladder):
+            raise ValueError(
+                f"window_ladder needs positive window sizes, got "
+                f"{window_ladder!r}")
+        # rung 1 is always present: _pick_window falls back to it (near
+        # budget end, pipelined tails), and warmup(windows=ladder) must
+        # precompile every size the scheduler can dispatch
+        ladder = tuple(sorted({1} | set(window_ladder)))
         self.engine = engine
         self.max_active = max_active
         self.queue_size = queue_size
+        self.window_ladder = ladder
+        # the in-flight decode window: (DecodeWindow handles, its rows'
+        # sessions in packed order). Owned by the scheduler thread only.
+        self._pending: tuple[DecodeWindow, list[_Session]] | None = None
         self._queue: deque[Request] = deque()
         self._active: list[_Session] = []
         self._lock = threading.Lock()
@@ -119,6 +167,8 @@ class Batcher:
         self.rejected = 0
         self.failed = 0
         self.tokens_generated = 0
+        self.windows_dispatched: dict[int, int] = {}  # K -> dispatch count
+        self.windows_pipelined = 0  # dispatched ahead of a pending fetch
         # liveness heartbeat for /healthz: monotonic timestamp of the last
         # scheduler pass (run-loop cycle or direct step()); None until the
         # scheduler first runs. A dead/stuck scheduler thread stops
@@ -236,10 +286,18 @@ class Batcher:
         return True
 
     def _decode_all(self) -> bool:
+        did = False
+        if self._pending is not None:
+            self._resolve_pending()
+            did = True
+            if self._pending is not None:
+                # pipelined: window i+1 is already in flight — it IS this
+                # iteration's decode work
+                return True
         with self._lock:
             active = list(self._active)
         if not active:
-            return False
+            return did
         for s in active:
             if s.req.cancelled:  # abandoned mid-decode: free the slot now
                 self._retire(s)
@@ -254,6 +312,18 @@ class Batcher:
         groups: dict[tuple, list[_Session]] = {}
         for s in active:
             groups.setdefault(s.req.sampling.key(), []).append(s)
+        # steady-state fast path: the whole active set is one sampling
+        # group in one batch bucket and nobody is waiting to be admitted —
+        # advance K tokens in one program and let the NEXT iteration fetch
+        # them (possibly after dispatching the window after that)
+        if len(groups) == 1 and len(active) <= self.engine.max_batch:
+            with self._lock:
+                queue_empty = not self._queue
+            if queue_empty:
+                k = self._pick_window(min(s.remaining for s in active))
+                if k > 1:
+                    self._dispatch_window(active, k)
+                    return True
         for group in groups.values():
             for i in range(0, len(group), self.engine.max_batch):
                 chunk = group[i : i + self.engine.max_batch]
@@ -262,11 +332,8 @@ class Batcher:
                 try:
                     nxt = self.engine.decode(slots, toks, chunk[0].req.sampling)
                 except Exception as e:
-                    for s in chunk:
-                        self._retire(s)
-                        self.engine.cache.release(s.sid)
-                        self._fail(s.req,
-                                   f"decode failed: {type(e).__name__}: {e}")
+                    self._fail_chunk(
+                        chunk, f"decode failed: {type(e).__name__}: {e}")
                     continue
                 for s, tok in zip(chunk, nxt):
                     self._append_token(s, int(tok))
@@ -275,8 +342,93 @@ class Batcher:
                         self._finish(s)
         return True
 
-    def _append_token(self, s: _Session, tok: int) -> None:
+    # ---- windowed decode (see module docstring) ------------------------
+
+    def _pick_window(self, min_remaining: int) -> int:
+        """Largest ladder rung no session would overshoot (a session
+        within K tokens of its budget forces a smaller K — the on-device
+        budget latch makes overshoot SAFE, this just keeps windows from
+        decoding padding and delaying completion)."""
+        k = 1
+        for w in self.window_ladder:
+            if w <= min_remaining:
+                k = max(k, w)
+        return k
+
+    def _dispatch_window(self, sessions: list[_Session], k: int) -> None:
+        """Dispatch a K-token window for ``sessions`` from host state; the
+        handles park in ``_pending`` for the next iteration's fetch."""
+        try:
+            win = self.engine.decode_window(
+                [s.slot for s in sessions],
+                [s.last_token for s in sessions],
+                [s.remaining for s in sessions],
+                [-1 if s.req.eos_id is None else s.req.eos_id
+                 for s in sessions],
+                sessions[0].req.sampling, window=k,
+            )
+        except Exception as e:
+            self._fail_chunk(sessions, f"decode failed: {type(e).__name__}: {e}")
+            return
+        self.windows_dispatched[k] = self.windows_dispatched.get(k, 0) + 1
+        self._pending = (win, list(sessions))
+
+    def _resolve_pending(self, pipeline: bool = True) -> None:
+        """Resolve the in-flight window: if steady state still holds,
+        dispatch its successor FROM ITS DEVICE HANDLES first (async
+        dispatch — the fetch below then overlaps that window's compute),
+        then fetch and distribute the tokens."""
+        win, sessions = self._pending
+        self._pending = None
+        with self._lock:
+            queue_empty = not self._queue
+            same_rows = self._active == sessions
+        cancelled = any(s.req.cancelled for s in sessions)
+        if pipeline and queue_empty and same_rows and not cancelled:
+            # remaining budgets as of AFTER the unfetched window, assuming
+            # full consumption (rows that EOS'd early are latched frozen on
+            # device, so overestimating their budget is harmless)
+            spec = [s.remaining - win.window for s in sessions]
+            live = [r for r in spec if r > 0]
+            if live:
+                try:
+                    nxt = self.engine.decode_window_next(
+                        win, window=self._pick_window(min(live)))
+                except Exception as e:
+                    self._fail_chunk(
+                        sessions, f"decode failed: {type(e).__name__}: {e}")
+                    return
+                self.windows_dispatched[nxt.window] = (
+                    self.windows_dispatched.get(nxt.window, 0) + 1)
+                self.windows_pipelined += 1
+                self._pending = (nxt, list(sessions))
+        # the pipeline's only sync point: blocks on window i while window
+        # i+1 (if dispatched above) runs on device
+        toks = self.engine.fetch_window(win)
+        now = time.perf_counter()
+        for s, row in zip(sessions, toks):
+            if s.req.cancelled or s.req.done.is_set():
+                continue  # the cancel sweep / a prior window settled it
+            for tok in row:
+                if tok == PAD_TOKEN:
+                    break
+                self._append_token(s, int(tok), now)
+                if s.remaining == 0:
+                    break
+            if s.remaining == 0:
+                self._retire(s)
+                self._finish(s)
+
+    def _fail_chunk(self, sessions: list[_Session], error: str) -> None:
+        for s in sessions:
+            self._retire(s)
+            self.engine.cache.release(s.sid)
+            self._fail(s.req, error)
+
+    def _append_token(self, s: _Session, tok: int,
+                      t: float | None = None) -> None:
         s.req.tokens.append(tok)
+        s.req.t_tokens.append(time.perf_counter() if t is None else t)
         s.last_token = tok
         s.remaining -= 1
         self.tokens_generated += 1
@@ -327,6 +479,12 @@ class Batcher:
             # idle cycles beat the heartbeat too: "no traffic" and "thread
             # stuck" must look different to /healthz
             self.last_heartbeat = time.monotonic()
+        if self._pending is not None:
+            # graceful shutdown: the in-flight window's tokens are already
+            # paid for — deliver them instead of hanging their requests
+            # until client timeout (no follow-up dispatch: queue clients
+            # waiting on THOSE must fail fast at stop, not decode on)
+            self._resolve_pending(pipeline=False)
 
     def stats(self) -> dict:
         with self._lock:
@@ -341,4 +499,7 @@ class Batcher:
             "active": active,
             "max_active": self.max_active,
             "queue_size": self.queue_size,
+            "window_ladder": list(self.window_ladder),
+            "windows_dispatched": dict(self.windows_dispatched),
+            "windows_pipelined": self.windows_pipelined,
         }

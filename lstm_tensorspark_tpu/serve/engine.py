@@ -9,17 +9,37 @@ carries back. Prefill is the same shape of program around the masked
 ends with exactly the state an unpadded run would produce and the first
 sampled token is token-identical to `models/generate.py`.
 
+**Windowed decode** (`decode_window`): the same fused step wrapped in a
+`lax.scan` that advances the packed batch K tokens in ONE XLA program —
+carries are gathered from the cache once at window entry and scattered
+once at exit, so K-fold fewer dispatches, gathers, scatters and host
+round-trips per generated token. Per-row liveness is latched **on
+device**: a row that emits its ``eos_id`` or exhausts its token budget
+freezes its carries for the rest of the window and emits ``PAD_TOKEN``
+(-1), so a window is always safe to run even when rows finish mid-window
+— frozen rows scatter their unchanged carries back. The window program
+returns device HANDLES (:class:`DecodeWindow`), not host arrays: the
+batcher can dispatch window i+1 from window i's ``next_tokens``/
+``alive``/``remaining`` handles *before* fetching window i's tokens
+(`fetch_window`), overlapping host readback and Python token
+distribution with device compute (JAX async dispatch; program order is
+enforced by the cache arrays threading functionally through every
+dispatch).
+
 Recompile discipline (the XLA-on-TPU cost that kills naive serving): every
 host-visible batch is padded to a **bucket** —
 
 - prompts pad to the smallest length bucket that fits (``prefill_buckets``);
 - batches pad to the smallest batch bucket (``batch_buckets``), dead rows
   pointing at the cache's scratch slot;
+- window sizes come from a small fixed ladder chosen by the batcher
+  (e.g. 1/4/8), each a compile key: at most one compile per
+  ``("decode_window", batch-bucket, K, sampling-config)``;
 
-so XLA compiles at most once per (phase, batch-bucket[, length-bucket],
-sampling-config), never per batch composition. `compile_counts` records
-actual traces (incremented at trace time) and is asserted in
-tests/test_serve_batcher.py.
+so XLA compiles at most once per (phase, batch-bucket[, length-bucket]
+[, window], sampling-config), never per batch composition.
+`compile_counts` records actual traces (incremented at trace time) and is
+asserted in tests/test_serve_batcher.py + tests/test_serve_window.py.
 
 Sampling parameters are compile-time constants (they specialize the sampled
 program, exactly as in `make_generate_fn`); the batcher groups requests by
@@ -38,11 +58,17 @@ from collections import defaultdict
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..models.generate import decode_one, fuse_layers, sample_logits
 from ..models.lstm_lm import LMConfig, _head_kernel, lm_backbone
 from ..resilience import faults as _faults
 from .state_cache import DetachedState, StateCache
+
+# Emitted by decode_window for a row that is no longer live (post-EOS /
+# budget-exhausted / batch padding): the host stops distributing a row's
+# tokens at the first PAD_TOKEN. -1 cannot collide with a vocab id.
+PAD_TOKEN = -1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,6 +86,29 @@ class SamplingParams:
 
 
 GREEDY = SamplingParams(greedy=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeWindow:
+    """A dispatched (possibly still in-flight) decode window.
+
+    All array fields are DEVICE handles — nothing here forces a sync.
+    ``tokens`` is the window's output ``[batch_b, window]`` (``PAD_TOKEN``
+    for non-live rows); ``next_tokens``/``alive``/``remaining`` are the
+    row states a follow-up window needs, so :meth:`ServeEngine.
+    decode_window_next` can dispatch window i+1 from window i's handles
+    before the host ever reads window i (`fetch_window`)."""
+
+    tokens: jax.Array       # [batch_b, window] int32, PAD_TOKEN when dead
+    next_tokens: jax.Array  # [batch_b] int32 — input for the next window
+    alive: jax.Array        # [batch_b] bool — rows still decoding
+    remaining: jax.Array    # [batch_b] int32 — per-row budget left
+    slots: jax.Array        # [batch_b] int32 cache slots (reused as-is)
+    eos_ids: jax.Array      # [batch_b] int32, -1 = no eos for that row
+    batch_b: int
+    window: int
+    n: int                  # live (non-padding) rows; fetch strips the rest
+    sampling: SamplingParams
 
 
 def _bucket_for(value: int, buckets: tuple[int, ...], what: str) -> int:
@@ -105,6 +154,7 @@ class ServeEngine:
         self.compile_counts: dict[tuple, int] = defaultdict(int)
         self._prefill_fns: dict[tuple, callable] = {}
         self._decode_fns: dict[tuple, callable] = {}
+        self._decode_window_fns: dict[tuple, callable] = {}
         self._rng = jax.random.PRNGKey(rng_seed)
         self._dummy_rng = jax.random.PRNGKey(0)
         self._lock = threading.RLock()
@@ -226,6 +276,67 @@ class ServeEngine:
         self._decode_fns[key] = fn
         return fn
 
+    def _get_decode_window_fn(self, batch_b: int, window: int,
+                              sampling: SamplingParams):
+        key = (batch_b, window, sampling.key())
+        fn = self._decode_window_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        count_key = ("decode_window", batch_b, window, sampling.key())
+
+        def window_fn(params, fused, h_cache, c_cache, slots, tokens,
+                      alive, remaining, eos_ids, rng):
+            with self._counts_lock:
+                self.compile_counts[count_key] += 1
+            h_in = h_cache[:, slots, :]
+            c_in = c_cache[:, slots, :]
+            carries = [(h_in[l], c_in[l]) for l in range(cfg.num_layers)]
+
+            def step(carry, rng_step):
+                carries, token, alive, remaining = carry
+                logits, new_carries = decode_one(params, fused, cfg,
+                                                 carries, token)
+                nxt = sample_logits(
+                    rng_step, logits, temperature=sampling.temperature,
+                    top_k=sampling.top_k, top_p=sampling.top_p,
+                    greedy=sampling.greedy,
+                )
+                # rows alive at step entry emit this step's token and
+                # commit its carry update (exactly the K=1 semantics:
+                # the EOS-emitting step still writes its carries, the
+                # steps after it never run)
+                emit = alive
+                out_tok = jnp.where(emit, nxt, PAD_TOKEN).astype(jnp.int32)
+                new_remaining = remaining - emit.astype(remaining.dtype)
+                hit_eos = emit & (eos_ids >= 0) & (nxt == eos_ids)
+                new_alive = emit & ~hit_eos & (new_remaining > 0)
+                frozen = [
+                    (jnp.where(emit[:, None], hn, ho),
+                     jnp.where(emit[:, None], cn, co))
+                    for (ho, co), (hn, cn) in zip(carries, new_carries)
+                ]
+                # dead rows feed token 0 onward — their carries are frozen
+                # and their outputs PAD, so the value never matters, but a
+                # PAD_TOKEN (-1) embedding lookup must not happen
+                next_tok = jnp.where(new_alive, nxt, 0).astype(jnp.int32)
+                return (frozen, next_tok, new_alive, new_remaining), out_tok
+
+            rngs = jax.random.split(rng, window)
+            (carries, next_tok, alive_out, rem_out), toks = lax.scan(
+                step, (carries, tokens, alive, remaining), rngs
+            )
+            new_h = jnp.stack([nc[0] for nc in carries])
+            new_c = jnp.stack([nc[1] for nc in carries])
+            h_cache = h_cache.at[:, slots, :].set(new_h.astype(jnp.float32))
+            c_cache = c_cache.at[:, slots, :].set(new_c.astype(jnp.float32))
+            toks = jnp.moveaxis(toks, 0, 1)  # [K, B] → [B, K]
+            return h_cache, c_cache, toks, next_tok, alive_out, rem_out
+
+        fn = jax.jit(window_fn)
+        self._decode_window_fns[key] = fn
+        return fn
+
     # ---- host-facing steps --------------------------------------------
 
     def prefill(self, items, sampling: SamplingParams = GREEDY) -> np.ndarray:
@@ -299,14 +410,102 @@ class ServeEngine:
             self.cache.swap(h, c)
         return np.asarray(tok)[:n]
 
+    def decode_window(self, slots, tokens, remaining, eos_ids=None,
+                      sampling: SamplingParams = GREEDY, *,
+                      window: int) -> DecodeWindow:
+        """Dispatch one K-token decode window and return device HANDLES
+        (no sync — pair with :meth:`fetch_window`).
+
+        ``slots``/``tokens``/``remaining`` are per-row [B] host values
+        (current slot, last emitted token, tokens-of-budget left);
+        ``eos_ids`` [B] uses -1 for "no eos". Rows are padded to the batch
+        bucket (dead rows: scratch slot, alive=False → all-PAD output,
+        frozen carries). Rows latch dead on device when they emit their
+        eos or exhaust ``remaining``, so ``window`` may exceed a row's
+        budget safely."""
+        n = len(slots)
+        if n == 0 or window < 1:
+            raise ValueError(f"decode_window needs rows and window >= 1, "
+                             f"got {n} rows, window {window}")
+        if not self._warming:
+            _faults.serve_decode_hook()
+        self._admit_sampling(sampling)
+        batch_b = _bucket_for(n, self.batch_buckets, "decode batch")
+        slots_p = np.full((batch_b,), self.cache.scratch_slot, np.int32)
+        slots_p[:n] = np.asarray(slots, np.int32)
+        tokens_p = np.zeros((batch_b,), np.int32)
+        tokens_p[:n] = np.asarray(tokens, np.int32)
+        rem_p = np.zeros((batch_b,), np.int32)
+        rem_p[:n] = np.asarray(remaining, np.int32)
+        eos_p = np.full((batch_b,), -1, np.int32)
+        if eos_ids is not None:
+            eos_p[:n] = np.asarray(eos_ids, np.int32)
+        alive_p = np.zeros((batch_b,), bool)
+        alive_p[:n] = rem_p[:n] > 0
+
+        with self._lock:
+            fn = self._get_decode_window_fn(batch_b, window, sampling)
+            rng = self._next_rng(sampling)
+            slots_d = jnp.asarray(slots_p)
+            eos_d = jnp.asarray(eos_p)
+            h, c, toks, next_tok, alive, rem = fn(
+                self.params, self.fused_layers, self.cache.h, self.cache.c,
+                slots_d, jnp.asarray(tokens_p), jnp.asarray(alive_p),
+                jnp.asarray(rem_p), eos_d, rng,
+            )
+            self.cache.swap(h, c)
+        return DecodeWindow(
+            tokens=toks, next_tokens=next_tok, alive=alive, remaining=rem,
+            slots=slots_d, eos_ids=eos_d, batch_b=batch_b, window=window,
+            n=n, sampling=sampling,
+        )
+
+    def decode_window_next(self, prev: DecodeWindow, *,
+                           window: int | None = None) -> DecodeWindow:
+        """Dispatch the follow-up window for the SAME packed rows entirely
+        from ``prev``'s device handles — callable before ``prev`` has been
+        fetched (or even finished computing): this is the dispatch-ahead
+        half of the async-readback pipeline. Rows ``prev`` latched dead
+        stay frozen, so running ahead never corrupts a finished session's
+        cached state."""
+        window = prev.window if window is None else window
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if not self._warming:
+            _faults.serve_decode_hook()
+        with self._lock:
+            fn = self._get_decode_window_fn(prev.batch_b, window,
+                                            prev.sampling)
+            rng = self._next_rng(prev.sampling)
+            h, c, toks, next_tok, alive, rem = fn(
+                self.params, self.fused_layers, self.cache.h, self.cache.c,
+                prev.slots, prev.next_tokens, prev.alive, prev.remaining,
+                prev.eos_ids, rng,
+            )
+            self.cache.swap(h, c)
+        return dataclasses.replace(
+            prev, tokens=toks, next_tokens=next_tok, alive=alive,
+            remaining=rem, window=window,
+        )
+
+    @staticmethod
+    def fetch_window(win: DecodeWindow) -> np.ndarray:
+        """Block until the window's tokens are on host; returns ``[n, K]``
+        int32 (padding rows stripped; ``PAD_TOKEN`` after a row's EOS or
+        budget end). The ONLY sync point of the windowed decode path."""
+        return np.asarray(jax.device_get(win.tokens))[: win.n]
+
     def warmup(self, sampling: SamplingParams = GREEDY,
                prompt_lens: tuple[int, ...] = (1,),
-               batch_sizes: tuple[int, ...] | None = None) -> int:
+               batch_sizes: tuple[int, ...] | None = None,
+               windows: tuple[int, ...] = ()) -> int:
         """Pre-compile the bucket lattice a workload will touch (every
         batch bucket x the length buckets covering ``prompt_lens``, both
-        phases) by running dummy steps against the scratch slot — so the
-        first real traffic burst is never charged the compiles. Returns
-        the number of (phase, bucket) programs now cached."""
+        phases, plus a ``decode_window`` program per batch bucket x each
+        K > 1 in ``windows``) by running dummy steps against the scratch
+        slot — so the first real traffic burst is never charged the
+        compiles. Returns the number of (phase, bucket) programs now
+        cached."""
         batch_sizes = tuple(batch_sizes or self.batch_buckets)
         len_buckets = sorted({
             _bucket_for(t, self.prefill_buckets, "prompt length")
@@ -321,9 +520,21 @@ class ServeEngine:
                     items = [(scratch, True, np.zeros((t,), np.int32))] * bb
                     self.prefill(items, sampling)
                 self.decode([scratch] * bb, [0] * bb, sampling)
+                # every rung compiles as a window program — INCLUDING k=1:
+                # the batcher's sync path uses the fused decode fn for
+                # K=1, but the pipelined window tail dispatches K=1 as a
+                # decode_window, and an unwarmed one would compile in the
+                # middle of serving traffic
+                for k in sorted(set(windows)):
+                    win = self.decode_window(
+                        [scratch] * bb, [0] * bb, [k] * bb,
+                        sampling=sampling, window=k,
+                    )
+                    self.fetch_window(win)
         finally:
             self._warming = False
-        return len(self._prefill_fns) + len(self._decode_fns)
+        return (len(self._prefill_fns) + len(self._decode_fns)
+                + len(self._decode_window_fns))
 
     # ---- session lifecycle (thin wrappers over the cache) -------------
 

@@ -11,7 +11,11 @@ Two standard modes:
   queueing delay and backpressure (429s are counted, not retried).
 
 The report carries request latency p50/p99/mean, time-to-first-token
-p50/p99, aggregate tokens/sec and requests/sec. Phases are wrapped in
+p50/p99, **inter-token latency** p50/p99/max (pooled over every token
+gap of every completed request — the decode-window tradeoff made
+visible: larger K raises tokens/sec AND raises tail ITL, because a
+window's K tokens arrive in one burst after a K-step device program),
+aggregate tokens/sec and requests/sec. Phases are wrapped in
 `utils.tracing` spans, so ``--trace`` on the CLI captures the run.
 
 `concurrency_sweep` runs the same closed-loop workload at increasing
@@ -52,6 +56,10 @@ def _report(results: list[dict], rejected: int, failed: int, wall_s: float,
             mode: str, sessions: int) -> dict:
     lat = sorted(r["latency_s"] for r in results)
     ttft = sorted(r["ttft_s"] for r in results if r["ttft_s"] is not None)
+    # inter-token latency: pooled token-arrival gaps across all requests
+    # (a request with T tokens contributes T-1 gaps; TTFT is reported
+    # separately and is NOT a gap here)
+    itl = sorted(g for r in results for g in r.get("itl_s", ()))
     tokens = sum(r["tokens"] for r in results)
     return {
         "mode": mode,
@@ -67,6 +75,9 @@ def _report(results: list[dict], rejected: int, failed: int, wall_s: float,
             (sum(lat) / len(lat) if lat else float("nan")) * 1e3, 3),
         "p50_ttft_ms": round(_percentile(ttft, 50) * 1e3, 3),
         "p99_ttft_ms": round(_percentile(ttft, 99) * 1e3, 3),
+        "p50_itl_ms": round(_percentile(itl, 50) * 1e3, 3),
+        "p99_itl_ms": round(_percentile(itl, 99) * 1e3, 3),
+        "max_itl_ms": round(max(itl) * 1e3, 3) if itl else float("nan"),
         "tokens_generated": tokens,
         "tokens_per_sec": round(tokens / wall_s, 2) if wall_s > 0 else 0.0,
         "requests_per_sec": round(len(results) / wall_s, 2)
@@ -122,6 +133,7 @@ def run_loadgen(
             "ttft_s": (req.t_first_token - req.t_submit)
             if req.t_first_token and req.t_submit else None,
             "tokens": len(req.tokens),
+            "itl_s": req.itl_gaps(),
         }
         with lock:
             results.append(rec)
@@ -180,7 +192,10 @@ def concurrency_sweep(
     level is charged XLA compiles mid-run). Returns
     ``{"levels": {n: report}, "speedup_max_vs_1": x}``."""
     with span("loadgen_warmup"):
-        server.engine.warmup(sampling, prompt_lens=(prompt_len,))
+        # include the batcher's decode-window ladder so no level is
+        # charged a window compile mid-run either
+        server.engine.warmup(sampling, prompt_lens=(prompt_len,),
+                             windows=server.batcher.window_ladder)
     reports = {}
     for n in levels:
         reports[n] = run_loadgen(

@@ -12,7 +12,11 @@ by both front-ends:
   - ``POST /v1/generate``  body ``{"prompt": [ids], "max_new_tokens": N,
     "greedy": true, "temperature": t, "top_k": k, "top_p": p,
     "session_id": "...", "keep_session": false, "eos_id": null}`` →
-    ``{"tokens": [...], "session_id": "...", "latency_ms": ...}``;
+    ``{"tokens": [...], "session_id": "...", "latency_ms": ...,
+    "ttft_ms": ..., "max_itl_ms": ...}`` (time-to-first-token and the
+    request's worst inter-token gap — windowed decode delivers K tokens
+    per burst, and a client deciding whether to pin ``--decode-window 1``
+    needs to SEE that, not guess it);
   - ``GET /healthz`` → honest liveness: 200 with the scheduler thread's
     heartbeat age while the batcher thread lives, 503 once it is dead or
     never started (a wedged server must fail probes, not smile at them);
@@ -235,10 +239,14 @@ class _Handler(BaseHTTPRequestHandler):
         except TimeoutError as e:
             self._reply(504, {"error": str(e)})
             return
+        gaps = req.itl_gaps()
         self._reply(200, {
             "tokens": list(req.tokens),
             "session_id": req.session_id,
             "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            "ttft_ms": round((req.t_first_token - req.t_submit) * 1e3, 3)
+            if req.t_first_token and req.t_submit else None,
+            "max_itl_ms": round(max(gaps) * 1e3, 3) if gaps else None,
         })
 
 

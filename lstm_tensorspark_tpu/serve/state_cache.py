@@ -22,6 +22,16 @@ plus a host-side session table:
   is exact (tests/test_serve_cache.py proves continued decode is
   token-identical to an uninterrupted run).
 
+Window-grain accounting: with windowed decode (serve/engine.py
+`decode_window`) the cache arrays advance once per WINDOW, not per token,
+and under the batcher's dispatch-ahead pipeline `swap` may install a
+handle whose program has not finished (or started) executing — that is
+safe because every consumer (the next window, a prefill, `detach`)
+receives the handle and is therefore data-ordered after it on device.
+``generation`` counts swaps (device programs applied to the cache), so
+``stats()`` exposes how coarse the update grain actually is:
+``tokens_generated / generation`` ≈ effective window size.
+
 Host-side bookkeeping is lock-protected; device reads/writes are plain
 jnp gather/scatter ops (one compile each per batch-shape, amortised).
 """
@@ -62,6 +72,7 @@ class StateCache:
         self._free: list[int] = list(range(num_slots))
         self._pinned: set[str] = set()
         self.evictions = 0
+        self.generation = 0  # device programs applied via swap()
 
     @property
     def scratch_slot(self) -> int:
@@ -136,8 +147,11 @@ class StateCache:
     # ---- device state --------------------------------------------------
 
     def swap(self, h: jnp.ndarray, c: jnp.ndarray) -> None:
-        """Install updated cache arrays (the jitted step's outputs)."""
+        """Install updated cache arrays (the jitted step's outputs — may
+        still be computing under async dispatch; consumers are
+        data-ordered through the handles)."""
         self.h, self.c = h, c
+        self.generation += 1
 
     def read_slots(self, slots) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Gather carries for ``slots`` [B] → (h, c) each ``[L, B, H]``."""
@@ -196,4 +210,5 @@ class StateCache:
                 "pinned": len(self._pinned),
                 "free": len(self._free),
                 "evictions": self.evictions,
+                "generation": self.generation,
             }

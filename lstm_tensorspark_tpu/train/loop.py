@@ -15,6 +15,7 @@ round-trips per step" (SURVEY.md §2 native-capability table).
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Any, Callable, Iterable, NamedTuple
 
@@ -293,12 +294,20 @@ def evaluate(
     batching — equal-size batches, dropped remainders, or variable-length
     buckets all give the same answer."""
     stateful = carries is not None
-    total, weight = 0.0, 0.0
+    # keep every batch's metric HANDLES and fetch once after the loop:
+    # float(...) inside the loop would block on each batch's device
+    # program (B host round-trips per eval sweep), serializing dispatch
+    # with readback exactly like per-token decode used to. The handles
+    # are O(1) scalars each, so holding B of them is free.
+    handles = []
     for batch in batches:
         if stateful:
             m, carries = eval_step(params, batch, carries)
         else:
             m = eval_step(params, batch)
+        handles.append(m)
+    total, weight = 0.0, 0.0
+    for m in jax.device_get(handles):
         w = float(m["tokens"]) if "tokens" in m else 1.0
         total += float(m["loss"]) * w
         weight += w
@@ -309,9 +318,13 @@ def evaluate(
 def eval_metrics(loss: float) -> dict[str, float]:
     """The ONE loss→metrics mapping shared by host-side `evaluate()` and the
     fused on-device eval (device_step.py) so their records are comparable."""
+    # math.exp, not jnp.exp: the jnp spelling dispatched a whole device
+    # program (and a blocking readback) to exponentiate ONE host scalar
+    # on every eval record
+    loss = float(loss)
     return {
-        "eval_loss": float(loss),
-        "eval_ppl": float(jnp.exp(jnp.minimum(loss, 30.0))),
+        "eval_loss": loss,
+        "eval_ppl": math.exp(min(loss, 30.0)),
     }
 
 

@@ -154,7 +154,11 @@ def test_max_active_cannot_exceed_cache_slots():
 
 
 def test_every_active_session_advances_each_step(engine):
-    batcher = Batcher(engine, max_active=4, queue_size=8)
+    # window_ladder=(1,) pins the per-token path: this test asserts the
+    # EXACT one-token-per-step cadence (the windowed cadence — up to K
+    # tokens per iteration, delivered a step later — is covered by
+    # tests/test_serve_window.py)
+    batcher = Batcher(engine, max_active=4, queue_size=8, window_ladder=(1,))
     a = Request(_prompt(2, 0), 6)
     b = Request(_prompt(3, 1), 6)
     batcher.submit(a)

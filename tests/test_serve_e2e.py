@@ -135,6 +135,17 @@ def test_loadgen_reports_latency_and_throughput(stack):
                 "tokens_per_sec"):
         assert report[key] > 0, (key, report)
     assert report["p99_latency_ms"] >= report["p50_latency_ms"]
+    # inter-token latency is reported SEPARATELY from end-to-end latency
+    # (the decode-window K tradeoff must be visible, not inferred): every
+    # request contributes tokens-1 gaps, and a gap can't exceed the
+    # request's own latency. ITL can be exactly 0.0 — a decode window's
+    # K tokens arrive in one burst and share a timestamp — so assert
+    # presence/ordering, not positivity.
+    for key in ("p50_itl_ms", "p99_itl_ms", "max_itl_ms"):
+        assert report[key] >= 0 and np.isfinite(report[key]), (key, report)
+    assert report["p99_itl_ms"] >= report["p50_itl_ms"]
+    assert report["max_itl_ms"] > 0
+    assert report["max_itl_ms"] <= report["p99_latency_ms"]
 
 
 def test_loadgen_open_loop_counts_backpressure():
