@@ -14,6 +14,7 @@ step; multi-host pods launch the same script once per host with
 from __future__ import annotations
 
 import argparse
+import sys
 
 import jax
 import numpy as np
@@ -1244,6 +1245,27 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "to pin the per-token path (lowest inter-token "
                         "latency; see docs/OPERATIONS.md). Every window "
                         "size is one XLA compile key per batch bucket.")
+    p.add_argument("--prefix-cache", type=str, default="on",
+                   choices=["on", "off"],
+                   help="shared-prompt prefix-state cache: fresh prompts "
+                        "resume prefill from the longest cached prefix "
+                        "(an LSTM prefix state is ONE (h, c) pair — reuse "
+                        "is a slot copy). Greedy output is token-identical "
+                        "on or off; 'off' frees the backing slots "
+                        "(docs/OPERATIONS.md)")
+    p.add_argument("--prefix-stride", type=int, default=8,
+                   help="prefix-cache insert granularity (tokens): entries "
+                        "live at stride-aligned prompt lengths")
+    p.add_argument("--prefix-entries", type=int, default=16,
+                   help="max cached prefix entries (each holds one "
+                        "state-cache slot; LRU beyond this)")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="chunked prefill: consume prompts <= N tokens per "
+                        "program, <= 1 prefill program per scheduler "
+                        "iteration — bounds how long a cold long prompt "
+                        "can stall running sessions' decode (and lifts "
+                        "the prompt-length cap). 0 = off (monolithic "
+                        "bucketed prefill)")
     # --- sampling defaults (selftest is always greedy) ---
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=None)
@@ -1258,9 +1280,23 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    choices=["closed", "open"])
     p.add_argument("--rate", type=float, default=None,
                    help="open-loop arrival rate (req/s)")
-    p.add_argument("--compare", type=str, default="1,8",
-                   help="closed-loop concurrency sweep levels (empty "
-                        "string: single run at --sessions)")
+    p.add_argument("--compare", type=str, default=None,
+                   help="closed-loop concurrency sweep levels (default "
+                        "1,8; empty string: single run at --sessions)")
+    p.add_argument("--shared-prefix-len", type=int, default=0,
+                   help="loadgen: every prompt shares its first N tokens "
+                        "(the shared-system-prompt workload the prefix "
+                        "cache targets); 0 = fully random prompts")
+    p.add_argument("--inject-prompt-len", type=int, default=0,
+                   help="loadgen: submit ONE extra cold request with a "
+                        "prompt this long mid-run (head-of-line-blocking "
+                        "probe, reported separately); 0 = off")
+    p.add_argument("--inject-delay", type=float, default=0.25,
+                   help="seconds into the run to submit the injected "
+                        "request")
+    p.add_argument("--json", type=str, default=None,
+                   help="also write the loadgen report (machine-readable "
+                        "JSON) to this path")
     # --- endpoint / observability ---
     p.add_argument("--host", type=str, default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
@@ -1311,6 +1347,16 @@ def _build_serve_stack(args):
     from .models import LMConfig, init_lm
     from .serve import ServeEngine, ServeServer
 
+    chunk = args.prefill_chunk or None
+    if (chunk is not None and chunk > 0 and args.prefix_cache == "on"
+            and chunk % args.prefix_stride != 0
+            and args.prefix_stride % chunk != 0):
+        # same constraint Batcher.__init__ enforces, checked here so a bad
+        # flag combo fails in ms, before params init / checkpoint restore
+        raise SystemExit(
+            f"--prefill-chunk {chunk} must be a multiple or divisor of "
+            f"--prefix-stride {args.prefix_stride} (chunk stops are "
+            "stride-aligned prefix insert points), or use --prefix-cache off")
     cfg = LMConfig(
         vocab_size=args.vocab_size,
         hidden_size=args.hidden_units,
@@ -1345,10 +1391,14 @@ def _build_serve_stack(args):
                                        "--prefill-buckets"),
         batch_buckets=_parse_buckets(args.batch_buckets, "--batch-buckets"),
         rng_seed=args.seed,
+        prefix_cache=args.prefix_cache == "on",
+        prefix_stride=args.prefix_stride,
+        prefix_entries=args.prefix_entries,
     )
     server = ServeServer(engine, max_active=args.max_active,
                          queue_size=args.queue_size,
-                         window_ladder=_parse_window_ladder(args.decode_window))
+                         window_ladder=_parse_window_ladder(args.decode_window),
+                         prefill_chunk=args.prefill_chunk or None)
     return params, cfg, server
 
 
@@ -1426,12 +1476,27 @@ def _serve_loadgen(args) -> int:
     from .serve import run_loadgen
     from .serve.loadgen import concurrency_sweep
 
+    # fail in milliseconds, not after the full warmup lattice compiles
+    if args.shared_prefix_len and args.shared_prefix_len >= args.prompt_len:
+        print(f"error: --shared-prefix-len {args.shared_prefix_len} must be "
+              f"< --prompt-len {args.prompt_len} (each prompt needs >= 1 "
+              "unshared token)", file=sys.stderr)
+        return 2
     _, cfg, server = _build_serve_stack(args)
     sampling = _serve_sampling(args)
+    # the prefix/inject probes are single-run workloads (the sweep does not
+    # thread them through) — never let the default --compare silently drop
+    # them, and never silently drop an EXPLICIT --compare either
+    probe_run = bool(args.shared_prefix_len or args.inject_prompt_len)
+    if probe_run and args.compare:
+        print("note: --shared-prefix-len/--inject-prompt-len run single-run "
+              f"at --sessions {args.sessions}; ignoring --compare "
+              f"{args.compare!r}", file=sys.stderr)
+    compare = "1,8" if args.compare is None else args.compare
     with server:
-        if args.compare and args.mode == "closed":
+        if compare and args.mode == "closed" and not probe_run:
             levels = tuple(
-                sorted({int(x) for x in args.compare.split(",") if x.strip()}
+                sorted({int(x) for x in compare.split(",") if x.strip()}
                        | {args.sessions})
             )
             out = concurrency_sweep(
@@ -1442,26 +1507,63 @@ def _serve_loadgen(args) -> int:
                 sampling=sampling, seed=args.seed,
             )
         else:
+            lens = {args.prompt_len}
+            # an unchunked inject longer than the largest bucket has no
+            # program to warm — admission rejects it and loadgen reports
+            # it under injected["error"]; warming it would just crash
+            if args.inject_prompt_len and (
+                    server.batcher.prefill_chunk is not None
+                    or args.inject_prompt_len
+                    <= server.batcher.engine.max_prompt_len):
+                lens.add(args.inject_prompt_len)
+            server.warmup(sampling, prompt_lens=tuple(lens))
             out = run_loadgen(
                 server, vocab_size=cfg.vocab_size, sessions=args.sessions,
                 requests_per_session=args.requests_per_session,
                 prompt_len=args.prompt_len,
                 max_new_tokens=args.max_new_tokens,
                 sampling=sampling, mode=args.mode, rate=args.rate,
-                seed=args.seed,
+                seed=args.seed, shared_prefix_len=args.shared_prefix_len,
+                inject_prompt_len=args.inject_prompt_len,
+                inject_delay_s=args.inject_delay,
             )
+    estats = server.engine.stats()
     out["engine"] = {
         "compiles_prefill": server.engine.num_compiles("prefill"),
+        "compiles_prefill_chunk": server.engine.num_compiles("prefill_chunk"),
         "compiles_decode": server.engine.num_compiles("decode"),
         "compiles_decode_window": server.engine.num_compiles("decode_window"),
-        **server.engine.cache.stats(),
+        "compiles_by_key": estats["compiles"],
+        "prefix_cache": estats["prefix_cache"],
+        **estats["cache"],
     }
     bstats = server.batcher.stats()
     out["batcher"] = {
         k: bstats[k]
-        for k in ("window_ladder", "windows_dispatched", "windows_pipelined")
+        for k in ("window_ladder", "windows_dispatched", "windows_pipelined",
+                  "prefill_chunk", "prefill_chunks_dispatched",
+                  "prefix_resumed", "prefix_tokens_saved")
     }
     print(json.dumps(out))
+    # the one-line human summary (stats live in the JSON above)
+    r = out.get("levels", {}).get(args.sessions, out)
+    px = r.get("prefix_cache") or {}
+    print(
+        f"loadgen summary: {r.get('completed', '?')} req, "
+        f"{r.get('tokens_per_sec', '?')} tok/s, "
+        f"ttft p50 {r.get('p50_ttft_ms', '?')} ms, "
+        f"itl p99 {r.get('p99_itl_ms', '?')} ms, "
+        f"prefix hit rate {px.get('hit_rate', 'n/a')}, "
+        f"compiles {out['engine']['compiles_prefill']}p"
+        f"+{out['engine']['compiles_prefill_chunk']}pc"
+        f"+{out['engine']['compiles_decode']}d"
+        f"+{out['engine']['compiles_decode_window']}w, "
+        f"swap generation {out['engine']['generation']}",
+        file=sys.stderr)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        print(f"loadgen: report written to {args.json}", file=sys.stderr)
     return 0
 
 
@@ -1475,9 +1577,8 @@ def _serve_http(args) -> int:
     # flip /healthz 503 on a healthy warming server (an orchestrator would
     # then kill-loop it). Selftest/loadgen warm implicitly; --http must too.
     print("serve: warming the compile lattice...", flush=True)
-    n = server.engine.warmup(_serve_sampling(args),
-                             prompt_lens=tuple(server.engine.prefill_buckets),
-                             windows=server.batcher.window_ladder)
+    n = server.warmup(_serve_sampling(args),
+                      prompt_lens=tuple(server.engine.prefill_buckets))
     print(f"serve: {n} programs compiled", flush=True)
     httpd = make_http_server(server, args.host, args.port)
     host, port = httpd.server_address[:2]

@@ -7,16 +7,25 @@ package turns the repo's training LM + one-shot sampler (models/generate.py)
 into a serving engine:
 
 - ``state_cache``: slot-based device-resident cache of per-session carries
-  (LRU eviction, explicit detach/restore);
+  (LRU eviction, explicit detach/restore), plus ``PrefixCache`` — a
+  shared-prompt prefix store (state after ``prompt[:k]`` is ONE (h, c)
+  pair: exact prefix reuse is a slot copy) with longest-match lookup,
+  refcounted backing slots, and LRU eviction that invalidates dependent
+  entries;
 - ``engine``: bucketed jitted prefill/decode programs over the cache —
   compile count bounded per (phase, bucket[, window], sampling), never
   per batch composition — including ``decode_window``: K tokens per XLA
   program with on-device per-row EOS/budget latching, returned as device
-  handles so readback can be pipelined;
+  handles so readback can be pipelined; prefill gathers from per-row src
+  slots (resume at any offset from a cached prefix) and ``prefill_chunk``
+  consumes a bounded slice of prompt per program;
 - ``batcher``: continuous-batching scheduler (admission control, bounded
   queue backpressure, round-robin decode fairness) with an adaptive
-  decode-window ladder and dispatch-ahead async readback (window i+1 is
-  dispatched before window i's tokens are fetched);
+  decode-window ladder, dispatch-ahead async readback (window i+1 is
+  dispatched before window i's tokens are fetched), prefix-cache
+  admission (fresh prompts resume from their longest cached prefix) and
+  chunked prefill (<= one bounded prefill program per scheduler
+  iteration — a long prompt cannot stall running sessions' decode);
 - ``server``: stdlib ThreadingHTTPServer JSON endpoint + in-process client;
 - ``loadgen``: closed/open-loop load generator (p50/p99 request latency,
   TTFT, inter-token latency, tokens/s).
@@ -24,7 +33,7 @@ into a serving engine:
 CLI: ``python -m lstm_tensorspark_tpu.cli serve --selftest`` (see cli.py).
 """
 
-from .state_cache import CacheFullError, StateCache
+from .state_cache import CacheFullError, PrefixCache, StateCache
 from .engine import PAD_TOKEN, DecodeWindow, SamplingParams, ServeEngine
 from .batcher import Batcher, QueueFullError, Request
 from .server import InprocessClient, ServeServer
@@ -36,6 +45,7 @@ __all__ = [
     "DecodeWindow",
     "InprocessClient",
     "PAD_TOKEN",
+    "PrefixCache",
     "QueueFullError",
     "Request",
     "SamplingParams",

@@ -1,16 +1,37 @@
 """Continuous-batching scheduler over the serve engine.
 
-One scheduler iteration (:meth:`Batcher.step`) does two things, in order:
+One scheduler iteration (:meth:`Batcher.step`) does three things, in order:
 
-1. **admission** — pop queued requests FIFO into one bucketed prefill
-   batch (same sampling config; capped by ``max_active`` and the engine's
-   batch bucket), allocate/pin their cache slots, run prefill → each new
-   session's first token;
-2. **decode** — advance EVERY active session, packed into bucketed decode
-   batches grouped by sampling config. In steady state (empty queue, one
-   sampling group that fits one batch bucket) the advance is a **decode
-   window**: K tokens in one XLA program (``window_ladder``, K chosen
-   adaptively), dispatched ahead of the previous window's readback.
+1. **admission** — pop queued requests FIFO (same sampling config; capped
+   by ``max_active`` and the engine's batch bucket), allocate/pin their
+   cache slots, look up the **prefix cache** (``engine.prefix``, when
+   enabled): a fresh prompt sharing a cached prefix resumes prefill at
+   the matched offset from the prefix entry's slot instead of re-running
+   the shared tokens — O(1) reuse of e.g. a system prompt thousands of
+   sessions share;
+2. **prefill** — dispatch prefill work for admitted sessions. Without
+   ``prefill_chunk`` the whole remaining prompt runs now (one program,
+   plus one head-less chunk when a prefix-insert split is due). With
+   ``prefill_chunk=C`` at most ONE bounded program (<= C tokens per row)
+   is dispatched per iteration, so a bucket-128 prompt's prefill
+   interleaves with decode instead of stalling every running session
+   behind one monolithic program (head-of-line ITL);
+3. **decode** — advance EVERY active session, packed into bucketed decode
+   batches grouped by sampling config. In steady state (empty queue, no
+   prefill in flight, one sampling group that fits one batch bucket) the
+   advance is a **decode window**: K tokens in one XLA program
+   (``window_ladder``, K chosen adaptively), dispatched ahead of the
+   previous window's readback.
+
+Prefix-cache discipline: lookups ref-hold the matched entry (its backing
+slot is pinned) until the resumed prefill is DISPATCHED — device data
+ordering through the cache arrays covers the rest. Insertion is canonical:
+a fresh prompt passing its stride boundary ``k`` snapshots the state after
+``prompt[:k]`` into a new entry (one O(1) slot copy) exactly once; session
+continuations (``session_id`` reuse) neither match nor insert, since their
+prompt fragments are not absolute prefixes. Greedy output is
+token-identical with the cache on (cold or hot), off, or chunked
+(tests/test_serve_prefix.py).
 
 **Adaptive windowing + async readback** (the per-token host-round-trip
 killer): K falls back to 1 whenever the submit queue is non-empty or any
@@ -52,6 +73,7 @@ from collections import deque
 import numpy as np
 
 from .engine import GREEDY, PAD_TOKEN, DecodeWindow, SamplingParams, ServeEngine
+from .state_cache import PREFIX_SID_NAMESPACE
 
 
 class QueueFullError(RuntimeError):
@@ -73,6 +95,7 @@ class Request:
         session_id: str | None = None,
         keep_session: bool = False,
         eos_id: int | None = None,
+        use_prefix: bool = True,
     ):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
@@ -81,9 +104,18 @@ class Request:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         self.max_new_tokens = int(max_new_tokens)
         self.sampling = sampling
+        if session_id is not None and session_id.startswith(PREFIX_SID_NAMESPACE):
+            # the prefix cache's backing slots live in this namespace — a
+            # client naming one would inherit (and corrupt) shared state
+            raise ValueError(
+                f"session_id namespace {PREFIX_SID_NAMESPACE!r} is reserved")
         self.session_id = session_id
         self.keep_session = keep_session
         self.eos_id = eos_id
+        # opt-out of prefix-cache lookup AND insert for this request —
+        # measurement probes must not perturb (or be flattered by) the
+        # shared cache
+        self.use_prefix = use_prefix
         self.id = next(Request._ids)
         self.tokens: list[int] = []
         self.error: str | None = None
@@ -119,6 +151,30 @@ class _Session:
         self.last_token = 0
 
 
+class _Prefilling:
+    """An admitted session whose prompt is not fully consumed yet.
+
+    ``pos`` counts consumed prompt tokens; ``entry`` is the ref-held
+    prefix-cache entry the FIRST dispatch gathers from (released, and set
+    to None, once that dispatch is in flight); ``was_fresh`` records
+    whether the session started stateless — only such sessions' prompts
+    are absolute prefixes eligible for prefix-cache insertion."""
+
+    __slots__ = ("sess", "pos", "entry", "was_fresh")
+
+    def __init__(self, sess: _Session, pos: int, entry, was_fresh: bool):
+        self.sess = sess
+        self.pos = pos
+        self.entry = entry
+        self.was_fresh = was_fresh
+
+    def src(self) -> tuple[int, bool]:
+        """(src_slot, fresh) for the next prefill dispatch."""
+        if self.entry is not None:
+            return self.entry.slot, False
+        return self.sess.slot, self.was_fresh and self.pos == 0
+
+
 class Batcher:
     #: default decode-window ladder: every K is a compile key, so the
     #: lattice stays tiny; (1,) disables windowing (pure K=1 path).
@@ -131,6 +187,7 @@ class Batcher:
         max_active: int = 16,
         queue_size: int = 64,
         window_ladder: tuple[int, ...] = DEFAULT_WINDOW_LADDER,
+        prefill_chunk: int | None = None,
     ):
         if max_active < 1:
             raise ValueError(f"max_active must be >= 1, got {max_active}")
@@ -146,6 +203,25 @@ class Batcher:
             raise ValueError(
                 f"window_ladder needs positive window sizes, got "
                 f"{window_ladder!r}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 or None, got {prefill_chunk}")
+        if prefill_chunk is not None and prefill_chunk > engine.max_prompt_len:
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} exceeds the largest prefill "
+                f"bucket {engine.max_prompt_len} — each chunk is one bucketed "
+                "program")
+        if (prefill_chunk is not None and engine.prefix is not None
+                and prefill_chunk % engine.prefix.stride != 0
+                and engine.prefix.stride % prefill_chunk != 0):
+            # _stop_from stride-aligns every pre-boundary stop, so an
+            # incompatible chunk is silently truncated each dispatch — the
+            # operator gets a smaller effective chunk than configured
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} is not a multiple or divisor "
+                f"of prefix stride {engine.prefix.stride} — chunks would be "
+                "truncated to stride alignment; pick a compatible chunk or "
+                "disable the prefix cache")
         # rung 1 is always present: _pick_window falls back to it (near
         # budget end, pipelined tails), and warmup(windows=ladder) must
         # precompile every size the scheduler can dispatch
@@ -154,6 +230,10 @@ class Batcher:
         self.max_active = max_active
         self.queue_size = queue_size
         self.window_ladder = ladder
+        self.prefill_chunk = prefill_chunk
+        # admitted sessions still consuming their prompt (FIFO; owned by
+        # the scheduler thread — the lock only covers reads from stats())
+        self._prefilling: list[_Prefilling] = []
         # the in-flight decode window: (DecodeWindow handles, its rows'
         # sessions in packed order). Owned by the scheduler thread only.
         self._pending: tuple[DecodeWindow, list[_Session]] | None = None
@@ -169,6 +249,9 @@ class Batcher:
         self.tokens_generated = 0
         self.windows_dispatched: dict[int, int] = {}  # K -> dispatch count
         self.windows_pipelined = 0  # dispatched ahead of a pending fetch
+        self.prefill_chunks_dispatched = 0  # head-less chunk programs
+        self.prefix_resumed = 0  # sessions that resumed from a prefix hit
+        self.prefix_tokens_saved = 0  # prompt tokens skipped via the cache
         # liveness heartbeat for /healthz: monotonic timestamp of the last
         # scheduler pass (run-loop cycle or direct step()); None until the
         # scheduler first runs. A dead/stuck scheduler thread stops
@@ -180,10 +263,15 @@ class Batcher:
     def submit(self, req: Request) -> None:
         """Enqueue a request, or raise :class:`QueueFullError` (bounded
         queue — the backpressure boundary)."""
-        if req.prompt.size > self.engine.max_prompt_len:
+        if (self.prefill_chunk is None
+                and req.prompt.size > self.engine.max_prompt_len):
+            # chunked prefill lifts this cap: any prompt length is consumed
+            # prefill_chunk tokens per dispatch, so no single program ever
+            # exceeds the bucket lattice
             raise ValueError(
                 f"prompt length {req.prompt.size} exceeds the engine's "
-                f"largest prefill bucket {self.engine.max_prompt_len}"
+                f"largest prefill bucket {self.engine.max_prompt_len} "
+                "(enable prefill_chunk to serve longer prompts)"
             )
         with self._lock:
             if len(self._queue) >= self.queue_size:
@@ -199,10 +287,12 @@ class Batcher:
     # ---- scheduler side ------------------------------------------------
 
     def step(self) -> bool:
-        """One scheduler iteration (admission + one decode token for every
-        active session). Returns True when any work was done."""
+        """One scheduler iteration (admission + bounded prefill progress +
+        a decode advance for every active session). Returns True when any
+        work was done."""
         self.last_heartbeat = time.monotonic()
         did = self._admit()
+        did = self._prefill_step() or did
         did = self._decode_all() or did
         return did
 
@@ -210,8 +300,10 @@ class Batcher:
         admit: list[Request] = []
         with self._lock:
             busy_sids = {s.sid for s in self._active}
+            busy_sids.update(p.sess.sid for p in self._prefilling)
             capacity = min(
-                self.max_active - len(self._active), self.engine.max_batch
+                self.max_active - len(self._active) - len(self._prefilling),
+                self.engine.max_batch,
             )
             while self._queue and len(admit) < capacity:
                 head = self._queue[0]
@@ -229,7 +321,6 @@ class Batcher:
         if not admit:
             return False
 
-        sessions, items = [], []
         for req in admit:
             sid = req.session_id
             if sid is None:
@@ -262,28 +353,200 @@ class Batcher:
                                 "never created; re-send the full prompt)")
                 continue
             self.engine.cache.pin(sid)
-            sessions.append(_Session(req, sid, slot))
-            items.append((slot, fresh, req.prompt))
+            sess = _Session(req, sid, slot)
+            # prefix-cache lookup: fresh sessions only (a continuation's
+            # prompt is a fragment, not an absolute prefix). The hit is
+            # ref-held until its resumed prefill is dispatched.
+            entry, matched = None, 0
+            if fresh and req.use_prefix and self.engine.prefix is not None:
+                entry, matched = self.engine.prefix.lookup(req.prompt)
+            with self._lock:
+                self._prefilling.append(
+                    _Prefilling(sess, matched, entry, fresh))
+        # dispatching happens in _prefill_step — same step() iteration, so
+        # an unchunked admission still prefills (and gets TTFT) right here
+        return True
 
-        if not items:
-            return True  # all admissions failed; queue drained some
+    # ---- prefill scheduling (chunked + prefix-resumed; see module doc) --
+
+    def _next_stop(self, p: _Prefilling) -> int:
+        """Prompt position the next dispatch advances ``p`` to: the prompt
+        end, capped by the chunk size. With the prefix cache on, stops are
+        stride-ALIGNED: every stop is a potential (deduped) insert point,
+        so chunked prefill caches a shared prefix at block granularity —
+        and without chunking, the single split lands at the largest stride
+        boundary (the state after ``prompt[:k]`` must exist in the
+        session's own slot for the one-copy insert)."""
+        # opt-out requests never insert, so never pay the insert-boundary
+        # split either — their prefill is the plain monolithic/chunked one
+        return self._stop_from(p.pos, p.sess.req.prompt.size,
+                               p.was_fresh and p.sess.req.use_prefix)
+
+    def _stop_from(self, pos: int, total: int, fresh: bool) -> int:
+        """Pure arithmetic core of :meth:`_next_stop` — also replayed by
+        :meth:`warmup` to enumerate the exact program lengths this
+        scheduler will dispatch for a prompt length."""
+        stop = total
+        if self.prefill_chunk is not None:
+            stop = min(stop, pos + self.prefill_chunk)
+        if self.engine.prefix is not None and fresh:
+            k = self.engine.prefix.boundary(total)
+            if pos < k:
+                # never run past the last insertable boundary in one
+                # dispatch, and keep chunk stops stride-aligned — every
+                # stop is then an insert point
+                stop = min(stop, k)
+                if self.prefill_chunk is not None:
+                    aligned = (stop // self.engine.prefix.stride
+                               ) * self.engine.prefix.stride
+                    if aligned > pos:
+                        stop = aligned
+        return stop
+
+    def warmup(self, sampling: SamplingParams = GREEDY,
+               prompt_lens: tuple[int, ...] = (1,)) -> int:
+        """Pre-compile every program this scheduler can dispatch for the
+        given prompt lengths. ``engine.warmup`` alone cannot know the
+        chunk and prefix-insert split lengths — those are scheduler
+        policy — so this replays :meth:`_stop_from`'s stop sequence per
+        length (a cold fresh prompt, a fresh prompt resumed from a full
+        prefix hit, and a continuation fragment) and warms the union of
+        (phase, length) programs plus the window ladder. Callers should
+        use this — or :meth:`ServeServer.warmup` — instead of calling
+        the engine directly, or first traffic gets charged mid-run XLA
+        compiles for the split programs."""
+        finals: set[int] = set()
+        chunks: set[int] = set()
+        prefix = self.engine.prefix
+        for t in prompt_lens:
+            t = max(1, int(t))
+            # (start position, was_fresh) dispatch sequences to replay —
+            # longest-match lookup can resume from ANY stride multiple up
+            # to boundary(t), not just the full boundary, so every such
+            # start must be replayed or a partial hit's remainder length
+            # dispatches an unwarmed program
+            starts = {(0, True), (0, False)}
+            if prefix is not None:
+                for k in range(prefix.stride, prefix.boundary(t) + 1,
+                               prefix.stride):
+                    starts.add((k, True))
+            # _stop_from is pure in (pos, fresh) for a given t, so every
+            # start's chain merges onto positions already walked — stop at
+            # the first visited one or replay is O(t^2/(stride*chunk))
+            seen: set[tuple[int, bool]] = set()
+            for pos, fresh in starts:
+                while pos < t and (pos, fresh) not in seen:
+                    seen.add((pos, fresh))
+                    stop = self._stop_from(pos, t, fresh)
+                    (finals if stop >= t else chunks).add(stop - pos)
+                    pos = stop
+        return self.engine.warmup(
+            sampling, prompt_lens=tuple(sorted(finals)),
+            windows=self.window_ladder,
+            chunk_lens=tuple(sorted(chunks)))
+
+    def _select_prefill_batch(self) -> tuple[list[_Prefilling], bool]:
+        """FIFO-fair batch selection: the HEAD of the prefilling list
+        always progresses (a stream of short prompts cannot starve a long
+        prompt's chunks); compatible rows ride along — same phase
+        (final/intermediate), and for finals the same sampling config
+        (intermediate chunks are sampling-free programs)."""
+        head = self._prefilling[0]
+        final = self._next_stop(head) >= head.sess.req.prompt.size
+        skey = head.sess.req.sampling.key()
+        batch = []
+        for p in self._prefilling:
+            if len(batch) >= self.engine.max_batch:
+                break
+            if (self._next_stop(p) >= p.sess.req.prompt.size) != final:
+                continue
+            if final and p.sess.req.sampling.key() != skey:
+                continue
+            batch.append(p)
+        return batch, final
+
+    def _prefill_step(self) -> bool:
+        """Advance prompt consumption. Unchunked: run every pending
+        prefill to completion now. Chunked: dispatch exactly ONE bounded
+        program (<= prefill_chunk tokens per row) and return — decode
+        interleaves between chunks, so a long prompt can only delay
+        running sessions by one chunk's latency per token."""
+        if not self._prefilling:
+            return False
+        for p in list(self._prefilling):
+            if p.sess.req.cancelled:
+                self._abort_prefilling(p, "cancelled during prefill")
+        while self._prefilling:
+            batch, final = self._select_prefill_batch()
+            self._dispatch_prefill(batch, final)
+            if self.prefill_chunk is not None:
+                break  # one bounded dispatch per scheduler iteration
+        return True
+
+    def _dispatch_prefill(self, batch: list[_Prefilling], final: bool) -> None:
+        prefix = self.engine.prefix
+        items = []
+        for p in batch:
+            stop = self._next_stop(p)
+            # stride-aligned insert point: the state after prompt[:pos]
+            # sits in the session's own slot — one O(1) device copy caches
+            # it for every future sharer (insert() dedups existing keys
+            # itself, refreshing their LRU recency; rows resuming FROM an
+            # entry this dispatch have p.entry set and skip)
+            if (prefix is not None and p.was_fresh and p.entry is None
+                    and p.sess.req.use_prefix
+                    and p.pos >= prefix.stride
+                    and p.pos % prefix.stride == 0):
+                prefix.insert(p.sess.req.prompt[: p.pos], p.sess.slot)
+            src_slot, fresh = p.src()
+            items.append((p.sess.slot, src_slot, fresh,
+                          p.sess.req.prompt[p.pos: stop]))
         try:
-            first = self.engine.prefill(items, admit[0].sampling)
+            if final:
+                first = self.engine.prefill(items, batch[0].sess.req.sampling)
+            else:
+                self.engine.prefill_chunk(items)
+                self.prefill_chunks_dispatched += 1
         except Exception as e:
-            for s in sessions:
-                self.engine.cache.release(s.sid)
-                self._fail(s.req, f"prefill failed: {type(e).__name__}: {e}")
-            return True
+            for p in batch:
+                self._abort_prefilling(
+                    p, f"prefill failed: {type(e).__name__}: {e}")
+            return
         now = time.perf_counter()
-        for s, tok in zip(sessions, first):
+        for i, p in enumerate(batch):
+            # the gather from a prefix slot is in flight and data-ordered:
+            # the ref can drop now — and only now did the resume actually
+            # happen (an aborted session must not count as savings)
+            if p.entry is not None:
+                self.prefix_resumed += 1
+                self.prefix_tokens_saved += p.pos
+                prefix.release(p.entry)
+                p.entry = None
+            if not final:
+                p.pos = self._next_stop(p)
+                continue
+            with self._lock:
+                self._prefilling.remove(p)
+            s = p.sess
             s.req.t_first_token = now
-            self._append_token(s, int(tok))
+            self._append_token(s, int(first[i]))
             if s.remaining == 0:
                 self._finish(s)
             else:
                 with self._lock:
                     self._active.append(s)
-        return True
+
+    def _abort_prefilling(self, p: _Prefilling, error: str) -> None:
+        with self._lock:
+            try:
+                self._prefilling.remove(p)
+            except ValueError:
+                return  # already settled
+        if p.entry is not None:
+            self.engine.prefix.release(p.entry)
+            p.entry = None
+        self.engine.cache.release(p.sess.sid)
+        self._fail(p.sess.req, error)
 
     def _decode_all(self) -> bool:
         did = False
@@ -318,7 +581,10 @@ class Batcher:
         # them (possibly after dispatching the window after that)
         if len(groups) == 1 and len(active) <= self.engine.max_batch:
             with self._lock:
-                queue_empty = not self._queue
+                # a non-empty prefilling set pins K=1 like a non-empty
+                # queue: decode must yield to the next prefill chunk every
+                # iteration, or chunking's bounded-stall guarantee dies
+                queue_empty = not self._queue and not self._prefilling
             if queue_empty:
                 k = self._pick_window(min(s.remaining for s in active))
                 if k > 1:
@@ -381,7 +647,7 @@ class Batcher:
         win, sessions = self._pending
         self._pending = None
         with self._lock:
-            queue_empty = not self._queue
+            queue_empty = not self._queue and not self._prefilling
             same_rows = self._active == sessions
         cancelled = any(s.req.cancelled for s in sessions)
         if pipeline and queue_empty and same_rows and not cancelled:
@@ -485,10 +751,15 @@ class Batcher:
             # until client timeout (no follow-up dispatch: queue clients
             # waiting on THOSE must fail fast at stop, not decode on)
             self._resolve_pending(pipeline=False)
+        # same fail-fast rule for mid-prefill sessions: a chunked prefill
+        # spans many iterations, and nothing else settles its request
+        for p in list(self._prefilling):
+            self._abort_prefilling(p, "server stopped during prefill")
 
     def stats(self) -> dict:
         with self._lock:
             queued, active = len(self._queue), len(self._active)
+            prefilling = len(self._prefilling)
         return {
             "submitted": self.submitted,
             "completed": self.completed,
@@ -497,9 +768,14 @@ class Batcher:
             "tokens_generated": self.tokens_generated,
             "queued": queued,
             "active": active,
+            "prefilling": prefilling,
             "max_active": self.max_active,
             "queue_size": self.queue_size,
             "window_ladder": list(self.window_ladder),
             "windows_dispatched": dict(self.windows_dispatched),
             "windows_pipelined": self.windows_pipelined,
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunks_dispatched": self.prefill_chunks_dispatched,
+            "prefix_resumed": self.prefix_resumed,
+            "prefix_tokens_saved": self.prefix_tokens_saved,
         }

@@ -26,6 +26,18 @@ distribution with device compute (JAX async dispatch; program order is
 enforced by the cache arrays threading functionally through every
 dispatch).
 
+**Resumable / chunked prefill**: every prefill program gathers carries
+from per-row ``src`` slots and scatters to ``dst`` slots. With src == dst
+that is the classic in-place prefill; with src pointing at a
+prefix-cache slot (state_cache.PrefixCache) the program resumes prefill
+at an arbitrary prompt offset from a cached carry — the src slot is
+READ-ONLY in the program, so a shared prefix is never aliased by a
+session's writes. ``prefill_chunk`` is the head-less variant
+(consume up to C tokens, scatter state, sample nothing): the batcher
+chains chunk programs — one bounded dispatch per scheduler iteration —
+so a bucket-128 prompt no longer stalls every running session's decode
+behind one monolithic prefill program.
+
 Recompile discipline (the XLA-on-TPU cost that kills naive serving): every
 host-visible batch is padded to a **bucket** —
 
@@ -35,6 +47,9 @@ host-visible batch is padded to a **bucket** —
 - window sizes come from a small fixed ladder chosen by the batcher
   (e.g. 1/4/8), each a compile key: at most one compile per
   ``("decode_window", batch-bucket, K, sampling-config)``;
+- intermediate prefill chunks are sampling-free: one compile per
+  ``("prefill_chunk", batch-bucket, length-bucket)`` across ALL sampling
+  configs;
 
 so XLA compiles at most once per (phase, batch-bucket[, length-bucket]
 [, window], sampling-config), never per batch composition.
@@ -63,7 +78,7 @@ from jax import lax
 from ..models.generate import decode_one, fuse_layers, sample_logits
 from ..models.lstm_lm import LMConfig, _head_kernel, lm_backbone
 from ..resilience import faults as _faults
-from .state_cache import DetachedState, StateCache
+from .state_cache import DetachedState, PrefixCache, StateCache
 
 # Emitted by decode_window for a row that is no longer live (post-EOS /
 # budget-exhausted / batch padding): the host stops distributing a row's
@@ -134,6 +149,9 @@ class ServeEngine:
         batch_buckets: tuple[int, ...] = (1, 2, 4, 8, 16),
         max_sampling_configs: int = 16,
         rng_seed: int = 0,
+        prefix_cache: bool = False,
+        prefix_stride: int = 8,
+        prefix_entries: int = 16,
     ):
         # serving never rematerialises (same override as generate())
         if cfg.remat_chunk is not None:
@@ -144,6 +162,14 @@ class ServeEngine:
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         self.batch_buckets = tuple(sorted(batch_buckets))
         self.cache = StateCache(cfg.num_layers, num_slots, cfg.hidden_size)
+        # shared-prompt prefix reuse (state_cache.PrefixCache): opt-in at
+        # engine construction; the batcher consults engine.prefix on every
+        # fresh admission when present
+        self.prefix = (
+            PrefixCache(self.cache, stride=prefix_stride,
+                        max_entries=prefix_entries)
+            if prefix_cache else None
+        )
         # sampling params are compile keys and client-controlled at the
         # HTTP boundary: bound how many distinct configs this engine will
         # ever compile, or a client sweeping temperatures could thrash
@@ -153,6 +179,7 @@ class ServeEngine:
         self._sampling_keys: set[tuple] = set()
         self.compile_counts: dict[tuple, int] = defaultdict(int)
         self._prefill_fns: dict[tuple, callable] = {}
+        self._prefill_chunk_fns: dict[tuple, callable] = {}
         self._decode_fns: dict[tuple, callable] = {}
         self._decode_window_fns: dict[tuple, callable] = {}
         self._rng = jax.random.PRNGKey(rng_seed)
@@ -195,6 +222,35 @@ class ServeEngine:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
+    def _consume_prompt(self, h_cache, c_cache, params, src_slots, dst_slots,
+                        fresh, prompts, lengths, len_b):
+        """Shared traced body of BOTH prefill programs: gather carries
+        FROM src (a prefix-cache slot for resumed prefill, the session's
+        own slot otherwise), consume the masked prompt tokens, and scatter
+        the advanced state TO dst. The prefix slot is read-only in the
+        program, so a refcounted prefix entry is never aliased by a
+        session's writes. Returns the updated cache arrays plus the
+        per-position backbone outputs ``ys`` — the final program's head
+        reads them; the chunk program drops them (XLA dead-code-eliminates
+        the head-side compute)."""
+        cfg = self.cfg
+        h_in = h_cache[:, src_slots, :]  # [L, B, H]
+        c_in = c_cache[:, src_slots, :]
+        # fresh rows start from zero state — no device-side slot
+        # zeroing on acquire, the zero rides along in this program
+        live = ~fresh[None, :, None]
+        h_in = jnp.where(live, h_in, 0.0)
+        c_in = jnp.where(live, c_in, 0.0)
+        carries = [(h_in[l], c_in[l]) for l in range(cfg.num_layers)]
+        mask = jnp.arange(len_b)[None, :] < lengths[:, None]  # [B, T]
+        finals, ys = lm_backbone(params, prompts, cfg, carries=carries,
+                                 mask=mask)
+        new_h = jnp.stack([f[0] for f in finals])  # [L, B, H]
+        new_c = jnp.stack([f[1] for f in finals])
+        h_cache = h_cache.at[:, dst_slots, :].set(new_h.astype(jnp.float32))
+        c_cache = c_cache.at[:, dst_slots, :].set(new_c.astype(jnp.float32))
+        return h_cache, c_cache, ys
+
     def _get_prefill_fn(self, batch_b: int, len_b: int, sampling: SamplingParams):
         key = (batch_b, len_b, sampling.key())
         fn = self._prefill_fns.get(key)
@@ -203,22 +259,14 @@ class ServeEngine:
         cfg = self.cfg
         count_key = ("prefill", batch_b, len_b, sampling.key())
 
-        def prefill_fn(params, h_cache, c_cache, slots, fresh, prompts,
-                       lengths, rng):
+        def prefill_fn(params, h_cache, c_cache, src_slots, dst_slots,
+                       fresh, prompts, lengths, rng):
             # trace-time side effect: one bump per XLA compile of this shape
             with self._counts_lock:
                 self.compile_counts[count_key] += 1
-            h_in = h_cache[:, slots, :]  # [L, B, H]
-            c_in = c_cache[:, slots, :]
-            # fresh rows start from zero state — no device-side slot
-            # zeroing on acquire, the zero ride along in this program
-            live = ~fresh[None, :, None]
-            h_in = jnp.where(live, h_in, 0.0)
-            c_in = jnp.where(live, c_in, 0.0)
-            carries = [(h_in[l], c_in[l]) for l in range(cfg.num_layers)]
-            mask = jnp.arange(len_b)[None, :] < lengths[:, None]  # [B, T]
-            finals, ys = lm_backbone(params, prompts, cfg, carries=carries,
-                                     mask=mask)
+            h_cache, c_cache, ys = self._consume_prompt(
+                h_cache, c_cache, params, src_slots, dst_slots, fresh,
+                prompts, lengths, len_b)
             # logits at each row's true last position (same head math, same
             # ldtype as lm_forward — near-tied logits must argmax alike)
             last = jnp.take_along_axis(
@@ -235,14 +283,35 @@ class ServeEngine:
                 top_k=sampling.top_k, top_p=sampling.top_p,
                 greedy=sampling.greedy,
             )
-            new_h = jnp.stack([f[0] for f in finals])  # [L, B, H]
-            new_c = jnp.stack([f[1] for f in finals])
-            h_cache = h_cache.at[:, slots, :].set(new_h.astype(jnp.float32))
-            c_cache = c_cache.at[:, slots, :].set(new_c.astype(jnp.float32))
             return h_cache, c_cache, token
 
         fn = jax.jit(prefill_fn)
         self._prefill_fns[key] = fn
+        return fn
+
+    def _get_prefill_chunk_fn(self, batch_b: int, len_b: int):
+        """An intermediate prefill chunk: consume up to ``len_b`` prompt
+        tokens from a gathered state and scatter the advanced state — no
+        head, no sampling (the final chunk's program does those), so one
+        compile per ("prefill_chunk", batch-bucket, length-bucket) covers
+        EVERY sampling config."""
+        key = (batch_b, len_b)
+        fn = self._prefill_chunk_fns.get(key)
+        if fn is not None:
+            return fn
+        count_key = ("prefill_chunk", batch_b, len_b)
+
+        def chunk_fn(params, h_cache, c_cache, src_slots, dst_slots, fresh,
+                     prompts, lengths):
+            with self._counts_lock:
+                self.compile_counts[count_key] += 1
+            h_cache, c_cache, _ = self._consume_prompt(
+                h_cache, c_cache, params, src_slots, dst_slots, fresh,
+                prompts, lengths, len_b)
+            return h_cache, c_cache
+
+        fn = jax.jit(chunk_fn)
+        self._prefill_chunk_fns[key] = fn
         return fn
 
     def _get_decode_fn(self, batch_b: int, sampling: SamplingParams):
@@ -339,45 +408,92 @@ class ServeEngine:
 
     # ---- host-facing steps --------------------------------------------
 
-    def prefill(self, items, sampling: SamplingParams = GREEDY) -> np.ndarray:
-        """Run one bucketed prefill batch.
+    @staticmethod
+    def _norm_prefill_items(items):
+        """Normalise prefill items to ``(dst_slot, src_slot, fresh,
+        prompt)`` quads. The legacy triple ``(slot, fresh, prompt)`` means
+        src == dst (prefill in place); a quad names a separate gather
+        source — a prefix-cache slot for resumed prefill."""
+        out = []
+        for it in items:
+            if len(it) == 3:
+                slot, fresh, prompt = it
+                out.append((slot, slot, fresh, prompt))
+            else:
+                out.append(tuple(it))
+        return out
 
-        ``items``: list of ``(slot, fresh, prompt)`` with ``prompt`` a 1-D
-        int array (1 <= len <= max_prompt_len). Rows are padded up to the
-        batch bucket (dead rows target the scratch slot) and prompts are
-        right-padded to the length bucket (carry-freeze mask). Returns the
-        first sampled token per item, ``[len(items)]`` int32.
-        """
+    def _pack_prefill(self, items):
+        """Pad normalised items to (batch, length) buckets; returns the
+        padded host arrays + (n, batch_b, len_b). Final and intermediate
+        chunk programs share ONE length-bucket lattice (prefill_buckets) —
+        Batcher.warmup's replay assumes this."""
         n = len(items)
-        if n == 0:
-            return np.zeros((0,), np.int32)
-        lengths = [int(np.asarray(p).size) for _, _, p in items]
+        lengths = [int(np.asarray(p).size) for _, _, _, p in items]
         for t in lengths:
             if t < 1:
                 raise ValueError("empty prompt")
-        self._admit_sampling(sampling)
         batch_b = _bucket_for(n, self.batch_buckets, "prefill batch")
-        len_b = _bucket_for(max(lengths), self.prefill_buckets, "prompt length")
-
-        slots = np.full((batch_b,), self.cache.scratch_slot, np.int32)
+        len_b = _bucket_for(max(lengths), self.prefill_buckets,
+                            "prompt length")
+        scratch = self.cache.scratch_slot
+        src = np.full((batch_b,), scratch, np.int32)
+        dst = np.full((batch_b,), scratch, np.int32)
         fresh = np.ones((batch_b,), bool)
         prompts = np.zeros((batch_b, len_b), np.int32)
         lens = np.ones((batch_b,), np.int32)
-        for i, (slot, is_fresh, prompt) in enumerate(items):
+        for i, (d, s, is_fresh, prompt) in enumerate(items):
             p = np.asarray(prompt, np.int32).reshape(-1)
-            slots[i] = slot
+            dst[i] = d
+            src[i] = s
             fresh[i] = bool(is_fresh)
             prompts[i, : p.size] = p
             lens[i] = p.size
+        return src, dst, fresh, prompts, lens, n, batch_b, len_b
 
+    def prefill(self, items, sampling: SamplingParams = GREEDY) -> np.ndarray:
+        """Run one bucketed prefill batch (the FINAL — or only — chunk of
+        each row's prompt: ends with the head + sampler).
+
+        ``items``: ``(slot, fresh, prompt)`` triples or ``(dst_slot,
+        src_slot, fresh, prompt)`` quads (see ``_norm_prefill_items``) with
+        ``prompt`` a 1-D int array (1 <= len <= max_prompt_len). Rows are
+        padded up to the batch bucket (dead rows target the scratch slot)
+        and prompts are right-padded to the length bucket (carry-freeze
+        mask). Returns the first sampled token per item, ``[len(items)]``
+        int32.
+        """
+        if len(items) == 0:
+            return np.zeros((0,), np.int32)
+        self._admit_sampling(sampling)
+        src, dst, fresh, prompts, lens, n, batch_b, len_b = (
+            self._pack_prefill(self._norm_prefill_items(items)))
         with self._lock:
             fn = self._get_prefill_fn(batch_b, len_b, sampling)
             rng = self._next_rng(sampling)
             h, c, tok = fn(self.params, self.cache.h, self.cache.c,
-                           jnp.asarray(slots), jnp.asarray(fresh),
-                           jnp.asarray(prompts), jnp.asarray(lens), rng)
+                           jnp.asarray(src), jnp.asarray(dst),
+                           jnp.asarray(fresh), jnp.asarray(prompts),
+                           jnp.asarray(lens), rng)
             self.cache.swap(h, c)
         return np.asarray(tok)[:n]
+
+    def prefill_chunk(self, items) -> None:
+        """Dispatch one INTERMEDIATE prefill chunk batch: advance each
+        row's state over its chunk tokens and scatter it — no head, no
+        sampling, nothing returned (async dispatch; the final chunk via
+        :meth:`prefill` emits the first token). ``items`` as in
+        :meth:`prefill`."""
+        if len(items) == 0:
+            return
+        src, dst, fresh, prompts, lens, _, batch_b, len_b = (
+            self._pack_prefill(self._norm_prefill_items(items)))
+        with self._lock:
+            fn = self._get_prefill_chunk_fn(batch_b, len_b)
+            h, c = fn(self.params, self.cache.h, self.cache.c,
+                      jnp.asarray(src), jnp.asarray(dst), jnp.asarray(fresh),
+                      jnp.asarray(prompts), jnp.asarray(lens))
+            self.cache.swap(h, c)
 
     def decode(self, slots, tokens, sampling: SamplingParams = GREEDY) -> np.ndarray:
         """Advance each session one token: gather carries by ``slots`` [B],
@@ -498,18 +614,28 @@ class ServeEngine:
     def warmup(self, sampling: SamplingParams = GREEDY,
                prompt_lens: tuple[int, ...] = (1,),
                batch_sizes: tuple[int, ...] | None = None,
-               windows: tuple[int, ...] = ()) -> int:
+               windows: tuple[int, ...] = (),
+               chunk_lens: tuple[int, ...] = ()) -> int:
         """Pre-compile the bucket lattice a workload will touch (every
         batch bucket x the length buckets covering ``prompt_lens``, both
         phases, plus a ``decode_window`` program per batch bucket x each
-        K > 1 in ``windows``) by running dummy steps against the scratch
-        slot — so the first real traffic burst is never charged the
-        compiles. Returns the number of (phase, bucket) programs now
-        cached."""
+        K > 1 in ``windows``, plus a ``prefill_chunk`` program per batch
+        bucket x the length buckets covering ``chunk_lens`` — chunked
+        prefill / prefix-insert splits dispatch those mid-traffic) by
+        running dummy steps against the scratch slot — so the first real
+        traffic burst is never charged the compiles. Front-ends should
+        call ``Batcher.warmup`` / ``ServeServer.warmup`` instead: the
+        split and window lengths are scheduler policy, and only the
+        batcher can derive them. Returns the number of (phase, bucket)
+        programs now cached."""
         batch_sizes = tuple(batch_sizes or self.batch_buckets)
         len_buckets = sorted({
             _bucket_for(t, self.prefill_buckets, "prompt length")
             for t in prompt_lens
+        })
+        chunk_buckets = sorted({
+            _bucket_for(t, self.prefill_buckets, "chunk length")
+            for t in chunk_lens
         })
         scratch = self.cache.scratch_slot
         self._warming = True
@@ -519,6 +645,9 @@ class ServeEngine:
                 for t in len_buckets:
                     items = [(scratch, True, np.zeros((t,), np.int32))] * bb
                     self.prefill(items, sampling)
+                for t in chunk_buckets:
+                    items = [(scratch, True, np.zeros((t,), np.int32))] * bb
+                    self.prefill_chunk(items)
                 self.decode([scratch] * bb, [0] * bb, sampling)
                 # every rung compiles as a window program — INCLUDING k=1:
                 # the batcher's sync path uses the fused decode fn for
@@ -533,8 +662,8 @@ class ServeEngine:
                     self.fetch_window(win)
         finally:
             self._warming = False
-        return (len(self._prefill_fns) + len(self._decode_fns)
-                + len(self._decode_window_fns))
+        return (len(self._prefill_fns) + len(self._prefill_chunk_fns)
+                + len(self._decode_fns) + len(self._decode_window_fns))
 
     # ---- session lifecycle (thin wrappers over the cache) -------------
 
@@ -562,6 +691,7 @@ class ServeEngine:
             compiles = dict(self.compile_counts)
         return {
             "cache": self.cache.stats(),
+            "prefix_cache": None if self.prefix is None else self.prefix.stats(),
             "compiles": {repr(k): v for k, v in compiles.items()},
             "prefill_buckets": self.prefill_buckets,
             "batch_buckets": self.batch_buckets,

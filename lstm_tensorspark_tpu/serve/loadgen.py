@@ -22,6 +22,17 @@ aggregate tokens/sec and requests/sec. Phases are wrapped in
 session counts on one warm server — the headline check that batched
 decode beats sequential serving (ISSUE acceptance: >= 8 concurrent
 sessions must out-throughput 1 session).
+
+Prefix-cache / chunked-prefill probes: ``shared_prefix_len`` makes every
+prompt share its first N tokens (the shared-system-prompt workload —
+TTFT with the cache on should beat cache-off once the prefix is hot, and
+the report carries the cache's hit/miss/insert deltas);
+``inject_prompt_len`` submits one cold long-prompt request mid-run and
+reports it separately — the head-of-line-blocking probe (without chunked
+prefill, its monolithic prefill program shows up in every running
+session's p99 ITL; with ``prefill_chunk`` the stall is bounded by one
+chunk). Reports are JSON-ready dicts: ``cli serve --loadgen --json PATH``
+persists them (BENCH_serve_r01.json is the checked-in baseline).
 """
 
 from __future__ import annotations
@@ -44,10 +55,22 @@ def _percentile(sorted_vals: list[float], pct: float) -> float:
     return sorted_vals[min(max(idx, 0), len(sorted_vals) - 1)]
 
 
-def _random_prompts(n: int, prompt_len: int, vocab_size: int, seed: int):
+def _random_prompts(n: int, prompt_len: int, vocab_size: int, seed: int,
+                    shared_prefix_len: int = 0):
+    """``shared_prefix_len > 0`` models the shared-system-prompt workload:
+    every prompt starts with the SAME random prefix of that length and
+    differs only in its suffix — the prefix cache's target case."""
+    if shared_prefix_len >= prompt_len:
+        raise ValueError(
+            f"shared_prefix_len {shared_prefix_len} must be < prompt_len "
+            f"{prompt_len} (each prompt needs a unique suffix)")
     rng = np.random.RandomState(seed)
+    shared = rng.randint(0, vocab_size, size=shared_prefix_len)
     return [
-        rng.randint(0, vocab_size, size=prompt_len).astype(np.int32)
+        np.concatenate([
+            shared,
+            rng.randint(0, vocab_size, size=prompt_len - shared_prefix_len),
+        ]).astype(np.int32)
         for _ in range(n)
     ]
 
@@ -98,17 +121,30 @@ def run_loadgen(
     rate: float | None = None,
     seed: int = 0,
     timeout: float = 300.0,
+    shared_prefix_len: int = 0,
+    inject_prompt_len: int = 0,
+    inject_delay_s: float = 0.25,
 ) -> dict:
-    """Drive a started :class:`ServeServer`; returns the report dict."""
+    """Drive a started :class:`ServeServer`; returns the report dict.
+
+    ``shared_prefix_len``: prompts share their first N tokens (the
+    prefix-cache workload). ``inject_prompt_len > 0``: one extra request
+    with a prompt of that length is submitted ``inject_delay_s`` seconds
+    into the run — the head-of-line-blocking probe (does a max-bucket
+    prefill mid-run stall everyone else's ITL?); it is reported under
+    ``"injected"`` and EXCLUDED from the pooled latency stats."""
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
     client = InprocessClient(server)
     total = sessions * requests_per_session
-    prompts = _random_prompts(total, prompt_len, vocab_size, seed)
+    prompts = _random_prompts(total, prompt_len, vocab_size, seed,
+                              shared_prefix_len)
     results: list[dict] = []
     rejected = [0]
     failed = [0]
     lock = threading.Lock()
+    prefix_before = (server.engine.prefix.stats()
+                     if server.engine.prefix is not None else None)
 
     def one_request(prompt) -> None:
         t0 = time.perf_counter()
@@ -138,8 +174,39 @@ def run_loadgen(
         with lock:
             results.append(rec)
 
+    injected: dict = {}
+
+    def inject() -> None:
+        time.sleep(inject_delay_s)
+        # a fresh random prompt (distinct seed → shares nothing): a cold
+        # max-bucket prefill landing in the middle of steady-state decode
+        prompt = _random_prompts(1, inject_prompt_len, vocab_size,
+                                 seed + 7919)[0]
+        t0 = time.perf_counter()
+        try:
+            # use_prefix=False: the probe must neither perturb the shared
+            # cache (stride-stop inserts would evict real entries) nor
+            # skew the report's prefix_cache deltas with its cold miss
+            req = server.generate(prompt, max_new_tokens=max_new_tokens,
+                                  sampling=sampling, use_prefix=False,
+                                  timeout=timeout)
+        except Exception as e:
+            injected["error"] = f"{type(e).__name__}: {e}"
+            return
+        injected.update({
+            "prompt_len": inject_prompt_len,
+            "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            "ttft_ms": round((req.t_first_token - req.t_submit) * 1e3, 3)
+            if req.t_first_token and req.t_submit else None,
+            "tokens": len(req.tokens),
+        })
+
     with span("loadgen", mode=mode, sessions=sessions, total=total):
         t_start = time.perf_counter()
+        inject_thread = None
+        if inject_prompt_len > 0:
+            inject_thread = threading.Thread(target=inject, daemon=True)
+            inject_thread.start()
         if mode == "closed":
             def worker(wid: int) -> None:
                 for r in range(requests_per_session):
@@ -169,10 +236,32 @@ def run_loadgen(
                 threads.append(t)
             for t in threads:
                 t.join()
+        # wall covers the POOLED workload only — joining the probe after
+        # would charge its sleep+request tail to tokens_per_sec while its
+        # tokens are excluded from results
         wall = time.perf_counter() - t_start
+        if inject_thread is not None:
+            inject_thread.join()
     report = _report(results, rejected[0], failed[0], wall, mode, sessions)
     if rate:
         report["offered_rate_rps"] = rate
+    report["prompt_len"] = prompt_len
+    report["shared_prefix_len"] = shared_prefix_len
+    if inject_prompt_len > 0:
+        report["injected"] = injected
+    if prefix_before is not None:
+        after = server.engine.prefix.stats()
+        hits = after["hits"] - prefix_before["hits"]
+        misses = after["misses"] - prefix_before["misses"]
+        report["prefix_cache"] = {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / (hits + misses), 4)
+            if hits + misses else 0.0,
+            "inserts": after["inserts"] - prefix_before["inserts"],
+            "entries": after["entries"],
+            "invalidated": after["invalidated"] - prefix_before["invalidated"],
+        }
     return report
 
 
@@ -192,10 +281,9 @@ def concurrency_sweep(
     level is charged XLA compiles mid-run). Returns
     ``{"levels": {n: report}, "speedup_max_vs_1": x}``."""
     with span("loadgen_warmup"):
-        # include the batcher's decode-window ladder so no level is
-        # charged a window compile mid-run either
-        server.engine.warmup(sampling, prompt_lens=(prompt_len,),
-                             windows=server.batcher.window_ladder)
+        # the batcher derives its own window-ladder / chunk / prefix-split
+        # programs, so no level is charged a compile mid-run
+        server.warmup(sampling, prompt_lens=(prompt_len,))
     reports = {}
     for n in levels:
         reports[n] = run_loadgen(

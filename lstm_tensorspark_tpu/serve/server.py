@@ -11,7 +11,8 @@ by both front-ends:
 
   - ``POST /v1/generate``  body ``{"prompt": [ids], "max_new_tokens": N,
     "greedy": true, "temperature": t, "top_k": k, "top_p": p,
-    "session_id": "...", "keep_session": false, "eos_id": null}`` →
+    "session_id": "...", "keep_session": false, "eos_id": null,
+    "use_prefix": true}`` →
     ``{"tokens": [...], "session_id": "...", "latency_ms": ...,
     "ttft_ms": ..., "max_itl_ms": ...}`` (time-to-first-token and the
     request's worst inter-token gap — windowed decode delivers K tokens
@@ -20,7 +21,9 @@ by both front-ends:
   - ``GET /healthz`` → honest liveness: 200 with the scheduler thread's
     heartbeat age while the batcher thread lives, 503 once it is dead or
     never started (a wedged server must fail probes, not smile at them);
-    ``GET /v1/stats`` → batcher/engine/cache counters.
+    ``GET /stats`` (alias ``/v1/stats``) → batcher/engine/cache counters:
+    per-key compile counts, prefix-cache hit/miss/evict/invalidate,
+    state-cache swap generation, prefill-chunk/window dispatch counts.
 
   Backpressure maps to HTTP: full queue → 429, bad request → 400,
   scheduler failure → 500, timeout → 504.
@@ -74,6 +77,14 @@ class ServeServer:
             self._thread.join(timeout=10.0)
             self._thread = None
 
+    def warmup(self, sampling: SamplingParams = GREEDY,
+               prompt_lens: tuple[int, ...] = (1,)) -> int:
+        """Pre-compile everything the scheduler can dispatch for these
+        prompt lengths. Delegates to the batcher, which derives the
+        chunk / prefix-insert split and window-ladder programs from its
+        own policy — the one warmup entry point front-ends should use."""
+        return self.batcher.warmup(sampling, prompt_lens=prompt_lens)
+
     def __enter__(self) -> "ServeServer":
         return self.start()
 
@@ -91,6 +102,7 @@ class ServeServer:
         session_id: str | None = None,
         keep_session: bool = False,
         eos_id: int | None = None,
+        use_prefix: bool = True,
         timeout: float = 120.0,
     ) -> Request:
         """Submit and block until the request completes; returns the filled
@@ -100,6 +112,7 @@ class ServeServer:
         req = Request(
             prompt, max_new_tokens, sampling=sampling,
             session_id=session_id, keep_session=keep_session, eos_id=eos_id,
+            use_prefix=use_prefix,
         )
         self.batcher.submit(req)
         if not req.done.wait(timeout):
@@ -197,7 +210,10 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             health = self._serve.health()
             self._reply(200 if health["ok"] else 503, health)
-        elif self.path == "/v1/stats":
+        elif self.path in ("/stats", "/v1/stats"):
+            # one payload, two routes: per-key compile counts, prefix-cache
+            # hit/miss/evict/invalidate counters, state-cache swap
+            # generation, batcher chunk/window counters
             self._reply(200, self._serve.stats())
         else:
             self._reply(404, {"error": f"no route {self.path}"})
@@ -225,6 +241,7 @@ class _Handler(BaseHTTPRequestHandler):
                 session_id=body.get("session_id"),
                 keep_session=bool(body.get("keep_session", False)),
                 eos_id=body.get("eos_id"),
+                use_prefix=bool(body.get("use_prefix", True)),
                 timeout=timeout,
             )
         except QueueFullError as e:

@@ -34,6 +34,12 @@ receives the handle and is therefore data-ordered after it on device.
 
 Host-side bookkeeping is lock-protected; device reads/writes are plain
 jnp gather/scatter ops (one compile each per batch-shape, amortised).
+
+:class:`PrefixCache` (same file) layers shared-prompt reuse on top: a
+store of "state after token-prefix P" entries, each backed by a
+state-cache slot under the reserved ``prefix/`` session namespace —
+longest-match lookup, refcounted use, LRU eviction in both directions
+(see its docstring).
 """
 
 from __future__ import annotations
@@ -48,6 +54,12 @@ import numpy as np
 
 class CacheFullError(RuntimeError):
     """No free slot and every occupied slot is pinned."""
+
+
+#: session-id namespace for prefix-cache backing slots. Client-facing
+#: layers (batcher Request) reject ids under it: a client naming a prefix
+#: entry's session would inherit — and corrupt — the shared prefix state.
+PREFIX_SID_NAMESPACE = "prefix/"
 
 
 class DetachedState(NamedTuple):
@@ -73,6 +85,11 @@ class StateCache:
         self._pinned: set[str] = set()
         self.evictions = 0
         self.generation = 0  # device programs applied via swap()
+        # eviction listeners: called (under the cache lock) with the sid of
+        # every LRU-evicted session — the prefix cache registers here so a
+        # slot eviction INVALIDATES the dependent prefix entry instead of
+        # leaving it pointing at a slot another session now owns
+        self.evict_listeners: list = []
 
     @property
     def scratch_slot(self) -> int:
@@ -112,6 +129,8 @@ class StateCache:
             if sid not in self._pinned:
                 slot = self._slots.pop(sid)
                 self.evictions += 1
+                for listener in self.evict_listeners:
+                    listener(sid)
                 return slot
         raise CacheFullError(
             f"all {self.num_slots} slots pinned by active sessions"
@@ -164,6 +183,14 @@ class StateCache:
         self.h = self.h.at[:, idx, :].set(h)
         self.c = self.c.at[:, idx, :].set(c)
 
+    def copy_slot(self, src: int, dst: int) -> None:
+        """O(1) on-device copy of one slot's carries (src read, dst
+        written) — how a prefix entry snapshots a session's state. Threads
+        through the cache arrays, so it is data-ordered after any
+        in-flight program that writes ``src``."""
+        self.h = self.h.at[:, dst, :].set(self.h[:, src, :])
+        self.c = self.c.at[:, dst, :].set(self.c[:, src, :])
+
     # ---- detach / restore ---------------------------------------------
 
     def detach(self, session_id: str) -> DetachedState:
@@ -211,4 +238,183 @@ class StateCache:
                 "free": len(self._free),
                 "evictions": self.evictions,
                 "generation": self.generation,
+            }
+
+
+class PrefixEntry:
+    """One cached prefix: the exact token prefix, its backing state-cache
+    session/slot, and a refcount of in-flight prefills reading it."""
+
+    __slots__ = ("key", "length", "sid", "slot", "refs")
+
+    def __init__(self, key: bytes, length: int, sid: str, slot: int):
+        self.key = key
+        self.length = length
+        self.sid = sid
+        self.slot = slot
+        self.refs = 0
+
+
+class PrefixCache:
+    """Shared-prompt prefix store over the :class:`StateCache`.
+
+    An LSTM's state after ANY prefix is one O(1) ``(h, c)`` pair per layer,
+    so exact prefix reuse is a slot copy — not a KV-cache re-plumb. Entries
+    are keyed by the **exact token bytes** of the prefix (the dict hash IS
+    the prefix hash; storing the bytes makes collisions impossible) and
+    live at ``stride``-aligned lengths, so :meth:`lookup` probes the few
+    distinct entry lengths longest-first. Each entry owns a state-cache
+    slot under the reserved ``prefix/`` session namespace:
+
+    - **refcounting**: ``lookup`` pins the backing slot and bumps ``refs``
+      until the resumed prefill has been *dispatched* (`release`) — device
+      data-ordering through the cache arrays makes it safe to release at
+      dispatch, not completion;
+    - **LRU eviction**: a full prefix cache evicts its own oldest
+      zero-ref entry (releasing the backing slot); conversely a state-cache
+      LRU eviction of a backing slot **invalidates** the dependent entry
+      via the cache's eviction listener — an invalidated prefix is a miss,
+      never a read of a slot someone else now owns;
+    - a matched length is capped at ``len(prompt) - 1``: at least one real
+      prompt token is always prefilled, so the first sampled token comes
+      from the same head math as an uncached run (token-identical greedy
+      parity, tests/test_serve_prefix.py).
+
+    Synchronisation: shares the state cache's reentrant lock — the
+    eviction listener fires under it, and a private lock here would ABBA
+    with ``acquire``/``pin`` calls made from prefix methods.
+    """
+
+    def __init__(self, cache: StateCache, *, stride: int = 8,
+                 max_entries: int = 16):
+        if stride < 1:
+            raise ValueError(f"stride must be >= 1, got {stride}")
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        self.cache = cache
+        self.stride = stride
+        self.max_entries = max_entries
+        self._lock = cache._lock  # shared on purpose (see docstring)
+        self._entries: OrderedDict[bytes, PrefixEntry] = OrderedDict()
+        self._by_sid: dict[str, bytes] = {}
+        self._sid_counter = 0
+        self.hits = 0
+        self.misses = 0
+        self.inserts = 0
+        self.evictions = 0     # own LRU (full prefix cache)
+        self.invalidated = 0   # backing slot evicted under us
+        cache.evict_listeners.append(self._on_slot_evicted)
+
+    @staticmethod
+    def _key(tokens) -> bytes:
+        return np.asarray(tokens, np.int32).tobytes()
+
+    def boundary(self, length: int) -> int:
+        """Largest cacheable prefix length for a ``length``-token prompt:
+        stride-aligned and <= length - 1 (>= 1 token must remain to
+        prefill). 0 = prompt too short to cache."""
+        k = ((length - 1) // self.stride) * self.stride
+        return k if k >= self.stride else 0
+
+    def lookup(self, prompt) -> tuple[PrefixEntry | None, int]:
+        """Longest exact-prefix match for ``prompt`` with matched length
+        <= len(prompt) - 1. A hit returns ``(entry, matched_len)`` with
+        the entry ref-held and its slot pinned — the caller MUST
+        :meth:`release` after dispatching the resumed prefill."""
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        with self._lock:
+            lengths = sorted({e.length for e in self._entries.values()},
+                             reverse=True)
+            for n in lengths:
+                if n > p.size - 1:
+                    continue
+                entry = self._entries.get(self._key(p[:n]))
+                if entry is None:
+                    continue
+                self._entries.move_to_end(entry.key)
+                # refresh the BACKING slot's recency too — the state-cache
+                # LRU must not evict the hottest prefix's slot first just
+                # because pin/unpin never reorder it (reentrant RLock)
+                self.cache.lookup(entry.sid)
+                if entry.refs == 0:
+                    self.cache.pin(entry.sid)
+                entry.refs += 1
+                self.hits += 1
+                return entry, entry.length
+            self.misses += 1
+            return None, 0
+
+    def release(self, entry: PrefixEntry) -> None:
+        """Drop one ref; the last ref unpins the backing slot (making the
+        entry LRU-evictable again). Safe after invalidation."""
+        with self._lock:
+            if entry.refs > 0:
+                entry.refs -= 1
+            if entry.refs == 0 and self._by_sid.get(entry.sid) == entry.key:
+                self.cache.unpin(entry.sid)
+
+    def insert(self, tokens, src_slot: int) -> bool:
+        """Snapshot the state in ``src_slot`` (== the state after exactly
+        ``tokens``) into a new prefix entry. Returns False — never raises —
+        when the entry already exists, every entry is ref-held, or the
+        state cache has no evictable slot left: prefix caching is an
+        optimisation and must degrade, not fail requests."""
+        key = self._key(tokens)
+        length = int(np.asarray(tokens).size)
+        with self._lock:
+            if key in self._entries:
+                # a dedup-hit is a hotness signal too: refresh the backing
+                # slot's state-cache recency like the lookup path does
+                self._entries.move_to_end(key)
+                self.cache.lookup(self._entries[key].sid)
+                return False
+            while len(self._entries) >= self.max_entries:
+                victim = next(
+                    (e for e in self._entries.values() if e.refs == 0), None)
+                if victim is None:
+                    return False  # every entry is mid-use
+                self._evict_entry_locked(victim)
+            self._sid_counter += 1
+            sid = f"{PREFIX_SID_NAMESPACE}{self._sid_counter}"
+            try:
+                slot, _ = self.cache.acquire(sid)
+            except CacheFullError:
+                return False
+            self.cache.copy_slot(src_slot, slot)
+            entry = PrefixEntry(key, length, sid, slot)
+            self._entries[key] = entry
+            self._by_sid[sid] = key
+            self.inserts += 1
+            return True
+
+    def _evict_entry_locked(self, entry: PrefixEntry) -> None:
+        self._entries.pop(entry.key, None)
+        self._by_sid.pop(entry.sid, None)
+        self.cache.release(entry.sid)
+        self.evictions += 1
+
+    def _on_slot_evicted(self, sid: str) -> None:
+        # state-cache LRU took a backing slot: the dependent entry is now
+        # garbage — drop it so lookups miss instead of reading a slot a
+        # live session owns (runs under the shared lock)
+        key = self._by_sid.pop(sid, None)
+        if key is not None:
+            self._entries.pop(key, None)
+            self.invalidated += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "stride": self.stride,
+                "max_entries": self.max_entries,
+                "hits": self.hits,
+                "misses": self.misses,
+                "inserts": self.inserts,
+                "evictions": self.evictions,
+                "invalidated": self.invalidated,
             }

@@ -16,6 +16,7 @@ round-trips per step" (SURVEY.md §2 native-capability table).
 from __future__ import annotations
 
 import math
+import os
 import time
 from typing import Any, Callable, Iterable, NamedTuple
 
@@ -63,9 +64,6 @@ def init_train_state(params, optimizer, rng, *, carries=None) -> TrainState:
         rng=rng,
         carries=carries,
     )
-
-
-import os
 
 
 def _donation_supported() -> bool:

@@ -1,0 +1,503 @@
+"""Prefix-state cache + chunked prefill (serve/state_cache.PrefixCache,
+serve/engine prefill src/dst + prefill_chunk, serve/batcher scheduling).
+
+The ISSUE-4 acceptance surface:
+
+- PARITY: greedy generation is token-identical across {prefix cache on
+  cold, on hot, off} and chunked prefill, all matching models/generate.py;
+- cache interaction: evicting a state-cache slot that backs a prefix
+  entry INVALIDATES the entry (lookups miss — never read a slot someone
+  else owns); detach/restore of a session never aliases a refcounted
+  prefix slot;
+- chunked prefill: a long prompt's prefill is consumed <= chunk tokens
+  per scheduler iteration with decode interleaved between chunks, and
+  lifts the prompt-length admission cap;
+- observability: /stats carries prefix-cache + compile + swap-generation
+  counters.
+
+Parity stacks build their own engines (prefix on/off is a constructor
+choice); the configs are tiny so each XLA compile is subsecond on CPU.
+"""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from lstm_tensorspark_tpu.models import LMConfig, init_lm, make_generate_fn
+from lstm_tensorspark_tpu.serve import (
+    Batcher,
+    PrefixCache,
+    Request,
+    ServeEngine,
+    ServeServer,
+    StateCache,
+)
+
+_CFG = LMConfig(vocab_size=37, hidden_size=16, num_layers=2)
+
+
+def _make_engine(**kw):
+    params = init_lm(jax.random.PRNGKey(0), _CFG)
+    kw.setdefault("num_slots", 16)
+    kw.setdefault("prefill_buckets", (4, 8, 16))
+    kw.setdefault("batch_buckets", (1, 2, 4))
+    return params, ServeEngine(params, _CFG, **kw)
+
+
+def _refs(params, prompts, n_new):
+    gen = make_generate_fn(_CFG, max_new_tokens=n_new, greedy=True)
+    return [
+        np.asarray(gen(params, p[None, :], jax.random.PRNGKey(0)))[
+            0, p.size:].tolist()
+        for p in prompts
+    ]
+
+
+def _run(batcher, prompts, n_new):
+    reqs = [Request(p, n_new) for p in prompts]
+    for r in reqs:
+        batcher.submit(r)
+    batcher.drain()
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    return [r.tokens for r in reqs]
+
+
+# ---- PrefixCache unit behaviour -----------------------------------------
+
+
+def test_longest_match_and_full_prompt_cap():
+    cache = StateCache(num_layers=1, num_slots=6, hidden_size=4)
+    prefix = PrefixCache(cache, stride=2, max_entries=4)
+    slot, _ = cache.acquire("seed")
+    assert prefix.insert(np.array([1, 2], np.int32), slot)
+    assert prefix.insert(np.array([1, 2, 3, 4], np.int32), slot)
+
+    entry, n = prefix.lookup(np.array([1, 2, 3, 4, 9], np.int32))
+    assert entry is not None and n == 4  # longest wins
+    prefix.release(entry)
+    # a matched length never covers the FULL prompt: >= 1 token must
+    # remain to prefill (that token produces the first sampled logits)
+    entry, n = prefix.lookup(np.array([1, 2, 3, 4], np.int32))
+    assert entry is not None and n == 2
+    prefix.release(entry)
+    entry, n = prefix.lookup(np.array([5, 6, 7], np.int32))
+    assert entry is None and n == 0
+    assert prefix.stats()["misses"] == 1
+
+
+def test_lookup_refcount_pins_backing_slot():
+    cache = StateCache(num_layers=1, num_slots=2, hidden_size=4)
+    prefix = PrefixCache(cache, stride=2, max_entries=2)
+    slot, _ = cache.acquire("seed")
+    assert prefix.insert(np.array([1, 2], np.int32), slot)
+    cache.release("seed")
+
+    entry, n = prefix.lookup(np.array([1, 2, 9], np.int32))
+    assert n == 2 and entry.refs == 1
+    # the backing slot is pinned while ref-held: churning sessions through
+    # the 1 remaining free slot cannot evict it
+    cache.acquire("a")
+    cache.release("a")
+    cache.acquire("b")
+    cache.release("b")
+    assert prefix.stats()["invalidated"] == 0
+    prefix.release(entry)
+    assert entry.refs == 0
+
+
+def test_prefix_lru_eviction_releases_backing_slot():
+    cache = StateCache(num_layers=1, num_slots=8, hidden_size=4)
+    prefix = PrefixCache(cache, stride=2, max_entries=2)
+    slot, _ = cache.acquire("seed")
+    assert prefix.insert(np.array([1, 2], np.int32), slot)
+    assert prefix.insert(np.array([3, 4], np.int32), slot)
+    live_before = len(cache)
+    assert prefix.insert(np.array([5, 6], np.int32), slot)  # evicts [1, 2]
+    assert len(prefix) == 2
+    assert len(cache) == live_before  # slot count unchanged: evict+insert
+    assert prefix.stats()["evictions"] == 1
+    entry, n = prefix.lookup(np.array([1, 2, 9], np.int32))
+    assert entry is None
+
+
+def test_state_cache_eviction_invalidates_dependent_entry():
+    """The satellite case: LRU-evicting the state-cache slot that BACKS a
+    prefix entry must invalidate the entry (miss), not corrupt it (a
+    lookup reading a slot some session now owns)."""
+    cache = StateCache(num_layers=1, num_slots=2, hidden_size=4)
+    prefix = PrefixCache(cache, stride=2, max_entries=4)
+    slot, _ = cache.acquire("seed")
+    assert prefix.insert(np.array([1, 2], np.int32), slot)
+    cache.release("seed")
+    # entry unpinned (no refs): filling the cache with pinned sessions
+    # forces the LRU to take the prefix's backing slot
+    cache.acquire("a")
+    cache.pin("a")
+    cache.acquire("b")
+    cache.pin("b")
+    assert prefix.stats()["invalidated"] == 1
+    entry, n = prefix.lookup(np.array([1, 2, 9], np.int32))
+    assert entry is None and n == 0
+
+
+def test_hit_refreshes_backing_slot_recency():
+    """A prefix hit must refresh the backing slot's STATE-cache recency,
+    not just the prefix LRU — otherwise slot pressure evicts the hottest
+    prefix's slot first (it never reorders via pin/unpin) and the cache
+    thrashes exactly under the load it exists for."""
+    cache = StateCache(num_layers=1, num_slots=3, hidden_size=4)
+    prefix = PrefixCache(cache, stride=2, max_entries=4)
+    slot, _ = cache.acquire("seed")
+    assert prefix.insert(np.array([1, 2], np.int32), slot)
+    cache.release("seed")
+    # age the prefix sid, then HIT it — the hit makes it most-recent
+    cache.acquire("a")
+    entry, _ = prefix.lookup(np.array([1, 2, 9], np.int32))
+    prefix.release(entry)  # refs back to 0: unpinned, LRU-evictable
+    # slot pressure: the eviction victim must be the stale "a", not the
+    # just-hit prefix slot
+    cache.acquire("b")
+    cache.acquire("c")
+    assert prefix.stats()["invalidated"] == 0
+    entry, n = prefix.lookup(np.array([1, 2, 9], np.int32))
+    assert entry is not None and n == 2
+
+
+def test_reserved_session_namespace_rejected():
+    with pytest.raises(ValueError, match="reserved"):
+        Request(np.array([1, 2], np.int32), 2, session_id="prefix/7")
+
+
+# ---- parity: the acceptance criterion -----------------------------------
+
+
+def test_parity_cache_on_cold_hot_off_and_chunked():
+    """Greedy output must be token-identical across {prefix cache on cold,
+    on hot, off} x {chunked, monolithic} prefill, and match
+    models/generate.py. Prompts share an 8-token prefix (stride-aligned),
+    so the hot runs genuinely resume from cache entries."""
+    rng = np.random.RandomState(3)
+    shared = rng.randint(0, 37, size=8).astype(np.int32)
+    prompts = [
+        np.concatenate([shared, rng.randint(0, 37, size=5).astype(np.int32)])
+        for _ in range(3)
+    ]
+    n_new = 6
+    refs = None
+    for kw_e, kw_b in [
+        ({}, {}),
+        ({"prefix_cache": True}, {}),
+        ({"prefix_cache": True}, {"prefill_chunk": 4}),
+        ({}, {"prefill_chunk": 4}),
+    ]:
+        params, engine = _make_engine(**kw_e)
+        if refs is None:
+            refs = _refs(params, prompts, n_new)
+        batcher = Batcher(engine, max_active=4, queue_size=8, **kw_b)
+        assert _run(batcher, prompts, n_new) == refs  # cold
+        assert _run(batcher, prompts, n_new) == refs  # hot (or re-run)
+        if engine.prefix is not None:
+            st = engine.prefix.stats()
+            assert st["hits"] >= 3, st   # the hot pass actually resumed
+            assert st["inserts"] >= 1, st
+            assert batcher.prefix_tokens_saved >= 8 * 3
+
+
+def test_eviction_under_pressure_stays_correct():
+    """Slot pressure evicting prefix entries mid-traffic must degrade to
+    misses, never to wrong tokens: a cache with barely more slots than
+    active sessions keeps evicting/invalidating entries while requests
+    flow."""
+    rng = np.random.RandomState(5)
+    shared = rng.randint(0, 37, size=8).astype(np.int32)
+    prompts = [
+        np.concatenate([shared, rng.randint(0, 37, size=3).astype(np.int32)])
+        for _ in range(4)
+    ]
+    n_new = 4
+    params, engine = _make_engine(num_slots=5, prefix_cache=True,
+                                  prefix_entries=8)
+    refs = _refs(params, prompts, n_new)
+    batcher = Batcher(engine, max_active=4, queue_size=8)
+    for _ in range(3):
+        assert _run(batcher, prompts, n_new) == refs
+
+
+# ---- detach/restore vs refcounted prefix slots --------------------------
+
+
+def test_detach_restore_never_aliases_prefix_slot():
+    """A session detached and restored around prefix-cache traffic must
+    get its own slot — never the backing slot of a live entry — and the
+    entry's stored state must survive the churn bit-identically."""
+    rng = np.random.RandomState(7)
+    shared = rng.randint(0, 37, size=8).astype(np.int32)
+    prompt = np.concatenate([shared,
+                             rng.randint(0, 37, size=4).astype(np.int32)])
+    n_total = 8
+    params, engine = _make_engine(prefix_cache=True)
+    batcher = Batcher(engine, max_active=4, queue_size=8)
+    (ref,) = _refs(params, [prompt], n_total)
+
+    k = 4
+    first = Request(prompt, k, keep_session=True)
+    batcher.submit(first)
+    batcher.drain()
+    assert first.error is None
+    # the cold pass inserted the shared prefix; snapshot its device state
+    entry, n = engine.prefix.lookup(np.concatenate([shared, [1]]).astype(np.int32))
+    assert entry is not None and n == 8
+    snap_h = np.asarray(engine.cache.h[:, entry.slot, :]).copy()
+    snap_c = np.asarray(engine.cache.c[:, entry.slot, :]).copy()
+
+    sid = first.session_id
+    detached = engine.detach_session(sid)
+    # churn while detached: hot traffic resumes FROM the entry (ref-held
+    # above, so it cannot be evicted under us)
+    churn = Request(prompt, 2)
+    batcher.submit(churn)
+    batcher.drain()
+    assert churn.error is None
+
+    new_slot = engine.restore_session(sid, detached)
+    assert new_slot != entry.slot  # restore must not alias the entry
+    second = Request(np.array([first.tokens[-1]], np.int32), n_total - k,
+                     session_id=sid)
+    batcher.submit(second)
+    batcher.drain()
+    assert second.error is None
+    engine.cache.release(sid)
+    assert first.tokens + second.tokens == ref
+
+    # the entry's device state never moved under all that traffic
+    np.testing.assert_array_equal(
+        np.asarray(engine.cache.h[:, entry.slot, :]), snap_h)
+    np.testing.assert_array_equal(
+        np.asarray(engine.cache.c[:, entry.slot, :]), snap_c)
+    engine.prefix.release(entry)
+
+
+# ---- chunked prefill scheduling -----------------------------------------
+
+
+def test_chunked_prefill_interleaves_decode():
+    """While a long prompt prefills chunk-by-chunk, an already-decoding
+    session must receive tokens BETWEEN chunks — the bounded-stall
+    property chunking exists for."""
+    params, engine = _make_engine()
+    batcher = Batcher(engine, max_active=4, queue_size=8,
+                      window_ladder=(1,), prefill_chunk=4)
+    short = Request(np.array([5, 3], np.int32), 12)
+    batcher.submit(short)
+    batcher.step()  # short is admitted and decoding
+    tokens_before = len(short.tokens)
+    assert tokens_before >= 1
+
+    long_prompt = np.arange(1, 17, dtype=np.int32) % 37  # 16 tokens, 4 chunks
+    long_req = Request(long_prompt, 2)
+    batcher.submit(long_req)
+    progress = []
+    while long_req.t_first_token is None:
+        batcher.step()
+        progress.append(len(short.tokens))
+    # 16 tokens at chunk 4 = 3 intermediate chunk programs + 1 final
+    assert batcher.prefill_chunks_dispatched == 3
+    # the short session advanced during the long prefill, iteration by
+    # iteration — not all-at-once after it
+    assert progress[0] > tokens_before
+    assert progress[-1] > progress[0]
+    batcher.drain()
+    assert short.error is None and long_req.error is None
+    (ref_long,) = _refs(params, [long_prompt], 2)
+    assert long_req.tokens == ref_long
+    (ref_short,) = _refs(params, [np.array([5, 3], np.int32)], 12)
+    assert short.tokens == ref_short
+
+
+def test_chunked_prefill_lifts_prompt_length_cap():
+    """Chunked prefill serves prompts LONGER than the largest prefill
+    bucket (each program consumes <= chunk tokens); without it the same
+    prompt is rejected at submit."""
+    params, engine = _make_engine()  # largest bucket: 16
+    long_prompt = (np.arange(24, dtype=np.int32) * 5 + 1) % 37
+    plain = Batcher(engine, max_active=4, queue_size=8)
+    with pytest.raises(ValueError, match="exceeds"):
+        plain.submit(Request(long_prompt, 2))
+
+    chunked = Batcher(engine, max_active=4, queue_size=8, prefill_chunk=8)
+    (ref,) = _refs(params, [long_prompt], 4)
+    assert _run(chunked, [long_prompt], 4) == [ref]
+
+
+def test_warmup_precompiles_chunk_programs():
+    _, engine = _make_engine(prefill_buckets=(4,), batch_buckets=(1, 2))
+    engine.warmup(prompt_lens=(4,), chunk_lens=(4,))
+    counts = dict(engine.compile_counts)
+    assert ("prefill_chunk", 1, 4) in counts
+    assert ("prefill_chunk", 2, 4) in counts
+    # replaying the warmed shapes recompiles nothing
+    scratch = engine.cache.scratch_slot
+    engine.prefill_chunk([(scratch, scratch, True, np.zeros(3, np.int32))])
+    assert dict(engine.compile_counts) == counts
+
+
+@pytest.mark.parametrize("chunk", [None, 4])
+def test_batcher_warmup_covers_split_programs(chunk):
+    """Batcher.warmup must pre-compile the chunk / prefix-insert split
+    programs the scheduler dispatches — engine.warmup can't derive them,
+    and an unwarmed split program would compile mid-traffic."""
+    _, engine = _make_engine(prefix_cache=True, prefix_stride=4)
+    batcher = Batcher(engine, max_active=4, queue_size=8, prefill_chunk=chunk)
+    batcher.warmup(prompt_lens=(12,))
+    before = dict(engine.compile_counts)
+    rng = np.random.RandomState(0)
+    shared = rng.randint(0, _CFG.vocab_size, size=8)
+    prompts = [
+        np.concatenate(
+            [shared, rng.randint(0, _CFG.vocab_size, size=4)]
+        ).astype(np.int32)
+        for _ in range(3)
+    ]
+    _run(batcher, prompts, 2)  # cold inserts, then hot resumed prefills
+    _run(batcher, prompts, 2)
+    assert dict(engine.compile_counts) == before
+
+
+def test_incompatible_chunk_stride_rejected():
+    """A chunk that is neither a multiple nor a divisor of the prefix
+    stride would be silently truncated to stride alignment at every
+    pre-boundary stop — the constructor must refuse it."""
+    _, engine = _make_engine(prefix_cache=True, prefix_stride=4)
+    with pytest.raises(ValueError, match="multiple or divisor"):
+        Batcher(engine, max_active=4, queue_size=8, prefill_chunk=6)
+    # multiples and divisors are fine, as is any chunk with the cache off
+    Batcher(engine, max_active=4, queue_size=8, prefill_chunk=8)
+    Batcher(engine, max_active=4, queue_size=8, prefill_chunk=2)
+    _, plain = _make_engine(prefix_cache=False)
+    Batcher(plain, max_active=4, queue_size=8, prefill_chunk=6)
+
+
+def test_stop_mid_chunked_prefill_fails_fast():
+    """run() exiting on the stop event must settle mid-prefill requests
+    (fail fast + release their slots), not leave clients blocked on
+    ``done`` until their timeout."""
+    _, engine = _make_engine()
+    batcher = Batcher(engine, max_active=4, queue_size=8,
+                      window_ladder=(1,), prefill_chunk=4)
+    free_before = engine.cache.stats()["free"]
+    req = Request(np.arange(1, 17, dtype=np.int32) % 37, 2)  # 4 chunks
+    batcher.submit(req)
+    batcher.step()  # first chunk dispatched; request still mid-prefill
+    assert req.t_first_token is None and not req.done.is_set()
+    stop = threading.Event()
+    stop.set()
+    batcher.run(stop)
+    assert req.done.is_set()
+    assert req.error is not None and "stopped" in req.error
+    assert engine.cache.stats()["free"] == free_before
+
+
+def test_use_prefix_false_bypasses_cache():
+    """A ``use_prefix=False`` request (loadgen's injected HOL probe) must
+    neither query nor populate the prefix cache — probes can't evict real
+    entries or skew the report's hit/miss deltas."""
+    params, engine = _make_engine(prefix_cache=True, prefix_stride=4)
+    batcher = Batcher(engine, max_active=4, queue_size=8)
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, _CFG.vocab_size, size=12).astype(np.int32)
+    req = Request(prompt, 3, use_prefix=False)
+    batcher.submit(req)
+    batcher.drain()
+    assert req.error is None
+    (ref,) = _refs(params, [prompt], 3)
+    assert req.tokens == ref
+    st = engine.prefix.stats()
+    assert (st["hits"], st["misses"], st["inserts"], st["entries"]) == (
+        0, 0, 0, 0)
+
+
+def test_batcher_warmup_covers_partial_prefix_resume():
+    """Longest-match lookup can resume from ANY stride multiple, not just
+    boundary(t) — warmup must cover the remainder programs of those
+    partial hits too, or the first such request compiles mid-traffic."""
+    from lstm_tensorspark_tpu.serve.engine import GREEDY
+
+    params, engine = _make_engine(prefix_cache=True, prefix_stride=4)
+    batcher = Batcher(engine, max_active=4, queue_size=8)  # unchunked
+    batcher.warmup(prompt_lens=(12,))
+
+    # hand-plant an entry at length 4 (< boundary(12) == 8): state after
+    # prompt[:4], exactly what a shorter earlier prompt would have cached
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, _CFG.vocab_size, size=12).astype(np.int32)
+    slot, _ = engine.cache.acquire("seed")
+    engine.prefill([(slot, slot, True, prompt[:4])], GREEDY)
+    assert engine.prefix.insert(prompt[:4], slot)
+    engine.cache.release("seed")
+
+    before = dict(engine.compile_counts)
+    (ref,) = _refs(params, [prompt], 3)
+    assert _run(batcher, [prompt], 3) == [ref]
+    assert batcher.prefix_resumed == 1
+    assert dict(engine.compile_counts) == before
+
+
+# ---- observability -------------------------------------------------------
+
+
+def test_stats_surface_and_http_route():
+    from lstm_tensorspark_tpu.serve.server import make_http_server
+
+    _, engine = _make_engine(prefix_cache=True)
+    server = ServeServer(engine, max_active=2, queue_size=4, prefill_chunk=4)
+    httpd = make_http_server(server, port=0)
+    host, port = httpd.server_address[:2]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    try:
+        with server:
+            thread.start()
+            rng = np.random.RandomState(11)
+            shared = rng.randint(0, 37, size=8).astype(np.int32)
+            for _ in range(2):
+                p = np.concatenate(
+                    [shared, rng.randint(0, 37, size=3).astype(np.int32)])
+                body = json.dumps({"prompt": p.tolist(), "max_new_tokens": 2,
+                                   "greedy": True}).encode()
+                req = urllib.request.Request(
+                    f"http://{host}:{port}/v1/generate", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    assert r.status == 200
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/stats", timeout=30) as r:
+                assert r.status == 200
+                stats = json.loads(r.read())
+            # the HTTP-level opt-out: no lookup, no insert
+            p = rng.randint(0, 37, size=11).astype(np.int32)
+            body = json.dumps({"prompt": p.tolist(), "max_new_tokens": 2,
+                               "greedy": True, "use_prefix": False}).encode()
+            req = urllib.request.Request(
+                f"http://{host}:{port}/v1/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status == 200
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/stats", timeout=30) as r:
+                stats_after = json.loads(r.read())
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    px = stats["prefix_cache"]
+    assert px["inserts"] >= 1 and px["hits"] + px["misses"] >= 2
+    pxa = stats_after["prefix_cache"]
+    assert (pxa["hits"] + pxa["misses"], pxa["inserts"]) == (
+        px["hits"] + px["misses"], px["inserts"])
+    assert "generation" in stats["cache"]
+    assert any("prefill" in k for k in stats["compiles"])
+    b = stats["batcher"]
+    assert b["prefill_chunk"] == 4
+    assert "prefill_chunks_dispatched" in b and "prefix_resumed" in b

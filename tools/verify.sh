@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# One-command tier-1 verify + regression gate.
+#
+# Runs the ROADMAP.md "Tier-1 verify" line exactly (same timeout, same
+# pytest flags, same DOTS_PASSED accounting), then gates on
+# tools/tier1_diff.py — which diffs the failing-test SET against
+# tools/tier1_baseline.txt and exits 3 (REGRESSION_RC) only on NEW
+# failures. The raw pytest rc is reported but NOT the verdict: the seed
+# tree carries ~75 known-environmental failures.
+#
+# Usage: tools/verify.sh        (from anywhere; cd's to the repo root)
+# Exit:  tier1_diff's code — 0 ok, 3 regression, 2 usage, 76 liveness.
+#
+# Run it with nothing else executing: CPU contention flakes the
+# convergence-threshold tests (ROADMAP.md).
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 2
+
+rm -f /tmp/_t1.log
+timeout -k 10 1080 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+echo "pytest raw rc=$rc (informational; the baseline diff below is the gate)"
+
+python tools/tier1_diff.py --log /tmp/_t1.log
+exit $?
