@@ -300,46 +300,53 @@ def main(argv=None) -> int:
     faults.arm_from_flag_or_env(args.faults, state_dir=args.checkpoint_dir)
 
     from .train.metrics import MetricsLogger
-    logger = MetricsLogger(args.jsonl)
 
-    from .utils import Tracer, set_tracer
-    tracer = None
-    if args.trace:
-        tracer = Tracer()
-        set_tracer(tracer)
+    # context-managed: the JSONL handle closes on EVERY exit path (a
+    # SystemExit out of a task runner used to leak it)
+    with MetricsLogger(args.jsonl) as logger:
+        from .utils import Tracer, set_tracer
+        tracer = None
+        if args.trace:
+            tracer = Tracer()
+            set_tracer(tracer)
 
-    from .train.loop import AnomalousTrainingError
+        from .train.loop import AnomalousTrainingError
 
-    try:
-        if args.dataset in LM_DATASETS:
-            rc = _run_lm(args, logger)
-        elif args.generate_tokens > 0:
-            raise SystemExit(
-                "--generate-tokens applies to the LM datasets only "
-                f"(got --dataset {args.dataset})"
-            )
-        elif args.dataset == "imdb":
-            rc = _run_classifier(args, logger)
-        else:
-            rc = _run_forecaster(args, logger)
-    except AnomalousTrainingError as e:
-        # dedicated exit code: the supervisor relaunches with --resume and
-        # restores the last (clean — updates were skipped) checkpoint
-        import sys
+        try:
+            if args.dataset in LM_DATASETS:
+                rc = _run_lm(args, logger)
+            elif args.generate_tokens > 0:
+                raise SystemExit(
+                    "--generate-tokens applies to the LM datasets only "
+                    f"(got --dataset {args.dataset})"
+                )
+            elif args.dataset == "imdb":
+                rc = _run_classifier(args, logger)
+            else:
+                rc = _run_forecaster(args, logger)
+        except AnomalousTrainingError as e:
+            # dedicated exit code: the supervisor relaunches with --resume
+            # and restores the last (clean — updates were skipped) checkpoint
+            import sys
 
-        from .resilience.exit_codes import ANOMALY_RC
+            from .resilience.exit_codes import ANOMALY_RC
 
-        print(f"anomaly abort: {e} (exit {ANOMALY_RC})", file=sys.stderr)
-        rc = ANOMALY_RC
-    finally:
-        if tracer is not None:
-            set_tracer(None)  # uninstall first: a failed save must not leak it
-            try:
-                tracer.save(args.trace)
-            except OSError as e:
-                # never mask the run's own outcome with a trace-write error
-                print(f"warning: could not write --trace file: {e}")
-    logger.close()
+            print(f"anomaly abort: {e} (exit {ANOMALY_RC})", file=sys.stderr)
+            rc = ANOMALY_RC
+        finally:
+            if tracer is not None:
+                set_tracer(None)  # uninstall first: a failed save must not leak it
+                try:
+                    tracer.save(args.trace)
+                except OSError as e:
+                    # never mask the run's own outcome with a trace-write error
+                    print(f"warning: could not write --trace file: {e}")
+        # final registry snapshot into the JSONL: the run's step-time /
+        # tokens-per-sec / anomalous-step telemetry (obs/), same numbers a
+        # live /metrics scrape would show
+        from .obs import REGISTRY
+
+        logger.log_registry(REGISTRY)
     return rc
 
 
@@ -1300,8 +1307,18 @@ def build_serve_parser() -> argparse.ArgumentParser:
     # --- endpoint / observability ---
     p.add_argument("--host", type=str, default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--telemetry", type=str, default="on",
+                   choices=["on", "off"],
+                   help="metrics registry (obs/): 'on' serves GET /metrics "
+                        "(Prometheus text exposition: server-side TTFT/ITL/"
+                        "queue-wait histograms, compile + cache counters) "
+                        "and histogram summaries in /stats; 'off' swaps in "
+                        "no-op instruments (near-zero record cost) and "
+                        "/metrics reports telemetry disabled")
     p.add_argument("--trace", type=str, default=None,
-                   help="host-side span trace output (Chrome trace JSON)")
+                   help="host-side span trace output (Chrome trace JSON; "
+                        "includes one admit→queue→prefill→decode→readback "
+                        "timeline row per request — open in Perfetto)")
     p.add_argument("--faults", type=str, default=None,
                    help="ARM FAULT INJECTION (chaos drills only): e.g. "
                         "'serve_error@2' raises from the 2nd decode call "
@@ -1384,6 +1401,8 @@ def _build_serve_stack(args):
                 f"every checkpoint in {args.checkpoint_dir} is corrupt "
                 "(now quarantined); refusing to serve an untrained model")
         params = jax.device_get(state.params)
+    from .obs import NULL_REGISTRY, REGISTRY
+
     engine = ServeEngine(
         params, cfg,
         num_slots=args.num_slots,
@@ -1394,6 +1413,10 @@ def _build_serve_stack(args):
         prefix_cache=args.prefix_cache == "on",
         prefix_stride=args.prefix_stride,
         prefix_entries=args.prefix_entries,
+        # one registry argument scopes the whole serve stack's telemetry
+        # (engine, caches, batcher, /metrics); off = no-op instruments
+        registry=NULL_REGISTRY if getattr(args, "telemetry", "on") == "off"
+        else REGISTRY,
     )
     server = ServeServer(engine, max_active=args.max_active,
                          queue_size=args.queue_size,
@@ -1544,6 +1567,10 @@ def _serve_loadgen(args) -> int:
                   "prefill_chunk", "prefill_chunks_dispatched",
                   "prefix_resumed", "prefix_tokens_saved")
     }
+    # server-side registry view (histogram p50/p99 + counters) so the
+    # loadgen JSON carries both measurement sides — see also the per-run
+    # "server_histograms" inside each report
+    out["server_metrics"] = server.metrics_summary()
     print(json.dumps(out))
     # the one-line human summary (stats live in the JSON above)
     r = out.get("levels", {}).get(args.sessions, out)
@@ -1583,7 +1610,8 @@ def _serve_http(args) -> int:
     httpd = make_http_server(server, args.host, args.port)
     host, port = httpd.server_address[:2]
     print(f"serving on http://{host}:{port} (POST /v1/generate, "
-          "GET /healthz, GET /v1/stats) — ctrl-C to stop", flush=True)
+          "GET /healthz, GET /v1/stats, GET /metrics) — ctrl-C to stop",
+          flush=True)
     with server:
         try:
             httpd.serve_forever()

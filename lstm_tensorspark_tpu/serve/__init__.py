@@ -26,9 +26,20 @@ into a serving engine:
   admission (fresh prompts resume from their longest cached prefix) and
   chunked prefill (<= one bounded prefill program per scheduler
   iteration — a long prompt cannot stall running sessions' decode);
-- ``server``: stdlib ThreadingHTTPServer JSON endpoint + in-process client;
+- ``server``: stdlib ThreadingHTTPServer JSON endpoint + in-process
+  client, with ``GET /metrics`` Prometheus exposition of the stack's
+  telemetry registry (obs/) and histogram summaries inside ``/stats``;
 - ``loadgen``: closed/open-loop load generator (p50/p99 request latency,
-  TTFT, inter-token latency, tokens/s).
+  TTFT, inter-token latency, tokens/s), embedding the server-side
+  histogram summaries next to its own percentiles.
+
+Telemetry: every layer records into ONE registry (``ServeEngine(
+registry=...)``, default ``obs.REGISTRY``; ``obs.NULL_REGISTRY``
+disables) — queue depth/wait, scheduler-iteration time, server-side
+TTFT/ITL histograms, window-K and prefill-chunk counters, compile and
+cache events — and the batcher emits per-request
+admit→queue→prefill→decode→readback timelines into the installed
+``utils.tracing`` tracer (``--trace``).
 
 CLI: ``python -m lstm_tensorspark_tpu.cli serve --selftest`` (see cli.py).
 """

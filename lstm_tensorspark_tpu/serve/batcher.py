@@ -61,6 +61,14 @@ always pin a slot without evicting another active session).
 The scheduler is single-threaded by design — `step()` is driven either by
 the server's background thread (`run`) or directly by tests (`drain`);
 `submit` may be called from any thread.
+
+Telemetry (obs/, via ``engine.metrics``): queue depth/wait, scheduler
+iteration time, server-side TTFT and inter-token-latency histograms
+(same timestamp definitions as loadgen's — the two views must agree),
+window-K / prefill-chunk / readback-latency counters, and per-request
+phase timelines (``Request.phases`` → the Chrome tracer under
+``--trace`` + ``phases_ms`` in the HTTP reply). Instruments are resolved
+once at construction; each record site costs a lock + an add.
 """
 
 from __future__ import annotations
@@ -72,6 +80,7 @@ from collections import deque
 
 import numpy as np
 
+from ..utils import tracing
 from .engine import GREEDY, PAD_TOKEN, DecodeWindow, SamplingParams, ServeEngine
 from .state_cache import PREFIX_SID_NAMESPACE
 
@@ -122,8 +131,16 @@ class Request:
         self.cancelled = False  # set by an abandoning client (timeout)
         self.done = threading.Event()
         self.t_submit: float | None = None
+        self.t_admit: float | None = None
         self.t_first_token: float | None = None
         self.t_done: float | None = None
+        # phase timeline: (name, start, end) perf_counter intervals the
+        # scheduler appends as the request moves admit → queue → prefill
+        # chunk(s) → decode window(s) → readback. Cheap (tuple appends);
+        # at completion the batcher emits them into the installed Chrome
+        # tracer (one synthetic row per request) and the HTTP reply
+        # carries phase_summary_ms().
+        self.phases: list[tuple[str, float, float]] = []
         # host-side arrival time of each token (one entry per token):
         # consecutive deltas are the request's inter-token latencies. A
         # decode window delivers its K tokens in one burst, so these make
@@ -138,6 +155,37 @@ class Request:
         gap (reported separately); a window's burst contributes 0.0s
         gaps between its tokens."""
         return [b - a for a, b in zip(self.t_tokens, self.t_tokens[1:])]
+
+    def phase_summary_ms(self) -> dict[str, float]:
+        """Total host-side time per phase (ms) — the per-request breakdown
+        the HTTP reply returns. Decode windows fold into ``decode_ms``
+        (the sync per-token path records ``decode`` directly);
+        ``readback_ms`` is fetch-blocked time. Per phase the spans are
+        UNION-merged, not summed: pipelined decode windows overlap in time
+        (window i+1 is dispatched before window i's fetch), and a plain
+        sum would report decode_ms larger than the request's own
+        latency. Each value is therefore <= the request latency, but
+        DIFFERENT phases still overlap each other under pipelining
+        (window i's readback runs inside window i+1's decode span — the
+        overlap IS the pipeline), so the values don't add up to the
+        latency either."""
+        spans: dict[str, list[tuple[float, float]]] = {}
+        for name, a, b in self.phases:
+            key = "decode" if name == "decode_window" else name
+            spans.setdefault(key, []).append((a, b))
+        out = {}
+        for key, ivs in spans.items():
+            ivs.sort()
+            total, cur_a, cur_b = 0.0, ivs[0][0], ivs[0][1]
+            for a, b in ivs[1:]:
+                if a > cur_b:
+                    total += cur_b - cur_a
+                    cur_a, cur_b = a, b
+                else:
+                    cur_b = max(cur_b, b)
+            total += cur_b - cur_a
+            out[f"{key}_ms"] = round(total * 1e3, 3)
+        return out
 
 
 class _Session:
@@ -257,6 +305,43 @@ class Batcher:
         # scheduler first runs. A dead/stuck scheduler thread stops
         # advancing it — the honest signal a wedged server must emit.
         self.last_heartbeat: float | None = None
+        # telemetry (obs/): instruments resolved ONCE here — the per-event
+        # cost at the record sites is a lock + an add. The registry comes
+        # from the engine so one constructor argument scopes the whole
+        # serve stack (and NULL_REGISTRY turns all of this into no-ops).
+        reg = engine.metrics
+        self._m_queue_depth = reg.gauge(
+            "serve_queue_depth", "requests waiting in the submit queue")
+        self._m_active = reg.gauge(
+            "serve_active_sessions", "sessions in active decode")
+        self._m_prefilling = reg.gauge(
+            "serve_prefilling_sessions", "admitted sessions mid-prefill")
+        self._m_queue_wait = reg.histogram(
+            "serve_queue_wait_seconds", "submit → admission wait")
+        self._m_ttft = reg.histogram(
+            "serve_ttft_seconds", "submit → first token (server-side)")
+        self._m_itl = reg.histogram(
+            "serve_itl_seconds",
+            "inter-token gaps, host arrival times (0 within a window burst)")
+        self._m_iteration = reg.histogram(
+            "serve_scheduler_iteration_seconds",
+            "duration of scheduler iterations that did work")
+        self._m_readback = reg.histogram(
+            "serve_readback_seconds",
+            "decode-window dispatch → tokens on host (fetch latency)")
+        self._m_chunks = reg.counter(
+            "serve_prefill_chunks_total",
+            "head-less bounded prefill chunk programs dispatched")
+        fam = reg.counter("serve_decode_windows_total",
+                          "decode windows dispatched by window size K",
+                          labelnames=("k",))
+        self._m_window_k = {k: fam.labels(k=str(k)) for k in self.window_ladder}
+        fam = reg.counter("serve_requests_total",
+                          "requests by final outcome",
+                          labelnames=("outcome",))
+        self._m_req_completed = fam.labels(outcome="completed")
+        self._m_req_failed = fam.labels(outcome="failed")
+        self._m_req_rejected = fam.labels(outcome="rejected")
 
     # ---- client side ---------------------------------------------------
 
@@ -276,6 +361,7 @@ class Batcher:
         with self._lock:
             if len(self._queue) >= self.queue_size:
                 self.rejected += 1
+                self._m_req_rejected.inc()
                 raise QueueFullError(
                     f"submit queue full ({self.queue_size} pending)"
                 )
@@ -291,9 +377,21 @@ class Batcher:
         a decode advance for every active session). Returns True when any
         work was done."""
         self.last_heartbeat = time.monotonic()
+        t0 = time.perf_counter()
         did = self._admit()
         did = self._prefill_step() or did
         did = self._decode_all() or did
+        with self._lock:
+            queued, active = len(self._queue), len(self._active)
+            prefilling = len(self._prefilling)
+        self._m_queue_depth.set(queued)
+        self._m_active.set(active)
+        self._m_prefilling.set(prefilling)
+        if did:
+            # idle passes are excluded: the histogram answers "how long
+            # does a WORKING iteration hold the scheduler", not "how often
+            # does the idle loop spin"
+            self._m_iteration.observe(time.perf_counter() - t0)
         return did
 
     def _admit(self) -> bool:
@@ -321,7 +419,12 @@ class Batcher:
         if not admit:
             return False
 
+        now = time.perf_counter()
         for req in admit:
+            req.t_admit = now
+            if req.t_submit is not None:
+                self._m_queue_wait.observe(now - req.t_submit)
+                req.phases.append(("queue", req.t_submit, now))
             sid = req.session_id
             if sid is None:
                 # auto ids share a namespace with client-chosen ones:
@@ -501,18 +604,25 @@ class Batcher:
             src_slot, fresh = p.src()
             items.append((p.sess.slot, src_slot, fresh,
                           p.sess.req.prompt[p.pos: stop]))
+        t0 = time.perf_counter()
         try:
             if final:
                 first = self.engine.prefill(items, batch[0].sess.req.sampling)
             else:
                 self.engine.prefill_chunk(items)
                 self.prefill_chunks_dispatched += 1
+                self._m_chunks.inc()
         except Exception as e:
             for p in batch:
                 self._abort_prefilling(
                     p, f"prefill failed: {type(e).__name__}: {e}")
             return
         now = time.perf_counter()
+        phase = "prefill" if final else "prefill_chunk"
+        for p in batch:
+            # final prefill syncs on the first token (np.asarray), so its
+            # span covers device compute; a chunk's span is dispatch only
+            p.sess.req.phases.append((phase, t0, now))
         for i, p in enumerate(batch):
             # the gather from a prefix slot is in flight and data-ordered:
             # the ref can drop now — and only now did the resume actually
@@ -529,6 +639,8 @@ class Batcher:
                 self._prefilling.remove(p)
             s = p.sess
             s.req.t_first_token = now
+            if s.req.t_submit is not None:
+                self._m_ttft.observe(now - s.req.t_submit)
             self._append_token(s, int(first[i]))
             if s.remaining == 0:
                 self._finish(s)
@@ -595,14 +707,17 @@ class Batcher:
                 chunk = group[i : i + self.engine.max_batch]
                 slots = [s.slot for s in chunk]
                 toks = [s.last_token for s in chunk]
+                t0 = time.perf_counter()
                 try:
                     nxt = self.engine.decode(slots, toks, chunk[0].req.sampling)
                 except Exception as e:
                     self._fail_chunk(
                         chunk, f"decode failed: {type(e).__name__}: {e}")
                     continue
+                t1 = time.perf_counter()
                 for s, tok in zip(chunk, nxt):
-                    self._append_token(s, int(tok))
+                    s.req.phases.append(("decode", t0, t1))
+                    self._append_token(s, int(tok), t1)
                     if s.remaining == 0:
                         self._retire(s)
                         self._finish(s)
@@ -637,7 +752,13 @@ class Batcher:
             self._fail_chunk(sessions, f"decode failed: {type(e).__name__}: {e}")
             return
         self.windows_dispatched[k] = self.windows_dispatched.get(k, 0) + 1
+        self._count_window(k)
         self._pending = (win, list(sessions))
+
+    def _count_window(self, k: int) -> None:
+        m = self._m_window_k.get(k)
+        if m is not None:  # ladder rungs are pre-resolved; others skipped
+            m.inc()
 
     def _resolve_pending(self, pipeline: bool = True) -> None:
         """Resolve the in-flight window: if steady state still holds,
@@ -666,15 +787,23 @@ class Batcher:
                     return
                 self.windows_dispatched[nxt.window] = (
                     self.windows_dispatched.get(nxt.window, 0) + 1)
+                self._count_window(nxt.window)
                 self.windows_pipelined += 1
                 self._pending = (nxt, list(sessions))
         # the pipeline's only sync point: blocks on window i while window
         # i+1 (if dispatched above) runs on device
+        t_fetch = time.perf_counter()
         toks = self.engine.fetch_window(win)
         now = time.perf_counter()
+        # dispatch→fetch-complete: how long the window's tokens took to
+        # reach the host after its program was dispatched (device compute
+        # + readback, minus whatever the scheduler overlapped)
+        self._m_readback.observe(now - win.t_dispatch)
         for s, row in zip(sessions, toks):
             if s.req.cancelled or s.req.done.is_set():
                 continue  # the cancel sweep / a prior window settled it
+            s.req.phases.append(("decode_window", win.t_dispatch, t_fetch))
+            s.req.phases.append(("readback", t_fetch, now))
             for tok in row:
                 if tok == PAD_TOKEN:
                     break
@@ -693,8 +822,15 @@ class Batcher:
 
     def _append_token(self, s: _Session, tok: int,
                       t: float | None = None) -> None:
+        if t is None:
+            t = time.perf_counter()
+        if s.req.t_tokens:
+            # server-side inter-token latency: same gap definition as
+            # Request.itl_gaps()/loadgen (host arrival deltas; a window's
+            # burst contributes 0.0 gaps), so the two views must agree
+            self._m_itl.observe(t - s.req.t_tokens[-1])
         s.req.tokens.append(tok)
-        s.req.t_tokens.append(time.perf_counter() if t is None else t)
+        s.req.t_tokens.append(t)
         s.last_token = tok
         s.remaining -= 1
         self.tokens_generated += 1
@@ -718,13 +854,34 @@ class Batcher:
             self.engine.cache.release(s.sid)
         s.req.t_done = time.perf_counter()
         self.completed += 1
+        self._m_req_completed.inc()
+        self._emit_timeline(s.req)
         s.req.done.set()
 
     def _fail(self, req: Request, error: str) -> None:
         req.error = error
         req.t_done = time.perf_counter()
         self.failed += 1
+        self._m_req_failed.inc()
+        self._emit_timeline(req)
         req.done.set()
+
+    @staticmethod
+    def _emit_timeline(req: Request) -> None:
+        """Emit the request's phase timeline into the installed Chrome
+        tracer (``--trace``): one complete event per phase on a synthetic
+        per-request row, so Perfetto shows each request's
+        admit→queue→prefill→decode→readback lane. No tracer → free."""
+        t = tracing.get_tracer()
+        if t is None or not req.phases:
+            return
+        tid = req.id  # request ids are tiny; pthread idents are huge —
+        t.set_tid_name(tid, f"request {req.id}")  # no collision in practice
+        for name, a, b in req.phases:
+            t.complete(name, a, b, tid=tid, request=req.id)
+        if req.error is not None:
+            t.complete("failed", req.phases[-1][2], req.t_done, tid=tid,
+                       request=req.id, error=req.error)
 
     # ---- drivers -------------------------------------------------------
 

@@ -68,6 +68,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from collections import defaultdict
 
 import jax
@@ -75,6 +76,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .. import obs
 from ..models.generate import decode_one, fuse_layers, sample_logits
 from ..models.lstm_lm import LMConfig, _head_kernel, lm_backbone
 from ..resilience import faults as _faults
@@ -124,6 +126,10 @@ class DecodeWindow:
     window: int
     n: int                  # live (non-padding) rows; fetch strips the rest
     sampling: SamplingParams
+    # host perf_counter stamp taken right after dispatch: the batcher
+    # derives dispatch→fetch readback latency and the request timeline's
+    # decode_window span from it (telemetry only — never device-ordered)
+    t_dispatch: float = 0.0
 
 
 def _bucket_for(value: int, buckets: tuple[int, ...], what: str) -> int:
@@ -152,6 +158,7 @@ class ServeEngine:
         prefix_cache: bool = False,
         prefix_stride: int = 8,
         prefix_entries: int = 16,
+        registry=None,
     ):
         # serving never rematerialises (same override as generate())
         if cfg.remat_chunk is not None:
@@ -161,13 +168,19 @@ class ServeEngine:
         self.fused_layers = fuse_layers(params, cfg)  # once, at init
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         self.batch_buckets = tuple(sorted(batch_buckets))
-        self.cache = StateCache(cfg.num_layers, num_slots, cfg.hidden_size)
+        # the telemetry registry every serve-side component records into
+        # (obs.REGISTRY process-wide default; obs.NULL_REGISTRY disables);
+        # the batcher and server read engine.metrics so one constructor
+        # argument scopes the whole stack
+        self.metrics = obs.REGISTRY if registry is None else registry
+        self.cache = StateCache(cfg.num_layers, num_slots, cfg.hidden_size,
+                                registry=self.metrics)
         # shared-prompt prefix reuse (state_cache.PrefixCache): opt-in at
         # engine construction; the batcher consults engine.prefix on every
         # fresh admission when present
         self.prefix = (
             PrefixCache(self.cache, stride=prefix_stride,
-                        max_entries=prefix_entries)
+                        max_entries=prefix_entries, registry=self.metrics)
             if prefix_cache else None
         )
         # sampling params are compile keys and client-controlled at the
@@ -191,6 +204,18 @@ class ServeEngine:
         # wedged — dispatch just to copy a counter dict
         self._counts_lock = threading.Lock()
         self._warming = False  # warmup decodes bypass the fault hook
+        # per-phase compile counter for /metrics, bumped at trace time
+        # alongside compile_counts (which keeps the full per-key detail
+        # for /stats — bucket/window/sampling tuples are too wide for
+        # Prometheus label cardinality)
+        fam = self.metrics.counter(
+            "serve_compiles_total", "XLA traces by program phase",
+            labelnames=("phase",))
+        self._m_compiles = {
+            phase: fam.labels(phase=phase)
+            for phase in ("prefill", "prefill_chunk", "decode",
+                          "decode_window")
+        }
 
     # ---- limits --------------------------------------------------------
 
@@ -264,6 +289,7 @@ class ServeEngine:
             # trace-time side effect: one bump per XLA compile of this shape
             with self._counts_lock:
                 self.compile_counts[count_key] += 1
+            self._m_compiles["prefill"].inc()
             h_cache, c_cache, ys = self._consume_prompt(
                 h_cache, c_cache, params, src_slots, dst_slots, fresh,
                 prompts, lengths, len_b)
@@ -305,6 +331,7 @@ class ServeEngine:
                      prompts, lengths):
             with self._counts_lock:
                 self.compile_counts[count_key] += 1
+            self._m_compiles["prefill_chunk"].inc()
             h_cache, c_cache, _ = self._consume_prompt(
                 h_cache, c_cache, params, src_slots, dst_slots, fresh,
                 prompts, lengths, len_b)
@@ -325,6 +352,7 @@ class ServeEngine:
         def decode_fn(params, fused, h_cache, c_cache, slots, tokens, rng):
             with self._counts_lock:
                 self.compile_counts[count_key] += 1
+            self._m_compiles["decode"].inc()
             h_in = h_cache[:, slots, :]
             c_in = c_cache[:, slots, :]
             carries = [(h_in[l], c_in[l]) for l in range(cfg.num_layers)]
@@ -358,6 +386,7 @@ class ServeEngine:
                       alive, remaining, eos_ids, rng):
             with self._counts_lock:
                 self.compile_counts[count_key] += 1
+            self._m_compiles["decode_window"].inc()
             h_in = h_cache[:, slots, :]
             c_in = c_cache[:, slots, :]
             carries = [(h_in[l], c_in[l]) for l in range(cfg.num_layers)]
@@ -573,7 +602,7 @@ class ServeEngine:
         return DecodeWindow(
             tokens=toks, next_tokens=next_tok, alive=alive, remaining=rem,
             slots=slots_d, eos_ids=eos_d, batch_b=batch_b, window=window,
-            n=n, sampling=sampling,
+            n=n, sampling=sampling, t_dispatch=time.perf_counter(),
         )
 
     def decode_window_next(self, prev: DecodeWindow, *,
@@ -601,7 +630,7 @@ class ServeEngine:
             self.cache.swap(h, c)
         return dataclasses.replace(
             prev, tokens=toks, next_tokens=next_tok, alive=alive,
-            remaining=rem, window=window,
+            remaining=rem, window=window, t_dispatch=time.perf_counter(),
         )
 
     @staticmethod

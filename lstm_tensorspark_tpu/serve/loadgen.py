@@ -262,6 +262,17 @@ def run_loadgen(
             "entries": after["entries"],
             "invalidated": after["invalidated"] - prefix_before["invalidated"],
         }
+    # server-side latency distributions (obs/ registry histograms) next to
+    # the loadgen-side percentiles above, so the two views are directly
+    # diffable. NOTE: the registry is CUMULATIVE over the server's life —
+    # a sweep's later levels include earlier levels' samples.
+    summary = server.metrics_summary()
+    hists = {k: summary[k] for k in ("serve_ttft_seconds",
+                                     "serve_itl_seconds",
+                                     "serve_queue_wait_seconds")
+             if isinstance(summary.get(k), dict)}
+    if hists:
+        report["server_histograms"] = hists
     return report
 
 

@@ -23,7 +23,18 @@ by both front-ends:
     never started (a wedged server must fail probes, not smile at them);
     ``GET /stats`` (alias ``/v1/stats``) → batcher/engine/cache counters:
     per-key compile counts, prefix-cache hit/miss/evict/invalidate,
-    state-cache swap generation, prefill-chunk/window dispatch counts.
+    state-cache swap generation, prefill-chunk/window dispatch counts,
+    plus ``metrics`` — histogram summaries (p50/p99) and counter/gauge
+    values from the telemetry registry (obs/);
+  - ``GET /metrics`` → Prometheus text exposition of the same registry
+    (histograms as cumulative buckets): server-side TTFT,
+    inter-token-latency and queue-wait distributions, scheduler
+    iteration time, readback latency, compile/cache/prefix counters —
+    the live-server view of what loadgen could only measure offline.
+
+  Each generate reply also carries ``phases_ms`` — the request's own
+  queue/prefill/decode/readback host-time breakdown (the per-request
+  trace timeline, summarised; the full timeline goes to ``--trace``).
 
   Backpressure maps to HTTP: full queue → 429, bad request → 400,
   scheduler failure → 500, timeout → 504.
@@ -128,7 +139,41 @@ class ServeServer:
         return req
 
     def stats(self) -> dict:
-        return {"batcher": self.batcher.stats(), **self.engine.stats()}
+        return {"batcher": self.batcher.stats(), **self.engine.stats(),
+                "metrics": self.metrics_summary()}
+
+    def _collect_gauges(self) -> None:
+        """Refresh poll-style gauges at scrape time — an idle server's
+        scheduler may not have run since the last change, and cache
+        occupancy is cheapest read on demand."""
+        reg = self.engine.metrics
+        b = self.batcher.stats()
+        reg.gauge("serve_queue_depth").set(b["queued"])
+        reg.gauge("serve_active_sessions").set(b["active"])
+        reg.gauge("serve_prefilling_sessions").set(b["prefilling"])
+        c = self.engine.cache.stats()
+        fam = reg.gauge("serve_state_cache_slots",
+                        "state-cache slot occupancy", labelnames=("state",))
+        fam.labels(state="live").set(c["live_sessions"])
+        fam.labels(state="pinned").set(c["pinned"])
+        fam.labels(state="free").set(c["free"])
+        if self.engine.prefix is not None:
+            reg.gauge("serve_prefix_cache_entries",
+                      "live prefix-cache entries").set(
+                self.engine.prefix.stats()["entries"])
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the serve stack's registry
+        (``GET /metrics``)."""
+        self._collect_gauges()
+        return self.engine.metrics.render_prometheus()
+
+    def metrics_summary(self) -> dict:
+        """JSON-ready registry view (histograms as {count,sum,p50,p99})
+        — embedded in ``/stats`` and the loadgen/bench reports so
+        server-side and loadgen-side percentiles sit next to each other."""
+        self._collect_gauges()
+        return self.engine.metrics.summaries()
 
     def health(self) -> dict:
         """Honest liveness: ``ok`` requires the scheduler THREAD to be
@@ -213,8 +258,20 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path in ("/stats", "/v1/stats"):
             # one payload, two routes: per-key compile counts, prefix-cache
             # hit/miss/evict/invalidate counters, state-cache swap
-            # generation, batcher chunk/window counters
+            # generation, batcher chunk/window counters + registry
+            # histogram summaries (p50/p99)
             self._reply(200, self._serve.stats())
+        elif self.path == "/metrics":
+            # Prometheus text exposition (server-side TTFT/ITL/queue-wait
+            # histograms as cumulative buckets; see docs/OPERATIONS.md for
+            # the scrape config and runbook)
+            data = self._serve.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -264,6 +321,9 @@ class _Handler(BaseHTTPRequestHandler):
             "ttft_ms": round((req.t_first_token - req.t_submit) * 1e3, 3)
             if req.t_first_token and req.t_submit else None,
             "max_itl_ms": round(max(gaps) * 1e3, 3) if gaps else None,
+            # per-request phase breakdown (queue/prefill/decode/readback
+            # host time) — the trace timeline, summarised into the reply
+            "phases_ms": req.phase_summary_ms(),
         })
 
 

@@ -51,6 +51,8 @@ from typing import NamedTuple
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
+
 
 class CacheFullError(RuntimeError):
     """No free slot and every occupied slot is pinned."""
@@ -70,7 +72,8 @@ class DetachedState(NamedTuple):
 
 
 class StateCache:
-    def __init__(self, num_layers: int, num_slots: int, hidden_size: int):
+    def __init__(self, num_layers: int, num_slots: int, hidden_size: int,
+                 registry=None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.num_layers = num_layers
@@ -85,6 +88,16 @@ class StateCache:
         self._pinned: set[str] = set()
         self.evictions = 0
         self.generation = 0  # device programs applied via swap()
+        # registry counters feed /metrics; the per-instance ints above stay
+        # the source for this instance's stats() (the registry aggregates
+        # across every cache in the process — Prometheus semantics)
+        reg = obs.REGISTRY if registry is None else registry
+        self._m_evictions = reg.counter(
+            "serve_state_cache_evictions_total",
+            "LRU evictions of unpinned session slots")
+        self._m_swaps = reg.counter(
+            "serve_state_cache_swaps_total",
+            "device programs applied to the cache arrays (generation)")
         # eviction listeners: called (under the cache lock) with the sid of
         # every LRU-evicted session — the prefix cache registers here so a
         # slot eviction INVALIDATES the dependent prefix entry instead of
@@ -129,6 +142,7 @@ class StateCache:
             if sid not in self._pinned:
                 slot = self._slots.pop(sid)
                 self.evictions += 1
+                self._m_evictions.inc()
                 for listener in self.evict_listeners:
                     listener(sid)
                 return slot
@@ -171,6 +185,7 @@ class StateCache:
         data-ordered through the handles)."""
         self.h, self.c = h, c
         self.generation += 1
+        self._m_swaps.inc()
 
     def read_slots(self, slots) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Gather carries for ``slots`` [B] → (h, c) each ``[L, B, H]``."""
@@ -286,7 +301,7 @@ class PrefixCache:
     """
 
     def __init__(self, cache: StateCache, *, stride: int = 8,
-                 max_entries: int = 16):
+                 max_entries: int = 16, registry=None):
         if stride < 1:
             raise ValueError(f"stride must be >= 1, got {stride}")
         if max_entries < 1:
@@ -303,6 +318,18 @@ class PrefixCache:
         self.inserts = 0
         self.evictions = 0     # own LRU (full prefix cache)
         self.invalidated = 0   # backing slot evicted under us
+        # /metrics mirror of the per-instance counters above (one registry
+        # family per outcome; stats() keeps serving the instance's ints)
+        reg = obs.REGISTRY if registry is None else registry
+        self._m = reg.counter(
+            "serve_prefix_cache_events_total",
+            "prefix-cache outcomes (hit/miss/insert/evict/invalidate)",
+            labelnames=("event",))
+        self._m_hit = self._m.labels(event="hit")
+        self._m_miss = self._m.labels(event="miss")
+        self._m_insert = self._m.labels(event="insert")
+        self._m_evict = self._m.labels(event="evict")
+        self._m_invalidate = self._m.labels(event="invalidate")
         cache.evict_listeners.append(self._on_slot_evicted)
 
     @staticmethod
@@ -340,8 +367,10 @@ class PrefixCache:
                     self.cache.pin(entry.sid)
                 entry.refs += 1
                 self.hits += 1
+                self._m_hit.inc()
                 return entry, entry.length
             self.misses += 1
+            self._m_miss.inc()
             return None, 0
 
     def release(self, entry: PrefixEntry) -> None:
@@ -385,6 +414,7 @@ class PrefixCache:
             self._entries[key] = entry
             self._by_sid[sid] = key
             self.inserts += 1
+            self._m_insert.inc()
             return True
 
     def _evict_entry_locked(self, entry: PrefixEntry) -> None:
@@ -392,6 +422,7 @@ class PrefixCache:
         self._by_sid.pop(entry.sid, None)
         self.cache.release(entry.sid)
         self.evictions += 1
+        self._m_evict.inc()
 
     def _on_slot_evicted(self, sid: str) -> None:
         # state-cache LRU took a backing slot: the dependent entry is now
@@ -401,6 +432,7 @@ class PrefixCache:
         if key is not None:
             self._entries.pop(key, None)
             self.invalidated += 1
+            self._m_invalidate.inc()
 
     def __len__(self) -> int:
         with self._lock:

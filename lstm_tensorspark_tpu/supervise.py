@@ -49,6 +49,7 @@ import sys
 import threading
 import time
 
+from . import obs
 from .resilience import ckpt_layout
 from .resilience.exit_codes import POISON_RC, RETRYABLE_RCS, USAGE_RC
 
@@ -219,6 +220,17 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
                 return run_with_stall_watch(cmd, stall_timeout)
             return subprocess.run(cmd).returncode
 
+    # telemetry (obs/): restart/backoff accounting in the process-wide
+    # registry — a long-lived supervisor's churn becomes scrapeable (and a
+    # MetricsLogger.log_registry snapshot in any co-resident run carries it)
+    m_restarts = obs.REGISTRY.counter(
+        "supervise_restarts_total", "child relaunches after failure")
+    m_backoff = obs.REGISTRY.counter(
+        "supervise_backoff_seconds_total", "total time slept backing off")
+    m_verdicts = obs.REGISTRY.counter(
+        "supervise_terminal_total",
+        "terminal supervisor verdicts (poisoned/deterministic/exhausted)",
+        labelnames=("verdict",))
     attempt = 0
     _UNSET = object()
     prev_ckpt_step = _UNSET  # latest checkpoint step at the PREVIOUS failure
@@ -245,6 +257,7 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
         if _deterministic_failure(rc, lifetime, subprocess_runner):
             print(f"supervise: child failed deterministically (exit {rc} "
                   f"after {lifetime:.2f}s) — not retrying", file=sys.stderr)
+            m_verdicts.labels(verdict="deterministic").inc()
             return rc
         # Forward-progress check: between consecutive FAILURES the latest
         # restorable checkpoint step must advance, or the restarts are a
@@ -272,6 +285,7 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
                           f"failures without checkpoint progress (stuck at "
                           f"step {cur}); giving up (exit {POISON_RC})",
                           file=sys.stderr)
+                    m_verdicts.labels(verdict="poisoned").inc()
                     return POISON_RC
             else:
                 no_progress = 0
@@ -279,10 +293,13 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
         if attempt >= max_restarts:
             print(f"supervise: giving up after {attempt} restart(s) "
                   f"(last exit code {rc})", file=sys.stderr)
+            m_verdicts.labels(verdict="exhausted").inc()
             return rc
         attempt += 1
         delay = backoff_delay(restart_delay, attempt, cap=max_delay,
                               rand=rand)
+        m_restarts.inc()
+        m_backoff.inc(delay)
         print(f"supervise: child exited {rc}; restart {attempt}/"
               f"{max_restarts} in {delay:.1f}s", file=sys.stderr)
         time.sleep(delay)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from .. import obs
 from ..resilience import faults as _faults
 
 
@@ -390,6 +391,21 @@ def train_loop(
     anomalous_total = 0
     anomalous_consec = 0
     best_val = best_init
+    # telemetry (obs/): step-time/tokens-per-sec recorded at the log
+    # cadence from the SAME window timings the JSONL records use (no
+    # extra host sync); anomalous steps counted wherever the scalar is
+    # already fetched. MetricsLogger.log_registry snapshots these.
+    _m_step = obs.REGISTRY.histogram(
+        "train_step_seconds", "mean optimizer-step wall time per log window",
+        buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0))
+    _m_tps = obs.REGISTRY.gauge(
+        "train_tokens_per_sec", "training throughput at the last log point")
+    _m_steps = obs.REGISTRY.counter(
+        "train_steps_total", "optimizer steps driven (log-window granular)")
+    _m_anomalous = obs.REGISTRY.counter(
+        "train_anomalous_steps_total",
+        "non-finite steps whose update was skipped")
     if num_steps is not None and num_steps <= 0:
         return state  # eval-only budget: never pull a batch from the feed
     for i, batch in enumerate(batches):
@@ -405,6 +421,8 @@ def train_loop(
         if anomaly_limit and "anomalous" in metrics:
             bad = int(float(metrics["anomalous"]))  # sync point (documented)
             anomalous_total += bad
+            if bad:
+                _m_anomalous.inc(bad)
             if bad >= steps_per_call:
                 anomalous_consec += bad
             else:
@@ -422,6 +440,9 @@ def train_loop(
             now = time.perf_counter()
             dt = now - window_start
             window_start = now
+            window_steps = log_every * steps_per_call
+            _m_step.observe(dt / window_steps)
+            _m_steps.inc(window_steps)
             record = {
                 "step": int(state.step),
                 "loss": loss,
@@ -438,9 +459,11 @@ def train_loop(
                 bad = float(metrics["anomalous"])
                 if bad:
                     record["anomalous"] = bad
+                    _m_anomalous.inc(bad)
             if tokens_per_batch:
                 tps = tokens_per_batch * log_every * steps_per_call / dt
                 record["tokens_per_sec"] = tps
+                _m_tps.set(tps)
                 if flops_per_token:
                     # live MFU: achieved model TFLOP/s (train = 3x forward
                     # matmul accounting, utils/flops.py). ``peak_tflops``

@@ -3,6 +3,13 @@
 Reference parity: SURVEY.md §5 "Metrics / logging" — the reference prints
 per-epoch loss to driver stdout and leans on the Spark UI; structured metrics
 are new capability (jsonl lines consumable by any downstream tooling).
+
+``MetricsLogger`` is a context manager (``with MetricsLogger(path) as
+logger``) so the JSONL handle closes on exception paths too — cli.py runs
+every task under it. :meth:`log_registry` writes one flat snapshot record
+of a telemetry registry (obs/) — histogram count/sum/p50/p99 plus
+counter/gauge values — so a training run's JSONL ends with the same
+numbers a live ``/metrics`` scrape would have shown.
 """
 
 from __future__ import annotations
@@ -32,6 +39,22 @@ class MetricsLogger:
             )
             print(parts, file=self.stream, flush=True)
 
+    def log_registry(self, registry, note: str = "metrics_snapshot") -> None:
+        """One flat record of the registry's current state (histograms as
+        ``name_count``/``name_sum``/``name_p50``/``name_p99`` keys)."""
+        snap = registry.snapshot()
+        if snap:
+            self.log({"note": note, **snap})
+
     def close(self) -> None:
         if self._fh:
             self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # close on success AND on exception/SystemExit paths — the JSONL
+        # handle must never leak past the run that opened it
+        self.close()

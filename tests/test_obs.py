@@ -1,0 +1,183 @@
+"""obs/: metrics registry, instruments, Prometheus exposition contract.
+
+Covers the ISSUE-5 /metrics test checklist: exposition-format validity
+(types declared, parseable samples, bucket monotonicity, _sum/_count
+consistency), histogram correctness under concurrent recording, and the
+quantile estimator's bucket-level accuracy.
+"""
+
+import math
+import threading
+
+import pytest
+
+from lstm_tensorspark_tpu.obs import (
+    DEFAULT_LATENCY_BUCKETS,
+    NULL_REGISTRY,
+    Histogram,
+    MetricsRegistry,
+    parse_exposition,
+)
+
+
+def test_counter_and_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total", "help text")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("depth")
+    g.set(7)
+    g.inc()
+    g.dec(3)
+    assert g.value == 5.0
+
+
+def test_registration_is_idempotent_but_kind_safe():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total")
+    assert reg.counter("x_total") is a  # same family back
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")  # one name, one meaning
+    with pytest.raises(ValueError):
+        reg.counter("x_total", labelnames=("k",))  # labelset is part of it
+    with pytest.raises(ValueError):
+        reg.counter("bad name")
+    h = reg.histogram("lat", buckets=(1.0, 2.0))
+    assert reg.histogram("lat", buckets=(1.0, 2.0)) is h
+    with pytest.raises(ValueError):
+        reg.histogram("lat", buckets=(5.0,))  # silently requantizing the
+        # second caller's observations would be the same one-name lie
+    with pytest.raises(ValueError):
+        reg.histogram("h", buckets=(1.0, 1.0))  # not strictly increasing
+    with pytest.raises(ValueError):
+        reg.histogram("h2", buckets=(1.0, float("inf")))  # +Inf is implicit
+
+
+def test_labels():
+    reg = MetricsRegistry()
+    fam = reg.counter("req_total", labelnames=("outcome",))
+    fam.labels(outcome="ok").inc(3)
+    fam.labels(outcome="err").inc()
+    assert fam.labels(outcome="ok").value == 3
+    with pytest.raises(ValueError):
+        fam.labels(wrong="x")
+    text = reg.render_prometheus()
+    assert 'req_total{outcome="ok"} 3' in text
+    assert 'req_total{outcome="err"} 1' in text
+
+
+def test_histogram_buckets_sum_count():
+    h = Histogram(buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 2.0, 100.0):  # 0.1 lands IN le=0.1 (inclusive)
+        h.observe(v)
+    counts, s, total = h.snapshot()
+    assert counts == [2, 1, 1, 1]  # last = +Inf overflow
+    assert total == 5 and abs(s - 102.65) < 1e-9
+    summ = h.summary()
+    assert summ["count"] == 5 and 0.1 <= summ["p50"] <= 1.0
+
+
+def test_quantile_lands_in_the_right_bucket():
+    h = Histogram(buckets=DEFAULT_LATENCY_BUCKETS)
+    for _ in range(100):
+        h.observe(0.003)  # bucket (0.0025, 0.005]
+    assert 0.0025 <= h.quantile(0.5) <= 0.005
+    assert 0.0025 <= h.quantile(0.99) <= 0.005
+    empty = Histogram()
+    assert math.isnan(empty.quantile(0.5))
+    # overflow-only mass clamps to the largest finite bound
+    h2 = Histogram(buckets=(1.0,))
+    h2.observe(50.0)
+    assert h2.quantile(0.5) == 1.0
+
+
+def test_histogram_concurrent_recording():
+    """N threads hammering one histogram must lose nothing: count, sum,
+    and the bucket totals all reconcile."""
+    h = Histogram(buckets=(0.5, 1.5, 2.5))
+    per_thread, n_threads = 1998, 8  # divisible by 3: exact bucket splits
+
+    def work(seed):
+        for i in range(per_thread):
+            h.observe((seed + i) % 3)  # values 0, 1, 2 round-robin
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    counts, s, total = h.snapshot()
+    expect = per_thread * n_threads
+    assert total == expect
+    assert sum(counts) == expect
+    assert abs(s - sum((i % 3) for i in range(3)) * expect / 3) < 1e-6
+    # values 0/1/2 split evenly across the first three buckets
+    assert counts[:3] == [expect // 3] * 3 and counts[3] == 0
+
+
+def test_exposition_parses_and_validates():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "things").inc(2)
+    reg.gauge("b", "level").set(-1.5)
+    hist = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    hist.observe(0.05)
+    hist.observe(5.0)
+    fam = reg.histogram("lab_seconds", labelnames=("k",), buckets=(1.0,))
+    fam.labels(k="4").observe(0.5)
+    text = reg.render_prometheus()
+    fams = parse_exposition(text)  # raises on any format violation
+    assert fams["a_total"]["type"] == "counter"
+    assert ("a_total", {}, 2.0) in fams["a_total"]["samples"]
+    assert ("b", {}, -1.5) in fams["b"]["samples"]
+    hs = {name: (labels, v)
+          for name, labels, v in fams["lat_seconds"]["samples"]}
+    assert hs["lat_seconds_count"][1] == 2.0
+    assert abs(hs["lat_seconds_sum"][1] - 5.05) < 1e-9
+    buckets = [(labels["le"], v) for name, labels, v
+               in fams["lat_seconds"]["samples"]
+               if name == "lat_seconds_bucket"]
+    assert buckets == [("0.1", 1.0), ("1", 1.0), ("+Inf", 2.0)]
+    # labelled histogram series round-trips too
+    assert any(labels.get("k") == "4"
+               for _, labels, _ in fams["lab_seconds"]["samples"])
+
+
+@pytest.mark.parametrize("bad", [
+    "no_type_decl 1",                                   # sample without TYPE
+    "# TYPE h histogram\nh_bucket{le=\"1\"} 2\nh_bucket{le=\"+Inf\"} 1\n"
+    "h_sum 1\nh_count 1",                               # buckets decrease
+    "# TYPE h histogram\nh_bucket{le=\"1\"} 1\nh_sum 1\nh_count 1",  # no +Inf
+    "# TYPE h histogram\nh_bucket{le=\"+Inf\"} 3\nh_sum 1\nh_count 2",  # count
+    "# TYPE c counter\nc{=} 1",                         # bad label block
+    "# TYPE c counter\nc one",                          # bad value
+])
+def test_exposition_validator_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_exposition(bad)
+
+
+def test_null_registry_noops():
+    c = NULL_REGISTRY.counter("x", "whatever")
+    c.inc()
+    c.labels(a="b").inc(5)
+    assert c.value == 0.0
+    h = NULL_REGISTRY.histogram("h")
+    h.observe(1.0)
+    assert h.summary() == {}
+    assert NULL_REGISTRY.summaries() == {}
+    assert "disabled" in NULL_REGISTRY.render_prometheus()
+
+
+def test_snapshot_flattens_for_jsonl():
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(4)
+    h = reg.histogram("h_seconds", buckets=(1.0, 2.0))
+    h.observe(0.5)
+    snap = reg.snapshot()
+    assert snap["c_total"] == 4
+    assert snap["h_seconds_count"] == 1
+    assert "h_seconds_p50" in snap and "h_seconds_p99" in snap

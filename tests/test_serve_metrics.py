@@ -1,0 +1,209 @@
+"""Serve-side telemetry: /metrics exposition over the real HTTP endpoint,
+histogram summaries in /stats, per-request phase breakdowns, server-vs-
+loadgen latency agreement, and the --trace request timelines.
+
+One module-scoped server with its OWN MetricsRegistry (not the process
+default) so every assertion reads exactly this stack's telemetry.
+"""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from lstm_tensorspark_tpu.models import LMConfig, init_lm
+from lstm_tensorspark_tpu.obs import (
+    DEFAULT_LATENCY_BUCKETS,
+    NULL_REGISTRY,
+    MetricsRegistry,
+    parse_exposition,
+)
+from lstm_tensorspark_tpu.serve import ServeEngine, ServeServer, run_loadgen
+from lstm_tensorspark_tpu.utils import Tracer, set_tracer
+
+_CFG = LMConfig(vocab_size=37, hidden_size=16, num_layers=2)
+
+
+def _build(registry):
+    params = init_lm(jax.random.PRNGKey(3), _CFG)
+    engine = ServeEngine(
+        params, _CFG, num_slots=8,
+        prefill_buckets=(4, 8), batch_buckets=(1, 2, 4),
+        registry=registry,
+    )
+    return ServeServer(engine, max_active=4, queue_size=16)
+
+
+@pytest.fixture(scope="module")
+def stack():
+    reg = MetricsRegistry()
+    server = _build(reg)
+    server.start()
+    yield reg, server
+    server.stop()
+
+
+def test_metrics_route_serves_valid_exposition(stack):
+    from lstm_tensorspark_tpu.serve.server import make_http_server
+
+    reg, server = stack
+    httpd = make_http_server(server, port=0)
+    host, port = httpd.server_address[:2]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        base = f"http://{host}:{port}"
+        body = json.dumps({"prompt": [5, 1, 2], "max_new_tokens": 6,
+                           "greedy": True}).encode()
+        req = urllib.request.Request(
+            base + "/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            ctype = r.headers["Content-Type"]
+            text = r.read().decode()
+        with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+    assert ctype.startswith("text/plain")
+    fams = parse_exposition(text)  # raises on any format violation
+    # the headline server-side distributions are all present as histograms
+    for name in ("serve_ttft_seconds", "serve_itl_seconds",
+                 "serve_queue_wait_seconds",
+                 "serve_scheduler_iteration_seconds"):
+        assert fams[name]["type"] == "histogram", name
+        count = next(v for n, _, v in fams[name]["samples"]
+                     if n == name + "_count")
+        assert count >= 1, name
+    # compile counters carry the phase label
+    phases = {labels["phase"] for _, labels, _
+              in fams["serve_compiles_total"]["samples"]}
+    assert {"prefill", "decode"} <= phases
+    assert fams["serve_requests_total"]["type"] == "counter"
+
+    # the HTTP reply carries the per-request phase breakdown
+    assert out["phases_ms"].get("queue_ms") is not None
+    assert out["phases_ms"].get("prefill_ms", 0) > 0
+    assert "decode_ms" in out["phases_ms"]
+
+    # /stats (the JSON alias) now embeds histogram summaries
+    ms = stats["metrics"]
+    assert ms["serve_ttft_seconds"]["count"] >= 1
+    assert "p50" in ms["serve_ttft_seconds"]
+    assert "p99" in ms["serve_ttft_seconds"]
+
+
+def _bucket_span(value_s: float) -> float:
+    """Width of the DEFAULT_LATENCY_BUCKETS bucket containing value_s —
+    the histogram's resolution at that point, hence the agreement bound."""
+    lo = 0.0
+    for hi in DEFAULT_LATENCY_BUCKETS:
+        if value_s <= hi:
+            return hi - lo
+        lo = hi
+    return float("inf")
+
+
+def test_server_percentiles_agree_with_loadgen():
+    """Server-side TTFT/ITL histograms and loadgen's sorted-sample
+    percentiles observe the SAME timestamps, so they must agree to within
+    the histogram's bucket resolution (the only quantization between
+    them). Fresh registry + warmed server: the histograms then hold
+    exactly this run's samples (no compile-inflated outliers)."""
+    reg = MetricsRegistry()
+    server = _build(reg)
+    with server:
+        server.warmup(prompt_lens=(4,))
+        report = run_loadgen(server, vocab_size=_CFG.vocab_size, sessions=3,
+                             requests_per_session=3, prompt_len=4,
+                             max_new_tokens=6)
+    assert report["failed"] == 0 and report["rejected"] == 0
+    # every completed request's TTFT landed in the server histogram
+    h_ttft = reg.histogram("serve_ttft_seconds")
+    assert h_ttft.snapshot()[2] == report["completed"]
+
+    # loadgen embeds the server-side summaries next to its own numbers
+    assert "server_histograms" in report
+    assert report["server_histograms"]["serve_ttft_seconds"]["count"] >= 9
+
+    for loadgen_key, name in (("p50_ttft_ms", "serve_ttft_seconds"),
+                              ("p50_itl_ms", "serve_itl_seconds"),
+                              ("p99_itl_ms", "serve_itl_seconds")):
+        lg_s = report[loadgen_key] / 1e3
+        q = 0.99 if loadgen_key.startswith("p99") else 0.5
+        srv_s = reg.histogram(name).quantile(q)
+        tol = _bucket_span(lg_s) + 0.005  # bucket resolution + sched noise
+        assert abs(srv_s - lg_s) <= tol, (loadgen_key, srv_s, lg_s, tol)
+
+
+def test_trace_carries_request_timeline(tmp_path):
+    """--trace on a serve run: every request gets a complete
+    admit→queue→prefill→decode→readback timeline on its own named row."""
+    server = _build(MetricsRegistry())
+    tracer = Tracer()
+    set_tracer(tracer)
+    try:
+        with server:
+            reqs = [server.generate([1, 2, 3], max_new_tokens=6),
+                    server.generate([4, 5], max_new_tokens=4)]
+    finally:
+        set_tracer(None)
+    path = tmp_path / "serve_trace.json"
+    tracer.save(str(path))
+    events = json.loads(path.read_text())["traceEvents"]
+    for req in reqs:
+        row = [e for e in events
+               if e.get("args", {}).get("request") == req.id]
+        names = {e["name"] for e in row}
+        assert {"queue", "prefill"} <= names, names
+        assert "decode" in names or "decode_window" in names, names
+        # windowed decode also shows the fetch-blocked readback slice
+        if "decode_window" in names:
+            assert "readback" in names
+        # one named row per request
+        assert any(e["ph"] == "M" and e["args"]["name"] == f"request {req.id}"
+                   for e in events)
+        # and the blocking phases cover positive time
+        total = req.phase_summary_ms()
+        assert total.get("prefill_ms", 0) > 0
+
+
+def test_null_registry_disables_serve_telemetry():
+    """--telemetry off: the stack records nothing, /metrics says so, and
+    requests still serve (the no-op instruments are the whole cost)."""
+    server = _build(NULL_REGISTRY)
+    with server:
+        req = server.generate([1, 2, 3], max_new_tokens=4)
+    assert len(req.tokens) == 4
+    assert server.metrics_summary() == {}
+    assert "disabled" in server.metrics_text()
+
+
+def test_registry_counters_track_stats_counters():
+    """Cache/prefix counters flow through the registry: the /metrics view
+    and the legacy stats() ints advance together."""
+    reg = MetricsRegistry()
+    params = init_lm(jax.random.PRNGKey(3), _CFG)
+    engine = ServeEngine(params, _CFG, num_slots=4,
+                         prefill_buckets=(4, 8), batch_buckets=(1, 2),
+                         prefix_cache=True, prefix_stride=2,
+                         registry=reg)
+    server = ServeServer(engine, max_active=2, queue_size=8)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    with server:
+        server.generate(prompt, max_new_tokens=2)  # cold: miss + insert
+        server.generate(prompt, max_new_tokens=2)  # hot: hit
+    st = engine.prefix.stats()
+    fam = reg.counter("serve_prefix_cache_events_total",
+                      labelnames=("event",))
+    assert fam.labels(event="hit").value == st["hits"] >= 1
+    assert fam.labels(event="miss").value == st["misses"] >= 1
+    assert fam.labels(event="insert").value == st["inserts"] >= 1
+    swaps = reg.counter("serve_state_cache_swaps_total").value
+    assert swaps == engine.cache.stats()["generation"] > 0

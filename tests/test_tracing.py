@@ -18,7 +18,8 @@ def test_tracer_records_spans_and_saves(tmp_path):
     t.save(str(path))
     doc = json.loads(path.read_text())
     events = doc["traceEvents"]
-    names = [e["name"] for e in events]
+    data = [e for e in events if e["ph"] != "M"]
+    names = [e["name"] for e in data]
     assert set(names) == {"outer", "inner", "marker"}
     outer = next(e for e in events if e["name"] == "outer")
     inner = next(e for e in events if e["name"] == "inner")
@@ -26,6 +27,52 @@ def test_tracer_records_spans_and_saves(tmp_path):
     assert outer["args"] == {"phase": "x"}
     # inner nested within outer's interval
     assert outer["ts"] <= inner["ts"] <= inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+    # recording threads are named via thread_name METADATA events (full
+    # tid, no 16-bit truncation that could fold two threads onto one row)
+    import threading
+
+    metas = [e for e in events if e["ph"] == "M"]
+    assert any(e["name"] == "thread_name"
+               and e["tid"] == threading.get_ident()
+               and e["args"]["name"] == threading.current_thread().name
+               for e in metas)
+    assert outer["tid"] == threading.get_ident()
+
+
+def test_tracer_ring_buffer_caps_events(tmp_path):
+    """Long serving runs must not grow the event list without bound: the
+    ring keeps the NEWEST max_events and counts what it displaced."""
+    t = Tracer(max_events=10)
+    for i in range(25):
+        t.instant(f"e{i}")
+    assert t.dropped == 15
+    path = tmp_path / "ring.json"
+    t.save(str(path))
+    events = json.loads(path.read_text())["traceEvents"]
+    kept = [e["name"] for e in events if e["name"].startswith("e")]
+    assert kept == [f"e{i}" for i in range(15, 25)]  # newest survive
+    drop = next(e for e in events if e["name"] == "tracer_dropped_events")
+    assert drop["args"]["dropped"] == 15
+
+
+def test_tracer_complete_and_tid_names(tmp_path):
+    """complete(): spans from explicit perf_counter stamps on a synthetic
+    named row — how serve emits per-request timelines after the fact."""
+    t = Tracer()
+    a = time.perf_counter()
+    time.sleep(0.005)
+    b = time.perf_counter()
+    t.complete("queue", a, b, tid=42, request=7)
+    t.set_tid_name(42, "request 7")
+    path = tmp_path / "c.json"
+    t.save(str(path))
+    events = json.loads(path.read_text())["traceEvents"]
+    ev = next(e for e in events if e["name"] == "queue")
+    assert ev["tid"] == 42 and ev["ph"] == "X"
+    assert 4_000 <= ev["dur"] <= 500_000  # ~5ms in us (scheduler slack)
+    assert ev["args"]["request"] == 7
+    assert any(e["ph"] == "M" and e["tid"] == 42
+               and e["args"]["name"] == "request 7" for e in events)
 
 
 def test_module_helpers_noop_when_disabled():

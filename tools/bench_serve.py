@@ -50,6 +50,7 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
 from lstm_tensorspark_tpu.models import LMConfig, init_lm  # noqa: E402
+from lstm_tensorspark_tpu.obs import MetricsRegistry  # noqa: E402
 from lstm_tensorspark_tpu.serve import ServeEngine, ServeServer  # noqa: E402
 from lstm_tensorspark_tpu.serve.loadgen import run_loadgen  # noqa: E402
 
@@ -77,6 +78,13 @@ def build_server(*, prefix_cache: bool, prefill_chunk: int | None,
         params, cfg, num_slots=64,
         prefill_buckets=(8, 16, 32, 64, 128), batch_buckets=(1, 2, 4, 8, 16),
         prefix_cache=prefix_cache, prefix_stride=STRIDE, prefix_entries=16,
+        # a PRIVATE registry per probe server: each report's embedded
+        # "server_histograms" (run_loadgen) then covers only that server's
+        # traffic — the server-side TTFT/ITL summaries land in the bench
+        # JSON next to loadgen's percentiles, diffable run over run. The
+        # probes measure WITH telemetry on, so the bench gates also price
+        # its (near-zero) recording overhead.
+        registry=MetricsRegistry(),
     )
     server = ServeServer(engine, max_active=16, queue_size=64,
                          prefill_chunk=prefill_chunk,

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Serve smoke: boot the real `cli serve --http` subprocess, hit
+/healthz + /v1/generate + /stats + /metrics, and validate the Prometheus
+exposition parses (obs.parse_exposition — the same validator the tests
+use, so the wire contract is checked by the exact code that defines it).
+
+Run by tools/verify.sh after the tier-1 gate. CPU, tiny model, pinned
+--decode-window 1 and two prefill buckets to keep the warmup lattice to a
+few seconds. Exit 0 on PASS, 1 on any failure, with the child's output
+replayed on failure for diagnosis.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/serve_smoke.py [--timeout 180]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+from lstm_tensorspark_tpu.obs import parse_exposition  # noqa: E402
+
+_SERVE_ARGS = [
+    "serve", "--http", "--port", "0", "--vocab-size", "31",
+    "--hidden-units", "12", "--num-layers", "1",
+    "--prefill-buckets", "4,8", "--batch-buckets", "1,2",
+    "--decode-window", "1", "--prefix-cache", "off",
+]
+
+
+def _fail(proc: subprocess.Popen, lines: list[str], why: str) -> int:
+    print(f"serve_smoke: FAIL — {why}", file=sys.stderr)
+    print("---- child output ----", file=sys.stderr)
+    print("".join(lines), file=sys.stderr)
+    proc.terminate()
+    return 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--timeout", type=float, default=180.0,
+                    help="seconds to wait for the server to come up "
+                         "(covers the CPU warmup compiles)")
+    args = ap.parse_args(argv)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "lstm_tensorspark_tpu.cli", *_SERVE_ARGS]
+    proc = subprocess.Popen(cmd, cwd=_REPO, env=env, text=True,
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    lines: list[str] = []
+    url: list[str] = []
+    ready = threading.Event()
+
+    def pump():
+        for line in proc.stdout:
+            lines.append(line)
+            m = re.search(r"serving on (http://[\w.]+:\d+)", line)
+            if m:
+                url.append(m.group(1))
+                ready.set()
+        ready.set()  # EOF: unblock the waiter to report the death
+
+    threading.Thread(target=pump, daemon=True).start()
+    try:
+        if not ready.wait(args.timeout) or not url:
+            return _fail(proc, lines, "server never reported its address")
+        base = url[0]
+
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        if not health.get("ok"):
+            return _fail(proc, lines, f"unhealthy at boot: {health}")
+
+        body = json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 4,
+                           "greedy": True}).encode()
+        req = urllib.request.Request(
+            base + "/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            reply = json.loads(r.read())
+        if len(reply.get("tokens", [])) != 4 or "phases_ms" not in reply:
+            return _fail(proc, lines, f"bad generate reply: {reply}")
+
+        with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        summ = stats.get("metrics", {})
+        if summ.get("serve_ttft_seconds", {}).get("count", 0) < 1:
+            return _fail(proc, lines,
+                         f"/stats metrics missing TTFT summary: {summ}")
+
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            ctype = r.headers.get("Content-Type", "")
+            text = r.read().decode()
+        if not ctype.startswith("text/plain"):
+            return _fail(proc, lines, f"bad /metrics content type {ctype!r}")
+        try:
+            fams = parse_exposition(text)
+        except ValueError as e:
+            return _fail(proc, lines, f"exposition invalid: {e}")
+        for name in ("serve_ttft_seconds", "serve_itl_seconds",
+                     "serve_queue_wait_seconds", "serve_compiles_total"):
+            if name not in fams:
+                return _fail(proc, lines, f"/metrics missing {name}")
+
+        print(f"serve_smoke: PASS ({base}: healthz + generate + stats + "
+              f"{len(fams)} metric families validated)")
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+if __name__ == "__main__":
+    start = time.monotonic()
+    rc = main()
+    print(f"serve_smoke: done in {time.monotonic() - start:.1f}s rc={rc}",
+          file=sys.stderr)
+    raise SystemExit(rc)

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# One-command tier-1 verify + regression gate.
+# One-command tier-1 verify + regression gate + serve smoke.
 #
 # Runs the ROADMAP.md "Tier-1 verify" line exactly (same timeout, same
 # pytest flags, same DOTS_PASSED accounting), then gates on
@@ -8,8 +8,14 @@
 # failures. The raw pytest rc is reported but NOT the verdict: the seed
 # tree carries ~75 known-environmental failures.
 #
+# After the gate passes, tools/serve_smoke.py boots the real
+# `cli serve --http` subprocess and validates /healthz, /v1/generate,
+# /stats, and the /metrics Prometheus exposition (runs AFTER the timed
+# suite on purpose — never concurrently with it).
+#
 # Usage: tools/verify.sh        (from anywhere; cd's to the repo root)
-# Exit:  tier1_diff's code — 0 ok, 3 regression, 2 usage, 76 liveness.
+# Exit:  tier1_diff's code on gate failure (3 regression, 2 usage,
+#        76 liveness), else the serve smoke's (0 ok, 1 fail).
 #
 # Run it with nothing else executing: CPU contention flakes the
 # convergence-threshold tests (ROADMAP.md).
@@ -25,4 +31,13 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 echo "pytest raw rc=$rc (informational; the baseline diff below is the gate)"
 
 python tools/tier1_diff.py --log /tmp/_t1.log
+gate=$?
+if [ "$gate" -ne 0 ]; then
+  exit "$gate"
+fi
+
+# 420 s > the smoke's own worst-case internal budget (180 s boot wait +
+# 60 s generate + 3x30 s GETs) so its failure diagnostics always print
+# before the outer kill fires
+JAX_PLATFORMS=cpu timeout -k 10 420 python tools/serve_smoke.py
 exit $?
