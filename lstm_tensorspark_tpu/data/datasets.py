@@ -46,7 +46,9 @@ def _sweep_stale_corpus_cache(cache_root: str) -> None:
     ``words_*`` files from the pre-namespaced layout."""
     import time
 
-    cutoff = time.time() - _CACHE_STALE_AGE_S
+    # wall clock on purpose: the cutoff is compared against st_mtime
+    # below, which is wall-clock time — monotonic would be wrong here
+    cutoff = time.time() - _CACHE_STALE_AGE_S  # graftlint: disable=wallclock-timing
     try:
         entries = os.listdir(cache_root)
     except OSError:

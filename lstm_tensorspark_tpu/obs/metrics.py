@@ -161,7 +161,8 @@ class Histogram:
 
     @property
     def value(self) -> float:  # uniform read surface with Counter/Gauge
-        return float(self._count)
+        with self._lock:
+            return float(self._count)
 
     def summary(self) -> dict:
         # ONE snapshot: count/sum/p50/p99 must describe the same sample
@@ -210,11 +211,11 @@ class _Family:
                 f"{self.name} takes labels {self.labelnames}, got "
                 f"{tuple(kv)}")
         key = tuple(str(kv[n]) for n in self.labelnames)
-        child = self._children.get(key)
-        if child is None:
-            with self._lock:
-                child = self._children.setdefault(key, self._make())
-        return child
+        # resolution path, not the record path (record sites hold the
+        # resolved child): always lock — the unlocked-get fast path read
+        # _children while another thread's setdefault mutated it
+        with self._lock:
+            return self._children.setdefault(key, self._make())
 
     def children(self) -> list[tuple[tuple[str, ...], object]]:
         with self._lock:

@@ -31,7 +31,15 @@ try:  # jax >= 0.4.35 exposes shard_map at top level
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from ..train.loop import TrainState
+# TrainState plus re-exports from train.loop (their dependency-free
+# home): the per-shard rng fold-in and the pmean gradient reduction
+# shared by every DP step builder here, in train/multistep.py and
+# train/device_step.py.
+from ..train.loop import (  # noqa: F401
+    TrainState,
+    dp_reduce_fn,
+    dp_rng_transform,
+)
 
 
 def shard_batch(batch, mesh: Mesh, axis: str = "data", *, dim: int = 0):
@@ -46,12 +54,6 @@ def replicate(tree, mesh: Mesh):
     """Fully-replicated placement — the reference's broadcast, done once."""
     sharding = NamedSharding(mesh, P())
     return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
-
-
-# Re-exported from train.loop (their dependency-free home): the per-shard
-# rng fold-in and the pmean gradient reduction shared by every DP step
-# builder here, in train/multistep.py and train/device_step.py.
-from ..train.loop import dp_reduce_fn, dp_rng_transform  # noqa: E402,F401
 
 
 def make_dp_train_step(

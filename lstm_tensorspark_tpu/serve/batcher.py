@@ -914,13 +914,18 @@ class Batcher:
             self._abort_prefilling(p, "server stopped during prefill")
 
     def stats(self) -> dict:
+        # one lock hold for the whole snapshot: submitted/rejected are
+        # written under the lock by submit(), so reading them outside it
+        # from this (client-thread) path is a data race — and a snapshot
+        # whose fields come from different instants lies under load
         with self._lock:
             queued, active = len(self._queue), len(self._active)
             prefilling = len(self._prefilling)
+            submitted, rejected = self.submitted, self.rejected
         return {
-            "submitted": self.submitted,
+            "submitted": submitted,
             "completed": self.completed,
-            "rejected": self.rejected,
+            "rejected": rejected,
             "failed": self.failed,
             "tokens_generated": self.tokens_generated,
             "queued": queued,

@@ -182,29 +182,36 @@ class StateCache:
     def swap(self, h: jnp.ndarray, c: jnp.ndarray) -> None:
         """Install updated cache arrays (the jitted step's outputs — may
         still be computing under async dispatch; consumers are
-        data-ordered through the handles)."""
-        self.h, self.c = h, c
-        self.generation += 1
+        data-ordered through the handles). Handle installation takes the
+        cache lock: the engine lock serialises dispatchers, but detach()
+        reads ``h``/``c`` from client threads and must never observe the
+        ``h``/``c`` pair mid-replacement."""
+        with self._lock:
+            self.h, self.c = h, c
+            self.generation += 1
         self._m_swaps.inc()
 
     def read_slots(self, slots) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Gather carries for ``slots`` [B] → (h, c) each ``[L, B, H]``."""
         idx = jnp.asarray(slots, jnp.int32)
-        return self.h[:, idx, :], self.c[:, idx, :]
+        with self._lock:
+            return self.h[:, idx, :], self.c[:, idx, :]
 
     def write_slots(self, slots, h, c) -> None:
         """Scatter (h, c) each ``[L, B, H]`` into ``slots`` [B]."""
         idx = jnp.asarray(slots, jnp.int32)
-        self.h = self.h.at[:, idx, :].set(h)
-        self.c = self.c.at[:, idx, :].set(c)
+        with self._lock:
+            self.h = self.h.at[:, idx, :].set(h)
+            self.c = self.c.at[:, idx, :].set(c)
 
     def copy_slot(self, src: int, dst: int) -> None:
         """O(1) on-device copy of one slot's carries (src read, dst
         written) — how a prefix entry snapshots a session's state. Threads
         through the cache arrays, so it is data-ordered after any
         in-flight program that writes ``src``."""
-        self.h = self.h.at[:, dst, :].set(self.h[:, src, :])
-        self.c = self.c.at[:, dst, :].set(self.c[:, src, :])
+        with self._lock:
+            self.h = self.h.at[:, dst, :].set(self.h[:, src, :])
+            self.c = self.c.at[:, dst, :].set(self.c[:, src, :])
 
     # ---- detach / restore ---------------------------------------------
 
@@ -219,12 +226,14 @@ class StateCache:
             if session_id not in self._slots:
                 raise KeyError(f"cannot detach unknown session {session_id!r}")
             slot = self._slots[session_id]
-            state = DetachedState(
-                h=np.asarray(self.h[:, slot, :]),
-                c=np.asarray(self.c[:, slot, :]),
-            )
+            # slice the handles under the lock; the blocking host fetch
+            # happens OUTSIDE it — holding the (scheduler-shared) lock
+            # across a device drain would stall every dispatch behind
+            # this client-thread call
+            h_handle = self.h[:, slot, :]
+            c_handle = self.c[:, slot, :]
             self.release(session_id)
-            return state
+        return DetachedState(h=np.asarray(h_handle), c=np.asarray(c_handle))
 
     def restore(self, session_id: str, state: DetachedState) -> int:
         """Re-admit a detached session; returns its (new) slot."""
@@ -330,7 +339,7 @@ class PrefixCache:
         self._m_insert = self._m.labels(event="insert")
         self._m_evict = self._m.labels(event="evict")
         self._m_invalidate = self._m.labels(event="invalidate")
-        cache.evict_listeners.append(self._on_slot_evicted)
+        cache.evict_listeners.append(self._on_slot_evicted_locked)
 
     @staticmethod
     def _key(tokens) -> bytes:
@@ -424,10 +433,12 @@ class PrefixCache:
         self.evictions += 1
         self._m_evict.inc()
 
-    def _on_slot_evicted(self, sid: str) -> None:
+    def _on_slot_evicted_locked(self, sid: str) -> None:
         # state-cache LRU took a backing slot: the dependent entry is now
         # garbage — drop it so lookups miss instead of reading a slot a
-        # live session owns (runs under the shared lock)
+        # live session owns. The _locked suffix is the held-lock calling
+        # contract (docs/LINT.md): eviction listeners fire under the
+        # shared cache lock.
         key = self._by_sid.pop(sid, None)
         if key is not None:
             self._entries.pop(key, None)

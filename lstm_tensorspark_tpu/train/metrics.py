@@ -25,10 +25,12 @@ class MetricsLogger:
         self.stream = stream or sys.stdout
         self.quiet = quiet
         self._fh = open(jsonl_path, "a") if jsonl_path else None
-        self._t0 = time.time()
+        # elapsed-time origin: monotonic — the "t" field is a duration
+        # since logger construction, and wall clock slews under NTP
+        self._t0 = time.monotonic()
 
     def log(self, record: dict) -> None:
-        record = {"t": round(time.time() - self._t0, 3), **record}
+        record = {"t": round(time.monotonic() - self._t0, 3), **record}
         if self._fh:
             self._fh.write(json.dumps(record) + "\n")
             self._fh.flush()

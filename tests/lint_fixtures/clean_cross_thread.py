@@ -1,0 +1,37 @@
+"""graftlint fixture: clean twin of viol_cross_thread — stats() takes
+the lock for its snapshot; the scheduler-thread closure (step) keeps its
+single-writer exemption, and a *_locked helper asserts the held-lock
+calling contract instead of re-acquiring."""
+
+import threading
+
+
+class MiniScheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self.submitted = 0
+        self.processed = 0
+
+    def submit(self, req):
+        with self._lock:
+            self._queue.append(req)
+            self.submitted += 1
+
+    def step(self):
+        with self._lock:
+            batch = self._drain_locked()
+        self.processed += len(batch)  # scheduler-owned: exempt
+        return bool(batch)
+
+    def _drain_locked(self):
+        batch = list(self._queue)
+        self._queue.clear()
+        return batch
+
+    def stats(self):
+        with self._lock:
+            return {
+                "submitted": self.submitted,
+                "queued": len(self._queue),
+            }

@@ -1,0 +1,44 @@
+"""graftlint fixture: clean twin of viol_host_sync — same shapes, no
+stray syncs. The scheduler fetches ONLY through the designated
+fetch_window point; traced bodies stay on device; host-side np.asarray
+outside hot scopes is fine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def make_step(params):
+    def step_fn(x):
+        return jnp.dot(params, x)  # stays on device
+
+    return jax.jit(step_fn)
+
+
+def scan_all(xs, carry):
+    def body(c, x):
+        c = c + x
+        return c, c
+
+    return lax.scan(body, carry, xs)
+
+
+def pack_prompt(prompt):
+    # not a hot scope: plain host-side packing may use numpy freely
+    return np.asarray(prompt, np.int32)
+
+
+class Batcher:
+    def __init__(self, engine):
+        self.engine = engine
+        self.pending = None
+
+    def step(self):
+        win = self.engine.dispatch()
+        # the designated sync point of the windowed path
+        return np.asarray(self.engine.fetch_window(win))
+
+    def run(self, stop):
+        while not stop.is_set():
+            self.step()

@@ -1,0 +1,25 @@
+"""graftlint fixture: clean twin of viol_lock_abba — both paths acquire
+in the same global order (A before B), so the acquisition graph is
+acyclic."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.a = 0
+        self.b = 0
+
+    def transfer_out(self, n):
+        with self._alock:
+            with self._block:  # A -> B
+                self.a -= n
+                self.b += n
+
+    def transfer_in(self, n):
+        with self._alock:
+            with self._block:  # A -> B again: consistent order
+                self.b -= n
+                self.a += n

@@ -1,0 +1,45 @@
+"""graftlint fixture: the shared-RLock pattern that must NOT fire.
+
+Exactly the PrefixCache/StateCache arrangement: the overlay shares the
+base cache's reentrant lock (``self._lock = cache._lock``), so the
+listener fires under the very lock the overlay's own methods take, and
+overlay methods re-enter base methods under it. Reentrant re-acquisition
+of ONE merged lock is the sanctioned design, not an ABBA."""
+
+import threading
+
+
+class BaseCache:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._slots = {}
+        self.evict_listeners = []
+
+    def acquire(self, sid):
+        with self._lock:
+            slot = self._slots.setdefault(sid, len(self._slots))
+            return slot
+
+    def evict(self, sid):
+        with self._lock:
+            self._slots.pop(sid, None)
+            for listener in self.evict_listeners:
+                listener(sid)
+
+
+class Overlay:
+    def __init__(self, cache: BaseCache):
+        self.cache = cache
+        self._lock = cache._lock  # shared on purpose (see module doc)
+        self._entries = {}
+        cache.evict_listeners.append(self._on_evicted_locked)
+
+    def lookup(self, key):
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self.cache.acquire(key)  # reentrant: same merged lock
+            return entry
+
+    def _on_evicted_locked(self, sid):
+        self._entries.pop(sid, None)

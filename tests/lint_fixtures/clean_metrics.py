@@ -1,0 +1,24 @@
+"""graftlint fixture: clean twin of viol_metrics — every name has one
+kind, one labelset, matching .labels() keys; a help-less re-fetch of an
+existing family (the registry's idempotent-lookup idiom) is fine, as is
+re-binding the family variable between registrations."""
+
+
+def record_queue(reg, depth):
+    m = reg.gauge("fix_queue_depth", "requests waiting")
+    m.set(depth)
+
+
+def scrape_queue(reg):
+    return reg.gauge("fix_queue_depth").value  # idempotent re-fetch
+
+
+def outcomes(reg):
+    fam = reg.counter("fix_requests_total", "requests by outcome",
+                      labelnames=("outcome",))
+    fam.labels(outcome="ok").inc()
+    fam.labels(outcome="failed").inc()
+    # re-bind to a second family: labels() below resolves to THIS one
+    fam = reg.counter("fix_windows_total", "windows by size",
+                      labelnames=("k",))
+    fam.labels(k="4").inc()

@@ -1,0 +1,26 @@
+"""graftlint fixture: clean twin of viol_midfile_import — every
+sanctioned import-section shape at once: __future__, plain imports, the
+try/except shim, a guarded sys.path bootstrap, and post-bootstrap
+imports. Function-level lazy imports stay legal."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+try:  # the jax >= 0.4.35 shim shape
+    from json import loads
+except ImportError:  # pragma: no cover
+    loads = None
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+
+import json  # still the import section: only bootstrap preceded it
+
+
+def lazy_user():
+    import base64  # lazy by design: legal
+
+    return base64, json, loads

@@ -1,0 +1,18 @@
+"""graftlint fixture: clean twin of viol_wallclock — monotonic for
+durations; the one legitimate wall-clock use (file-mtime comparison)
+carries a suppression with its reason."""
+
+import os
+import time
+
+
+def timed_call(fn):
+    t0 = time.monotonic()
+    out = fn()
+    return out, time.monotonic() - t0
+
+
+def is_stale(path, max_age_s):
+    # wall clock on purpose: compared against st_mtime (wall-clock epoch)
+    cutoff = time.time() - max_age_s  # graftlint: disable=wallclock-timing
+    return os.stat(path).st_mtime < cutoff

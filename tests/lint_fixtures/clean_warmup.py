@@ -1,0 +1,33 @@
+"""graftlint fixture: clean twin of viol_warmup — warmup() reaches every
+compile-key family (beam included), so no program compiles
+mid-traffic."""
+
+
+class MiniEngine:
+    def __init__(self):
+        self.compile_counts = {}
+        self._fns = {}
+
+    def _get_decode_fn(self, bucket):
+        count_key = ("decode", bucket)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def _get_beam_fn(self, bucket, width):
+        count_key = ("decode_beam", bucket, width)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def decode(self, tokens):
+        return self._get_decode_fn(len(tokens))(tokens)
+
+    def decode_beam(self, tokens, width):
+        return self._get_beam_fn(len(tokens), width)(tokens)
+
+    def warmup(self, widths=(1, 4)):
+        out = self.decode([0])
+        for w in widths:
+            self.decode_beam([0], w)
+        return out

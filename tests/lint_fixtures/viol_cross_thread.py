@@ -1,0 +1,32 @@
+"""graftlint fixture: cross-thread-state true positive — ``submitted``
+is written under the scheduler's lock by submit(), so the lock owns it;
+the HTTP-facing stats() reads it (and ``_queue``) with no lock held."""
+
+import threading
+
+
+class MiniScheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self.submitted = 0
+        self.processed = 0
+
+    def submit(self, req):
+        with self._lock:
+            self._queue.append(req)
+            self.submitted += 1
+
+    def step(self):
+        with self._lock:
+            batch = list(self._queue)
+            self._queue.clear()
+        # single-writer scheduler state: unguarded on purpose, exempt
+        self.processed += len(batch)
+        return bool(batch)
+
+    def stats(self):
+        return {
+            "submitted": self.submitted,  # racy read, no lock
+            "queued": len(self._queue),   # racy read, no lock
+        }

@@ -1,0 +1,18 @@
+"""graftlint fixture: exit-code-literal true positives — magic integers
+in all three exit spellings."""
+
+import os
+import sys
+
+
+def gate(failed):
+    if failed:
+        sys.exit(3)  # collides with whatever else exits 3
+
+
+def bail(reason):
+    raise SystemExit(77)
+
+
+def hard_kill():
+    os._exit(75)

@@ -1,0 +1,41 @@
+"""graftlint fixture: host-sync-in-hot-path true positives ONLY.
+
+Three hot scopes, one stray sync each: a jit-traced body, a lax.scan
+body, and a scheduler (Batcher) hot-loop method."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def make_step(params):
+    def step_fn(x):
+        y = jnp.dot(params, x)
+        return np.asarray(y)  # sync inside a traced body
+
+    return jax.jit(step_fn)
+
+
+def scan_all(xs, carry):
+    def body(c, x):
+        c = c + x
+        bad = c.item()  # sync inside the scan body
+        return c, bad
+
+    return lax.scan(body, carry, xs)
+
+
+class Batcher:
+    def __init__(self, engine):
+        self.engine = engine
+        self.pending = None
+
+    def step(self):
+        win = self.engine.dispatch()
+        toks = jax.device_get(win.tokens)  # stray sync in the hot loop
+        return toks
+
+    def run(self, stop):
+        while not stop.is_set():
+            self.step()

@@ -1,0 +1,25 @@
+"""graftlint fixture: lock-order true positive — the classic 2-lock ABBA
+(thread 1 runs transfer_out, thread 2 runs transfer_in, each holds its
+first lock and blocks on the other's)."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.a = 0
+        self.b = 0
+
+    def transfer_out(self, n):
+        with self._alock:
+            with self._block:  # A -> B
+                self.a -= n
+                self.b += n
+
+    def transfer_in(self, n):
+        with self._block:
+            with self._alock:  # B -> A: the ABBA cycle
+                self.b -= n
+                self.a += n

@@ -1,0 +1,51 @@
+"""graftlint fixture: lock-order true positive — a 3-lock cycle routed
+through a listener callback (the PrefixCache.evict_listeners shape):
+
+    Cache._lock   --(evict fires listeners)-->  Index._lock
+    Index._lock   --(refresh calls store)-->    Store._lock
+    Store._lock   --(flush calls cache)-->      Cache._lock
+
+No single method nests all three; only the callback edge closes the
+cycle — exactly the hazard a reviewer reading one class at a time
+cannot see."""
+
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = {}
+        self.evict_listeners = []
+
+    def evict(self, sid):
+        with self._lock:
+            self._slots.pop(sid, None)
+            for listener in self.evict_listeners:
+                listener(sid)
+
+
+class Index:
+    def __init__(self, cache: Cache, store: "Store"):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self.store = store
+        cache.evict_listeners.append(self._on_evicted)
+
+    def _on_evicted(self, sid):
+        with self._lock:
+            self._entries.pop(sid, None)
+            self.store.refresh(sid)
+
+
+class Store:
+    def __init__(self, cache: Cache):
+        self._lock = threading.Lock()
+        self.cache = cache
+
+    def refresh(self, sid):
+        with self._lock:
+            self.flush(sid)
+
+    def flush(self, sid):
+        self.cache.evict(sid)

@@ -1,0 +1,33 @@
+"""graftlint fixture: metrics-consistency true positives — one name
+registered as two kinds, one registered with two labelsets, and a
+.labels() call whose keys don't match the registration."""
+
+
+def record_queue(reg, depth):
+    m = reg.gauge("fix_queue_depth", "requests waiting")
+    m.set(depth)
+
+
+def count_queue(reg):
+    # same name, different kind: dashboards can't average a counter
+    m = reg.counter("fix_queue_depth", "requests waiting")
+    m.inc()
+
+
+def outcomes_a(reg):
+    fam = reg.counter("fix_requests_total", "requests by outcome",
+                      labelnames=("outcome",))
+    fam.labels(outcome="ok").inc()
+
+
+def outcomes_b(reg):
+    # same name, different labelset
+    fam = reg.counter("fix_requests_total", "requests by outcome",
+                      labelnames=("status",))
+    fam.labels(status="ok").inc()
+
+
+def windows(reg, k):
+    fam = reg.counter("fix_windows_total", "windows by size",
+                      labelnames=("k",))
+    fam.labels(size=str(k)).inc()  # wrong label key at the call site

@@ -1,0 +1,16 @@
+"""graftlint fixture: mid-file-import true positive — a module-level
+import stranded after the first definition (the PR 4 train/loop.py
+class)."""
+
+import sys
+
+
+def early():
+    return sys.maxsize
+
+
+import os  # stranded: hoist to the header
+
+
+def late(path):
+    return os.path.basename(path)

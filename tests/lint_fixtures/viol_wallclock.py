@@ -1,0 +1,10 @@
+"""graftlint fixture: wallclock-timing true positive — a latency
+measured with the NTP-slewable wall clock."""
+
+import time
+
+
+def timed_call(fn):
+    t0 = time.time()
+    out = fn()
+    return out, time.time() - t0

@@ -1,0 +1,31 @@
+"""graftlint fixture: warmup-coverage true positive — two compile-key
+families, warmup() dispatches only one. The ("decode_beam", ...) family
+compiles in the middle of serving the first beam request."""
+
+
+class MiniEngine:
+    def __init__(self):
+        self.compile_counts = {}
+        self._fns = {}
+
+    def _get_decode_fn(self, bucket):
+        count_key = ("decode", bucket)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def _get_beam_fn(self, bucket, width):
+        count_key = ("decode_beam", bucket, width)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def decode(self, tokens):
+        return self._get_decode_fn(len(tokens))(tokens)
+
+    def decode_beam(self, tokens, width):
+        return self._get_beam_fn(len(tokens), width)(tokens)
+
+    def warmup(self):
+        # misses decode_beam: its first real request pays the compile
+        return self.decode([0])

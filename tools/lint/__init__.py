@@ -1,0 +1,20 @@
+"""graftlint — AST-based invariant analyzer for this repo.
+
+Stdlib-``ast`` only (no new dependencies). ``python -m tools.lint`` runs
+every registered rule over ``lstm_tensorspark_tpu/`` + ``tools/`` and
+gates on tools/lint_baseline.txt exactly like tools/tier1_diff.py gates
+tier-1: exit ``REGRESSION_RC`` (3) only on NEW findings. Rule catalogue,
+suppression policy and how to add a rule: docs/LINT.md.
+"""
+
+from . import core, model  # noqa: F401
+# importing the rule modules populates core.RULES
+from . import (  # noqa: F401
+    rules_hostsync,
+    rules_hygiene,
+    rules_locks,
+    rules_metrics,
+    rules_warmup,
+)
+from .core import RULES, Finding, run_rules  # noqa: F401
+from .model import load_project  # noqa: F401

@@ -1,0 +1,103 @@
+"""graftlint CLI: ``python -m tools.lint [paths...]``.
+
+Default paths are the production tree (``lstm_tensorspark_tpu/`` +
+``tools/``); tests pass fixture directories instead. Exit codes come
+from the one table (resilience/exit_codes.py):
+
+- 0  — no findings outside the baseline;
+- 3  — REGRESSION_RC: new findings (the verify.sh gate);
+- 2  — USAGE_RC: bad flags/paths.
+
+``--update-baseline`` rewrites tools/lint_baseline.txt to the current
+finding set (keeping existing justifications; new entries get a TODO a
+human must replace). ``--json PATH`` writes the machine-readable report
+(mirrors serve/loadgen.py --json) so finding counts can be trended next
+to the BENCH_*.json baselines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.lint import RULES, core, model  # noqa: E402
+
+DEFAULT_PATHS = ("lstm_tensorspark_tpu", "tools")
+DEFAULT_BASELINE = os.path.join(_REPO, "tools", "lint_baseline.txt")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="AST invariant analyzer (see docs/LINT.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: "
+                         "lstm_tensorspark_tpu/ tools/)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="accepted-findings file (default: "
+                         "tools/lint_baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: exit 3 on ANY finding "
+                         "(fixture tests)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current finding set")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write a machine-readable findings report")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relative finding paths (default: "
+                         "inferred; fixture tests pass the fixture dir)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id in sorted(RULES):
+            print(f"{rule_id}: {RULES[rule_id].doc}")
+        return 0
+
+    only = None
+    if args.rules:
+        only = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = only - set(RULES)
+        if unknown:
+            print(f"lint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return core.USAGE_RC
+
+    paths = args.paths or [os.path.join(_REPO, p) for p in DEFAULT_PATHS]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"lint: no such path: {p}", file=sys.stderr)
+            return core.USAGE_RC
+    root = os.path.abspath(args.root) if args.root else _REPO
+
+    project = model.load_project(paths, root)
+    findings = core.run_rules(project, only)
+    baseline = {} if args.no_baseline else core.load_baseline(args.baseline)
+
+    if args.update_baseline:
+        # ALWAYS read the file here, even under --no-baseline: the rewrite
+        # must preserve existing hand-written justifications
+        core.write_baseline(args.baseline, findings,
+                            core.load_baseline(args.baseline))
+        print(f"lint: baseline updated ({len(findings)} entries) — fill in "
+              "any TODO justifications")
+        # an intentional rewrite is not a regression (tier1_diff contract)
+        core.report(findings, {f.key(): "" for f in findings},
+                    json_path=args.json)
+        return 0
+
+    new, _retired = core.report(findings, baseline, json_path=args.json)
+    return core.REGRESSION_RC if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,225 @@
+"""graftlint's shared project model: one parse of the analyzed tree.
+
+Every rule consumes the same :class:`Project` — modules parsed once,
+classes/methods indexed, best-effort attribute types inferred from
+constructor parameter annotations and constructor-call assignments — so
+adding a rule never adds a parse pass, and cross-module resolution
+(``self.engine.warmup`` → ``ServeEngine.warmup``) lives in ONE place.
+
+The type inference here is deliberately shallow and under-approximate:
+names it cannot resolve simply resolve to nothing, so rules built on it
+miss, they do not false-positive. That is the right default for a gate
+(tools/lint/core.py exits REGRESSION_RC on NEW findings): a silent miss
+costs a review; a noisy false positive costs the gate's credibility.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+
+class ModuleInfo:
+    """One parsed source file."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel  # repo-relative, posix separators (finding identity)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        self.classes: dict[str, ClassInfo] = {}
+        self.functions: dict[str, ast.FunctionDef] = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = ClassInfo(node, self)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+def _annotation_names(node: ast.AST | None) -> list[str]:
+    """Candidate class names in an annotation: ``Batcher | None`` →
+    ["Batcher"], ``"ServeEngine"`` (string annotation) → ["ServeEngine"]."""
+    if node is None:
+        return []
+    out: list[str] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id[:1].isupper():
+            out.append(sub.id)
+        elif isinstance(sub, ast.Attribute) and sub.attr[:1].isupper():
+            out.append(sub.attr)
+    return out
+
+
+def _value_type_names(value: ast.AST, param_types: dict[str, list[str]]
+                      ) -> list[str]:
+    """Best-effort type candidates for an assigned value."""
+    if isinstance(value, ast.Name):
+        return param_types.get(value.id, [])
+    if isinstance(value, ast.Call):
+        f = value.func
+        if isinstance(f, ast.Name) and f.id[:1].isupper():
+            return [f.id]
+        if isinstance(f, ast.Attribute) and f.attr[:1].isupper():
+            return [f.attr]
+        return []
+    if isinstance(value, ast.IfExp):
+        return (_value_type_names(value.body, param_types)
+                or _value_type_names(value.orelse, param_types))
+    if isinstance(value, ast.BoolOp):
+        for v in value.values:
+            got = _value_type_names(v, param_types)
+            if got:
+                return got
+    return []
+
+
+class ClassInfo:
+    """A class, its directly-defined methods, and inferred attr types."""
+
+    def __init__(self, node: ast.ClassDef, module: ModuleInfo):
+        self.node = node
+        self.name = node.name
+        self.module = module
+        self.methods: dict[str, ast.FunctionDef] = {}
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        #: attr name -> candidate class names ("self.engine" -> ["ServeEngine"])
+        self.attr_types: dict[str, list[str]] = {}
+        for meth in self.methods.values():
+            param_types = {
+                a.arg: _annotation_names(a.annotation)
+                for a in (meth.args.posonlyargs + meth.args.args
+                          + meth.args.kwonlyargs)
+            }
+            for sub in ast.walk(meth):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for tgt in sub.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                            and tgt.attr not in self.attr_types):
+                        names = _value_type_names(sub.value, param_types)
+                        if names:
+                            self.attr_types[tgt.attr] = names
+
+
+class Project:
+    """All analyzed modules + cross-module class index."""
+
+    def __init__(self, modules: list[ModuleInfo]):
+        self.modules = modules
+        self.by_rel = {m.rel: m for m in modules}
+        self.classes_by_name: dict[str, list[ClassInfo]] = {}
+        for m in modules:
+            for c in m.classes.values():
+                self.classes_by_name.setdefault(c.name, []).append(c)
+
+    def find_class(self, name: str) -> ClassInfo | None:
+        hits = self.classes_by_name.get(name)
+        return hits[0] if hits else None
+
+    # ---- call / attribute resolution (shared by rules) ----------------
+
+    def attr_class(self, cls: ClassInfo, attr: str) -> ClassInfo | None:
+        for name in cls.attr_types.get(attr, []):
+            hit = self.find_class(name)
+            if hit is not None:
+                return hit
+        return None
+
+    def resolve_receiver(self, expr: ast.AST, cls: ClassInfo | None,
+                         local_types: dict[str, list[str]] | None = None
+                         ) -> ClassInfo | None:
+        """Class of the object an attribute access hangs off: ``self`` →
+        cls; ``self.a`` / ``self.a.b`` → chased through attr_types; a
+        local name → its recorded candidate types."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self":
+                return cls
+            for name in (local_types or {}).get(expr.id, []):
+                hit = self.find_class(name)
+                if hit is not None:
+                    return hit
+            return None
+        if isinstance(expr, ast.Attribute):
+            owner = self.resolve_receiver(expr.value, cls, local_types)
+            if owner is not None:
+                return self.attr_class(owner, expr.attr)
+        return None
+
+    def resolve_call(self, call: ast.Call, module: ModuleInfo,
+                     cls: ClassInfo | None,
+                     local_types: dict[str, list[str]] | None = None
+                     ) -> tuple[ClassInfo | None, ast.FunctionDef] | None:
+        """(owning class or None, FunctionDef) for a call we can resolve
+        statically; None otherwise."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            fn = module.functions.get(f.id)
+            if fn is not None:
+                return (None, fn)
+            return None
+        if isinstance(f, ast.Attribute):
+            owner = self.resolve_receiver(f.value, cls, local_types)
+            if owner is not None and f.attr in owner.methods:
+                return (owner, owner.methods[f.attr])
+        return None
+
+
+def local_alias_types(fn: ast.FunctionDef, project: Project,
+                      cls: ClassInfo | None) -> dict[str, list[str]]:
+    """Types of simple local aliases in one function body: parameters by
+    annotation, plus ``x = self.a[.b]`` chains."""
+    out: dict[str, list[str]] = {}
+    for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs):
+        names = _annotation_names(a.annotation)
+        if names:
+            out[a.arg] = names
+    for sub in ast.walk(fn):
+        if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Name)):
+            target = sub.targets[0].id
+            got = project.resolve_receiver(sub.value, cls, out)
+            if got is not None:
+                out.setdefault(target, []).append(got.name)
+    return out
+
+
+def load_project(paths: list[str], repo_root: str) -> Project:
+    """Parse every ``.py`` under ``paths`` (files or directories).
+    Unparseable files are skipped — a syntax error is the interpreter's
+    job to report, not the linter's."""
+    files: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            files.extend(os.path.join(dirpath, f)
+                         for f in filenames if f.endswith(".py"))
+    modules = []
+    for f in sorted(set(files)):
+        rel = os.path.relpath(os.path.abspath(f), repo_root).replace(
+            os.sep, "/")
+        try:
+            with open(f, encoding="utf-8") as fh:
+                source = fh.read()
+            modules.append(ModuleInfo(f, rel, source))
+        except (OSError, SyntaxError, ValueError):
+            continue
+    return Project(modules)

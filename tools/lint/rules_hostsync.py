@@ -1,0 +1,204 @@
+"""``host-sync-in-hot-path``: device→host synchronisation inside code
+that must never block on the device.
+
+Three hot scopes, matching how this repo actually loses its async
+pipeline (PR 3's whole tentpole was deleting one stray per-token
+``np.asarray``):
+
+1. **jit-traced bodies** — functions decorated with ``@jax.jit`` /
+   ``@partial(jax.jit, ...)`` or passed to a ``jit(...)`` call in the
+   same scope (the ``fn = jax.jit(prefill_fn)`` idiom in serve/engine.py);
+2. **lax.scan bodies** — functions passed as the first argument to a
+   ``lax.scan``/``jax.lax.scan`` call (window/step bodies);
+3. **the scheduler loop** — methods of scheduler classes (``Batcher``)
+   reachable from ``run``/``step``/``drain``: the continuous-batching
+   loop where one blocking fetch serialises every session's decode.
+
+Flagged syncs: ``np.asarray``/``np.array``, ``jax.device_get``,
+``.item()``, ``.block_until_ready()``. In a traced body these are
+either a tracer error waiting to happen or a silent constant-fold; in
+the scheduler loop they stall the pipeline. The designated fetch points
+(``fetch_window`` — the documented ONLY sync of the windowed path — and
+the prefill/decode return fetches in the engine, which are outside these
+scopes) stay legal; anything else needs an explicit suppression with a
+reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .model import ModuleInfo, Project
+
+#: classes whose run/step/drain closure is the serving hot loop
+SCHEDULER_CLASSES = {"Batcher"}
+_SCHEDULER_ENTRIES = {"run", "step", "drain"}
+#: attribute-call names that ARE the designated sync points — a direct
+#: np.asarray around them is the blessed fetch, not a stray sync
+_FETCH_ALLOWLIST = {"fetch_window"}
+_SYNC_ATTR_CALLS = {"item", "block_until_ready"}
+
+
+def _is_jit_func(expr: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` as a call target."""
+    if isinstance(expr, ast.Name):
+        return expr.id == "jit"
+    if isinstance(expr, ast.Attribute):
+        return expr.attr == "jit"
+    return False
+
+
+def _decorated_jit(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if _is_jit_func(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jit_func(dec.func):
+                return True
+            # partial(jax.jit, ...) / functools.partial(jit, ...)
+            fname = (dec.func.attr if isinstance(dec.func, ast.Attribute)
+                     else dec.func.id if isinstance(dec.func, ast.Name)
+                     else "")
+            if fname == "partial" and dec.args and _is_jit_func(dec.args[0]):
+                return True
+    return False
+
+
+def _is_scan_call(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "scan"
+            and (isinstance(f.value, ast.Name) and f.value.id in ("lax",)
+                 or isinstance(f.value, ast.Attribute)
+                 and f.value.attr == "lax"))
+
+
+def _hot_functions(tree: ast.AST) -> dict[ast.FunctionDef, str]:
+    """FunctionDef -> reason ('jit' | 'scan-body') for every traced-body
+    function in a module, resolved lexically: a Name passed to jit()/
+    lax.scan() binds to the nearest enclosing-scope def with that name."""
+    hot: dict[ast.FunctionDef, str] = {}
+
+    def scope_walk(node: ast.AST, defs: dict[str, ast.FunctionDef]) -> None:
+        local_defs = dict(defs)
+        body = getattr(node, "body", [])
+        for stmt in body if isinstance(body, list) else []:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs[stmt.name] = stmt
+                if _decorated_jit(stmt):
+                    hot[stmt] = "jit"
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if _is_jit_func(sub.func):
+                for arg in sub.args[:1]:
+                    if isinstance(arg, ast.Name) and arg.id in local_defs:
+                        hot.setdefault(local_defs[arg.id], "jit")
+            elif _is_scan_call(sub):
+                if (sub.args and isinstance(sub.args[0], ast.Name)
+                        and sub.args[0].id in local_defs):
+                    hot.setdefault(local_defs[sub.args[0].id], "scan-body")
+        for stmt in body if isinstance(body, list) else []:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                scope_walk(stmt, local_defs)
+
+    scope_walk(tree, {})
+    return hot
+
+
+def _sync_calls(fn: ast.FunctionDef, *, include_np: bool = True
+                ) -> list[tuple[int, str]]:
+    out: list[tuple[int, str]] = []
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _SYNC_ATTR_CALLS:
+                out.append((sub.lineno, f".{f.attr}()"))
+            elif (f.attr in ("asarray", "array") and include_np
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id in ("np", "numpy")
+                  and not _wraps_fetch(sub)):
+                out.append((sub.lineno, f"np.{f.attr}"))
+            elif (f.attr == "device_get"
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id == "jax"):
+                out.append((sub.lineno, "jax.device_get"))
+    return out
+
+
+def _wraps_fetch(call: ast.Call) -> bool:
+    """np.asarray(<something>.fetch_window(...)) is the designated fetch."""
+    for arg in call.args:
+        for sub in ast.walk(arg):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _FETCH_ALLOWLIST):
+                return True
+    return False
+
+
+def _calls_fetch(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _FETCH_ALLOWLIST)
+
+
+@register
+class HostSyncRule(Rule):
+    id = "host-sync"
+    doc = ("Host synchronisation (np.asarray/np.array, .item(), "
+           ".block_until_ready(), jax.device_get) inside jit-traced "
+           "functions, lax.scan bodies, or the scheduler hot loop — "
+           "outside the designated fetch points (fetch_window).")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in project.modules:
+            for fn, reason in _hot_functions(module.tree).items():
+                for line, what in _sync_calls(fn):
+                    findings.append(Finding(
+                        self.id, module.rel, line,
+                        f"{what} inside {reason} function {fn.name}() — "
+                        "forces a device sync / breaks tracing"))
+            findings.extend(self._scheduler_findings(module))
+        return findings
+
+    def _scheduler_findings(self, module: ModuleInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        for cls in module.classes.values():
+            if cls.name not in SCHEDULER_CLASSES:
+                continue
+            sched = self._closure(cls)
+            for meth_name in sorted(sched):
+                meth = cls.methods.get(meth_name)
+                if meth is None:
+                    continue
+                for line, what in _sync_calls(meth):
+                    findings.append(Finding(
+                        self.id, module.rel, line,
+                        f"{what} in scheduler hot path "
+                        f"{cls.name}.{meth_name}() — only the designated "
+                        "fetch points may block on the device"))
+        return findings
+
+    @staticmethod
+    def _closure(cls) -> set[str]:
+        out: set[str] = set()
+        stack = [m for m in _SCHEDULER_ENTRIES if m in cls.methods]
+        while stack:
+            name = stack.pop()
+            if name in out:
+                continue
+            out.add(name)
+            for sub in ast.walk(cls.methods[name]):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "self"
+                        and sub.func.attr in cls.methods
+                        and sub.func.attr not in out):
+                    stack.append(sub.func.attr)
+        return out

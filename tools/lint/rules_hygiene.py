@@ -1,0 +1,171 @@
+"""Hygiene rules: ``exit-code-literal``, ``wallclock-timing``,
+``mid-file-import``.
+
+- **exit-code-literal** — exit codes are a cross-process protocol
+  (resilience/exit_codes.py is the ONE table; the supervisor, bench,
+  chip tooling and verify gates all route on them). An integer literal
+  in ``sys.exit``/``os._exit``/``SystemExit`` re-creates the collision
+  class PR 2 spent a whole table killing (bench's liveness rc=3 vs the
+  regression gate's rc=3).
+- **wallclock-timing** — ``time.time()`` is subject to NTP slews and
+  clock steps; every latency/duration/backoff measurement must use
+  ``time.monotonic()`` (or ``perf_counter``). Legit wall-clock uses
+  (comparing against file mtimes, stamping records for humans) carry a
+  suppression with the reason.
+- **mid-file-import** — a module-level import after the import section
+  ends (first def/class/real statement). PR 4 hoisted a stray mid-file
+  ``import os`` from train/loop.py; this keeps the class extinct. The
+  import section tolerates the repo's sanctioned preambles: docstring,
+  ``__future__``, try/except import shims (the jax ``shard_map``
+  compatibility dance), and the tools/ ``sys.path`` bootstrap pattern
+  (simple assignments + ``sys.``/``os.`` calls before the imports they
+  enable).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .model import Project
+
+_EXIT_CALLS = {
+    ("sys", "exit"), ("os", "_exit"),
+}
+#: the one module allowed to spell exit codes as integers
+_EXIT_TABLE_SUFFIX = "resilience/exit_codes.py"
+
+
+def _int_literal(node: ast.AST) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant)
+            and isinstance(node.operand.value, int)):
+        return -node.operand.value
+    return None
+
+
+@register
+class ExitCodeLiteralRule(Rule):
+    id = "exit-code-literal"
+    doc = ("Integer literals in sys.exit/os._exit/SystemExit outside "
+           "resilience/exit_codes.py — exit codes are a cross-process "
+           "protocol and must come from the one table.")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in project.modules:
+            if module.rel.endswith(_EXIT_TABLE_SUFFIX):
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                f = node.func
+                named = None
+                if (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and (f.value.id, f.attr) in _EXIT_CALLS):
+                    named = f"{f.value.id}.{f.attr}"
+                elif isinstance(f, ast.Name) and f.id == "SystemExit":
+                    named = "SystemExit"
+                if named is None:
+                    continue
+                val = _int_literal(node.args[0])
+                if val is None or val == 0:
+                    continue  # exit(0) is the one universal constant
+                findings.append(Finding(
+                    self.id, module.rel, node.lineno,
+                    f"{named}({val}) uses a magic exit code — import the "
+                    "named constant from resilience/exit_codes.py"))
+        return findings
+
+
+@register
+class WallclockTimingRule(Rule):
+    id = "wallclock-timing"
+    doc = ("time.time() in measurement code — durations, latencies and "
+           "backoff must use time.monotonic()/perf_counter() (wall clock "
+           "slews under NTP). Suppress with a reason where wall-clock "
+           "semantics are the point (file-mtime comparisons, record "
+           "timestamps for humans).")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in project.modules:
+            for node in ast.walk(module.tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "time"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "time"):
+                    findings.append(Finding(
+                        self.id, module.rel, node.lineno,
+                        "time.time() — use time.monotonic() (or "
+                        "perf_counter) unless wall-clock semantics are "
+                        "required (then suppress with the reason)"))
+        return findings
+
+
+def _is_import_section_stmt(stmt: ast.stmt, *, first: bool) -> bool:
+    """Statements that keep the import section open."""
+    if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        return True
+    if first and isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Constant) and isinstance(stmt.value.value, str):
+        return True  # module docstring
+    if isinstance(stmt, (ast.Try, ast.If)):
+        # import shims (`try: from jax import shard_map`) and guarded
+        # bootstraps (`if _DIR not in sys.path: sys.path.insert(...)`):
+        # every statement inside must itself be import-section material
+        body_stmts = []
+        for attr in ("body", "orelse", "finalbody"):
+            body_stmts.extend(getattr(stmt, attr, []) or [])
+        for handler in getattr(stmt, "handlers", []) or []:
+            body_stmts.extend(handler.body)
+        return all(
+            isinstance(s, (ast.Pass, ast.Raise))
+            or _is_import_section_stmt(s, first=False)
+            for s in body_stmts
+        )
+    # bootstrap preamble: `_HERE = os.path...` / `sys.path.insert(...)` /
+    # `os.environ.setdefault(...)` / `__version__ = "..."` — simple
+    # assignments and sys/os calls that make the subsequent imports work
+    if isinstance(stmt, ast.Assign) and all(
+            isinstance(t, ast.Name) for t in stmt.targets):
+        return True
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        f = stmt.value.func
+        root = f
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        return isinstance(root, ast.Name) and root.id in ("sys", "os",
+                                                          "warnings")
+    return False
+
+
+@register
+class MidFileImportRule(Rule):
+    id = "mid-file-import"
+    doc = ("Module-level import after the import section ended (first "
+           "def/class/non-bootstrap statement). Hoist it — lazy imports "
+           "belong inside functions, not between definitions.")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in project.modules:
+            section_open = True
+            for i, stmt in enumerate(module.tree.body):
+                if section_open:
+                    if not _is_import_section_stmt(stmt, first=(i == 0)):
+                        section_open = False
+                    continue
+                if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                    names = ", ".join(
+                        a.name for a in stmt.names) or "*"
+                    findings.append(Finding(
+                        self.id, module.rel, stmt.lineno,
+                        f"module-level import of {names} after the import "
+                        "section — hoist to the header"))
+        return findings

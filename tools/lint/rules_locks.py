@@ -1,0 +1,515 @@
+"""Lock discipline rules: ``lock-order`` (ABBA cycles) and
+``cross-thread-state`` (guarded attributes read without their lock).
+
+Shared machinery — one static lock model over the project:
+
+- **lock identities** are ``Class.attr`` keys union-found together across
+  aliases: ``self._work = threading.Condition(self._lock)`` and
+  ``self._lock = cache._lock`` (the PrefixCache/StateCache shared-RLock
+  pattern) both MERGE identities, so a reentrant re-acquire of a shared
+  RLock is not a cycle — that pattern exists precisely to avoid the ABBA
+  the lock-order rule hunts;
+- **acquisition graph**: walking each method with the statically-held
+  lock set, an acquisition of B (directly, through a resolvable call's
+  transitive closure, or through a registered listener/callback list —
+  the ``StateCache.evict_listeners`` indirection that made PR 4's hazard
+  invisible to review) while holding A adds edge A→B. Any cycle in the
+  graph is a deadlock schedule some interleaving can realize; a
+  self-edge on a non-reentrant lock is one no interleaving can avoid.
+- **thread roles** (cross-thread-state): methods reachable from the
+  scheduler entry points (``run``/``step``/``drain``) are
+  scheduler-owned — the single-writer exemption; every other method is
+  assumed callable from client/HTTP/supervise threads and must hold the
+  class lock to touch any attribute that is WRITTEN under that lock
+  somewhere (being written under the lock is the code declaring "this
+  lock owns this attribute"). Methods named ``*_locked`` assert a
+  held-lock calling contract and are exempt (docs/LINT.md).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .model import ClassInfo, ModuleInfo, Project, local_alias_types
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_MUTATING_METHODS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft", "remove",
+    "clear", "add", "discard", "update", "setdefault", "move_to_end",
+    "popitem", "sort",
+}
+_SCHEDULER_ENTRIES = {"run", "step", "drain"}
+
+
+def _ctor_kind(value: ast.AST) -> tuple[str, ast.AST | None] | None:
+    """('lock'|'rlock', condition-underlying-lock-expr|None) when
+    ``value`` constructs a threading primitive."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None)
+    if name not in _LOCK_CTORS:
+        return None
+    if name == "Condition":
+        under = value.args[0] if value.args else None
+        return ("lock", under)
+    return ("rlock" if name == "RLock" else "lock", None)
+
+
+class _LockWorld:
+    """Union-found lock identities + kinds over the whole project.
+
+    Identity keys are MODULE-QUALIFIED (``rel::Class.attr``) so two
+    same-named classes in different files never alias; messages show the
+    short ``Class.attr`` display name."""
+
+    def __init__(self):
+        self._parent: dict[str, str] = {}
+        self._rlock: set[str] = set()
+        self._display: dict[str, str] = {}
+
+    def _key(self, cls: ClassInfo, attr: str) -> str:
+        return f"{cls.module.rel}::{cls.name}.{attr}"
+
+    def add(self, cls: ClassInfo, attr: str, kind: str) -> None:
+        key = self._key(cls, attr)
+        self._parent.setdefault(key, key)
+        self._display.setdefault(key, f"{cls.name}.{attr}")
+        if kind == "rlock":
+            self._rlock.add(key)
+
+    def merge(self, a: str, b: str) -> None:
+        self._parent.setdefault(a, a)
+        self._parent.setdefault(b, b)
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            # deterministic root: smallest name wins (stable messages)
+            lo, hi = sorted((ra, rb))
+            self._parent[hi] = lo
+
+    def find(self, key: str) -> str:
+        while self._parent.get(key, key) != key:
+            self._parent[key] = self._parent.get(self._parent[key],
+                                                 self._parent[key])
+            key = self._parent[key]
+        return key
+
+    def known(self, cls: ClassInfo, attr: str) -> bool:
+        return self._key(cls, attr) in self._parent
+
+    def root(self, cls: ClassInfo, attr: str) -> str | None:
+        key = self._key(cls, attr)
+        if key not in self._parent:
+            return None
+        return self.find(key)
+
+    def display(self, root: str) -> str:
+        return self._display.get(root, root.split("::", 1)[-1])
+
+    def is_rlock(self, root: str) -> bool:
+        return any(self.find(k) == root for k in self._rlock)
+
+    def class_lock_attrs(self, cls: ClassInfo) -> set[str]:
+        prefix = f"{cls.module.rel}::{cls.name}."
+        return {k[len(prefix):] for k in self._parent if k.startswith(prefix)}
+
+
+def _attr_chain_lock(expr: ast.AST, project: Project, cls: ClassInfo | None,
+                     local_types, world: _LockWorld) -> str | None:
+    """Lock root for a with-target / alias expression, or None."""
+    if not isinstance(expr, ast.Attribute):
+        return None
+    owner: ClassInfo | None
+    if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        owner = cls
+    else:
+        owner = project.resolve_receiver(expr.value, cls, local_types)
+    if owner is None:
+        return None
+    return world.root(owner, expr.attr)
+
+
+def build_lock_world(project: Project) -> _LockWorld:
+    world = _LockWorld()
+    pending_aliases: list[tuple[ClassInfo, ast.FunctionDef]] = []
+    # pass 1: creations
+    for module in project.modules:
+        for cls in module.classes.values():
+            for meth in cls.methods.values():
+                pending_aliases.append((cls, meth))
+                for sub in ast.walk(meth):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    got = _ctor_kind(sub.value)
+                    if got is None:
+                        continue
+                    kind, _ = got
+                    if isinstance(sub.value, ast.Call):
+                        f = sub.value.func
+                        if (isinstance(f, ast.Attribute)
+                                and f.attr == "RLock") or (
+                                isinstance(f, ast.Name) and f.id == "RLock"):
+                            kind = "rlock"
+                    for tgt in sub.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            world.add(cls, tgt.attr, kind)
+    # pass 2: aliases (Condition(self._lock), self._lock = other._lock)
+    for cls, meth in pending_aliases:
+        local_types = local_alias_types(meth, project, cls)
+        for sub in ast.walk(meth):
+            if not isinstance(sub, ast.Assign):
+                continue
+            targets = [t for t in sub.targets
+                       if isinstance(t, ast.Attribute)
+                       and isinstance(t.value, ast.Name)
+                       and t.value.id == "self"]
+            if not targets:
+                continue
+            got = _ctor_kind(sub.value)
+            if got is not None and got[1] is not None:
+                # self._work = threading.Condition(self._lock)
+                under = _attr_chain_lock(got[1], project, cls, local_types,
+                                         world)
+                for tgt in targets:
+                    world.add(cls, tgt.attr, "lock")
+                    if under is not None:
+                        world.merge(world._key(cls, tgt.attr), under)
+                continue
+            src = _attr_chain_lock(sub.value, project, cls, local_types,
+                                   world)
+            if src is not None:
+                for tgt in targets:
+                    world.add(cls, tgt.attr,
+                              "rlock" if world.is_rlock(src) else "lock")
+                    world.merge(world._key(cls, tgt.attr), src)
+    return world
+
+
+class _Access:
+    __slots__ = ("attr", "write", "held", "line")
+
+    def __init__(self, attr: str, write: bool, held: bool, line: int):
+        self.attr = attr
+        self.write = write
+        self.held = held
+        self.line = line
+
+
+#: method identity: (module rel, class name or None, function name) —
+#: module-qualified so same-named classes in different files never merge
+_MethodKey = tuple[str, str | None, str]
+
+
+class _MethodFacts:
+    def __init__(self):
+        self.acquisitions: list[tuple[str, tuple[str, ...], int]] = []
+        self.calls: list[tuple[_MethodKey, tuple[str, ...], int]] = []
+        self.callback_calls: list[tuple[str, tuple[str, ...], int]] = []
+        self.accesses: list[_Access] = []
+
+
+def _collect_facts(project: Project, module: ModuleInfo,
+                   cls: ClassInfo | None, fn: ast.FunctionDef,
+                   world: _LockWorld) -> _MethodFacts:
+    facts = _MethodFacts()
+    local_types = local_alias_types(fn, project, cls) if cls else {}
+    # loop vars iterating a self.<listattr> — potential callback fan-out
+    loop_cb: dict[str, str] = {}
+    for sub in ast.walk(fn):
+        if (isinstance(sub, ast.For) and isinstance(sub.target, ast.Name)
+                and isinstance(sub.iter, ast.Attribute)
+                and isinstance(sub.iter.value, ast.Name)
+                and sub.iter.value.id == "self"):
+            loop_cb[sub.target.id] = sub.iter.attr
+
+    def record_attr(node: ast.Attribute, write: bool, held: tuple) -> None:
+        if (isinstance(node.value, ast.Name) and node.value.id == "self"
+                and cls is not None
+                and not world.known(cls, node.attr)):
+            facts.accesses.append(
+                _Access(node.attr, write, bool(held), node.lineno))
+
+    def walk(node: ast.AST, held: tuple[str, ...]) -> None:
+        if isinstance(node, ast.With):
+            acquired: list[str] = []
+            for item in node.items:
+                root = _attr_chain_lock(item.context_expr, project, cls,
+                                        local_types, world)
+                if root is None and isinstance(item.context_expr, ast.Call):
+                    # `with lock.acquire_timeout()`-style: resolve the
+                    # receiver of an .acquire() call too
+                    f = item.context_expr.func
+                    if isinstance(f, ast.Attribute):
+                        root = _attr_chain_lock(f.value, project, cls,
+                                                local_types, world)
+                if root is not None:
+                    facts.acquisitions.append((root, held, node.lineno))
+                    acquired.append(root)
+                else:
+                    walk(item.context_expr, held)
+            inner = held + tuple(a for a in acquired if a not in held)
+            for stmt in node.body:
+                walk(stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: a separate execution context (no lexical hold),
+            # but its acquisitions count toward the enclosing method's
+            # may-acquire closure (it is created — and usually called —
+            # on this method's behalf, e.g. jit-traced bodies)
+            for stmt in node.body:
+                walk(stmt, ())
+            return
+        if isinstance(node, ast.Call):
+            resolved = project.resolve_call(node, module, cls, local_types)
+            if resolved is not None:
+                owner, callee = resolved
+                key: _MethodKey = (
+                    (owner.module.rel, owner.name, callee.name)
+                    if owner else (module.rel, None, callee.name))
+                facts.calls.append((key, held, node.lineno))
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id in loop_cb):
+                facts.callback_calls.append(
+                    (loop_cb[node.func.id], held, node.lineno))
+        if isinstance(node, ast.Attribute):
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            record_attr(node, write, held)
+        if isinstance(node, ast.Subscript):
+            # self.x[i] = v / self.x[i] += v are writes THROUGH the attr
+            if (isinstance(node.value, ast.Attribute)
+                    and isinstance(node.ctx, (ast.Store, ast.Del))):
+                record_attr(node.value, True, held)
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATING_METHODS
+                and isinstance(node.func.value, ast.Attribute)):
+            record_attr(node.func.value, True, held)
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in fn.body:
+        walk(stmt, ())
+    return facts
+
+
+class _Analysis:
+    """Facts + closures + edges for the whole project (built once, shared
+    by both rules via ``analyze``)."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.world = build_lock_world(project)
+        self.facts: dict[_MethodKey, _MethodFacts] = {}
+        self.callbacks: dict[str, set[_MethodKey]] = {}
+        for module in project.modules:
+            for cls in module.classes.values():
+                for meth in cls.methods.values():
+                    key = (module.rel, cls.name, meth.name)
+                    self.facts[key] = _collect_facts(
+                        project, module, cls, meth, self.world)
+                # callback registration: <obj>.<L>.append(self.<m>)
+                for meth in cls.methods.values():
+                    for sub in ast.walk(meth):
+                        if not (isinstance(sub, ast.Call)
+                                and isinstance(sub.func, ast.Attribute)
+                                and sub.func.attr == "append"
+                                and isinstance(sub.func.value, ast.Attribute)
+                                and sub.args):
+                            continue
+                        arg = sub.args[0]
+                        if (isinstance(arg, ast.Attribute)
+                                and isinstance(arg.value, ast.Name)
+                                and arg.value.id == "self"):
+                            self.callbacks.setdefault(
+                                sub.func.value.attr, set()).add(
+                                (module.rel, cls.name, arg.attr))
+        self._closure_memo: dict[_MethodKey, frozenset[str]] = {}
+
+    def closure(self, key: _MethodKey,
+                _stack: frozenset | None = None) -> frozenset[str]:
+        """Locks a method may acquire, transitively."""
+        if key in self._closure_memo:
+            return self._closure_memo[key]
+        stack = _stack or frozenset()
+        if key in stack:
+            return frozenset()
+        stack = stack | {key}
+        facts = self.facts.get(key)
+        out: set[str] = set()
+        if facts is None:
+            self._closure_memo.setdefault(key, frozenset())
+            return frozenset()
+        out.update(root for root, _, _ in facts.acquisitions)
+        for callee, _, _ in facts.calls:
+            out.update(self.closure(callee, stack))
+        for listattr, _, _ in facts.callback_calls:
+            for target in self.callbacks.get(listattr, ()):
+                out.update(self.closure(target, stack))
+        result = frozenset(out)
+        if _stack is None:  # only memoize complete (non-cut) closures
+            self._closure_memo[key] = result
+        return result
+
+    def edges(self) -> dict[tuple[str, str], tuple[str, int, str]]:
+        """(A, B) -> (rel, line, why): B acquired while A held."""
+        out: dict[tuple[str, str], tuple[str, int, str]] = {}
+
+        def add(a: str, b: str, rel: str, line: int, why: str) -> None:
+            if a == b and self.world.is_rlock(a):
+                return  # reentrant re-acquire of a shared RLock is the
+                # sanctioned pattern, not a hazard
+            out.setdefault((a, b), (rel, line, why))
+
+        for (rel, cls_name, meth_name), facts in self.facts.items():
+            where = f"{cls_name}.{meth_name}"
+            for root, held, line in facts.acquisitions:
+                for a in held:
+                    add(a, root, rel, line, f"with in {where}")
+            for callee, held, line in facts.calls:
+                if not held:
+                    continue
+                callee_disp = (f"{callee[1]}.{callee[2]}" if callee[1]
+                               else callee[2])
+                for b in self.closure(callee):
+                    for a in held:
+                        add(a, b, rel, line,
+                            f"{where} calls {callee_disp}")
+            for listattr, held, line in facts.callback_calls:
+                if not held:
+                    continue
+                for target in self.callbacks.get(listattr, ()):
+                    for b in self.closure(target):
+                        for a in held:
+                            add(a, b, rel, line,
+                                f"{where} fires {listattr} -> "
+                                f"{target[1]}.{target[2]}")
+        return out
+
+
+def analyze(project: Project) -> _Analysis:
+    cached = getattr(project, "_graftlint_lock_analysis", None)
+    if cached is None:
+        cached = _Analysis(project)
+        project._graftlint_lock_analysis = cached  # type: ignore[attr-defined]
+    return cached
+
+
+@register
+class LockOrderRule(Rule):
+    id = "lock-order"
+    doc = ("Cycles in the static lock-acquisition graph (ABBA deadlocks), "
+           "including acquisitions reached through calls and registered "
+           "listener callbacks; self-acquire of a non-reentrant lock.")
+
+    def run(self, project: Project) -> list[Finding]:
+        analysis = analyze(project)
+        world = analysis.world
+        edges = analysis.edges()
+        findings: list[Finding] = []
+        # self-edges on non-reentrant locks: unconditional deadlock
+        for (a, b), (rel, line, why) in sorted(edges.items()):
+            if a == b:
+                findings.append(Finding(
+                    self.id, rel, line,
+                    f"non-reentrant lock {world.display(a)} re-acquired "
+                    f"while held ({why})"))
+        # cycles among distinct locks: iterative DFS per SCC would be
+        # overkill at this scale — find one cycle per offending edge pair
+        graph: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+        seen_cycles: set[frozenset[str]] = set()
+        for start in sorted(graph):
+            path: list[str] = []
+            on_path: set[str] = set()
+
+            def dfs(node: str) -> None:
+                if node in on_path:
+                    cyc = path[path.index(node):] + [node]
+                    cid = frozenset(cyc)
+                    if cid in seen_cycles:
+                        return
+                    seen_cycles.add(cid)
+                    rel, line, why = edges[(cyc[0], cyc[1])]
+                    findings.append(Finding(
+                        self.id, rel, line,
+                        "lock order cycle: "
+                        + " -> ".join(world.display(n) for n in cyc)
+                        + f" (first edge: {why})"))
+                    return
+                if node not in graph:
+                    return
+                path.append(node)
+                on_path.add(node)
+                for nxt in sorted(graph[node]):
+                    dfs(nxt)
+                path.pop()
+                on_path.discard(node)
+
+            dfs(start)
+        return findings
+
+
+@register
+class CrossThreadStateRule(Rule):
+    id = "cross-thread-state"
+    doc = ("Attributes written under a class's lock are owned by it; "
+           "reading or writing them WITHOUT the lock from methods "
+           "reachable by client/HTTP/supervise threads (anything outside "
+           "the run/step/drain scheduler closure) is a data race. "
+           "Methods named *_locked assert a held-lock contract and are "
+           "exempt, as is __init__ (pre-thread construction).")
+
+    def run(self, project: Project) -> list[Finding]:
+        analysis = analyze(project)
+        world = analysis.world
+        findings: list[Finding] = []
+        for module in project.modules:
+            for cls in module.classes.values():
+                if not world.class_lock_attrs(cls):
+                    continue
+                guarded: set[str] = set()
+                for meth_name in cls.methods:
+                    for acc in analysis.facts[(module.rel, cls.name,
+                                               meth_name)].accesses:
+                        if acc.write and acc.held:
+                            guarded.add(acc.attr)
+                if not guarded:
+                    continue
+                sched = self._scheduler_closure(analysis, cls)
+                for meth_name, meth in cls.methods.items():
+                    if (meth_name in sched or meth_name == "__init__"
+                            or meth_name.endswith("_locked")):
+                        continue
+                    for acc in analysis.facts[(module.rel, cls.name,
+                                               meth_name)].accesses:
+                        if acc.held or acc.attr not in guarded:
+                            continue
+                        findings.append(Finding(
+                            self.id, module.rel, acc.line,
+                            f"{cls.name}.{acc.attr} is written under the "
+                            f"class lock elsewhere but "
+                            f"{'written' if acc.write else 'read'} without "
+                            f"it in {meth_name}()"))
+        return findings
+
+    @staticmethod
+    def _scheduler_closure(analysis: _Analysis, cls: ClassInfo) -> set[str]:
+        rel = cls.module.rel
+        roots = _SCHEDULER_ENTRIES & set(cls.methods)
+        out: set[str] = set()
+        stack = list(roots)
+        while stack:
+            name = stack.pop()
+            if name in out:
+                continue
+            out.add(name)
+            for (crel, owner, callee), _, _ in analysis.facts[
+                    (rel, cls.name, name)].calls:
+                if (crel, owner) == (rel, cls.name) and callee not in out:
+                    stack.append(callee)
+        return out

@@ -1,0 +1,322 @@
+"""``metrics-consistency``: one name, one meaning — statically.
+
+The PR 5 registry enforces at runtime that a metric name maps to one
+kind and one labelset; dashboards built on ``/metrics`` additionally
+assume docs/OPERATIONS.md's runbook signatures exist. This rule moves
+all three contracts to lint time:
+
+1. every registration of a name (``reg.counter/gauge/histogram("name",
+   ...)``) agrees on kind AND ``labelnames`` with every other
+   registration (a mismatch is a guaranteed ``ValueError`` on whichever
+   code path registers second — possibly a rarely-exercised one);
+2. every ``.labels(...)`` call on a family resolved from a registration
+   passes exactly the registered label keys (else a guaranteed
+   runtime ``ValueError`` at the record site);
+3. every metric the docs/OPERATIONS.md runbook names (backticked
+   ``serve_*``/``supervise_*``/``train_*`` tokens, with optional
+   ``{label=...}`` signatures) is actually registered, with those label
+   keys — a renamed metric must not leave the runbook pointing at a
+   series that no longer exists.
+
+Help strings: the FIRST non-empty help is the definition; a second
+registration with a DIFFERENT non-empty help is two meanings for one
+name and flagged. Help-less re-fetches (``reg.gauge("name")``) are the
+sanctioned idempotent-lookup idiom and never conflict.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Finding, Rule, register
+from .model import Project
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_NAME_OK = re.compile(r"^[a-z][a-z0-9_]*$")
+#: docs token: `serve_queue_depth` or `serve_requests_total{outcome="x"}`
+_DOC_TOKEN = re.compile(
+    r"`((?:serve|supervise|train)_[a-z][a-z0-9_]*)"
+    r"(?:\{([^}`]*)\})?`")  # closing backtick required: `serve_error@N`
+# (a fault name, not a metric) must not match as `serve_error`
+_DOC_LABEL = re.compile(r"([a-zA-Z_][a-zA-Z0-9_]*)\s*=")
+_DOC_RELPATH = os.path.join("docs", "OPERATIONS.md")
+
+
+class _Registration:
+    __slots__ = ("kind", "labelnames", "help", "rel", "line")
+
+    def __init__(self, kind, labelnames, help_, rel, line):
+        self.kind = kind
+        self.labelnames = labelnames
+        self.help = help_
+        self.rel = rel
+        self.line = line
+
+
+def _labelnames_from_call(call: ast.Call) -> tuple[str, ...] | None:
+    """Literal labelnames tuple, () when omitted, None when dynamic."""
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            if isinstance(kw.value, ast.Tuple) and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in kw.value.elts):
+                return tuple(e.value for e in kw.value.elts)
+            return None
+    return ()
+
+
+def _help_from_call(call: ast.Call) -> str:
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "help" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return ""
+
+
+def _registration_of(call: ast.Call) -> tuple[str, str] | None:
+    """(kind, metric name) when ``call`` is a registry registration."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _REG_METHODS):
+        return None
+    if not call.args or not isinstance(call.args[0], ast.Constant) \
+            or not isinstance(call.args[0].value, str):
+        return None
+    name = call.args[0].value
+    if not _NAME_OK.match(name):
+        return None
+    return f.attr, name
+
+
+@register
+class MetricsConsistencyRule(Rule):
+    id = "metrics-consistency"
+    doc = ("Metric registrations must agree on kind/labelnames/help "
+           "across all sites; .labels() keyword sets must match the "
+           "registered labelnames; every metric docs/OPERATIONS.md's "
+           "runbook names must exist with those labels.")
+
+    def run(self, project: Project) -> list[Finding]:
+        registrations: dict[str, list[_Registration]] = {}
+        # family-variable bindings: (scope id, var) -> metric name;
+        # scope id keeps function-local `fam` bindings apart
+        findings: list[Finding] = []
+
+        label_sites: list[tuple[str, frozenset[str], str, int]] = []
+        for module in project.modules:
+            self._scan_module(module, registrations, label_sites)
+
+        # 1. cross-site registration consistency
+        for name, regs in sorted(registrations.items()):
+            first = regs[0]
+            for other in regs[1:]:
+                if other.kind != first.kind:
+                    findings.append(Finding(
+                        self.id, other.rel, other.line,
+                        f"metric {name!r} registered as {other.kind} here "
+                        f"but as {first.kind} at {first.rel} — one name, "
+                        "one kind"))
+                if (other.labelnames is not None
+                        and first.labelnames is not None
+                        and other.labelnames != () and first.labelnames != ()
+                        and other.labelnames != first.labelnames):
+                    findings.append(Finding(
+                        self.id, other.rel, other.line,
+                        f"metric {name!r} registered with labelnames "
+                        f"{other.labelnames} here but {first.labelnames} "
+                        f"at {first.rel}"))
+                if (other.help and first.help and other.help != first.help):
+                    findings.append(Finding(
+                        self.id, other.rel, other.line,
+                        f"metric {name!r} registered with a different "
+                        "help string than the defining site — two "
+                        "meanings for one name"))
+
+        # 2. .labels(...) keyword sets
+        defined_labels: dict[str, tuple[str, ...]] = {}
+        for name, regs in registrations.items():
+            for reg in regs:
+                if reg.labelnames:
+                    defined_labels[name] = reg.labelnames
+                    break
+        for name, keys, rel, line in label_sites:
+            expected = defined_labels.get(name)
+            if expected is None:
+                if name in registrations:
+                    findings.append(Finding(
+                        self.id, rel, line,
+                        f".labels() called on label-less metric {name!r}"))
+                continue
+            if keys != frozenset(expected):
+                findings.append(Finding(
+                    self.id, rel, line,
+                    f".labels({sorted(keys)}) on {name!r} does not match "
+                    f"registered labelnames {expected}"))
+
+        # 3. runbook references
+        findings.extend(self._doc_findings(project, registrations,
+                                           defined_labels))
+        return findings
+
+    # ---- scanning ------------------------------------------------------
+
+    def _scan_module(self, module, registrations, label_sites) -> None:
+        # walk per top-level scope so `fam` bindings don't leak between
+        # functions; class-level: track self._attr bindings per class.
+        # Local bindings are position-aware: `fam = reg.counter(A); ...
+        # fam = reg.counter(B)` is the registry's documented idiom, and a
+        # labels() call must resolve against the assignment ABOVE it.
+        for scope, attr_binds, local_assigns, global_binds in self._scopes(
+                module):
+            for node in ast.walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                reg = _registration_of(node)
+                if reg is not None:
+                    kind, name = reg
+                    registrations.setdefault(name, []).append(_Registration(
+                        kind, _labelnames_from_call(node),
+                        _help_from_call(node), module.rel, node.lineno))
+                    continue
+                f = node.func
+                if (isinstance(f, ast.Attribute) and f.attr == "labels"):
+                    name = self._family_name(
+                        f.value, attr_binds, local_assigns, node.lineno,
+                        global_binds)
+                    if name is not None:
+                        keys = frozenset(kw.arg for kw in node.keywords
+                                         if kw.arg is not None)
+                        label_sites.append(
+                            (name, keys, module.rel, node.lineno))
+
+    @staticmethod
+    def _scopes(module):
+        """Yield (scope node, self-attr bindings, positional local
+        assigns). Local assigns are ``(line, var, metric)`` sorted by
+        line, so a ``labels()`` call binds to the nearest assignment
+        above it (the `fam = ...; fam = ...` re-binding idiom)."""
+        class_attr_bindings: dict[str, dict[str, str]] = {}
+        # pre-pass: self._x = reg.counter("name", ...) per class
+        for cls in module.classes.values():
+            binds: dict[str, str] = {}
+            for meth in cls.methods.values():
+                for node in ast.walk(meth):
+                    if not (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)):
+                        continue
+                    reg = _registration_of(node.value)
+                    if reg is None:
+                        continue
+                    for tgt in node.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            binds[f"self.{tgt.attr}"] = reg[1]
+            class_attr_bindings[cls.name] = binds
+        # module-level scope: registrations at import time (`M = reg.
+        # counter(...)` between defs) must be visible too, or the runbook
+        # check calls them unregistered. Nested defs/classes are excluded
+        # — they have their own scopes below.
+        top = ast.Module(
+            body=[s for s in module.tree.body
+                  if not isinstance(s, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.ClassDef))],
+            type_ignores=[])
+        top_assigns = MetricsConsistencyRule._local_assigns(top)
+        # read-only globals fallback for function scopes (last bind wins)
+        global_binds = {var: metric for _, var, metric in top_assigns}
+        yield top, {}, top_assigns, {}
+        # per-function scopes (methods AND module functions)
+        for cls in module.classes.values():
+            for meth in cls.methods.values():
+                yield (meth, class_attr_bindings[cls.name],
+                       MetricsConsistencyRule._local_assigns(meth),
+                       global_binds)
+        for fn in module.functions.values():
+            yield (fn, {}, MetricsConsistencyRule._local_assigns(fn),
+                   global_binds)
+
+    @staticmethod
+    def _local_assigns(fn) -> list[tuple[int, str, str]]:
+        out: list[tuple[int, str, str]] = []
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            reg = _registration_of(node.value)
+            if reg is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.append((node.lineno, tgt.id, reg[1]))
+        out.sort()
+        return out
+
+    @staticmethod
+    def _family_name(expr: ast.AST, attr_binds: dict[str, str],
+                     local_assigns: list[tuple[int, str, str]],
+                     at_line: int,
+                     global_binds: dict[str, str] | None = None
+                     ) -> str | None:
+        if isinstance(expr, ast.Name):
+            best = None
+            for line, var, metric in local_assigns:
+                if var == expr.id and line <= at_line:
+                    best = metric
+            if best is None and global_binds:
+                best = global_binds.get(expr.id)
+            return best
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return attr_binds.get(f"self.{expr.attr}")
+        if isinstance(expr, ast.Call):
+            reg = _registration_of(expr)
+            if reg is not None:  # reg.gauge("name", ...).labels(...)
+                return reg[1]
+        return None
+
+    # ---- docs ----------------------------------------------------------
+
+    def _doc_findings(self, project: Project, registrations,
+                      defined_labels) -> list[Finding]:
+        # locate the repo root from any analyzed module path
+        doc_path = None
+        for module in project.modules:
+            root = module.path[: -len(module.rel)] if module.path.endswith(
+                module.rel.replace("/", os.sep)) else None
+            if root:
+                cand = os.path.join(root, _DOC_RELPATH)
+                if os.path.exists(cand):
+                    doc_path = cand
+                    break
+        if doc_path is None:
+            return []
+        findings: list[Finding] = []
+        with open(doc_path, encoding="utf-8") as f:
+            doc_lines = f.read().splitlines()
+        rel = _DOC_RELPATH.replace(os.sep, "/")
+        for lineno, line in enumerate(doc_lines, 1):
+            for m in _DOC_TOKEN.finditer(line):
+                name, labelpart = m.group(1), m.group(2)
+                if name not in registrations:
+                    findings.append(Finding(
+                        self.id, rel, lineno,
+                        f"runbook references metric {name!r} which is not "
+                        "registered anywhere in the analyzed tree"))
+                    continue
+                if labelpart:
+                    expected = defined_labels.get(name, ())
+                    for lm in _DOC_LABEL.finditer(labelpart):
+                        if lm.group(1) not in expected:
+                            findings.append(Finding(
+                                self.id, rel, lineno,
+                                f"runbook names label "
+                                f"{lm.group(1)!r} on {name!r} but its "
+                                f"registered labelnames are {expected}"))
+        return findings

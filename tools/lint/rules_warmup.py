@@ -1,0 +1,130 @@
+"""``warmup-coverage``: every compile-key family must be reachable from a
+``warmup`` method.
+
+PR 4 fixed this class twice: compile keys (``("decode_window", bucket,
+K, sampling)``-style tuples bumped into ``compile_counts`` at trace
+time) that no warmup path dispatched meant the FIRST traffic burst paid
+a mid-run XLA compile — 8x latency on the victim request, invisible in
+any unit test that reuses a warm engine.
+
+Statically: a **family** is the leading string of a tuple literal that
+ends up keying ``compile_counts`` (``count_key = ("prefill", ...)`` ...
+``self.compile_counts[count_key] += 1``, or the subscript written with
+the tuple inline). A family is **covered** when its defining function is
+reachable — through resolvable ``self.x()`` / typed-attribute calls —
+from any method named ``warmup`` in the analyzed tree. Uncovered
+families fail the gate: either warm them or explain why in a
+suppression/baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .model import ClassInfo, ModuleInfo, Project, local_alias_types
+
+
+def _family_of_tuple(node: ast.AST) -> str | None:
+    if (isinstance(node, ast.Tuple) and node.elts
+            and isinstance(node.elts[0], ast.Constant)
+            and isinstance(node.elts[0].value, str)):
+        return node.elts[0].value
+    return None
+
+
+def _compile_count_subscripted(fn: ast.FunctionDef, var: str) -> bool:
+    """Does ``fn`` (or a nested def) subscript ``*.compile_counts`` with
+    ``var``?"""
+    for sub in ast.walk(fn):
+        if (isinstance(sub, ast.Subscript)
+                and isinstance(sub.value, ast.Attribute)
+                and sub.value.attr == "compile_counts"
+                and isinstance(sub.slice, ast.Name)
+                and sub.slice.id == var):
+            return True
+    return False
+
+
+def _families_in_method(fn: ast.FunctionDef) -> list[tuple[str, int]]:
+    """(family, line) for compile-key tuples defined in this method."""
+    out: list[tuple[str, int]] = []
+    for sub in ast.walk(fn):
+        # count_key = ("prefill", ...) later keying compile_counts
+        if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Name)):
+            fam = _family_of_tuple(sub.value)
+            if fam is not None and _compile_count_subscripted(
+                    fn, sub.targets[0].id):
+                out.append((fam, sub.lineno))
+        # self.compile_counts[("prefill", ...)] += 1 inline
+        if (isinstance(sub, ast.Subscript)
+                and isinstance(sub.value, ast.Attribute)
+                and sub.value.attr == "compile_counts"):
+            fam = _family_of_tuple(sub.slice)
+            if fam is not None:
+                out.append((fam, sub.lineno))
+    return out
+
+
+@register
+class WarmupCoverageRule(Rule):
+    id = "warmup-coverage"
+    doc = ("Compile-key families (leading strings of compile_counts key "
+           "tuples) whose defining method is not reachable from any "
+           "warmup() method — those programs compile mid-traffic, "
+           "charging a real request the XLA compile.")
+
+    def run(self, project: Project) -> list[Finding]:
+        # (family, owning method) -> (module, line): EVERY defining
+        # method is tracked — two methods sharing a family string are two
+        # program sets, and each must be warmable on its own
+        families: dict[tuple[str, tuple[str, str]],
+                       tuple[ModuleInfo, int]] = {}
+        for module in project.modules:
+            for cls in module.classes.values():
+                for meth_name, meth in cls.methods.items():
+                    for fam, line in _families_in_method(meth):
+                        families.setdefault(
+                            (fam, (cls.name, meth_name)), (module, line))
+        if not families:
+            return []
+        reachable = self._reachable_from_warmups(project)
+        findings: list[Finding] = []
+        for (fam, owner), (module, line) in sorted(families.items()):
+            if owner not in reachable:
+                findings.append(Finding(
+                    self.id, module.rel, line,
+                    f"compile-key family {fam!r} (defined in "
+                    f"{owner[0]}.{owner[1]}) is not reachable from any "
+                    "warmup() — it will compile mid-traffic"))
+        return findings
+
+    @staticmethod
+    def _reachable_from_warmups(project: Project) -> set[tuple[str, str]]:
+        roots: list[tuple[ClassInfo, ModuleInfo]] = []
+        for module in project.modules:
+            for cls in module.classes.values():
+                if "warmup" in cls.methods:
+                    roots.append((cls, module))
+        seen: set[tuple[str, str]] = set()
+        stack: list[tuple[ClassInfo, ModuleInfo, str]] = [
+            (cls, module, "warmup") for cls, module in roots]
+        while stack:
+            cls, module, meth_name = stack.pop()
+            key = (cls.name, meth_name)
+            if key in seen or meth_name not in cls.methods:
+                continue
+            seen.add(key)
+            meth = cls.methods[meth_name]
+            local_types = local_alias_types(meth, project, cls)
+            for sub in ast.walk(meth):
+                if not isinstance(sub, ast.Call):
+                    continue
+                resolved = project.resolve_call(sub, module, cls,
+                                                local_types)
+                if resolved is None or resolved[0] is None:
+                    continue
+                owner, callee = resolved
+                stack.append((owner, owner.module, callee.name))
+        return seen

@@ -1,26 +1,44 @@
 #!/usr/bin/env bash
-# One-command tier-1 verify + regression gate + serve smoke.
+# One-command repo verify: graftlint gate + tier-1 + regression gate +
+# serve smoke, in that order.
 #
-# Runs the ROADMAP.md "Tier-1 verify" line exactly (same timeout, same
-# pytest flags, same DOTS_PASSED accounting), then gates on
-# tools/tier1_diff.py — which diffs the failing-test SET against
+# Phase 0 — GRAFTLINT: `python -m tools.lint` (AST invariant analyzer,
+# docs/LINT.md) over lstm_tensorspark_tpu/ + tools/, gated on
+# tools/lint_baseline.txt. Prints its own `GRAFTLINT new=N baseline=M`
+# summary line and exits REGRESSION_RC (3) on NEW findings — the run
+# aborts HERE, before the ~15 min suite, because a lint regression is a
+# deterministic fail and the feedback should be seconds, not minutes.
+# Pure CPU/AST, sequenced BEFORE the timed suite so it cannot perturb it.
+#
+# Phase 1 — tier-1: the ROADMAP.md "Tier-1 verify" line exactly (same
+# timeout, same pytest flags, same DOTS_PASSED accounting), then gated
+# on tools/tier1_diff.py — which diffs the failing-test SET against
 # tools/tier1_baseline.txt and exits 3 (REGRESSION_RC) only on NEW
 # failures. The raw pytest rc is reported but NOT the verdict: the seed
 # tree carries ~75 known-environmental failures.
 #
-# After the gate passes, tools/serve_smoke.py boots the real
+# Phase 2 — serve smoke: tools/serve_smoke.py boots the real
 # `cli serve --http` subprocess and validates /healthz, /v1/generate,
 # /stats, and the /metrics Prometheus exposition (runs AFTER the timed
 # suite on purpose — never concurrently with it).
 #
 # Usage: tools/verify.sh        (from anywhere; cd's to the repo root)
-# Exit:  tier1_diff's code on gate failure (3 regression, 2 usage,
-#        76 liveness), else the serve smoke's (0 ok, 1 fail).
+# Exit:  graftlint's code on lint regressions (3), else tier1_diff's on
+#        gate failure (3 regression, 2 usage, 76 liveness), else the
+#        serve smoke's (0 ok, 1 fail).
 #
 # Run it with nothing else executing: CPU contention flakes the
 # convergence-threshold tests (ROADMAP.md).
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 2
+
+python -m tools.lint
+lint_rc=$?
+if [ "$lint_rc" -ne 0 ]; then
+  echo "verify: graftlint gate failed (rc=$lint_rc) — fix or baseline" \
+       "with a justification (docs/LINT.md) before running the suite"
+  exit "$lint_rc"
+fi
 
 rm -f /tmp/_t1.log
 timeout -k 10 1080 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
