@@ -1231,6 +1231,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="checkpoint-template optimizer (restore only)")
     p.add_argument("--learning-rate", type=float, default=1.0)
     # --- engine / batcher (docs/OPERATIONS.md "Serving") ---
+    p.add_argument("--replicas", type=str, default="1",
+                   help="data-parallel serving replicas (serve/router.py): "
+                        "N engine+scheduler replicas behind one admission "
+                        "router with session→replica affinity — thread-per-"
+                        "replica on CPU, device-per-replica when multiple "
+                        "accelerators exist. --num-slots/--max-active are "
+                        "PER REPLICA; --queue-size is the global admission "
+                        "bound. With --loadgen a comma list (e.g. '1,2') "
+                        "runs the replica-scaling comparison instead: the "
+                        "same workload at each level, aggregate tokens/s + "
+                        "greedy parity reported (BENCH_serve_r02.json)")
     p.add_argument("--num-slots", type=int, default=64,
                    help="state-cache slots (= max resident sessions)")
     p.add_argument("--prefill-buckets", type=str, default="8,16,32,64,128",
@@ -1359,8 +1370,37 @@ def _parse_buckets(spec: str, flag: str) -> tuple[int, ...]:
     return buckets
 
 
-def _build_serve_stack(args):
-    """(params, cfg, started-server) from the serve flags."""
+def _parse_replicas(spec: str, flag: str = "--replicas") -> tuple[int, ...]:
+    try:
+        levels = tuple(int(x) for x in spec.split(",") if x.strip())
+    except ValueError:
+        raise SystemExit(f"{flag}: expected an int or comma-separated ints, "
+                         f"got {spec!r}")
+    if not levels or any(n < 1 for n in levels):
+        raise SystemExit(f"{flag}: need positive replica counts, got {spec!r}")
+    return levels
+
+
+def _single_replica_count(args, mode: str) -> int:
+    levels = _parse_replicas(args.replicas)
+    if len(levels) > 1:
+        raise SystemExit(
+            f"--replicas {args.replicas!r}: a comma list is the --loadgen "
+            f"comparison mode; {mode} needs a single count")
+    return levels[0]
+
+
+def _build_serve_stack(args, n_replicas: int = 1, registry=None):
+    """(params, cfg, started-server) from the serve flags.
+
+    ``n_replicas`` > 1 builds one engine per replica (each with its own
+    state/prefix caches and compiled programs) behind the admission
+    router; when the host exposes multiple accelerators the engines are
+    committed round-robin across ``jax.devices()`` (device-per-replica),
+    otherwise they share the one device (thread-per-replica).
+    ``registry`` overrides the --telemetry-selected registry (the replica
+    sweep scopes one fresh registry per level so the per-level reports
+    don't accumulate each other's samples)."""
     from .models import LMConfig, init_lm
     from .serve import ServeEngine, ServeServer
 
@@ -1403,22 +1443,35 @@ def _build_serve_stack(args):
         params = jax.device_get(state.params)
     from .obs import NULL_REGISTRY, REGISTRY
 
-    engine = ServeEngine(
-        params, cfg,
-        num_slots=args.num_slots,
-        prefill_buckets=_parse_buckets(args.prefill_buckets,
-                                       "--prefill-buckets"),
-        batch_buckets=_parse_buckets(args.batch_buckets, "--batch-buckets"),
-        rng_seed=args.seed,
-        prefix_cache=args.prefix_cache == "on",
-        prefix_stride=args.prefix_stride,
-        prefix_entries=args.prefix_entries,
-        # one registry argument scopes the whole serve stack's telemetry
-        # (engine, caches, batcher, /metrics); off = no-op instruments
-        registry=NULL_REGISTRY if getattr(args, "telemetry", "on") == "off"
-        else REGISTRY,
-    )
-    server = ServeServer(engine, max_active=args.max_active,
+    if registry is None:
+        registry = (NULL_REGISTRY
+                    if getattr(args, "telemetry", "on") == "off"
+                    else REGISTRY)
+    devices = jax.devices()
+    engines = [
+        ServeEngine(
+            params, cfg,
+            num_slots=args.num_slots,
+            prefill_buckets=_parse_buckets(args.prefill_buckets,
+                                           "--prefill-buckets"),
+            batch_buckets=_parse_buckets(args.batch_buckets,
+                                         "--batch-buckets"),
+            # distinct per-replica sampling chains (greedy is unaffected)
+            rng_seed=args.seed + i,
+            prefix_cache=args.prefix_cache == "on",
+            prefix_stride=args.prefix_stride,
+            prefix_entries=args.prefix_entries,
+            # one registry argument scopes the whole serve stack's
+            # telemetry (engine, caches, batcher, router, /metrics);
+            # off = no-op instruments
+            registry=registry,
+            # device-per-replica when the host has more than one
+            device=devices[i % len(devices)] if len(devices) > 1 else None,
+        )
+        for i in range(n_replicas)
+    ]
+    server = ServeServer(engines if n_replicas > 1 else engines[0],
+                         max_active=args.max_active,
                          queue_size=args.queue_size,
                          window_ladder=_parse_window_ladder(args.decode_window),
                          prefill_chunk=args.prefill_chunk or None)
@@ -1442,7 +1495,8 @@ def _serve_selftest(args) -> int:
     from .models import make_generate_fn
     from .serve import InprocessClient
 
-    params, cfg, server = _build_serve_stack(args)
+    params, cfg, server = _build_serve_stack(
+        args, _single_replica_count(args, "--selftest"))
     rng = np.random.RandomState(args.seed)
     lengths = [3, 5, 8, 13, 2, 7][: max(args.sessions, 2)]
     while len(lengths) < args.sessions:
@@ -1505,7 +1559,10 @@ def _serve_loadgen(args) -> int:
               f"< --prompt-len {args.prompt_len} (each prompt needs >= 1 "
               "unshared token)", file=sys.stderr)
         return 2
-    _, cfg, server = _build_serve_stack(args)
+    replica_levels = _parse_replicas(args.replicas)
+    if len(replica_levels) > 1:
+        return _serve_loadgen_replica_sweep(args, replica_levels)
+    _, cfg, server = _build_serve_stack(args, replica_levels[0])
     sampling = _serve_sampling(args)
     # the prefix/inject probes are single-run workloads (the sweep does not
     # thread them through) — never let the default --compare silently drop
@@ -1550,23 +1607,44 @@ def _serve_loadgen(args) -> int:
                 inject_prompt_len=args.inject_prompt_len,
                 inject_delay_s=args.inject_delay,
             )
-    estats = server.engine.stats()
+    # aggregate across replicas — a --replicas N run spreads traffic, and
+    # replica-0-only counters would silently halve every number vs /stats
+    from .serve.loadgen import prefix_totals
+
+    compiles_by_key: dict = {}
+    cache_tot: dict = {}
+    for rep in server.replicas:
+        es = rep.engine.stats()
+        for k, v in es["compiles"].items():
+            compiles_by_key[k] = compiles_by_key.get(k, 0) + v
+        for k, v in es["cache"].items():
+            if k == "slots" and cache_tot:
+                continue  # per-replica config, not a counter to sum
+            cache_tot[k] = cache_tot.get(k, 0) + v
+    prefix_tot = prefix_totals(server)
     out["engine"] = {
-        "compiles_prefill": server.engine.num_compiles("prefill"),
-        "compiles_prefill_chunk": server.engine.num_compiles("prefill_chunk"),
-        "compiles_decode": server.engine.num_compiles("decode"),
-        "compiles_decode_window": server.engine.num_compiles("decode_window"),
-        "compiles_by_key": estats["compiles"],
-        "prefix_cache": estats["prefix_cache"],
-        **estats["cache"],
+        "compiles_prefill": sum(
+            r.engine.num_compiles("prefill") for r in server.replicas),
+        "compiles_prefill_chunk": sum(
+            r.engine.num_compiles("prefill_chunk") for r in server.replicas),
+        "compiles_decode": sum(
+            r.engine.num_compiles("decode") for r in server.replicas),
+        "compiles_decode_window": sum(
+            r.engine.num_compiles("decode_window") for r in server.replicas),
+        "compiles_by_key": compiles_by_key,
+        "prefix_cache": prefix_tot,
+        **cache_tot,
     }
-    bstats = server.batcher.stats()
+    bstats = server.stats()["batcher"]  # the cross-replica aggregate
     out["batcher"] = {
         k: bstats[k]
         for k in ("window_ladder", "windows_dispatched", "windows_pipelined",
                   "prefill_chunk", "prefill_chunks_dispatched",
                   "prefix_resumed", "prefix_tokens_saved")
     }
+    # absolute router counters (incl. retired list) under a DISTINCT key —
+    # each run report's "router" section stays the per-run delta view
+    out["router_totals"] = server.router.stats()
     # server-side registry view (histogram p50/p99 + counters) so the
     # loadgen JSON carries both measurement sides — see also the per-run
     # "server_histograms" inside each report
@@ -1594,19 +1672,71 @@ def _serve_loadgen(args) -> int:
     return 0
 
 
+def _serve_loadgen_replica_sweep(args, levels: tuple[int, ...]) -> int:
+    """``serve --loadgen --replicas 1,2``: the data-parallel scaling
+    comparison — same closed-loop workload on a fresh n-replica stack per
+    level, aggregate tokens/s + greedy parity in one machine-readable
+    report (the BENCH_serve_r02.json gate)."""
+    import json
+
+    from .serve import replica_sweep
+
+    if args.compare or args.shared_prefix_len or args.inject_prompt_len:
+        print("note: --replicas comparison runs the plain closed-loop "
+              "workload; ignoring --compare/--shared-prefix-len/"
+              "--inject-prompt-len", file=sys.stderr)
+    if args.mode != "closed":
+        print("error: --replicas comparison is closed-loop only",
+              file=sys.stderr)
+        return 2
+    sampling = _serve_sampling(args)
+
+    def make_server(n):
+        # fresh registry per level (telemetry on): a sweep's levels build
+        # separate servers, and sharing the process registry would fold
+        # level 1's samples into level 2's embedded summaries
+        from .obs import MetricsRegistry
+
+        reg = (None if getattr(args, "telemetry", "on") == "off"
+               else MetricsRegistry())
+        return _build_serve_stack(args, n, registry=reg)[2]
+
+    out = replica_sweep(
+        make_server, vocab_size=args.vocab_size, levels=levels,
+        sessions=args.sessions,
+        requests_per_session=args.requests_per_session,
+        prompt_len=args.prompt_len, max_new_tokens=args.max_new_tokens,
+        sampling=sampling, seed=args.seed,
+    )
+    print(json.dumps(out))
+    sc = out["scaling"]
+    print(f"replica sweep: tokens/s {sc['tokens_per_sec']}, "
+          f"speedup {sc['speedup_top_vs_base']}x "
+          f"({sc['top_level']} vs {sc['base_level']} replicas), "
+          f"parity_ok {out.get('parity_ok', 'n/a')}", file=sys.stderr)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        print(f"loadgen: report written to {args.json}", file=sys.stderr)
+    return 0 if out.get("parity_ok", True) else 1
+
+
 def _serve_http(args) -> int:
     from .serve.server import make_http_server
 
-    _, _, server = _build_serve_stack(args)
+    _, _, server = _build_serve_stack(
+        args, _single_replica_count(args, "--http"))
     # pre-compile the bucket lattice for the default sampling config BEFORE
     # taking traffic: on TPU a compile is ~20-40 s, which would both time
     # out first requests and starve the scheduler heartbeat long enough to
     # flip /healthz 503 on a healthy warming server (an orchestrator would
     # then kill-loop it). Selftest/loadgen warm implicitly; --http must too.
-    print("serve: warming the compile lattice...", flush=True)
+    print(f"serve: warming the compile lattice "
+          f"({len(server.replicas)} replica(s))...", flush=True)
     n = server.warmup(_serve_sampling(args),
                       prompt_lens=tuple(server.engine.prefill_buckets))
-    print(f"serve: {n} programs compiled", flush=True)
+    print(f"serve: {n} programs compiled across "
+          f"{len(server.replicas)} replica(s)", flush=True)
     httpd = make_http_server(server, args.host, args.port)
     host, port = httpd.server_address[:2]
     print(f"serving on http://{host}:{port} (POST /v1/generate, "

@@ -136,24 +136,7 @@ class Histogram:
 
     def _quantile_from(self, counts: list[int], total: int,
                        q: float) -> float:
-        """Estimated q-quantile over one consistent ``counts`` snapshot:
-        linear interpolation inside the bucket holding the target rank —
-        Prometheus' ``histogram_quantile`` estimate, computed locally.
-        NaN when empty; clamped to the largest finite bound for
-        overflow-bucket ranks."""
-        if total == 0:
-            return float("nan")
-        rank = q * total
-        cum = 0.0
-        for i, c in enumerate(counts):
-            if c and cum + c >= rank:
-                if i >= len(self.buckets):  # overflow bucket: no upper bound
-                    return self.buckets[-1]
-                lo = self.buckets[i - 1] if i > 0 else 0.0
-                hi = self.buckets[i]
-                return lo + (hi - lo) * ((rank - cum) / c)
-            cum += c
-        return self.buckets[-1]
+        return _estimate_quantile(self.buckets, counts, total, q)
 
     def quantile(self, q: float) -> float:
         counts, _, total = self.snapshot()
@@ -173,6 +156,30 @@ class Histogram:
             out["p50"] = round(self._quantile_from(counts, total, 0.5), 6)
             out["p99"] = round(self._quantile_from(counts, total, 0.99), 6)
         return out
+
+
+def _estimate_quantile(buckets: tuple[float, ...], counts: list[int],
+                       total: int, q: float) -> float:
+    """Estimated q-quantile over one consistent ``counts`` snapshot:
+    linear interpolation inside the bucket holding the target rank —
+    Prometheus' ``histogram_quantile`` estimate, computed locally.
+    NaN when empty; clamped to the largest finite bound for
+    overflow-bucket ranks. Module-level so a MERGED multi-child count
+    vector (``_Family.aggregate``) summarises exactly like a single
+    child's."""
+    if total == 0:
+        return float("nan")
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c and cum + c >= rank:
+            if i >= len(buckets):  # overflow bucket: no upper bound
+                return buckets[-1]
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i]
+            return lo + (hi - lo) * ((rank - cum) / c)
+        cum += c
+    return buckets[-1]
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
@@ -220,6 +227,51 @@ class _Family:
     def children(self) -> list[tuple[tuple[str, ...], object]]:
         with self._lock:
             return sorted(self._children.items())
+
+    def _merge_instances(self, insts: list):
+        """Aggregate a group of children: counters/gauges sum; histograms
+        merge per-bucket counts EXACTLY (every child shares the family's
+        bucket bounds) and summarise the merged distribution — so the
+        merged view quantizes identically to a single child's summary."""
+        if self.kind != "histogram":
+            return sum(inst.value for inst in insts)
+        merged: list[int] | None = None
+        msum, mtotal = 0.0, 0
+        for inst in insts:
+            counts, s, total = inst.snapshot()
+            merged = (counts if merged is None
+                      else [a + b for a, b in zip(merged, counts)])
+            msum += s
+            mtotal += total
+        out = {"count": mtotal, "sum": round(msum, 6)}
+        if mtotal and merged is not None:
+            bounds = tuple(float(b) for b in
+                           (self._buckets or DEFAULT_LATENCY_BUCKETS))
+            out["p50"] = round(
+                _estimate_quantile(bounds, merged, mtotal, 0.5), 6)
+            out["p99"] = round(
+                _estimate_quantile(bounds, merged, mtotal, 0.99), 6)
+        return out
+
+    def aggregate_over(self, label: str) -> dict:
+        """Aggregates with ``label`` summed out, keyed by the residual
+        label string (``""`` when ``label`` is the only one). Summing a
+        SPECIFIC label keeps the residual series meaningful — e.g.
+        ``serve_requests_total{outcome=,replica=}`` aggregated over
+        ``replica`` yields per-``outcome`` fleet totals, exactly the key
+        shapes consumers used before the ``replica`` label existed —
+        whereas a blind all-children sum would fold unrelated label
+        values (states, outcomes) into one meaningless number."""
+        if label not in self.labelnames:
+            return {}
+        idx = self.labelnames.index(label)
+        residual = tuple(n for n in self.labelnames if n != label)
+        groups: dict[tuple[str, ...], list] = {}
+        for key, inst in self.children():
+            rkey = tuple(v for i, v in enumerate(key) if i != idx)
+            groups.setdefault(rkey, []).append(inst)
+        return {_labelstr(residual, rkey): self._merge_instances(insts)
+                for rkey, insts in groups.items()}
 
     # -- label-less convenience (delegates to the anonymous child) -------
 
@@ -358,13 +410,23 @@ class MetricsRegistry:
 
     def summaries(self) -> dict:
         """JSON-ready view for ``/stats``: counters/gauges as values,
-        histograms as {count, sum, p50, p99}."""
+        histograms as {count, sum, p50, p99}. ``replica``-labelled
+        families ALSO export aggregates with the replica label summed
+        out, under the residual-label keys (the bare family name for
+        replica-only families) — so consumers keyed on
+        ``serve_ttft_seconds`` or ``serve_requests_total{outcome="..."}``
+        keep working when a family grows the ``replica`` label, and the
+        per-child ``name{...,replica="r"}`` entries carry the split."""
         out: dict = {}
         for fam in self.families():
-            for key, inst in fam.children():
+            children = fam.children()
+            for key, inst in children:
                 name = fam.name + _labelstr(fam.labelnames, key)
                 out[name] = (inst.summary() if fam.kind == "histogram"
                              else inst.value)
+            if "replica" in fam.labelnames and children:
+                for suffix, val in fam.aggregate_over("replica").items():
+                    out[fam.name + suffix] = val
         return out
 
     def snapshot(self) -> dict:

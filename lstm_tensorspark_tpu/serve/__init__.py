@@ -26,9 +26,18 @@ into a serving engine:
   admission (fresh prompts resume from their longest cached prefix) and
   chunked prefill (<= one bounded prefill program per scheduler
   iteration — a long prompt cannot stall running sessions' decode);
+- ``router``: the data-parallel admission front (``--replicas N``) —
+  N engine+batcher replicas (thread-per-replica on CPU, device-per-
+  replica on TPU), session→replica affinity so recurrent-state slots
+  and prefix entries stay replica-local, one global bounded admission
+  queue (429), and honest replica-death handling (queued work requeued,
+  in-flight failed loudly, idle kept sessions migrated via
+  detach/restore);
 - ``server``: stdlib ThreadingHTTPServer JSON endpoint + in-process
-  client, with ``GET /metrics`` Prometheus exposition of the stack's
-  telemetry registry (obs/) and histogram summaries inside ``/stats``;
+  client over the replica set, with ``GET /metrics`` Prometheus
+  exposition of the stack's telemetry registry (obs/, ``replica``-
+  labelled serve families) and histogram summaries inside ``/stats``;
+  ``/healthz`` fans per-replica heartbeats into ok/degraded/down;
 - ``loadgen``: closed/open-loop load generator (p50/p99 request latency,
   TTFT, inter-token latency, tokens/s), embedding the server-side
   histogram summaries next to its own percentiles.
@@ -47,8 +56,9 @@ CLI: ``python -m lstm_tensorspark_tpu.cli serve --selftest`` (see cli.py).
 from .state_cache import CacheFullError, PrefixCache, StateCache
 from .engine import PAD_TOKEN, DecodeWindow, SamplingParams, ServeEngine
 from .batcher import Batcher, QueueFullError, Request
+from .router import Replica, Router
 from .server import InprocessClient, ServeServer
-from .loadgen import run_loadgen
+from .loadgen import replica_sweep, run_loadgen
 
 __all__ = [
     "Batcher",
@@ -58,10 +68,13 @@ __all__ = [
     "PAD_TOKEN",
     "PrefixCache",
     "QueueFullError",
+    "Replica",
     "Request",
+    "Router",
     "SamplingParams",
     "ServeEngine",
     "ServeServer",
     "StateCache",
+    "replica_sweep",
     "run_loadgen",
 ]

@@ -126,6 +126,10 @@ class Request:
         # shared cache
         self.use_prefix = use_prefix
         self.id = next(Request._ids)
+        # replica index this request was routed to (serve/router.py) —
+        # None until routed (or forever, for a direct Batcher.submit).
+        # Surfaced in the HTTP reply and loadgen's per-replica counts.
+        self.replica: int | None = None
         self.tokens: list[int] = []
         self.error: str | None = None
         self.cancelled = False  # set by an abandoning client (timeout)
@@ -232,6 +236,7 @@ class Batcher:
         self,
         engine: ServeEngine,
         *,
+        replica: int = 0,
         max_active: int = 16,
         queue_size: int = 64,
         window_ladder: tuple[int, ...] = DEFAULT_WINDOW_LADDER,
@@ -275,6 +280,10 @@ class Batcher:
         # precompile every size the scheduler can dispatch
         ladder = tuple(sorted({1} | set(window_ladder)))
         self.engine = engine
+        # identity within a replicated server (serve/router.py): labels
+        # this scheduler's metric children and names it in /healthz —
+        # a standalone batcher is replica 0 of a one-replica stack
+        self.replica = int(replica)
         self.max_active = max_active
         self.queue_size = queue_size
         self.window_ladder = ladder
@@ -309,39 +318,54 @@ class Batcher:
         # cost at the record sites is a lock + an add. The registry comes
         # from the engine so one constructor argument scopes the whole
         # serve stack (and NULL_REGISTRY turns all of this into no-ops).
+        # Every family carries a `replica` label: a replicated server's
+        # schedulers share the registry, and their children must stay
+        # separable (summaries() exports the cross-replica aggregate
+        # under the bare family name).
         reg = engine.metrics
+        rl = str(self.replica)
         self._m_queue_depth = reg.gauge(
-            "serve_queue_depth", "requests waiting in the submit queue")
+            "serve_queue_depth", "requests waiting in the submit queue",
+            labelnames=("replica",)).labels(replica=rl)
         self._m_active = reg.gauge(
-            "serve_active_sessions", "sessions in active decode")
+            "serve_active_sessions", "sessions in active decode",
+            labelnames=("replica",)).labels(replica=rl)
         self._m_prefilling = reg.gauge(
-            "serve_prefilling_sessions", "admitted sessions mid-prefill")
+            "serve_prefilling_sessions", "admitted sessions mid-prefill",
+            labelnames=("replica",)).labels(replica=rl)
         self._m_queue_wait = reg.histogram(
-            "serve_queue_wait_seconds", "submit → admission wait")
+            "serve_queue_wait_seconds", "submit → admission wait",
+            labelnames=("replica",)).labels(replica=rl)
         self._m_ttft = reg.histogram(
-            "serve_ttft_seconds", "submit → first token (server-side)")
+            "serve_ttft_seconds", "submit → first token (server-side)",
+            labelnames=("replica",)).labels(replica=rl)
         self._m_itl = reg.histogram(
             "serve_itl_seconds",
-            "inter-token gaps, host arrival times (0 within a window burst)")
+            "inter-token gaps, host arrival times (0 within a window burst)",
+            labelnames=("replica",)).labels(replica=rl)
         self._m_iteration = reg.histogram(
             "serve_scheduler_iteration_seconds",
-            "duration of scheduler iterations that did work")
+            "duration of scheduler iterations that did work",
+            labelnames=("replica",)).labels(replica=rl)
         self._m_readback = reg.histogram(
             "serve_readback_seconds",
-            "decode-window dispatch → tokens on host (fetch latency)")
+            "decode-window dispatch → tokens on host (fetch latency)",
+            labelnames=("replica",)).labels(replica=rl)
         self._m_chunks = reg.counter(
             "serve_prefill_chunks_total",
-            "head-less bounded prefill chunk programs dispatched")
+            "head-less bounded prefill chunk programs dispatched",
+            labelnames=("replica",)).labels(replica=rl)
         fam = reg.counter("serve_decode_windows_total",
                           "decode windows dispatched by window size K",
-                          labelnames=("k",))
-        self._m_window_k = {k: fam.labels(k=str(k)) for k in self.window_ladder}
+                          labelnames=("k", "replica"))
+        self._m_window_k = {k: fam.labels(k=str(k), replica=rl)
+                            for k in self.window_ladder}
         fam = reg.counter("serve_requests_total",
                           "requests by final outcome",
-                          labelnames=("outcome",))
-        self._m_req_completed = fam.labels(outcome="completed")
-        self._m_req_failed = fam.labels(outcome="failed")
-        self._m_req_rejected = fam.labels(outcome="rejected")
+                          labelnames=("outcome", "replica"))
+        self._m_req_completed = fam.labels(outcome="completed", replica=rl)
+        self._m_req_failed = fam.labels(outcome="failed", replica=rl)
+        self._m_req_rejected = fam.labels(outcome="rejected", replica=rl)
 
     # ---- client side ---------------------------------------------------
 
@@ -365,10 +389,79 @@ class Batcher:
                 raise QueueFullError(
                     f"submit queue full ({self.queue_size} pending)"
                 )
-            req.t_submit = time.perf_counter()
+            if req.t_submit is None:
+                # first submission; a REQUEUED request (router: replica
+                # death) arrives with t_submit already stamped and is
+                # neither re-stamped nor re-counted — queue-wait/TTFT
+                # must cover the time spent on the dead replica's queue,
+                # and the dead replica already counted the submission
+                # (the cross-replica `submitted` sum stays one per
+                # client request; the serving replica's per-replica
+                # count undercounts by the requeues, which the router's
+                # `requeued` counter makes explicit)
+                req.t_submit = time.perf_counter()
+                self.submitted += 1
             self._queue.append(req)
-            self.submitted += 1
             self._work.notify()
+
+    def queued(self) -> int:
+        """Requests waiting for admission (the router sums this across
+        replicas for the GLOBAL queue bound)."""
+        with self._lock:
+            return len(self._queue)
+
+    def load(self) -> int:
+        """Routing weight: queued + admitted work on this scheduler, read
+        under one lock hold (the router's least-loaded pick)."""
+        with self._lock:
+            return (len(self._queue) + len(self._active)
+                    + len(self._prefilling))
+
+    # ---- replica retirement (router-driven; see serve/router.py) -------
+    #
+    # These are called by the admission router ONLY after this scheduler's
+    # thread has exited — they mutate scheduler-owned state from another
+    # thread, which is safe precisely because the owner is gone (and every
+    # guarded structure is still snapshotted under the lock, so a stats()
+    # or health reader racing the retirement sees consistent views).
+
+    def drain_queue(self) -> list[Request]:
+        """Remove and return every not-yet-admitted request (the router
+        requeues them onto live replicas)."""
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+        return out
+
+    def fail_inflight(self, reason: str) -> int:
+        """Fail every admitted (prefilling or decoding) request with
+        ``reason`` and release its slot/prefix refs. Under dispatch-ahead
+        windowed decode the host cannot know how many tokens an
+        un-fetched window already consumed, so a dead scheduler's
+        in-flight sessions cannot be resumed elsewhere without risking
+        silent double-decode — honest failure is the only correct
+        outcome. Returns the number of requests failed."""
+        with self._lock:
+            prefilling = list(self._prefilling)
+            self._prefilling.clear()
+            active = list(self._active)
+            self._active.clear()
+        self._pending = None  # scheduler-owned; the owner thread is dead
+        for p in prefilling:
+            if p.entry is not None:
+                self.engine.prefix.release(p.entry)
+                p.entry = None
+            self.engine.cache.release(p.sess.sid)
+            self._fail(p.sess.req, reason)
+        for s in active:
+            self.engine.cache.release(s.sid)
+            self._fail(s.req, reason)
+        return len(prefilling) + len(active)
+
+    def fail_request(self, req: Request, reason: str) -> None:
+        """Settle a request this batcher owns with an error (router use:
+        a drained request that could not be requeued anywhere)."""
+        self._fail(req, reason)
 
     # ---- scheduler side ------------------------------------------------
 
@@ -430,10 +523,14 @@ class Batcher:
                 # auto ids share a namespace with client-chosen ones:
                 # skip any id the cache already holds, or an anonymous
                 # request could silently inherit (and overwrite) a kept
-                # session's carries
-                sid = f"s{next(self._sid_counter)}"
+                # session's carries. The replica index is baked in so the
+                # ids are unique ACROSS a replicated server — the router
+                # resolves session affinity by probing every replica's
+                # cache, and two replicas independently minting "s0"
+                # would alias two different clients' conversations.
+                sid = f"s{self.replica}-{next(self._sid_counter)}"
                 while sid in self.engine.cache:
-                    sid = f"s{next(self._sid_counter)}"
+                    sid = f"s{self.replica}-{next(self._sid_counter)}"
             if sid in busy_sids:
                 # two in-flight requests on one session would share a cache
                 # slot and corrupt each other's carries — reject the
@@ -923,6 +1020,7 @@ class Batcher:
             prefilling = len(self._prefilling)
             submitted, rejected = self.submitted, self.rejected
         return {
+            "replica": self.replica,
             "submitted": submitted,
             "completed": self.completed,
             "rejected": rejected,

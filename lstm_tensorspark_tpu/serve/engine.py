@@ -159,11 +159,19 @@ class ServeEngine:
         prefix_stride: int = 8,
         prefix_entries: int = 16,
         registry=None,
+        device=None,
     ):
         # serving never rematerialises (same override as generate())
         if cfg.remat_chunk is not None:
             cfg = dataclasses.replace(cfg, remat_chunk=None)
         self.cfg = cfg
+        # device-per-replica serving (serve/router.py): committing params
+        # + cache arrays pins every program of this engine to one device,
+        # so N replicas spread across jax.devices() compute concurrently
+        # (uncommitted host inputs follow the committed operands)
+        self.device = device
+        if device is not None:
+            params = jax.device_put(params, device)
         self.params = params
         self.fused_layers = fuse_layers(params, cfg)  # once, at init
         self.prefill_buckets = tuple(sorted(prefill_buckets))
@@ -174,7 +182,7 @@ class ServeEngine:
         # argument scopes the whole stack
         self.metrics = obs.REGISTRY if registry is None else registry
         self.cache = StateCache(cfg.num_layers, num_slots, cfg.hidden_size,
-                                registry=self.metrics)
+                                registry=self.metrics, device=device)
         # shared-prompt prefix reuse (state_cache.PrefixCache): opt-in at
         # engine construction; the batcher consults engine.prefix on every
         # fresh admission when present

@@ -21,7 +21,11 @@ aggregate tokens/sec and requests/sec. Phases are wrapped in
 `concurrency_sweep` runs the same closed-loop workload at increasing
 session counts on one warm server — the headline check that batched
 decode beats sequential serving (ISSUE acceptance: >= 8 concurrent
-sessions must out-throughput 1 session).
+sessions must out-throughput 1 session). `replica_sweep` runs it at
+increasing REPLICA counts (a fresh server per level) — the data-parallel
+scaling gate (aggregate tokens/s across N schedulers, greedy parity
+token-identical to one replica); every report carries per-replica
+routed/served counts plus the router's requeue/rejection deltas.
 
 Prefix-cache / chunked-prefill probes: ``shared_prefix_len`` makes every
 prompt share its first N tokens (the shared-system-prompt workload —
@@ -73,6 +77,44 @@ def _random_prompts(n: int, prompt_len: int, vocab_size: int, seed: int,
         ]).astype(np.int32)
         for _ in range(n)
     ]
+
+
+def _per_replica(results: list[dict]) -> dict:
+    """Completed-request / token counts by the replica that served them
+    (``Request.replica``, stamped by the router) — the scaling gate's
+    routed-request evidence. Single-replica runs report one bucket."""
+    out: dict[str, dict] = {}
+    for r in results:
+        if r.get("replica") is None:
+            continue
+        d = out.setdefault(str(r["replica"]), {"completed": 0, "tokens": 0})
+        d["completed"] += 1
+        d["tokens"] += r["tokens"]
+    return out
+
+
+#: prefix-cache stats() keys that are per-replica CONFIG, not counters —
+#: aggregation keeps the first replica's value instead of summing
+_PREFIX_CONFIG_KEYS = ("stride", "max_entries")
+
+
+def prefix_totals(server: ServeServer) -> dict | None:
+    """Prefix-cache stats summed across every replica's cache (entries
+    are replica-local; the workload-level hit rate is the sum's). Config
+    keys keep replica 0's value; the ONE aggregation used by loadgen
+    reports and the CLI's engine section, so the two can never drift."""
+    totals = None
+    for rep in server.replicas:
+        if rep.engine.prefix is None:
+            continue
+        st = rep.engine.prefix.stats()
+        if totals is None:
+            totals = dict(st)
+            continue
+        for k, v in st.items():
+            if k not in _PREFIX_CONFIG_KEYS:
+                totals[k] += v
+    return totals
 
 
 def _report(results: list[dict], rejected: int, failed: int, wall_s: float,
@@ -143,8 +185,8 @@ def run_loadgen(
     rejected = [0]
     failed = [0]
     lock = threading.Lock()
-    prefix_before = (server.engine.prefix.stats()
-                     if server.engine.prefix is not None else None)
+    prefix_before = prefix_totals(server)
+    router_before = server.router.stats()
 
     def one_request(prompt) -> None:
         t0 = time.perf_counter()
@@ -170,6 +212,7 @@ def run_loadgen(
             if req.t_first_token and req.t_submit else None,
             "tokens": len(req.tokens),
             "itl_s": req.itl_gaps(),
+            "replica": req.replica,
         }
         with lock:
             results.append(rec)
@@ -249,8 +292,23 @@ def run_loadgen(
     report["shared_prefix_len"] = shared_prefix_len
     if inject_prompt_len > 0:
         report["injected"] = injected
+    # per-replica routing evidence: completed/token counts by serving
+    # replica (from the requests) + the router's routed/requeue deltas
+    report["replicas"] = _per_replica(results)
+    ra, rb = server.router.stats(), router_before
+    report["router"] = {
+        "replicas": ra["replicas"],
+        "live": ra["live"],
+        "routed": {k: ra["routed"][k] - rb["routed"].get(k, 0)
+                   for k in ra["routed"]},
+        "rejected": ra["rejected"] - rb["rejected"],
+        "requeued": ra["requeued"] - rb["requeued"],
+        "failed_on_death": ra["failed_on_death"] - rb["failed_on_death"],
+        "migrated_sessions":
+            ra["migrated_sessions"] - rb["migrated_sessions"],
+    }
     if prefix_before is not None:
-        after = server.engine.prefix.stats()
+        after = prefix_totals(server)
         hits = after["hits"] - prefix_before["hits"]
         misses = after["misses"] - prefix_before["misses"]
         report["prefix_cache"] = {
@@ -309,4 +367,76 @@ def concurrency_sweep(
         out["speedup_max_vs_1"] = round(
             max(r["tokens_per_sec"] for r in reports.values()) / base, 3
         )
+    return out
+
+
+def replica_sweep(
+    make_server,
+    *,
+    vocab_size: int,
+    levels: tuple[int, ...] = (1, 2),
+    sessions: int = 8,
+    requests_per_session: int = 4,
+    prompt_len: int = 8,
+    max_new_tokens: int = 16,
+    sampling: SamplingParams = GREEDY,
+    seed: int = 0,
+    parity_prompts: int = 4,
+) -> dict:
+    """Replica-count comparison: run the SAME closed-loop workload on a
+    fresh ``make_server(n)`` stack per level — the machine-checkable
+    scaling gate for data-parallel serving (``cli serve --loadgen
+    --replicas 1,2``; BENCH_serve_r02.json).
+
+    ``make_server(n)`` must return an UNSTARTED :class:`ServeServer`
+    with ``n`` replicas; each level is warmed before timing (every
+    replica compiles its own program lattice) and stopped after.
+    ``parity_prompts`` > 0 with greedy sampling additionally decodes a
+    fixed prompt set through every level and reports ``parity_ok`` —
+    multi-replica greedy output must be token-identical to
+    ``--replicas 1`` (each replica runs the same params through the
+    same programs; routing must not change a single token).
+
+    Returns ``{"levels": {n: report}, "scaling": {...}, "parity_ok"}``;
+    each level's report carries the per-replica routed/served counts
+    (``report["replicas"]``/``report["router"]``)."""
+    levels = tuple(sorted({int(n) for n in levels}))
+    if not levels or levels[0] < 1:
+        raise ValueError(f"levels must be positive replica counts, "
+                         f"got {levels!r}")
+    check_parity = parity_prompts > 0 and sampling.greedy
+    probes = (_random_prompts(parity_prompts, prompt_len, vocab_size,
+                              seed + 4242) if check_parity else [])
+    out: dict = {"levels": {}}
+    parity: dict[int, list[list[int]]] = {}
+    for n in levels:
+        server = make_server(n)
+        if len(server.replicas) != n:
+            raise ValueError(
+                f"make_server({n}) built {len(server.replicas)} replicas")
+        with server:
+            with span("replica_sweep_warmup", replicas=n):
+                server.warmup(sampling, prompt_lens=(prompt_len,))
+            out["levels"][n] = run_loadgen(
+                server, vocab_size=vocab_size, sessions=sessions,
+                requests_per_session=requests_per_session,
+                prompt_len=prompt_len, max_new_tokens=max_new_tokens,
+                sampling=sampling, seed=seed,
+            )
+            if probes:
+                parity[n] = [
+                    list(server.generate(p, max_new_tokens=max_new_tokens,
+                                         sampling=sampling).tokens)
+                    for p in probes
+                ]
+    base, top = levels[0], levels[-1]
+    tps = {n: out["levels"][n]["tokens_per_sec"] for n in levels}
+    out["scaling"] = {
+        "tokens_per_sec": tps,
+        "base_level": base,
+        "top_level": top,
+        "speedup_top_vs_base": round(tps[top] / (tps[base] or 1e-9), 3),
+    }
+    if parity:
+        out["parity_ok"] = all(parity[n] == parity[base] for n in levels)
     return out

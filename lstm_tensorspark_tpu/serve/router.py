@@ -1,0 +1,372 @@
+"""Admission router over N per-replica schedulers (data-parallel serving).
+
+The serve stack's scaling unit is a **replica**: one :class:`ServeEngine`
+(its own state cache, prefix cache and compiled programs — on TPU also
+its own device, via ``ServeEngine(device=...)``) plus one
+:class:`Batcher` driven by its own scheduler thread. Replicas share
+NOTHING on the hot path: recurrent-state slots and ``PrefixCache``
+entries are replica-local, so there is no cross-replica cache coherence
+to get wrong — the source paper's driver/worker split applied to
+inference (the router is the driver, replicas are the map workers; cf.
+the DrJAX map/reduce framing in PAPERS.md). Aggregate decode tokens/s
+then scales with replicas instead of hard-capping at one scheduler
+(BENCH_serve_r02.json is the measured trajectory).
+
+Routing (:meth:`Router.submit`):
+
+- **session → replica affinity**: a request naming a ``session_id`` goes
+  to the replica whose state cache holds that session — probed directly
+  (``sid in engine.cache``), so the cache IS the affinity table and
+  there is no side mapping to go stale. A kept session's continuations
+  therefore always land where its carries (and any prefix entries its
+  prompts seeded) live.
+- **fresh requests** go to the least-loaded live replica
+  (queued + active + prefilling), round-robin on ties — so an idle
+  fleet splits a burst instead of piling onto replica 0.
+- **admission** enforces ONE global bound: total queued across live
+  replicas ``>= queue_size`` raises :class:`QueueFullError` (HTTP 429).
+  Per-replica queues are sized at the same bound, so the global check
+  is the only one that ever fires.
+
+Replica death — a scheduler thread that EXITS outside ``stop()``
+(uncaught exception) — is detected by :meth:`Router.sweep` (piggybacked
+on every submit and health probe; no monitor thread) and the replica is
+retired exactly once:
+
+1. its queued, not-yet-admitted requests are **requeued** onto live
+   replicas (bypassing the global bound — they already held queue slots
+   before the death);
+2. its in-flight (admitted) requests **fail honestly**: under
+   dispatch-ahead windowed decode the host cannot know how many tokens
+   an un-fetched window already consumed, so resuming mid-decode on
+   another replica could silently double-decode — "state lost" is the
+   truthful verdict;
+3. its idle kept sessions **migrate** to live replicas via the exact
+   ``detach``/``restore`` path (state_cache), BEFORE the requeue — so a
+   queued continuation follows its migrated state and completes
+   token-identically to an uninterrupted run. Sessions that cannot be
+   restored are dropped; their next continuation fails loudly as
+   "unknown session" (never silently decodes from zero state).
+
+A WEDGED replica (thread alive, heartbeat stale) is only excluded from
+fresh routing and health — its thread may still wake and touch its
+structures, so retirement (which mutates them from the router's thread)
+would race; see docs/OPERATIONS.md "Router runbook". Retirement runs
+inline on the detecting probe/submit thread; its cost is bounded by
+``num_slots`` × one O(1) LSTM state per kept session (KBs each —
+detach/restore of idle state, no pending compute to await), so a sweep
+stays well under orchestrator probe timeouts. A continuation submitted
+concurrently with its session's in-flight migration can land between
+detach and restore and fail "unknown session" once — transient by
+construction; an immediate retry follows the restored state.
+
+Lock order: ``Router._lock`` is acquired ABOVE replica locks (the
+router reads ``Batcher.queued()``/``load()`` and probes caches while
+holding it); nothing in a replica ever calls back up into the router,
+so the acquisition graph stays acyclic (graftlint ``lock-order``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from .batcher import Batcher, QueueFullError, Request
+from .engine import ServeEngine
+from .state_cache import PREFIX_SID_NAMESPACE
+
+
+class Replica:
+    """One engine + scheduler pair. The thread handle lives here so the
+    router and server agree on liveness; ``retired`` marks a dead
+    replica whose cleanup (requeue/fail/migrate) already ran."""
+
+    __slots__ = ("index", "engine", "batcher", "thread", "retired")
+
+    def __init__(self, index: int, engine: ServeEngine, batcher: Batcher):
+        self.index = index
+        self.engine = engine
+        self.batcher = batcher
+        self.thread: threading.Thread | None = None
+        self.retired = False  # claimed under the router lock, exactly once
+
+    def alive(self) -> bool:
+        """Routable: never started (requests queue until ``start()``) or
+        the thread is running. Started-and-exited is dead."""
+        return not self.retired and (
+            self.thread is None or self.thread.is_alive())
+
+    def stale(self, stale_after: float) -> bool:
+        """Running but heartbeat-silent past ``stale_after`` — the wedge
+        case (thread stuck inside a dispatch that never returns). An
+        unstarted replica has no heartbeat and is NOT stale."""
+        hb = self.batcher.last_heartbeat
+        return (self.thread is not None and hb is not None
+                and time.monotonic() - hb > stale_after)
+
+
+class Router:
+    """Admission front for a set of replicas (module docstring)."""
+
+    def __init__(self, replicas: list[Replica], *, queue_size: int = 64,
+                 stale_after: float = 60.0, registry=None):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        if queue_size < 1:
+            raise ValueError(f"queue_size must be >= 1, got {queue_size}")
+        self.replicas = list(replicas)
+        self.queue_size = queue_size
+        # heartbeat-staleness bound for ROUTING (mirrors the server's
+        # health_stale_after): a wedged replica must stop receiving fresh
+        # sessions — they would hang to client timeout while holding
+        # global queue capacity, even with healthy replicas idle
+        self.stale_after = stale_after
+        self._lock = threading.Lock()
+        self._rr = itertools.count()  # round-robin tie-break cursor
+        # the death sweep starts DISARMED: ServeServer.start() arms it
+        # (set_stopping(False)) only once every scheduler thread is
+        # running — otherwise a submit/probe racing the first start()
+        # could see an assigned-but-not-yet-started thread and retire a
+        # replica that is about to serve
+        self._stopping = True
+        self.rejected = 0            # global-bound 429s
+        self.requeued = 0            # dead-replica queue → live replica
+        self.failed_on_death = 0     # in-flight requests failed honestly
+        self.migrated_sessions = 0   # idle kept sessions detach/restored
+        self.lost_sessions = 0       # could not be restored anywhere
+        self.routed: dict[int, int] = {r.index: 0 for r in self.replicas}
+        reg = registry if registry is not None else replicas[0].engine.metrics
+        self._m_rejected = reg.counter(
+            "serve_router_rejected_total",
+            "requests 429'd at the router's global admission bound")
+        # ALSO recorded under the shared outcome family (replica="router"):
+        # the global bound fires before any per-replica bound can, and the
+        # runbook's queue-saturation signature is
+        # serve_requests_total{outcome="rejected"} — it must keep climbing
+        # on real 429s, not flatline because rejection moved up a layer
+        self._m_rejected_outcome = reg.counter(
+            "serve_requests_total",
+            labelnames=("outcome", "replica")).labels(
+            outcome="rejected", replica="router")
+        fam = reg.counter("serve_router_routed_total",
+                          "requests routed, by target replica",
+                          labelnames=("replica",))
+        self._m_routed = {r.index: fam.labels(replica=str(r.index))
+                          for r in self.replicas}
+        self._m_requeued = reg.counter(
+            "serve_router_requeued_total",
+            "dead-replica queued requests requeued onto live replicas")
+        self._m_failed_death = reg.counter(
+            "serve_router_death_failures_total",
+            "in-flight requests failed honestly on replica death")
+        self._m_migrated = reg.counter(
+            "serve_router_migrated_sessions_total",
+            "idle kept sessions moved off dead replicas via detach/restore")
+
+    # ---- client side ---------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        """Admit + route one request, or raise :class:`QueueFullError`
+        (global bound; HTTP 429) / ``RuntimeError`` when no replica is
+        live. Called from client/HTTP threads."""
+        self.sweep()
+        with self._lock:
+            live = [r for r in self.replicas if r.alive()]
+            if not live:
+                raise RuntimeError(
+                    "no live replica schedulers (all replicas dead)")
+            # the bound covers NON-STALE queues only: a wedged replica
+            # never drains (its admission loop is stuck), so counting its
+            # stranded entries would permanently shrink the fleet's
+            # effective admission capacity until restart. If the wedge
+            # recovers, a transient overshoot of the bound drains normally.
+            if sum(r.batcher.queued() for r in live
+                   if not r.stale(self.stale_after)) >= self.queue_size:
+                self.rejected += 1
+                self._m_rejected.inc()
+                self._m_rejected_outcome.inc()
+                raise QueueFullError(
+                    f"submit queue full ({self.queue_size} pending)")
+            self._dispatch_locked(req, live)
+
+    def _dispatch_locked(self, req: Request, live: list[Replica]) -> None:
+        self._submit_to_locked(req, self._pick_locked(req, live))
+
+    def _submit_to_locked(self, req: Request, target: Replica) -> None:
+        req.replica = target.index
+        # per-replica queues are sized at the global bound, so this never
+        # raises QueueFullError here; a bad prompt still raises ValueError
+        # before any accounting (nothing to undo)
+        target.batcher.submit(req)
+        self.routed[target.index] += 1
+        self._m_routed[target.index].inc()
+
+    def _pick_locked(self, req: Request, live: list[Replica]) -> Replica:
+        sid = req.session_id
+        if sid is not None:
+            # affinity: the replica holding the session's carries owns the
+            # session — even when heartbeat-stale (a transient stall must
+            # not hard-fail a valid session; routing elsewhere would
+            # GUARANTEE an "unknown session" error). No match → route by
+            # load; the target batcher then fails an expired continuation
+            # loudly (never decodes from zero state), exactly as in the
+            # single-replica stack.
+            for r in live:
+                if sid in r.engine.cache:
+                    return r
+        # fresh sessions avoid wedged (stale) replicas while any healthy
+        # one exists — a stale replica admits nothing, so work routed
+        # there hangs to client timeout while holding queue capacity
+        fresh = [r for r in live if not r.stale(self.stale_after)]
+        pool = fresh or live
+        loads = [(r.batcher.load(), r) for r in pool]
+        lo = min(load for load, _ in loads)
+        cands = [r for load, r in loads if load == lo]
+        return cands[next(self._rr) % len(cands)]
+
+    # ---- replica-death handling ----------------------------------------
+
+    def set_stopping(self, stopping: bool) -> None:
+        """A deliberate ``stop()`` joins every scheduler thread — the
+        sweep must not mistake that for death and start requeueing."""
+        with self._lock:
+            self._stopping = bool(stopping)
+
+    def sweep(self) -> None:
+        """Detect replicas whose scheduler thread DIED (started, then
+        exited outside ``stop()``) and retire each exactly once.
+        Piggybacked on submit() and the health probe — O(replicas) when
+        nothing died, so no monitor thread is needed."""
+        claimed: list[Replica] = []
+        with self._lock:
+            if self._stopping:
+                return
+            for r in self.replicas:
+                if (not r.retired and r.thread is not None
+                        and not r.thread.is_alive()):
+                    r.retired = True  # claim under the lock, clean outside
+                    claimed.append(r)
+        for r in claimed:
+            self._retire(r)
+
+    def _retire(self, dead: Replica) -> None:
+        """Runs OUTSIDE the router lock: reaches into the dead replica's
+        batcher and cache (their own locks) and resubmits through the
+        normal routing path."""
+        drained = dead.batcher.drain_queue()
+        failed = dead.batcher.fail_inflight(
+            f"replica {dead.index} scheduler died mid-request; its decode "
+            "position is indeterminate under dispatch-ahead windows "
+            "(state lost — resend the request)")
+        # migrate idle kept sessions FIRST so a drained continuation is
+        # requeued to wherever its state now lives
+        migrated = lost = 0
+        for sid in dead.engine.cache.session_ids():
+            if sid.startswith(PREFIX_SID_NAMESPACE):
+                continue  # prefix entries are an optimisation — they die
+                # with their replica and re-seed from live traffic
+            try:
+                state = dead.engine.detach_session(sid)
+            except KeyError:
+                continue  # raced an eviction; nothing to move
+            placed = False
+            with self._lock:
+                targets = [r for r in self.replicas if r.alive()]
+            # healthy targets ONLY — no wedged fallback: a wedged
+            # replica's engine lock may be held across a dispatch that
+            # never returns, so restore_session could block this thread
+            # (a health probe!) forever, and even a successful restore
+            # parks the session where continuations hang to client
+            # timeout. No healthy target → the session is lost, honestly.
+            healthy = [r for r in targets
+                       if not r.stale(self.stale_after)]
+            for target in sorted(healthy,
+                                 key=lambda r: r.batcher.load()):
+                try:
+                    target.engine.restore_session(sid, state)
+                except Exception:
+                    continue  # cache full of pinned slots: try the next
+                if not target.alive():
+                    # the target died while the restore was in flight
+                    # (double death): a session landed in a corpse's cache
+                    # is unreachable — pull it back out and keep looking
+                    # rather than reporting a migration that never helps
+                    try:
+                        state = target.engine.detach_session(sid)
+                    except Exception:
+                        break  # its own retirement already took the sid
+                    continue
+                placed = True
+                break
+            if placed:
+                migrated += 1
+                self._m_migrated.inc()
+            else:
+                lost += 1
+        requeued = 0
+        for req in drained:
+            try:
+                with self._lock:
+                    live = [r for r in self.replicas if r.alive()]
+                    if not live:
+                        raise RuntimeError("no live replica schedulers")
+                    # no global-bound recheck: these requests already held
+                    # queue slots before the death. Concurrent submits can
+                    # still steal that headroom (the drain released it
+                    # before this loop re-enqueues), so capacity is
+                    # checked under the router lock (every client submit
+                    # serialises through it) and a full affinity pick
+                    # falls back to any live replica with room — no
+                    # exception-driven retry, so the per-replica
+                    # rejected counters never see these internal probes.
+                    target = self._pick_locked(req, live)
+                    if target.batcher.queued() >= self.queue_size:
+                        if req.session_id is not None:
+                            # never override affinity: rerouting a
+                            # continuation to a replica without its state
+                            # would fail it "unknown session" while the
+                            # session is intact — queue-full is the
+                            # honest verdict here
+                            raise QueueFullError(
+                                "the session's replica queue is full")
+                        target = next(
+                            (r for r in sorted(
+                                live, key=lambda x: x.batcher.queued())
+                             if r.batcher.queued() < self.queue_size),
+                            None)
+                    if target is None:
+                        raise QueueFullError(
+                            "every live replica's queue is full")
+                    self._submit_to_locked(req, target)
+                requeued += 1
+                self._m_requeued.inc()
+            except Exception as e:
+                dead.batcher.fail_request(
+                    req, f"replica {dead.index} scheduler died and the "
+                         f"request could not be requeued: {e}")
+        with self._lock:
+            self.requeued += requeued
+            self.failed_on_death += failed
+            self.migrated_sessions += migrated
+            self.lost_sessions += lost
+        if failed:
+            self._m_failed_death.inc(failed)
+
+    # ---- views ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "replicas": len(self.replicas),
+                "live": sum(1 for r in self.replicas if r.alive()),
+                "retired": [r.index for r in self.replicas if r.retired],
+                "queue_size": self.queue_size,
+                "routed": {str(k): v
+                           for k, v in sorted(self.routed.items())},
+                "rejected": self.rejected,
+                "requeued": self.requeued,
+                "failed_on_death": self.failed_on_death,
+                "migrated_sessions": self.migrated_sessions,
+                "lost_sessions": self.lost_sessions,
+            }

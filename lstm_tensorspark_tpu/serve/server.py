@@ -1,8 +1,12 @@
 """Serving front-ends: in-process synchronous client + stdlib HTTP server.
 
-:class:`ServeServer` owns the engine + batcher and a background scheduler
-thread; :meth:`ServeServer.generate` is the synchronous request path used
-by both front-ends:
+:class:`ServeServer` owns N replicas (one engine + batcher + scheduler
+thread each) behind an admission :class:`~.router.Router` — a single
+engine is the classic one-replica stack, a list of engines is the
+data-parallel ``--replicas N`` stack (session→replica affinity, global
+bounded admission, honest replica-death handling; serve/router.py).
+:meth:`ServeServer.generate` is the synchronous request path used by
+both front-ends:
 
 - :class:`InprocessClient` — the test/loadgen client: same admission,
   batching and backpressure semantics as HTTP, no sockets;
@@ -18,9 +22,11 @@ by both front-ends:
     request's worst inter-token gap — windowed decode delivers K tokens
     per burst, and a client deciding whether to pin ``--decode-window 1``
     needs to SEE that, not guess it);
-  - ``GET /healthz`` → honest liveness: 200 with the scheduler thread's
-    heartbeat age while the batcher thread lives, 503 once it is dead or
-    never started (a wedged server must fail probes, not smile at them);
+  - ``GET /healthz`` → honest liveness fanned in across replicas:
+    ``status`` is ``ok`` / ``degraded`` (some replicas dead or wedged —
+    still 200, survivors are serving) / ``down`` (503), with per-replica
+    alive/stale/heartbeat-age detail (a wedged server must fail probes,
+    not smile at them);
     ``GET /stats`` (alias ``/v1/stats``) → batcher/engine/cache counters:
     per-key compile counts, prefix-cache hit/miss/evict/invalidate,
     state-cache swap generation, prefill-chunk/window dispatch counts,
@@ -49,52 +55,123 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .batcher import Batcher, QueueFullError, Request
 from .engine import GREEDY, SamplingParams, ServeEngine
+from .router import Replica, Router
+
+#: aggregated batcher counters summed across replicas in stats(); config
+#: fields (window ladder etc.) are taken from replica 0 instead
+_SUMMED_BATCHER_KEYS = (
+    "submitted", "completed", "rejected", "failed", "tokens_generated",
+    "queued", "active", "prefilling", "windows_pipelined",
+    "prefill_chunks_dispatched", "prefix_resumed", "prefix_tokens_saved",
+)
 
 
 class ServeServer:
-    """Engine + batcher + scheduler thread, with a synchronous submit path.
+    """N replicas (engine + batcher + scheduler thread each) behind an
+    admission router, with a synchronous submit path.
+
+    ``engine`` may be a single :class:`ServeEngine` (the classic
+    one-replica stack — every existing call site) or a list of engines
+    (``cli serve --replicas N``): one :class:`Batcher` is built per
+    engine and the :class:`Router` spreads fresh sessions by load while
+    keeping session continuations replica-affine. ``queue_size`` is the
+    GLOBAL admission bound, enforced at the router.
 
     ``health_stale_after``: seconds of scheduler-heartbeat silence before
-    ``health()`` reports not-ok even though the thread is alive — the
+    a replica counts unhealthy even though its thread is alive — the
     wedged-dispatch case (thread stuck inside a device call that never
     returns) where ``is_alive()`` stays true forever. An idle scheduler
     beats the heartbeat every ``idle_wait`` (~0.05 s), so any healthy
     server sits far below the default."""
 
-    def __init__(self, engine: ServeEngine, batcher: Batcher | None = None,
+    def __init__(self, engine, batcher: Batcher | None = None,
                  health_stale_after: float = 60.0, **batcher_kw):
-        self.engine = engine
-        self.batcher = batcher or Batcher(engine, **batcher_kw)
+        engines = (list(engine) if isinstance(engine, (list, tuple))
+                   else [engine])
+        if not engines:
+            raise ValueError("ServeServer needs at least one engine")
+        if batcher is not None and len(engines) > 1:
+            raise ValueError(
+                "an explicit batcher only makes sense for a single-replica "
+                "server; pass batcher_kw for replicated stacks")
+        self.replicas: list[Replica] = []
+        for i, eng in enumerate(engines):
+            b = batcher if (batcher is not None and i == 0) else Batcher(
+                eng, replica=i, **batcher_kw)
+            self.replicas.append(Replica(i, eng, b))
+        # the global admission bound == the per-replica queue bound, so
+        # the router's check is the only one that ever fires
+        self.router = Router(
+            self.replicas, queue_size=self.replicas[0].batcher.queue_size,
+            stale_after=health_stale_after, registry=engines[0].metrics)
         self.health_stale_after = health_stale_after
         self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
+
+    # ---- single-replica views (back-compat + convenience) --------------
+
+    @property
+    def engine(self) -> ServeEngine:
+        """Replica 0's engine (THE engine of a single-replica server)."""
+        return self.replicas[0].engine
+
+    @property
+    def batcher(self) -> Batcher:
+        """Replica 0's batcher (THE batcher of a single-replica server)."""
+        return self.replicas[0].batcher
+
+    @property
+    def _thread(self) -> threading.Thread | None:
+        return self.replicas[0].thread
 
     # ---- lifecycle -----------------------------------------------------
 
     def start(self) -> "ServeServer":
-        if self._thread is not None:
+        if any(r.thread is not None for r in self.replicas):
             raise RuntimeError("server already started")
         self._stop.clear()
-        self._thread = threading.Thread(
-            target=self.batcher.run, args=(self._stop,),
-            name="serve-scheduler", daemon=True,
-        )
-        self._thread.start()
+        for r in self.replicas:
+            # a stop()/start() restart revives retired replicas: their
+            # death cleanup (requeue/fail/migrate) already ran, and the
+            # fresh scheduler thread below serves again — leaving the
+            # flag set would make the router refuse them forever while
+            # health reports the new thread alive
+            r.retired = False
+            # target resolved at start time so tests can monkeypatch
+            # replica batchers' run/step before (or between) starts
+            t = threading.Thread(
+                target=r.batcher.run, args=(self._stop,),
+                name=f"serve-scheduler-{r.index}", daemon=True,
+            )
+            r.thread = t
+            t.start()
+        # re-arm the death sweep only once every thread is RUNNING: a
+        # concurrent probe/submit sweeping between `r.thread = t` and
+        # `t.start()` would see a not-yet-alive thread and retire a
+        # replica that is about to serve
+        self.router.set_stopping(False)
         return self
 
     def stop(self) -> None:
+        # mark the stop BEFORE joining: the router's death sweep must not
+        # mistake deliberately-joined scheduler threads for crashes and
+        # start requeueing a shutting-down server's work
+        self.router.set_stopping(True)
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
-            self._thread = None
+        for r in self.replicas:
+            if r.thread is not None:
+                r.thread.join(timeout=10.0)
+                r.thread = None
 
     def warmup(self, sampling: SamplingParams = GREEDY,
                prompt_lens: tuple[int, ...] = (1,)) -> int:
-        """Pre-compile everything the scheduler can dispatch for these
-        prompt lengths. Delegates to the batcher, which derives the
-        chunk / prefix-insert split and window-ladder programs from its
-        own policy — the one warmup entry point front-ends should use."""
-        return self.batcher.warmup(sampling, prompt_lens=prompt_lens)
+        """Pre-compile everything the schedulers can dispatch for these
+        prompt lengths, on EVERY replica (each engine owns its compiled
+        programs). Delegates to each batcher, which derives the chunk /
+        prefix-insert split and window-ladder programs from its own
+        policy — the one warmup entry point front-ends should use.
+        Returns the total number of cached programs across replicas."""
+        return sum(r.batcher.warmup(sampling, prompt_lens=prompt_lens)
+                   for r in self.replicas)
 
     def __enter__(self) -> "ServeServer":
         return self.start()
@@ -117,15 +194,16 @@ class ServeServer:
         timeout: float = 120.0,
     ) -> Request:
         """Submit and block until the request completes; returns the filled
-        :class:`Request` (``.tokens``, ``.session_id``, timestamps).
-        Raises :class:`QueueFullError` (backpressure), ``TimeoutError``, or
-        ``RuntimeError`` on a scheduler-side failure."""
+        :class:`Request` (``.tokens``, ``.session_id``, ``.replica``,
+        timestamps). Raises :class:`QueueFullError` (backpressure),
+        ``TimeoutError``, or ``RuntimeError`` on a scheduler-side
+        failure."""
         req = Request(
             prompt, max_new_tokens, sampling=sampling,
             session_id=session_id, keep_session=keep_session, eos_id=eos_id,
             use_prefix=use_prefix,
         )
-        self.batcher.submit(req)
+        self.router.submit(req)
         if not req.done.wait(timeout):
             # tell the scheduler to stop working for a client that left —
             # otherwise abandoned requests hold queue/slot capacity and
@@ -139,28 +217,76 @@ class ServeServer:
         return req
 
     def stats(self) -> dict:
-        return {"batcher": self.batcher.stats(), **self.engine.stats(),
-                "metrics": self.metrics_summary()}
+        """Aggregate view + per-replica detail. Top-level ``batcher`` sums
+        counters across replicas (identical to replica 0's stats on a
+        single-replica server); top-level engine fields stay replica 0's
+        for back-compat; ``replicas`` carries each replica's full
+        batcher/engine stats and ``router`` the routing/requeue/migration
+        counters."""
+        agg: dict = {}
+        per = []
+        for r in self.replicas:
+            b = r.batcher.stats()
+            per.append({"replica": r.index, "batcher": b, **r.engine.stats()})
+            if not agg:
+                # seed from THIS snapshot (not a second stats() call) so
+                # the aggregate and replicas[0]'s detail in one reply
+                # describe the same instant; deep-copy the merged dict so
+                # summing never mutates replica 0's reported view
+                agg = dict(b)
+                agg["windows_dispatched"] = dict(b["windows_dispatched"])
+                continue
+            for k in _SUMMED_BATCHER_KEYS:
+                agg[k] += b[k]
+            for k, v in b["windows_dispatched"].items():
+                agg["windows_dispatched"][k] = (
+                    agg["windows_dispatched"].get(k, 0) + v)
+        agg.pop("replica", None)  # the aggregate is not one replica's view
+        rt = self.router.stats()
+        # router-level 429s are THE backpressure count of the replicated
+        # stack (per-replica bounds never fire; see Router docstring)
+        agg["rejected"] += rt["rejected"]
+        return {"batcher": agg, **self.engine.stats(), "router": rt,
+                "replicas": per, "metrics": self.metrics_summary()}
 
     def _collect_gauges(self) -> None:
         """Refresh poll-style gauges at scrape time — an idle server's
-        scheduler may not have run since the last change, and cache
-        occupancy is cheapest read on demand."""
+        schedulers may not have run since the last change, and cache
+        occupancy is cheapest read on demand. One child per replica."""
         reg = self.engine.metrics
-        b = self.batcher.stats()
-        reg.gauge("serve_queue_depth").set(b["queued"])
-        reg.gauge("serve_active_sessions").set(b["active"])
-        reg.gauge("serve_prefilling_sessions").set(b["prefilling"])
-        c = self.engine.cache.stats()
-        fam = reg.gauge("serve_state_cache_slots",
-                        "state-cache slot occupancy", labelnames=("state",))
-        fam.labels(state="live").set(c["live_sessions"])
-        fam.labels(state="pinned").set(c["pinned"])
-        fam.labels(state="free").set(c["free"])
-        if self.engine.prefix is not None:
-            reg.gauge("serve_prefix_cache_entries",
-                      "live prefix-cache entries").set(
-                self.engine.prefix.stats()["entries"])
+        live = dead = 0
+        for r in self.replicas:
+            rl = str(r.index)
+            b = r.batcher.stats()
+            reg.gauge("serve_queue_depth", labelnames=("replica",)).labels(
+                replica=rl).set(b["queued"])
+            reg.gauge("serve_active_sessions",
+                      labelnames=("replica",)).labels(
+                replica=rl).set(b["active"])
+            reg.gauge("serve_prefilling_sessions",
+                      labelnames=("replica",)).labels(
+                replica=rl).set(b["prefilling"])
+            c = r.engine.cache.stats()
+            fam = reg.gauge("serve_state_cache_slots",
+                            "state-cache slot occupancy",
+                            labelnames=("replica", "state"))
+            fam.labels(replica=rl, state="live").set(c["live_sessions"])
+            fam.labels(replica=rl, state="pinned").set(c["pinned"])
+            fam.labels(replica=rl, state="free").set(c["free"])
+            if r.engine.prefix is not None:
+                reg.gauge("serve_prefix_cache_entries",
+                          "live prefix-cache entries",
+                          labelnames=("replica",)).labels(replica=rl).set(
+                    r.engine.prefix.stats()["entries"])
+            if r.alive():
+                live += 1
+            else:
+                dead += 1
+        fam = reg.gauge("serve_replicas",
+                        "replica schedulers by liveness state",
+                        labelnames=("state",))
+        fam.labels(state="live").set(live)
+        fam.labels(state="dead").set(dead)
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the serve stack's registry
@@ -171,33 +297,66 @@ class ServeServer:
     def metrics_summary(self) -> dict:
         """JSON-ready registry view (histograms as {count,sum,p50,p99})
         — embedded in ``/stats`` and the loadgen/bench reports so
-        server-side and loadgen-side percentiles sit next to each other."""
+        server-side and loadgen-side percentiles sit next to each other.
+        ``replica``-labelled families export per-child entries plus one
+        cross-replica aggregate under the bare name."""
         self._collect_gauges()
         return self.engine.metrics.summaries()
 
     def health(self) -> dict:
-        """Honest liveness: ``ok`` requires the scheduler THREAD to be
-        alive AND its heartbeat fresher than ``health_stale_after`` — a
-        crashed batcher fails probes (HTTP 503), and so does a WEDGED one
-        (thread alive but stuck inside a dispatch that never returns: the
-        is_alive() check alone would smile through that forever). Reports
-        ``seconds_since_last_iteration`` (scheduler heartbeat age; idle
-        cycles count as iterations, so a healthy idle server stays near
-        its poll interval) plus queue depth for probe-side context."""
-        thread = self._thread
-        alive = thread is not None and thread.is_alive()
-        hb = self.batcher.last_heartbeat
-        age = None if hb is None else max(time.monotonic() - hb, 0.0)
-        stale = age is not None and age > self.health_stale_after
-        st = self.batcher.stats()
+        """Honest liveness, fanned in across replicas. A replica is
+        healthy when its scheduler THREAD is alive AND its heartbeat is
+        fresher than ``health_stale_after`` (a wedged thread — stuck
+        inside a dispatch that never returns — stays is_alive() forever,
+        so the heartbeat age is the real signal). The aggregate
+        ``status`` is ``ok`` (all healthy), ``degraded`` (some dead or
+        wedged, survivors still serving — HTTP 200, because an
+        orchestrator kill-looping a half-healthy server would destroy
+        the surviving capacity too) or ``down`` (nothing serving —
+        HTTP 503). The probe also triggers the router's death sweep, so
+        a dead replica's queued work is requeued by the next probe even
+        on an otherwise idle server."""
+        self.router.sweep()
+        now = time.monotonic()
+        reps = []
+        healthy = 0
+        for r in self.replicas:
+            alive = r.thread is not None and r.thread.is_alive()
+            hb = r.batcher.last_heartbeat
+            age = None if hb is None else max(now - hb, 0.0)
+            stale = age is not None and age > self.health_stale_after
+            ok = bool(alive and not stale)
+            healthy += ok
+            st = r.batcher.stats()
+            reps.append({
+                "replica": r.index,
+                "ok": ok,
+                "alive": bool(alive),
+                "stale": bool(stale),
+                "retired": bool(r.retired),
+                "seconds_since_last_iteration":
+                    None if age is None else round(age, 3),
+                "queued": st["queued"],
+                "active": st["active"],
+            })
+        status = ("ok" if healthy == len(reps)
+                  else "degraded" if healthy else "down")
+        ages = [x["seconds_since_last_iteration"] for x in reps
+                if x["seconds_since_last_iteration"] is not None]
         return {
-            "ok": bool(alive and not stale),
-            "batcher_alive": bool(alive),
-            "batcher_stale": bool(stale),
-            "seconds_since_last_iteration":
-                None if age is None else round(age, 3),
-            "queued": st["queued"],
-            "active": st["active"],
+            "ok": status == "ok",
+            "status": status,
+            "replicas_healthy": healthy,
+            "replicas_total": len(reps),
+            "replicas": reps,
+            # legacy flat fields: the single-replica view generalised —
+            # alive only when EVERY scheduler thread lives, stale when any
+            # heartbeat is, worst-case heartbeat age, summed depths
+            "batcher_alive": all(x["alive"] for x in reps),
+            "batcher_stale": any(x["stale"] for x in reps),
+            "seconds_since_last_iteration": max(ages) if ages else None,
+            "queued": sum(x["queued"] for x in reps),
+            "active": sum(x["active"] for x in reps),
         }
 
 
@@ -253,8 +412,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:
         if self.path == "/healthz":
+            # per-replica fan-in: 200 while ANY replica serves ("ok" or
+            # "degraded" — kill-looping a half-healthy server would take
+            # out the surviving capacity too), 503 only when "down"
             health = self._serve.health()
-            self._reply(200 if health["ok"] else 503, health)
+            self._reply(200 if health["status"] != "down" else 503, health)
         elif self.path in ("/stats", "/v1/stats"):
             # one payload, two routes: per-key compile counts, prefix-cache
             # hit/miss/evict/invalidate counters, state-cache swap
@@ -317,6 +479,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, {
             "tokens": list(req.tokens),
             "session_id": req.session_id,
+            "replica": req.replica,
             "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
             "ttft_ms": round((req.t_first_token - req.t_submit) * 1e3, 3)
             if req.t_first_token and req.t_submit else None,

@@ -48,6 +48,7 @@ import threading
 from collections import OrderedDict
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -73,7 +74,7 @@ class DetachedState(NamedTuple):
 
 class StateCache:
     def __init__(self, num_layers: int, num_slots: int, hidden_size: int,
-                 registry=None):
+                 registry=None, device=None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.num_layers = num_layers
@@ -82,6 +83,12 @@ class StateCache:
         # +1: the scratch slot for padded batch rows (index == num_slots)
         self.h = jnp.zeros((num_layers, num_slots + 1, hidden_size), jnp.float32)
         self.c = jnp.zeros((num_layers, num_slots + 1, hidden_size), jnp.float32)
+        if device is not None:
+            # device-per-replica serving: commit the cache arrays so every
+            # program touching them (and their uncommitted host inputs)
+            # runs on this replica's device
+            self.h = jax.device_put(self.h, device)
+            self.c = jax.device_put(self.c, device)
         self._lock = threading.RLock()
         self._slots: OrderedDict[str, int] = OrderedDict()  # LRU: oldest first
         self._free: list[int] = list(range(num_slots))
@@ -172,6 +179,14 @@ class StateCache:
     def __contains__(self, session_id: str) -> bool:
         with self._lock:
             return session_id in self._slots
+
+    def session_ids(self) -> list[str]:
+        """Live session ids, LRU-oldest first (includes the ``prefix/``
+        namespace — callers that only want client sessions filter it).
+        The router's replica-retirement path enumerates these to migrate
+        a dead replica's idle kept sessions via detach/restore."""
+        with self._lock:
+            return list(self._slots)
 
     def __len__(self) -> int:
         with self._lock:

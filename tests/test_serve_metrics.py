@@ -125,7 +125,9 @@ def test_server_percentiles_agree_with_loadgen():
                              max_new_tokens=6)
     assert report["failed"] == 0 and report["rejected"] == 0
     # every completed request's TTFT landed in the server histogram
-    h_ttft = reg.histogram("serve_ttft_seconds")
+    # (serve families carry a replica label; this stack is replica 0)
+    h_ttft = reg.histogram("serve_ttft_seconds",
+                           labelnames=("replica",)).labels(replica="0")
     assert h_ttft.snapshot()[2] == report["completed"]
 
     # loadgen embeds the server-side summaries next to its own numbers
@@ -137,7 +139,8 @@ def test_server_percentiles_agree_with_loadgen():
                               ("p99_itl_ms", "serve_itl_seconds")):
         lg_s = report[loadgen_key] / 1e3
         q = 0.99 if loadgen_key.startswith("p99") else 0.5
-        srv_s = reg.histogram(name).quantile(q)
+        srv_s = reg.histogram(name, labelnames=("replica",)).labels(
+            replica="0").quantile(q)
         tol = _bucket_span(lg_s) + 0.005  # bucket resolution + sched noise
         assert abs(srv_s - lg_s) <= tol, (loadgen_key, srv_s, lg_s, tol)
 

@@ -24,9 +24,17 @@ acceptance criteria:
    PASS: p99_itl(chunked+inject) - p99_itl(chunked baseline) <= chunk
    latency (+ a 2x scheduling-noise allowance on CPU).
 
+With ``--replicas 1,2`` the tool instead runs the **data-parallel
+replica scaling probe** (ISSUE-8 acceptance; writes BENCH_serve_r02.json):
+the same closed-loop decode workload — sessions at 2x one engine's
+largest decode bucket, where a single scheduler must serialise sub-bucket
+chunks — at each replica count, gating on aggregate tokens/s >= 1.7x at
+2 replicas and greedy outputs token-identical across levels.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/bench_serve.py [--out BENCH_serve_r01.json]
+    JAX_PLATFORMS=cpu python tools/bench_serve.py --replicas 1,2
 
 Run it with nothing else executing (same discipline as the tier-1 suite:
 CPU contention corrupts latency percentiles).
@@ -52,7 +60,10 @@ import numpy as np  # noqa: E402
 from lstm_tensorspark_tpu.models import LMConfig, init_lm  # noqa: E402
 from lstm_tensorspark_tpu.obs import MetricsRegistry  # noqa: E402
 from lstm_tensorspark_tpu.serve import ServeEngine, ServeServer  # noqa: E402
-from lstm_tensorspark_tpu.serve.loadgen import run_loadgen  # noqa: E402
+from lstm_tensorspark_tpu.serve.loadgen import (  # noqa: E402
+    replica_sweep,
+    run_loadgen,
+)
 
 CFG = dict(vocab_size=89, hidden_size=128, num_layers=2)
 SESSIONS = 8
@@ -162,10 +173,103 @@ def stall_latencies_ms() -> tuple[float, float]:
     return chunk_ms, full_ms
 
 
+# ---- data-parallel replica scaling probe (--replicas; BENCH_serve_r02) --
+#
+# The single-scheduler stack hard-caps aggregate decode at one engine's
+# batch bucket: with 2x the sessions of the largest decode bucket, ONE
+# replica must split every iteration into sequential sub-bucket chunks
+# (and loses the windowed fast path, which requires the whole active set
+# to fit one bucket), while N replicas run their buckets concurrently —
+# exactly the capacity wall data-parallel serving removes. The probe runs
+# the SAME workload at --replicas 1 and 2 and gates on aggregate
+# tokens/s >= 1.7x plus greedy parity (token-identical outputs).
+
+R_CFG = dict(vocab_size=89, hidden_size=128, num_layers=2)
+R_SESSIONS = 16           # 2x the decode bucket: one scheduler saturates
+R_BATCH_BUCKETS = (1, 2, 4, 8)   # largest bucket = one replica's capacity
+R_PROMPT_LEN = 8
+R_MAX_NEW = 64
+R_REQS = 4
+
+
+def replica_probe(levels: tuple[int, ...]) -> dict:
+    cfg = LMConfig(**R_CFG)
+    params = init_lm(jax.random.PRNGKey(0), cfg)
+
+    def make_server(n: int) -> ServeServer:
+        # ONE private registry per level, shared by every replica of that
+        # level: the router/server aggregate engines[0].metrics, so
+        # per-engine registries would silently drop replica >= 1's
+        # server-side histograms from the embedded report
+        reg = MetricsRegistry()
+        engines = [
+            ServeEngine(
+                params, cfg, num_slots=32,
+                prefill_buckets=(8, 16), batch_buckets=R_BATCH_BUCKETS,
+                rng_seed=i, registry=reg,
+            )
+            for i in range(n)
+        ]
+        return ServeServer(engines if n > 1 else engines[0],
+                           max_active=R_SESSIONS, queue_size=64,
+                           window_ladder=(1, 4, 8))
+
+    return replica_sweep(
+        make_server, vocab_size=cfg.vocab_size, levels=levels,
+        sessions=R_SESSIONS, requests_per_session=R_REQS,
+        prompt_len=R_PROMPT_LEN, max_new_tokens=R_MAX_NEW, seed=5,
+    )
+
+
+def run_replica_bench(levels: tuple[int, ...], out_path: str) -> int:
+    print(f"bench_serve: replica scaling probe (levels {levels})...",
+          flush=True)
+    sweep = replica_probe(levels)
+    sc = sweep["scaling"]
+    speedup = sc["speedup_top_vs_base"]
+    out = {
+        "note": "serve_bench_r02 replica scaling (tools/bench_serve.py "
+                "--replicas)",
+        "config": {
+            **R_CFG, "sessions": R_SESSIONS,
+            "batch_buckets": list(R_BATCH_BUCKETS),
+            "prompt_len": R_PROMPT_LEN, "max_new_tokens": R_MAX_NEW,
+            "requests_per_session": R_REQS, "levels": list(levels),
+            "platform": jax.devices()[0].platform,
+        },
+        "replica_scaling": sweep,
+        "pass_1p7x": bool(speedup >= 1.7),
+        "pass_parity": bool(sweep.get("parity_ok", False)),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "tokens_per_sec": sc["tokens_per_sec"],
+        "speedup_top_vs_base": speedup,
+        "parity_ok": sweep.get("parity_ok"),
+        "pass_1p7x": out["pass_1p7x"],
+    }))
+    print(f"bench_serve: report written to {out_path}")
+    return 0 if (out["pass_1p7x"] and out["pass_parity"]) else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--out", default=os.path.join(_REPO, "BENCH_serve_r01.json"))
+    ap.add_argument("--out", default=None,
+                    help="report path (default BENCH_serve_r01.json, or "
+                         "BENCH_serve_r02.json with --replicas)")
+    ap.add_argument("--replicas", default=None,
+                    help="comma list (e.g. 1,2): run the data-parallel "
+                         "replica scaling probe instead of the r01 "
+                         "prefix/ITL probes")
     args = ap.parse_args(argv)
+
+    if args.replicas:
+        levels = tuple(int(x) for x in args.replicas.split(",") if x.strip())
+        out_path = args.out or os.path.join(_REPO, "BENCH_serve_r02.json")
+        return run_replica_bench(levels, out_path)
+    args.out = args.out or os.path.join(_REPO, "BENCH_serve_r01.json")
 
     print("bench_serve: TTFT probe (prefix cache on, hot)...", flush=True)
     on = ttft_run(prefix_cache=True)

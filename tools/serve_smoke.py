@@ -1,13 +1,15 @@
 #!/usr/bin/env python
-"""Serve smoke: boot the real `cli serve --http` subprocess, hit
-/healthz + /v1/generate + /stats + /metrics, and validate the Prometheus
-exposition parses (obs.parse_exposition — the same validator the tests
-use, so the wire contract is checked by the exact code that defines it).
+"""Serve smoke: boot the real `cli serve --http --replicas 2` subprocess,
+hit /healthz (per-replica fan-in) + /v1/generate (router-stamped replica)
++ /stats (router + per-replica sections) + /metrics, and validate the
+Prometheus exposition parses (obs.parse_exposition — the same validator
+the tests use, so the wire contract is checked by the exact code that
+defines it) including the `replica` label on the serve families.
 
 Run by tools/verify.sh after the tier-1 gate. CPU, tiny model, pinned
---decode-window 1 and two prefill buckets to keep the warmup lattice to a
-few seconds. Exit 0 on PASS, 1 on any failure, with the child's output
-replayed on failure for diagnosis.
+--decode-window 1 and two prefill buckets to keep the warmup lattice
+(compiled once PER replica) to a few seconds. Exit 0 on PASS, 1 on any
+failure, with the child's output replayed on failure for diagnosis.
 
 Usage::
 
@@ -32,11 +34,13 @@ sys.path.insert(0, _REPO)
 
 from lstm_tensorspark_tpu.obs import parse_exposition  # noqa: E402
 
+_REPLICAS = 2
 _SERVE_ARGS = [
     "serve", "--http", "--port", "0", "--vocab-size", "31",
     "--hidden-units", "12", "--num-layers", "1",
     "--prefill-buckets", "4,8", "--batch-buckets", "1,2",
     "--decode-window", "1", "--prefix-cache", "off",
+    "--replicas", str(_REPLICAS),
 ]
 
 
@@ -80,8 +84,12 @@ def main(argv=None) -> int:
 
         with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
             health = json.loads(r.read())
-        if not health.get("ok"):
+        if not health.get("ok") or health.get("status") != "ok":
             return _fail(proc, lines, f"unhealthy at boot: {health}")
+        reps = health.get("replicas", [])
+        if len(reps) != _REPLICAS or not all(x.get("ok") for x in reps):
+            return _fail(proc, lines,
+                         f"/healthz replica fan-in wrong: {reps}")
 
         body = json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 4,
                            "greedy": True}).encode()
@@ -92,6 +100,9 @@ def main(argv=None) -> int:
             reply = json.loads(r.read())
         if len(reply.get("tokens", [])) != 4 or "phases_ms" not in reply:
             return _fail(proc, lines, f"bad generate reply: {reply}")
+        if reply.get("replica") not in range(_REPLICAS):
+            return _fail(proc, lines,
+                         f"generate reply missing routed replica: {reply}")
 
         with urllib.request.urlopen(base + "/stats", timeout=30) as r:
             stats = json.loads(r.read())
@@ -99,6 +110,14 @@ def main(argv=None) -> int:
         if summ.get("serve_ttft_seconds", {}).get("count", 0) < 1:
             return _fail(proc, lines,
                          f"/stats metrics missing TTFT summary: {summ}")
+        router = stats.get("router", {})
+        if (router.get("live") != _REPLICAS
+                or sum(router.get("routed", {}).values()) < 1):
+            return _fail(proc, lines, f"/stats router section wrong: {router}")
+        if len(stats.get("replicas", [])) != _REPLICAS:
+            return _fail(proc, lines,
+                         "/stats missing per-replica sections: "
+                         f"{list(stats)}")
 
         with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
             ctype = r.headers.get("Content-Type", "")
@@ -110,12 +129,21 @@ def main(argv=None) -> int:
         except ValueError as e:
             return _fail(proc, lines, f"exposition invalid: {e}")
         for name in ("serve_ttft_seconds", "serve_itl_seconds",
-                     "serve_queue_wait_seconds", "serve_compiles_total"):
+                     "serve_queue_wait_seconds", "serve_compiles_total",
+                     "serve_router_routed_total", "serve_replicas"):
             if name not in fams:
                 return _fail(proc, lines, f"/metrics missing {name}")
+        # every replica's scheduler exports its own labelled children
+        seen = {labels.get("replica")
+                for _, labels, _ in fams["serve_queue_depth"]["samples"]}
+        want = {str(i) for i in range(_REPLICAS)}
+        if not want <= seen:
+            return _fail(proc, lines,
+                         f"/metrics replica labels wrong: {seen} != {want}")
 
-        print(f"serve_smoke: PASS ({base}: healthz + generate + stats + "
-              f"{len(fams)} metric families validated)")
+        print(f"serve_smoke: PASS ({base}: healthz fan-in ({len(reps)} "
+              f"replicas) + routed generate + stats + {len(fams)} metric "
+              "families validated)")
         proc.terminate()
         try:
             proc.wait(timeout=10)

@@ -18,9 +18,10 @@
 # tree carries ~75 known-environmental failures.
 #
 # Phase 2 — serve smoke: tools/serve_smoke.py boots the real
-# `cli serve --http` subprocess and validates /healthz, /v1/generate,
-# /stats, and the /metrics Prometheus exposition (runs AFTER the timed
-# suite on purpose — never concurrently with it).
+# `cli serve --http --replicas 2` subprocess and validates the /healthz
+# replica fan-in, routed /v1/generate replies, /stats router+replica
+# sections, and the replica-labelled /metrics Prometheus exposition
+# (runs AFTER the timed suite on purpose — never concurrently with it).
 #
 # Usage: tools/verify.sh        (from anywhere; cd's to the repo root)
 # Exit:  graftlint's code on lint regressions (3), else tier1_diff's on
