@@ -1277,6 +1277,30 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefix-entries", type=int, default=16,
                    help="max cached prefix entries (each holds one "
                         "state-cache slot; LRU beyond this)")
+    p.add_argument("--tiered-cache", type=str, default="on",
+                   choices=["on", "off"],
+                   help="tiered session-state cache (serve/state_cache.py "
+                        "SessionTiers): LRU-evicted session states spill "
+                        "ASYNC to host RAM (tier 1) with a durable disk "
+                        "tier below (--session-dir); continuations of "
+                        "spilled sessions fill back for one state copy "
+                        "instead of failing 'expired' — the long-tail "
+                        "multi-tenant lever (thousands of mostly-idle "
+                        "sessions over a few device slots). 'off' keeps "
+                        "the fixed-slot behavior (evicted = expired)")
+    p.add_argument("--host-tier-entries", type=int, default=256,
+                   help="max spilled session states held in host RAM "
+                        "(each is one tiny (h, c) pair per layer); "
+                        "overflow cascades to --session-dir or is "
+                        "dropped honestly")
+    p.add_argument("--session-dir", type=str, default=None,
+                   help="disk tier + serve-session checkpoints: kept "
+                        "sessions are write-behind checkpointed here at "
+                        "each request boundary (sha256-verified atomic "
+                        "files), so a supervised kill/restart resumes "
+                        "them token-identically; also the overflow tier "
+                        "below --host-tier-entries. Implies the tiered "
+                        "cache even with --tiered-cache off")
     p.add_argument("--prefill-chunk", type=int, default=0,
                    help="chunked prefill: consume prompts <= N tokens per "
                         "program, <= 1 prefill program per scheduler "
@@ -1312,6 +1336,19 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--inject-delay", type=float, default=0.25,
                    help="seconds into the run to submit the injected "
                         "request")
+    p.add_argument("--idle-churn", action="store_true",
+                   help="loadgen: long-tail multi-tenant workload — "
+                        "--sessions LIVE kept sessions (size it ~10x "
+                        "--num-slots) continued by Zipf-popularity draws "
+                        "(--zipf-s), so the idle tail is LRU-evicted and "
+                        "must fill from the tiers (or re-prefill its full "
+                        "history with --tiered-cache off). Reports "
+                        "per-tier hit rates, re-prefill cost and hot-set "
+                        "tokens/s — the tiered-cache gate workload")
+    p.add_argument("--zipf-s", type=float, default=1.1,
+                   help="--idle-churn popularity exponent: session rank r "
+                        "is drawn with weight (r+1)^-s (higher = hotter "
+                        "hot set)")
     p.add_argument("--json", type=str, default=None,
                    help="also write the loadgen report (machine-readable "
                         "JSON) to this path")
@@ -1461,6 +1498,15 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
             prefix_cache=args.prefix_cache == "on",
             prefix_stride=args.prefix_stride,
             prefix_entries=args.prefix_entries,
+            # tiered session-state cache: host-RAM spill of evicted
+            # slots + durable disk tier / restart-surviving session
+            # checkpoints under --session-dir (shared by all replicas —
+            # session files are replica-agnostic, so any replica can
+            # restore any session after a restart)
+            tiered_cache=args.tiered_cache == "on",
+            host_tier_entries=args.host_tier_entries,
+            session_dir=args.session_dir,
+            replica=i,
             # one registry argument scopes the whole serve stack's
             # telemetry (engine, caches, batcher, router, /metrics);
             # off = no-op instruments
@@ -1560,6 +1606,12 @@ def _serve_loadgen(args) -> int:
               "unshared token)", file=sys.stderr)
         return 2
     replica_levels = _parse_replicas(args.replicas)
+    if args.idle_churn:
+        if len(replica_levels) > 1:
+            print("error: --idle-churn runs at one replica count "
+                  "(--replicas N, not a comma list)", file=sys.stderr)
+            return 2
+        return _serve_loadgen_longtail(args, replica_levels[0])
     if len(replica_levels) > 1:
         return _serve_loadgen_replica_sweep(args, replica_levels)
     _, cfg, server = _build_serve_stack(args, replica_levels[0])
@@ -1665,6 +1717,53 @@ def _serve_loadgen(args) -> int:
         f"+{out['engine']['compiles_decode_window']}w, "
         f"swap generation {out['engine']['generation']}",
         file=sys.stderr)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        print(f"loadgen: report written to {args.json}", file=sys.stderr)
+    return 0
+
+
+def _serve_loadgen_longtail(args, n_replicas: int) -> int:
+    """``serve --loadgen --idle-churn``: the long-tail multi-tenant
+    workload the tiered cache is gated on — N live kept sessions over
+    few device slots, Zipf-popularity continuations, per-tier hit rates
+    + re-prefill cost + hot-set tokens/s in one machine-readable report
+    (tools/bench_serve.py --tiered-cache writes BENCH_serve_r03.json)."""
+    import json
+
+    from .serve import run_longtail
+
+    _, cfg, server = _build_serve_stack(args, n_replicas)
+    sampling = _serve_sampling(args)
+    with server:
+        # warm the full final-prefill lattice: re-prefills (tiers off /
+        # lost state) replay a session's whole history, whose length
+        # lands on arbitrary buckets — an unwarmed one would charge a
+        # mid-run compile to exactly the workload being measured
+        server.warmup(sampling, prompt_lens=tuple(
+            set(server.engine.prefill_buckets) | {args.prompt_len}))
+        out = run_longtail(
+            server, vocab_size=cfg.vocab_size, sessions=args.sessions,
+            requests_per_session=args.requests_per_session,
+            prompt_len=args.prompt_len,
+            max_new_tokens=args.max_new_tokens,
+            sampling=sampling, zipf_s=args.zipf_s, seed=args.seed,
+        )
+        out["tier_stats_total"] = {
+            r.index: r.engine.stats()["tiers"] for r in server.replicas
+        }
+    print(json.dumps(out))
+    t = out.get("tiers") or {}
+    hr = t.get("hit_rates", {})
+    hot = out.get("hot_set", {})
+    print(
+        f"longtail summary: {out['completed']} req over {args.sessions} "
+        f"sessions, {out['tokens_per_sec']} tok/s "
+        f"(hot set {hot.get('tokens_per_sec', '?')} tok/s), tier hits "
+        f"device {hr.get('device', '?')} / host {hr.get('host', '?')} / "
+        f"disk {hr.get('disk', '?')}, re-prefills {out['re_prefills']} "
+        f"({out['re_prefill_tokens']} tokens)", file=sys.stderr)
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=1, sort_keys=True)

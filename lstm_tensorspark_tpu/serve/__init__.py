@@ -11,7 +11,11 @@ into a serving engine:
   shared-prompt prefix store (state after ``prompt[:k]`` is ONE (h, c)
   pair: exact prefix reuse is a slot copy) with longest-match lookup,
   refcounted backing slots, and LRU eviction that invalidates dependent
-  entries;
+  entries — plus ``SessionTiers``: host-RAM and disk tiers below the
+  device slots (async spill of evicted states, inline fill on
+  continuation, sha256/fsync-durable session files so a restarted server
+  resumes kept sessions token-identically; prefix entries spill/promote
+  through the same tiers);
 - ``engine``: bucketed jitted prefill/decode programs over the cache —
   compile count bounded per (phase, bucket[, window], sampling), never
   per batch composition — including ``decode_window``: K tokens per XLA
@@ -53,12 +57,12 @@ admit→queue→prefill→decode→readback timelines into the installed
 CLI: ``python -m lstm_tensorspark_tpu.cli serve --selftest`` (see cli.py).
 """
 
-from .state_cache import CacheFullError, PrefixCache, StateCache
+from .state_cache import CacheFullError, PrefixCache, SessionTiers, StateCache
 from .engine import PAD_TOKEN, DecodeWindow, SamplingParams, ServeEngine
 from .batcher import Batcher, QueueFullError, Request
 from .router import Replica, Router
 from .server import InprocessClient, ServeServer
-from .loadgen import replica_sweep, run_loadgen
+from .loadgen import replica_sweep, run_loadgen, run_longtail
 
 __all__ = [
     "Batcher",
@@ -74,7 +78,9 @@ __all__ = [
     "SamplingParams",
     "ServeEngine",
     "ServeServer",
+    "SessionTiers",
     "StateCache",
     "replica_sweep",
     "run_loadgen",
+    "run_longtail",
 ]

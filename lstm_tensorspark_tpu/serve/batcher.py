@@ -540,19 +540,33 @@ class Batcher:
                 continue
             busy_sids.add(sid)
             try:
-                slot, fresh = self.engine.cache.acquire(sid)
+                # acquire+pin ATOMICALLY: a tier fill (below) may read
+                # the disk outside the cache lock, and a concurrent
+                # fill_ahead's acquire must never evict this
+                # just-acquired slot — neither mid-restore nor in the
+                # window before a separate pin() call (release() on the
+                # failure paths clears the pin along with the slot)
+                slot, fresh = self.engine.cache.acquire_pinned(sid)
             except Exception as e:  # cache exhausted by pinned slots
                 self._fail(req, f"{type(e).__name__}: {e}")
                 continue
             if req.session_id is not None and fresh:
-                # explicit continuation of a session the cache no longer
-                # holds (evicted or never created): silently decoding from
-                # zero state would return wrong tokens — fail loudly
-                self.engine.cache.release(sid)
-                self._fail(req, f"unknown session {sid!r} (expired or "
-                                "never created; re-send the full prompt)")
-                continue
-            self.engine.cache.pin(sid)
+                # explicit continuation of a session no longer in a
+                # device slot: a tiered engine restores the spilled state
+                # (pending spill capture / host RAM / verified disk read)
+                # into the fresh slot — the exact pre-eviction carries,
+                # so the continuation decodes token-identically. Nothing
+                # restorable (never created, spilled copy lost, corrupt
+                # disk file quarantined): silently decoding from zero
+                # state would return wrong tokens — fail loudly.
+                tiers = self.engine.tiers
+                if tiers is None or not tiers.fill(sid, slot):
+                    self.engine.cache.release(sid)
+                    self._fail(req, f"unknown session {sid!r} (expired, "
+                                    "never created, or its spilled state "
+                                    "was lost; re-send the full prompt)")
+                    continue
+                fresh = False
             sess = _Session(req, sid, slot)
             # prefix-cache lookup: fresh sessions only (a continuation's
             # prompt is a fragment, not an absolute prefix). The hit is
@@ -947,8 +961,22 @@ class Batcher:
             # follow-up request with this session_id continues in place
             self.engine.cache.unpin(s.sid)
             s.req.session_id = s.sid
+            if self.engine.tiers is not None:
+                # durable serve-session checkpoint at the request
+                # boundary (async write-behind to the disk tier): a
+                # crashed-and-restarted server resumes this session
+                # token-identically from the last completed request
+                self.engine.tiers.checkpoint(s.sid)
         else:
             self.engine.cache.release(s.sid)
+            if self.engine.tiers is not None:
+                # the conversation ended un-kept: stale tier copies from
+                # earlier boundaries must not resurrect it — a later fill
+                # would decode from BEFORE this request's tokens, i.e.
+                # wrong output. (Failure paths deliberately keep tier
+                # copies: resuming a failed continuation from the last
+                # completed boundary is the token-identical recovery.)
+                self.engine.tiers.discard(s.sid)
         s.req.t_done = time.perf_counter()
         self.completed += 1
         self._m_req_completed.inc()

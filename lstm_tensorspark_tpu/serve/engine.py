@@ -80,7 +80,7 @@ from .. import obs
 from ..models.generate import decode_one, fuse_layers, sample_logits
 from ..models.lstm_lm import LMConfig, _head_kernel, lm_backbone
 from ..resilience import faults as _faults
-from .state_cache import DetachedState, PrefixCache, StateCache
+from .state_cache import DetachedState, PrefixCache, SessionTiers, StateCache
 
 # Emitted by decode_window for a row that is no longer live (post-EOS /
 # budget-exhausted / batch padding): the host stops distributing a row's
@@ -158,6 +158,10 @@ class ServeEngine:
         prefix_cache: bool = False,
         prefix_stride: int = 8,
         prefix_entries: int = 16,
+        tiered_cache: bool = False,
+        host_tier_entries: int = 256,
+        session_dir: str | None = None,
+        replica: int = 0,
         registry=None,
         device=None,
     ):
@@ -183,12 +187,25 @@ class ServeEngine:
         self.metrics = obs.REGISTRY if registry is None else registry
         self.cache = StateCache(cfg.num_layers, num_slots, cfg.hidden_size,
                                 registry=self.metrics, device=device)
+        # tiered session-state cache (state_cache.SessionTiers): device
+        # slots stay tier 0; LRU-evicted sessions spill async to host RAM
+        # with a durable disk tier below (``session_dir`` — also what a
+        # restarted server restores sessions from). A session_dir alone
+        # implies the tiers: durability needs the spill plane.
+        self.tiers = (
+            SessionTiers(self.cache, host_entries=host_tier_entries,
+                         directory=session_dir, registry=self.metrics,
+                         replica=replica)
+            if (tiered_cache or session_dir is not None) else None
+        )
         # shared-prompt prefix reuse (state_cache.PrefixCache): opt-in at
         # engine construction; the batcher consults engine.prefix on every
-        # fresh admission when present
+        # fresh admission when present. With tiers attached, an evicted
+        # backing slot SPILLS the entry instead of invalidating it.
         self.prefix = (
             PrefixCache(self.cache, stride=prefix_stride,
-                        max_entries=prefix_entries, registry=self.metrics)
+                        max_entries=prefix_entries, registry=self.metrics,
+                        tiers=self.tiers)
             if prefix_cache else None
         )
         # sampling params are compile keys and client-controlled at the
@@ -712,6 +729,13 @@ class ServeEngine:
         with self._lock:
             return self.cache.restore(session_id, state)
 
+    def has_session(self, session_id: str) -> bool:
+        """Affinity probe (serve/router.py): True when the session is
+        device-resident OR restorable from a tier (host RAM / disk)."""
+        if session_id in self.cache:
+            return True
+        return self.tiers is not None and self.tiers.has(session_id)
+
     def num_compiles(self, phase: str | None = None) -> int:
         # snapshot under the COUNTS lock (not _lock, which is held across
         # whole device calls): a first-time compile inserts into
@@ -729,6 +753,7 @@ class ServeEngine:
         return {
             "cache": self.cache.stats(),
             "prefix_cache": None if self.prefix is None else self.prefix.stats(),
+            "tiers": None if self.tiers is None else self.tiers.stats(),
             "compiles": {repr(k): v for k, v in compiles.items()},
             "prefill_buckets": self.prefill_buckets,
             "batch_buckets": self.batch_buckets,

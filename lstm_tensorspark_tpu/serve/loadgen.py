@@ -334,6 +334,249 @@ def run_loadgen(
     return report
 
 
+def tier_totals(server: ServeServer) -> dict | None:
+    """SessionTiers stats summed across replicas (same aggregation policy
+    as :func:`prefix_totals`): counters summed, per-tier entry counts
+    summed, config keys keep replica 0's value."""
+    totals = None
+    for rep in server.replicas:
+        if rep.engine.tiers is None:
+            continue
+        st = rep.engine.tiers.stats()
+        if totals is None:
+            totals = {
+                "host_entries_max": st["host_entries_max"],
+                "entries": dict(st["entries"]),
+                "spills": dict(st["spills"]),
+                "fills": dict(st["fills"]),
+                "misses": st["misses"],
+                "corrupt": st["corrupt"],
+                "lost": st["lost"],
+                "disk_errors": st["disk_errors"],
+            }
+            continue
+        for k in ("entries", "spills", "fills"):
+            for t, v in st[k].items():
+                totals[k][t] = totals[k].get(t, 0) + v
+        for k in ("misses", "corrupt", "lost", "disk_errors"):
+            totals[k] += st[k]
+    return totals
+
+
+def run_longtail(
+    server: ServeServer,
+    *,
+    vocab_size: int,
+    sessions: int,
+    requests_per_session: int = 3,
+    prompt_len: int = 8,
+    max_new_tokens: int = 8,
+    sampling: SamplingParams = GREEDY,
+    zipf_s: float = 1.1,
+    concurrency: int = 8,
+    seed: int = 0,
+    timeout: float = 300.0,
+) -> dict:
+    """Long-tail multi-tenant workload (``cli serve --loadgen
+    --idle-churn``): ``sessions`` live kept sessions — size it to ~10x
+    the device slots — each created once and then continued by draws
+    from a Zipf(``zipf_s``) popularity distribution, so a small hot set
+    sees most of the traffic while the long tail sits idle and gets
+    LRU-evicted. Exactly the workload the tiered cache is gated on
+    (ROADMAP item 2): without tiers, every evicted session's
+    continuation fails "expired" and the client re-prefills its FULL
+    accumulated history (counted as ``re_prefills`` /
+    ``re_prefill_tokens``); with tiers, continuations fill from host or
+    disk for one tiny state copy.
+
+    The report extends :func:`_report` with per-tier hit counts and
+    rates for the continuations (``tiers``: device/host/disk/lost),
+    the re-prefill cost, and the HOT-SET throughput
+    (``hot_set.tokens_per_sec`` over the top-10% sessions by rank) —
+    the number the tiered-vs-all-on-device gate compares
+    (tools/bench_serve.py --tiered-cache → BENCH_serve_r03.json).
+
+    Each logical session's full token history is tracked so a
+    re-prefilled session resumes token-identically — re-prefill changes
+    the COST, never the output."""
+    if sessions < 1:
+        raise ValueError(f"sessions must be >= 1, got {sessions}")
+    rng = np.random.RandomState(seed)
+    prompts = _random_prompts(sessions, prompt_len, vocab_size, seed)
+    # Zipf-ish popularity: session rank r drawn with weight (r+1)^-s
+    weights = (np.arange(sessions) + 1.0) ** -float(zipf_s)
+    weights /= weights.sum()
+    schedule = list(rng.choice(sessions, size=sessions * requests_per_session,
+                               p=weights))
+    hot_k = max(1, sessions // 10)
+
+    lock = threading.Lock()
+    cond = threading.Condition(lock)
+    # per logical session: server-side sid (None until created), full
+    # history (prompt + every generated token), token count, in-flight
+    # flag (two concurrent requests on one session would be rejected
+    # "busy" — the driver serialises per session, like a real client)
+    sids: list[str | None] = [None] * sessions
+    history: list[list[int]] = [list(map(int, p)) for p in prompts]
+    tokens_by_session = [0] * sessions
+    busy: set[int] = set()
+    rejected = [0]
+    failed = [0]
+    re_prefills = [0]
+    re_prefill_tokens = [0]
+    continuations = [0]  # session_id continuations that COMPLETED
+    results: list[dict] = []
+
+    tiers_before = tier_totals(server)
+    prefix_before = prefix_totals(server)
+
+    def _generate(logical: int, prompt, *, session_id):
+        t0 = time.perf_counter()
+        req = server.generate(
+            prompt, max_new_tokens=max_new_tokens, sampling=sampling,
+            session_id=session_id, keep_session=True, timeout=timeout,
+        )
+        rec = {
+            "latency_s": time.perf_counter() - t0,
+            "ttft_s": (req.t_first_token - req.t_submit)
+            if req.t_first_token and req.t_submit else None,
+            "tokens": len(req.tokens),
+            "itl_s": req.itl_gaps(),
+            "replica": req.replica,
+            "session": logical,
+        }
+        with lock:
+            sids[logical] = req.session_id
+            history[logical].extend(int(t) for t in req.tokens)
+            tokens_by_session[logical] += len(req.tokens)
+            results.append(rec)
+
+    def one_turn(logical: int) -> None:
+        with lock:
+            sid = sids[logical]
+        try:
+            if sid is None:
+                _generate(logical, prompts[logical], session_id=None)
+                return
+            with lock:
+                cont = [history[logical][-1]]
+            try:
+                _generate(logical, np.asarray(cont, np.int32),
+                          session_id=sid)
+                with lock:
+                    continuations[0] += 1
+                return
+            except RuntimeError as e:
+                if "unknown session" not in str(e):
+                    raise
+            # evicted with no restorable tier state: the honest client
+            # re-sends its FULL history — the cost the tiers exist to kill
+            with lock:
+                full = list(history[logical])
+                re_prefills[0] += 1
+                re_prefill_tokens[0] += len(full)
+                sids[logical] = None
+            _generate(logical, np.asarray(full, np.int32), session_id=None)
+        except QueueFullError:
+            with lock:
+                rejected[0] += 1
+        except Exception:
+            with lock:
+                failed[0] += 1
+
+    def worker() -> None:
+        while True:
+            with cond:
+                idx = next((i for i, s in enumerate(schedule)
+                            if s not in busy), None)
+                if idx is None:
+                    if not schedule:
+                        return
+                    # every remaining turn targets an in-flight session:
+                    # wait for one to free up
+                    cond.wait(timeout=0.05)
+                    continue
+                logical = schedule.pop(idx)
+                busy.add(logical)
+            try:
+                one_turn(logical)
+            finally:
+                with cond:
+                    busy.discard(logical)
+                    cond.notify_all()
+
+    with span("loadgen_longtail", sessions=sessions,
+              turns=len(schedule)):
+        t_start = time.perf_counter()
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(max(1, concurrency))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+
+    report = _report(results, rejected[0], failed[0], wall, "longtail",
+                     sessions)
+    report["prompt_len"] = prompt_len
+    report["zipf_s"] = zipf_s
+    report["requests_per_session"] = requests_per_session
+    report["re_prefills"] = re_prefills[0]
+    report["re_prefill_tokens"] = re_prefill_tokens[0]
+    hot_tokens = sum(tokens_by_session[:hot_k])
+    report["hot_set"] = {
+        "sessions": hot_k,
+        "tokens_generated": hot_tokens,
+        "tokens_per_sec": round(hot_tokens / wall, 2) if wall > 0 else 0.0,
+    }
+    # per-tier continuation accounting: fills by tier from the tiers'
+    # own counters; device hits are the continuations that needed none.
+    # Re-prefills count the evicted-and-unrestorable tail ("lost" from
+    # the client's point of view), whatever the tiers' miss counter saw.
+    conts = continuations[0] + re_prefills[0]
+    ta, tb = tier_totals(server), tiers_before
+    if ta is not None:
+        host = ta["fills"]["host"] - (tb["fills"]["host"] if tb else 0)
+        disk = ta["fills"]["disk"] - (tb["fills"]["disk"] if tb else 0)
+        lost = re_prefills[0]
+        spills = {t: ta["spills"][t] - (tb["spills"][t] if tb else 0)
+                  for t in ta["spills"]}
+        device = max(continuations[0] - host - disk, 0)
+        total = max(conts, 1)
+        report["tiers"] = {
+            "continuations": conts,
+            "hits": {"device": device, "host": host, "disk": disk},
+            "lost": lost,
+            "spills": spills,
+            "hit_rates": {
+                "device": round(device / total, 4),
+                "host": round(host / total, 4),
+                "disk": round(disk / total, 4),
+            },
+            "entries": dict(ta["entries"]),
+        }
+    else:
+        report["tiers"] = {
+            "continuations": conts,
+            "hits": {"device": continuations[0], "host": 0, "disk": 0},
+            "lost": re_prefills[0],
+            "spills": {},
+            "hit_rates": {
+                "device": round(continuations[0] / max(conts, 1), 4),
+                "host": 0.0, "disk": 0.0,
+            },
+            "entries": {},
+        }
+    report["replicas"] = _per_replica(results)
+    if prefix_before is not None:
+        after = prefix_totals(server)
+        report["prefix_cache"] = {
+            k: after[k] - prefix_before[k]
+            for k in ("hits", "misses", "inserts", "invalidated")
+        }
+    return report
+
+
 def concurrency_sweep(
     server: ServeServer,
     *,

@@ -215,6 +215,50 @@ class Router:
             for r in live:
                 if sid in r.engine.cache:
                     return r
+            # tier residency (SessionTiers): the session was spilled off
+            # its device slot. MEMORY tiers first — a replica holding the
+            # session in its pending/host/evacuating tiers is the OWNER
+            # with the freshest request boundary, and with a SHARED
+            # --session-dir every replica's disk probe matches, possibly
+            # against an older not-yet-overwritten file (filling that
+            # elsewhere would silently decode stale tokens). Fill-ahead
+            # promotes the memory copy so the state is already
+            # device-resident when the continuation reaches admission;
+            # skipped on a wedged replica (its locks may be held across a
+            # dispatch that never returns — admission fills once it
+            # wakes).
+            for r in live:
+                tiers = r.engine.tiers
+                if tiers is not None and tiers.has_memory(sid):
+                    if not r.stale(self.stale_after):
+                        tiers.fill_ahead(sid)
+                    return r
+            # disk tier only: no live replica holds a fresher memory
+            # copy, so the (shared) file IS the last flushed boundary —
+            # any tiered replica can restore it; pick healthy ones by
+            # load (stale replicas only as a last resort). The residency
+            # stat is deduped per DISTINCT session directory: this runs
+            # under the router's global lock, and an unknown-sid burst
+            # must cost at most one stat per directory, not per replica.
+            cands = []
+            by_dir: dict[str, bool] = {}
+            for r in live:
+                tiers = r.engine.tiers
+                if tiers is None:
+                    continue
+                d = tiers.disk_dir
+                if d is None:
+                    continue  # memory tiers already probed above
+                hit = by_dir.get(d)
+                if hit is None:
+                    hit = by_dir[d] = tiers.has(sid)
+                if hit:
+                    cands.append(r)
+            healthy = [r for r in cands
+                       if not r.stale(self.stale_after)]
+            if cands:
+                return min(healthy or cands,
+                           key=lambda r: r.batcher.load())
         # fresh sessions avoid wedged (stale) replicas while any healthy
         # one exists — a stale replica admits nothing, so work routed
         # there hangs to client timeout while holding queue capacity
@@ -304,6 +348,29 @@ class Router:
                 self._m_migrated.inc()
             else:
                 lost += 1
+        # tier-held sessions (spilled to host RAM / pending spills) are
+        # still reachable — the replica's THREAD died, not the process.
+        # Persist them to the shared disk tier when one exists (any live
+        # replica then fills from it on demand), else adopt them into a
+        # live healthy replica's host tier.
+        if dead.engine.tiers is not None:
+            persisted, homeless = dead.engine.tiers.evacuate()
+            migrated += persisted
+            if persisted:
+                self._m_migrated.inc(persisted)
+            for sid, state in homeless:
+                with self._lock:
+                    targets = [r for r in self.replicas
+                               if r.alive() and r.engine.tiers is not None
+                               and not r.stale(self.stale_after)]
+                target = min(targets, key=lambda r: r.batcher.load(),
+                             default=None)
+                if target is not None:
+                    target.engine.tiers.adopt(sid, state)
+                    migrated += 1
+                    self._m_migrated.inc()
+                else:
+                    lost += 1
         requeued = 0
         for req in drained:
             try:

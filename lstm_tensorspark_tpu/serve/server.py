@@ -98,6 +98,11 @@ class ServeServer:
         for i, eng in enumerate(engines):
             b = batcher if (batcher is not None and i == 0) else Batcher(
                 eng, replica=i, **batcher_kw)
+            if eng.tiers is not None:
+                # tier metrics carry the replica label like every other
+                # serve family — rebinding here covers engines built
+                # without an explicit replica index
+                eng.tiers.set_replica(i)
             self.replicas.append(Replica(i, eng, b))
         # the global admission bound == the per-replica queue bound, so
         # the router's check is the only one that ever fires
@@ -161,6 +166,16 @@ class ServeServer:
             if r.thread is not None:
                 r.thread.join(timeout=10.0)
                 r.thread = None
+        for r in self.replicas:
+            if r.engine.tiers is not None:
+                # durability barrier: a clean stop lands every kept
+                # session's write-behind checkpoint on the disk tier, so
+                # stop → start resumes them all (tests/test_serve_tiers);
+                # close() then parks the spill worker (a later start's
+                # first enqueue revives it) so stopped stacks don't leak
+                # polling threads
+                r.engine.tiers.flush(timeout=10.0)
+                r.engine.tiers.close()
 
     def warmup(self, sampling: SamplingParams = GREEDY,
                prompt_lens: tuple[int, ...] = (1,)) -> int:
@@ -278,6 +293,16 @@ class ServeServer:
                           "live prefix-cache entries",
                           labelnames=("replica",)).labels(replica=rl).set(
                     r.engine.prefix.stats()["entries"])
+            if r.engine.tiers is not None:
+                ts = r.engine.tiers.stats()
+                fam = reg.gauge("serve_tier_entries",
+                                "spilled session states held per tier "
+                                "(pending = spill captured, fetch not "
+                                "done)",
+                                labelnames=("tier", "replica"))
+                for tier in ("pending", "host", "disk"):
+                    fam.labels(tier=tier, replica=rl).set(
+                        ts["entries"][tier])
             if r.alive():
                 live += 1
             else:

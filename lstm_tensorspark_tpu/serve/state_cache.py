@@ -44,8 +44,12 @@ longest-match lookup, refcounted use, LRU eviction in both directions
 
 from __future__ import annotations
 
+import json
+import hashlib
+import os
 import threading
-from collections import OrderedDict
+import time
+from collections import OrderedDict, deque
 from typing import NamedTuple
 
 import jax
@@ -53,6 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..train.checkpoint import CorruptCheckpointError, atomic_write, read_verified
 
 
 class CacheFullError(RuntimeError):
@@ -105,10 +110,13 @@ class StateCache:
         self._m_swaps = reg.counter(
             "serve_state_cache_swaps_total",
             "device programs applied to the cache arrays (generation)")
-        # eviction listeners: called (under the cache lock) with the sid of
-        # every LRU-evicted session — the prefix cache registers here so a
-        # slot eviction INVALIDATES the dependent prefix entry instead of
-        # leaving it pointing at a slot another session now owns
+        # eviction listeners: called (under the cache lock) with the
+        # ``(sid, slot)`` of every LRU-evicted session — the prefix cache
+        # registers here so a slot eviction INVALIDATES (or, tiered,
+        # SPILLS) the dependent prefix entry instead of leaving it
+        # pointing at a slot another session now owns; SessionTiers
+        # registers here to capture the evicted state's device handles
+        # for the async host-tier spill
         self.evict_listeners: list = []
 
     @property
@@ -151,7 +159,7 @@ class StateCache:
                 self.evictions += 1
                 self._m_evictions.inc()
                 for listener in self.evict_listeners:
-                    listener(sid)
+                    listener(sid, slot)
                 return slot
         raise CacheFullError(
             f"all {self.num_slots} slots pinned by active sessions"
@@ -165,6 +173,17 @@ class StateCache:
             slot = self._slots.pop(session_id, None)
             if slot is not None:
                 self._free.append(slot)
+
+    def acquire_pinned(self, session_id: str) -> tuple[int, bool]:
+        """:meth:`acquire` + :meth:`pin` under ONE lock hold — with
+        concurrent acquirers (the router's fill_ahead), a separate
+        acquire→pin pair leaves a window where the fresh unpinned slot
+        is LRU-evicted from under the caller and pin() raises. The
+        batcher's admission uses this."""
+        with self._lock:
+            slot, fresh = self.acquire(session_id)
+            self._pinned.add(session_id)
+            return slot, fresh
 
     def pin(self, session_id: str) -> None:
         with self._lock:
@@ -230,6 +249,41 @@ class StateCache:
 
     # ---- detach / restore ---------------------------------------------
 
+    @staticmethod
+    def fetch_detached(h_handle, c_handle) -> DetachedState:
+        """Blocking device→host fetch of one session's sliced carries —
+        the spill plane's ONE designated sync point (graftlint
+        ``host-sync`` allow-list, like the batcher's ``fetch_window``).
+        The handles are functional snapshots, so this may run long after
+        the slot was reused and still reads the pre-eviction values."""
+        return DetachedState(h=np.asarray(h_handle), c=np.asarray(c_handle))
+
+    @staticmethod
+    def fetch_detached_batch(captures) -> list[DetachedState]:
+        """Batched form of :meth:`fetch_detached` for the spill worker:
+        ``captures`` is a list of ``(h_array, c_array, slot)`` triples —
+        FULL cache-array snapshots plus the slot to extract, or
+        pre-sliced ``[L, H]`` handles with ``slot=None`` (the tiers'
+        memory-pressure valve). One blocking ``device_get`` over the
+        deduplicated arrays fetches everything (N spills cost one
+        pipeline wait), and the per-slot extraction happens in numpy —
+        ZERO per-job device ops on the fast path."""
+        uniq: dict[int, object] = {}
+        for h, c, slot in captures:
+            uniq.setdefault(id(h), h)
+            uniq.setdefault(id(c), c)
+        fetched = jax.device_get(list(uniq.values()))
+        by_id = dict(zip(uniq.keys(), fetched))
+        out = []
+        for h, c, slot in captures:
+            fh, fc = by_id[id(h)], by_id[id(c)]
+            if slot is None:  # pre-sliced capture: already [L, H]
+                out.append(DetachedState(h=fh, c=fc))
+            else:
+                out.append(DetachedState(h=fh[:, slot, :].copy(),
+                                         c=fc[:, slot, :].copy()))
+        return out
+
     def detach(self, session_id: str) -> DetachedState:
         """Pull a session's carries to host and release its slot.
 
@@ -282,11 +336,14 @@ class StateCache:
 
 class PrefixEntry:
     """One cached prefix: the exact token prefix, its backing state-cache
-    session/slot, and a refcount of in-flight prefills reading it."""
+    session/slot, and a refcount of in-flight prefills reading it.
+    ``slot`` is None while the entry is SPILLED (its backing slot was
+    LRU-evicted under a tiered cache — the state lives in the host tier
+    until a lookup promotes it back)."""
 
     __slots__ = ("key", "length", "sid", "slot", "refs")
 
-    def __init__(self, key: bytes, length: int, sid: str, slot: int):
+    def __init__(self, key: bytes, length: int, sid: str, slot: int | None):
         self.key = key
         self.length = length
         self.sid = sid
@@ -325,7 +382,7 @@ class PrefixCache:
     """
 
     def __init__(self, cache: StateCache, *, stride: int = 8,
-                 max_entries: int = 16, registry=None):
+                 max_entries: int = 16, registry=None, tiers=None):
         if stride < 1:
             raise ValueError(f"stride must be >= 1, got {stride}")
         if max_entries < 1:
@@ -333,6 +390,11 @@ class PrefixCache:
         self.cache = cache
         self.stride = stride
         self.max_entries = max_entries
+        # tiered spill/promote (SessionTiers): with tiers attached, a
+        # state-cache eviction of a backing slot SPILLS the entry (state
+        # survives in the host tier, slot=None) instead of invalidating
+        # it — a later hit pays one host→device copy, not a re-prefill
+        self.tiers: SessionTiers | None = tiers
         self._lock = cache._lock  # shared on purpose (see docstring)
         self._entries: OrderedDict[bytes, PrefixEntry] = OrderedDict()
         self._by_sid: dict[str, bytes] = {}
@@ -341,19 +403,24 @@ class PrefixCache:
         self.misses = 0
         self.inserts = 0
         self.evictions = 0     # own LRU (full prefix cache)
-        self.invalidated = 0   # backing slot evicted under us
+        self.invalidated = 0   # backing slot evicted under us, state lost
+        self.spilled = 0       # backing slot evicted, state kept in a tier
+        self.promoted = 0      # spilled entry restored to a device slot
         # /metrics mirror of the per-instance counters above (one registry
         # family per outcome; stats() keeps serving the instance's ints)
         reg = obs.REGISTRY if registry is None else registry
         self._m = reg.counter(
             "serve_prefix_cache_events_total",
-            "prefix-cache outcomes (hit/miss/insert/evict/invalidate)",
+            "prefix-cache outcomes (hit/miss/insert/evict/invalidate/"
+            "spill/promote)",
             labelnames=("event",))
         self._m_hit = self._m.labels(event="hit")
         self._m_miss = self._m.labels(event="miss")
         self._m_insert = self._m.labels(event="insert")
         self._m_evict = self._m.labels(event="evict")
         self._m_invalidate = self._m.labels(event="invalidate")
+        self._m_spill = self._m.labels(event="spill")
+        self._m_promote = self._m.labels(event="promote")
         cache.evict_listeners.append(self._on_slot_evicted_locked)
 
     @staticmethod
@@ -382,6 +449,10 @@ class PrefixCache:
                 entry = self._entries.get(self._key(p[:n]))
                 if entry is None:
                     continue
+                if entry.slot is None and not self._promote_locked(entry):
+                    # spilled entry whose state the tiers lost: the entry
+                    # was dropped — keep probing shorter lengths
+                    continue
                 self._entries.move_to_end(entry.key)
                 # refresh the BACKING slot's recency too — the state-cache
                 # LRU must not evict the hottest prefix's slot first just
@@ -396,6 +467,29 @@ class PrefixCache:
             self.misses += 1
             self._m_miss.inc()
             return None, 0
+
+    def _promote_locked(self, entry: PrefixEntry) -> bool:
+        """Restore a SPILLED entry's state from the tiers into a fresh
+        slot — the one host→device copy a tiered eviction costs instead
+        of re-prefilling the shared prefix. Returns False (and drops the
+        entry) when the tiered state is gone; False without dropping when
+        no slot can be had right now (every slot pinned — transient)."""
+        try:
+            slot, fresh = self.cache.acquire(entry.sid)
+        except CacheFullError:
+            return False  # transient: entry stays spilled, miss this time
+        if fresh and (self.tiers is None
+                      or not self.tiers.fill(entry.sid, slot)):
+            self.cache.release(entry.sid)
+            self._by_sid.pop(entry.sid, None)
+            self._entries.pop(entry.key, None)
+            self.invalidated += 1
+            self._m_invalidate.inc()
+            return False
+        entry.slot = slot
+        self.promoted += 1
+        self._m_promote.inc()
+        return True
 
     def release(self, entry: PrefixEntry) -> None:
         """Drop one ref; the last ref unpins the backing slot (making the
@@ -445,20 +539,35 @@ class PrefixCache:
         self._entries.pop(entry.key, None)
         self._by_sid.pop(entry.sid, None)
         self.cache.release(entry.sid)
+        if self.tiers is not None:
+            # drop any spilled copy too, or the tiers would hold state
+            # for an entry that no longer exists
+            self.tiers.discard(entry.sid)
         self.evictions += 1
         self._m_evict.inc()
 
-    def _on_slot_evicted_locked(self, sid: str) -> None:
-        # state-cache LRU took a backing slot: the dependent entry is now
-        # garbage — drop it so lookups miss instead of reading a slot a
-        # live session owns. The _locked suffix is the held-lock calling
-        # contract (docs/LINT.md): eviction listeners fire under the
-        # shared cache lock.
-        key = self._by_sid.pop(sid, None)
-        if key is not None:
-            self._entries.pop(key, None)
-            self.invalidated += 1
-            self._m_invalidate.inc()
+    def _on_slot_evicted_locked(self, sid: str, slot: int) -> None:
+        # state-cache LRU took a backing slot. Untiered: the dependent
+        # entry is now garbage — drop it so lookups miss instead of
+        # reading a slot a live session owns. Tiered: the SessionTiers
+        # listener captured the state's device handles, so the entry
+        # survives SPILLED (slot=None) and a later hit promotes it back
+        # for one host→device copy. The _locked suffix is the held-lock
+        # calling contract (docs/LINT.md): eviction listeners fire under
+        # the shared cache lock.
+        key = self._by_sid.get(sid)
+        if key is None:
+            return
+        entry = self._entries.get(key)
+        if self.tiers is not None and entry is not None:
+            entry.slot = None
+            self.spilled += 1
+            self._m_spill.inc()
+            return
+        self._by_sid.pop(sid, None)
+        self._entries.pop(key, None)
+        self.invalidated += 1
+        self._m_invalidate.inc()
 
     def __len__(self) -> int:
         with self._lock:
@@ -475,4 +584,868 @@ class PrefixCache:
                 "inserts": self.inserts,
                 "evictions": self.evictions,
                 "invalidated": self.invalidated,
+                "spilled": self.spilled,
+                "promoted": self.promoted,
+            }
+
+
+class _SpillJob:
+    """A spill in flight: REFERENCES to the cache arrays captured (under
+    the cache lock) at enqueue time plus the slot index — capturing is
+    zero device ops (jax arrays are immutable functional snapshots;
+    later writes to the slot create new arrays), and the actual slicing
+    happens on the worker thread / at fill time, OFF the scheduler's
+    admission path. ``in_queue`` tracks whether a worker queue entry
+    still points here (a merged re-enqueue must not double-queue; an
+    in-flight job must re-queue)."""
+
+    __slots__ = ("h", "c", "slot", "sliced", "t0", "to_host", "to_disk",
+                 "in_queue")
+
+    def __init__(self, h, c, slot: int, t0: float, *, to_host: bool,
+                 to_disk: bool, sliced: bool = False):
+        self.h = h
+        self.c = c
+        self.slot = slot
+        # sliced=True: h/c are already the [L, H] row handles (the
+        # memory-pressure valve sliced at capture — see _enqueue_locked);
+        # False: h/c are FULL cache-array snapshots to slice at ``slot``
+        self.sliced = sliced
+        self.t0 = t0
+        self.to_host = to_host
+        self.to_disk = to_disk
+        self.in_queue = False
+
+
+class _DiskTier:
+    """Durable session files under one directory — the serve twin of the
+    training checkpoint story (train/checkpoint.py): every file is
+    written via the same fsync-before-rename ``atomic_write``, with the
+    state's sha256 embedded IN the JSON header — ONE file, so
+    ``os.replace`` alone decides atomically which complete payload wins
+    even under concurrent same-path writers (a payload can never pair
+    with another writer's stale sidecar). A file that fails its hash
+    (or cannot be parsed) is QUARANTINED (renamed ``*.quarantined``,
+    kept for forensics) and reported as state honestly lost — never
+    served as wrong tokens.
+
+    File name = ``sess-<sha256(sid)[:24]>.state`` (session ids are
+    client-controlled strings — hashing keeps them filesystem-safe); the
+    sid itself lives in the JSON header line, so a startup scan rebuilds
+    the sid→file index and a restarted server can serve every session
+    the previous process checkpointed.
+
+    A private lock guards only the in-memory index; file IO runs outside
+    it (and the spill worker writes files without holding the cache
+    lock, so an fsync never stalls the scheduler)."""
+
+    SUFFIX = ".state"
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._index: dict[str, str] = {}
+        self._scan()
+
+    def _scan(self) -> None:
+        for name in sorted(os.listdir(self.directory)):
+            if not name.endswith(self.SUFFIX):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                with open(path, "rb") as f:
+                    meta = json.loads(f.readline())
+                sid = meta["sid"]
+                if not isinstance(sid, str):
+                    raise ValueError(f"bad sid {sid!r}")
+            except (OSError, ValueError, KeyError, TypeError,
+                    json.JSONDecodeError):
+                # TypeError: header parsed as non-dict JSON — the same
+                # corruption class, quarantined not crashed-on-boot
+                self._quarantine(None, path)
+                continue
+            with self._lock:
+                self._index[sid] = path
+
+    def _path(self, sid: str) -> str:
+        digest = hashlib.sha256(sid.encode()).hexdigest()[:24]
+        return os.path.join(self.directory, f"sess-{digest}{self.SUFFIX}")
+
+    def _quarantine(self, sid: str | None, path: str) -> None:
+        for p in (path, path + ".sha256"):
+            try:
+                if os.path.exists(p):
+                    os.replace(p, p + ".quarantined")
+            except OSError:
+                pass  # best effort: a vanished file is already gone
+        if sid is not None:
+            with self._lock:
+                self._index.pop(sid, None)
+
+    def has_indexed(self, sid: str) -> bool:
+        """Index-only probe — no filesystem IO, safe under hot locks
+        (the eviction listener's to_disk decision; a false negative
+        merely costs one redundant write)."""
+        with self._lock:
+            return sid in self._index
+
+    def has(self, sid: str) -> bool:
+        with self._lock:
+            if sid in self._index:
+                return True
+        # shared-directory fallback: another replica (or a previous
+        # process) may have written this session AFTER our startup scan —
+        # the filename is deterministic from the sid, so one stat makes
+        # peers' files visible without a rescan (the router's
+        # evacuate-to-shared-disk migration depends on this)
+        path = self._path(sid)
+        if os.path.exists(path):
+            with self._lock:
+                self._index[sid] = path
+            return True
+        return False
+
+    def sids(self) -> list[str]:
+        with self._lock:
+            return list(self._index)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def put(self, sid: str, state: DetachedState) -> None:
+        body = (state.h.astype(np.float32).tobytes()
+                + state.c.astype(np.float32).tobytes())
+        # the sha256 lives IN the header, not a sidecar: a session file
+        # is then ONE file whose os.replace alone decides, atomically,
+        # which complete payload wins — concurrent same-path writers
+        # (shared --session-dir retirement races) can never pair one
+        # writer's payload with another's sidecar hash
+        meta = {"sid": sid, "layers": int(state.h.shape[0]),
+                "hidden": int(state.h.shape[1]), "dtype": "float32",
+                "sha256": hashlib.sha256(body).hexdigest()}
+        payload = json.dumps(meta).encode() + b"\n" + body
+        path = self._path(sid)
+        atomic_write(path, payload)
+        with self._lock:
+            self._index[sid] = path
+
+    def get(self, sid: str, num_layers: int,
+            hidden_size: int) -> DetachedState | None:
+        """Read + verify one session file. None = not present; raises
+        :class:`CorruptCheckpointError` AFTER quarantining the file when
+        it exists but cannot be trusted."""
+        with self._lock:
+            path = self._index.get(sid)
+        if path is None:
+            # same shared-directory fallback as has(): a peer replica may
+            # have written the file after our startup scan
+            cand = self._path(sid)
+            if not os.path.exists(cand):
+                return None
+            path = cand
+            with self._lock:
+                self._index[sid] = path
+        try:
+            data = read_verified(path)
+        except CorruptCheckpointError:
+            self._quarantine(sid, path)
+            raise
+        except OSError:
+            # vanished/unreadable: a miss, not corruption — keep the file
+            with self._lock:
+                self._index.pop(sid, None)
+            return None
+        try:
+            head, _, body = data.partition(b"\n")
+            meta = json.loads(head)
+            n = num_layers * hidden_size * 4
+            if meta.get("sid") != sid or len(body) != 2 * n:
+                raise ValueError(
+                    f"session payload mismatch (sid {meta.get('sid')!r}, "
+                    f"{len(body)} state bytes, expected {2 * n})")
+            got = hashlib.sha256(body).hexdigest()
+            if meta.get("sha256") != got:
+                raise ValueError(
+                    f"state sha256 mismatch (header "
+                    f"{str(meta.get('sha256'))[:12]}…, got {got[:12]}…) — "
+                    "truncated or corrupted write")
+            h = np.frombuffer(body[:n], np.float32).reshape(
+                num_layers, hidden_size).copy()
+            c = np.frombuffer(body[n:], np.float32).reshape(
+                num_layers, hidden_size).copy()
+        except (ValueError, KeyError, TypeError, AttributeError,
+                json.JSONDecodeError) as e:
+            # TypeError/AttributeError: header parsed as non-dict JSON —
+            # corruption, not a crash for the scheduler thread
+            self._quarantine(sid, path)
+            raise CorruptCheckpointError(f"{path}: {e}") from e
+        return DetachedState(h=h, c=c)
+
+    def discard(self, sid: str) -> None:
+        with self._lock:
+            path = self._index.pop(sid, None)
+        if path is not None:
+            for p in (path, path + ".sha256"):
+                try:
+                    if os.path.exists(p):
+                        os.remove(p)
+                except OSError:
+                    pass
+
+
+class SessionTiers:
+    """Host-RAM and disk tiers under the device :class:`StateCache`.
+
+    Device slots stay tier 0. When the state cache LRU-evicts an idle
+    session, the eviction listener (fired under the cache lock) captures
+    REFERENCES to the current ``(h, c)`` cache arrays plus the slot
+    index — zero device ops on the serving path; jax arrays are
+    immutable functional snapshots, so the capture stays valid after the
+    slot is reused — and enqueues an ASYNC spill: a background worker
+    thread drains the queue in batches and performs the one designated
+    device→host fetch (``StateCache.fetch_detached_batch`` — deduped
+    full-snapshot ``device_get`` + numpy slot extraction, ONE pipeline
+    wait per batch; graftlint ``host-sync`` covers this thread exactly
+    like the batcher's scheduler loop) and stores the states in the host
+    tier. Host-tier overflow cascades the oldest entry down to the disk
+    tier (:class:`_DiskTier` — the PR 2 sha256/fsync checkpoint
+    machinery applied to session files), or drops it honestly when no
+    directory is configured.
+
+    **Fill** is the reverse path: a continuation for a spilled session
+    restores its state into a freshly acquired slot — from the pending
+    spill's device handles (a device→device copy; the fetch never
+    happened), the host tier (one host→device copy), or a verified disk
+    read. Fills run inline under the shared cache lock (admission calls
+    :meth:`fill`; the router's affinity probe calls :meth:`fill_ahead`
+    before the continuation reaches the scheduler), so a session is
+    either resident or honestly absent — there is no window where a
+    racing eviction can hand a continuation someone else's slot.
+
+    **Serve-session checkpointing**: :meth:`checkpoint` (called by the
+    batcher when a ``keep_session`` request completes) write-behinds the
+    session's request-boundary state to the disk tier. Because sessions
+    are only evictable while idle, and idle state always equals the last
+    request boundary, a disk file is never stale while its session is
+    fillable — so a crashed-and-restarted server (supervise.py) resumes
+    every checkpointed session token-identically from disk. The
+    durability boundary is the last COMPLETED request whose write-behind
+    flushed (``flush()``; a clean ``ServeServer.stop`` flushes).
+
+    Synchronisation: shares the state cache's reentrant lock (the evict
+    listener fires under it; a private lock would ABBA with the
+    ``acquire``/``write_slots`` calls made from fill paths). The worker
+    fetches and writes files OUTSIDE the lock."""
+
+    def __init__(self, cache: StateCache, *, host_entries: int = 256,
+                 directory: str | None = None, registry=None,
+                 replica: int = 0):
+        if host_entries < 1:
+            raise ValueError(f"host_entries must be >= 1, got {host_entries}")
+        self.cache = cache
+        self.host_entries = host_entries
+        self._lock = cache._lock  # shared on purpose (see docstring)
+        self._work = threading.Condition(self._lock)
+        self._pending: dict[str, _SpillJob] = {}
+        self._queue: deque[str] = deque()
+        self._host: OrderedDict[str, DetachedState] = OrderedDict()
+        # host-overflow victims whose disk write is IN FLIGHT: they stay
+        # fillable here until the write lands — without this, a
+        # continuation arriving between the host-tier pop and the fsync
+        # would spuriously fail "state lost"
+        self._evacuating: dict[str, DetachedState] = {}
+        # sids discarded WHILE a disk flush is running: the flusher
+        # deletes any file it just wrote for them (a stale write landing
+        # after an un-kept completion's discard must not resurrect the
+        # session). Only populated during a flush; cleared after.
+        self._dropped: set[str] = set()
+        self._flushing = 0
+        self._disk = _DiskTier(directory) if directory else None
+        self._thread: threading.Thread | None = None
+        self._closed = False  # close() parks the worker; enqueue revives
+        self._in_flight = 0
+        self.spills = {"host": 0, "disk": 0}
+        self.fills = {"host": 0, "disk": 0}
+        self.misses = 0
+        self.corrupt = 0
+        self.lost = 0  # host overflow dropped without a disk tier
+        self.disk_errors = 0  # failed disk writes (state kept in RAM)
+        self._registry = obs.REGISTRY if registry is None else registry
+        self._bind_metrics(replica)
+        cache.evict_listeners.append(self._on_slot_evicted_locked)
+
+    def _bind_metrics(self, replica: int) -> None:
+        """Resolve the labelled instruments for ``replica``. Plain
+        attribute assignment on purpose (NOT under the lock): rebinding
+        happens before traffic (construction / ServeServer wiring), and
+        the record sites read these without holding the lock."""
+        reg = self._registry
+        rl = str(replica)
+        fam = reg.counter(
+            "serve_tier_spills_total",
+            "session states spilled into a tier (host = RAM spill of an "
+            "evicted slot; disk = durable session file written)",
+            labelnames=("tier", "replica"))
+        self._m_spill = {t: fam.labels(tier=t, replica=rl)
+                         for t in ("host", "disk")}
+        fam = reg.counter(
+            "serve_tier_fills_total",
+            "spilled session states restored into a device slot, by "
+            "source tier",
+            labelnames=("tier", "replica"))
+        self._m_fill = {t: fam.labels(tier=t, replica=rl)
+                        for t in ("host", "disk")}
+        fam = reg.counter(
+            "serve_tier_lost_total",
+            "tier state trouble, by reason (miss = no tier holds it, "
+            "corrupt = disk file quarantined, overflow = host tier full "
+            "with no disk tier; disk_error = a disk write failed — state "
+            "stays in RAM, durability lost, correctness kept)",
+            labelnames=("reason", "replica"))
+        self._m_lost = {r: fam.labels(reason=r, replica=rl)
+                        for r in ("miss", "corrupt", "overflow",
+                                  "disk_error")}
+        self._m_spill_lat = reg.histogram(
+            "serve_tier_spill_seconds",
+            "eviction → spilled state stored (device fetch + optional "
+            "disk write), per spill job",
+            labelnames=("replica",)).labels(replica=rl)
+        self._m_fill_lat = reg.histogram(
+            "serve_tier_fill_seconds",
+            "tier fill: probe → state written back into a device slot",
+            labelnames=("replica",)).labels(replica=rl)
+
+    def set_replica(self, replica: int) -> None:
+        """Re-bind the metric children to a replica index (ServeServer
+        wires this so tier metrics carry the right ``replica`` label even
+        for engines built without one). Call before taking traffic."""
+        self._bind_metrics(replica)
+
+    # ---- spill capture (under the cache lock) --------------------------
+
+    def _on_slot_evicted_locked(self, sid: str, slot: int) -> None:
+        # fired by the state cache's LRU under the shared lock: capture
+        # REFERENCES to the current cache arrays (zero device ops — the
+        # functional snapshot means later writes to the slot create new
+        # arrays) and let the worker slice + fetch them off-thread.
+        # Evicted sids are idle kept sessions (active ones are pinned)
+        # and prefix/ backing slots; prefix states stay host-only (their
+        # entries die with the process anyway).
+        # has_indexed (no filesystem stat): this fires on the scheduler's
+        # admission path under the shared lock — a false negative only
+        # costs one redundant disk write
+        to_disk = (self._disk is not None
+                   and not sid.startswith(PREFIX_SID_NAMESPACE)
+                   and not self._disk.has_indexed(sid))
+        self._enqueue_locked(sid, slot, to_host=True, to_disk=to_disk)
+
+    def _enqueue_locked(self, sid: str, slot: int, *, to_host: bool,
+                        to_disk: bool) -> None:
+        h, c = self.cache.h, self.cache.c  # refs, not slices: zero ops
+        sliced = False
+        if len(self._pending) >= self.SPILL_BATCH:
+            # memory-pressure valve: each full-array capture pins one
+            # whole cache-array generation on device, so a backed-up
+            # queue (e.g. a disk stall) must not hold O(pending x cache)
+            # device memory. Under pressure, pay the two slice dispatches
+            # here so the job holds only this session's [L, H] rows.
+            h = h[:, slot, :]
+            c = c[:, slot, :]
+            sliced = True
+        job = self._pending.get(sid)
+        if job is not None:
+            # merge: an existing job for this sid describes the same
+            # request-boundary state (sessions are only spillable /
+            # checkpointable while idle) — refresh the capture, OR the
+            # destinations
+            job.h, job.c, job.slot = h, c, slot
+            job.sliced = sliced
+            job.to_host = job.to_host or to_host
+            job.to_disk = job.to_disk or to_disk
+        else:
+            job = _SpillJob(h, c, slot, time.perf_counter(),
+                            to_host=to_host, to_disk=to_disk,
+                            sliced=sliced)
+            self._pending[sid] = job
+        if not job.in_queue:
+            job.in_queue = True
+            self._queue.append(sid)
+        # deliberately NO notify: enqueue fires on the scheduler's
+        # admission path (evictions) and at every request finish
+        # (checkpoints), and waking the worker per event makes it
+        # contend for this very lock mid-admission. The worker POLLS
+        # (short timed wait), so spills batch up and the serving path
+        # pays a deque append, nothing more.
+        self._ensure_worker_locked()
+
+    def _ensure_worker_locked(self) -> None:
+        self._closed = False
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self.run, name="serve-tier-spill", daemon=True)
+            self._thread.start()
+
+    def close(self) -> None:
+        """Park the spill worker (ServeServer.stop calls flush() then
+        this): without it, every retired serve stack would leak one
+        forever-polling daemon thread pinning the engine's arrays. A
+        later enqueue/flush lazily revives the worker, so restartable
+        servers keep working."""
+        with self._work:
+            self._closed = True
+            self._work.notify_all()
+
+    def checkpoint(self, sid: str) -> bool:
+        """Write-behind the session's current (request-boundary) state to
+        the disk tier while it stays device-resident — the serve-session
+        checkpoint a restarted server restores from. No-op without a
+        disk tier or for unknown sids."""
+        if self._disk is None:
+            return False
+        with self._lock:
+            slot = self.cache.lookup(sid)
+            if slot is None:
+                return False
+            self._enqueue_locked(sid, slot, to_host=False, to_disk=True)
+            return True
+
+    # ---- the spill worker (graftlint host-sync scheduler scope) --------
+
+    #: max spill jobs fetched per worker batch (one blocking device_get
+    #: per batch — bounds the latency any single flush() waits on)
+    SPILL_BATCH = 64
+
+    def run(self) -> None:
+        """Worker loop: drain the spill queue forever, a BATCH at a time
+        (one blocking device fetch per batch — N spills cost one
+        pipeline wait, not N serialized ones). Daemon thread — started
+        lazily on the first enqueue; ``flush()`` is the synchronisation
+        point for callers that need durability."""
+        while True:
+            with self._work:
+                while not self._queue:
+                    if self._closed:
+                        return  # close(): park until a revive
+                    # timed wait: enqueues do NOT notify (see
+                    # _enqueue_locked) — the poll is the worker's only
+                    # wake-up for new work, and bounds the write-behind
+                    # delay a spill can sit unfetched
+                    self._work.wait(timeout=0.05)
+                batch: list[tuple[str, _SpillJob]] = []
+                while self._queue and len(batch) < self.SPILL_BATCH:
+                    sid = self._queue.popleft()
+                    job = self._pending.get(sid)
+                    if job is None or not job.in_queue:
+                        continue  # cancelled or superseded
+                    job.in_queue = False
+                    batch.append((sid, job))
+                self._in_flight += len(batch)
+            if batch:
+                try:
+                    self._spill_batch(batch)
+                finally:
+                    # decremented HERE — after the disk writes — so
+                    # flush() is a real durability barrier, and
+                    # decremented even if a write raised, so flush can
+                    # never wedge on a stuck in-flight count
+                    with self._work:
+                        self._in_flight -= len(batch)
+                        self._work.notify_all()
+
+    def _spill_batch(self, batch: list[tuple[str, _SpillJob]]) -> None:
+        # the ONE designated device→host fetch of the spill plane
+        # (StateCache.fetch_detached_batch; graftlint host-sync
+        # allow-list): full-snapshot fetch + numpy slot extraction —
+        # no per-job device ops anywhere in the spill pipeline
+        states = self.cache.fetch_detached_batch(
+            [(job.h, job.c, None if job.sliced else job.slot)
+             for _, job in batch])
+        disk_writes: list[tuple[str, DetachedState]] = []
+        stored: list[_SpillJob] = []
+        dropped = 0
+        with self._work:
+            for (sid, job), state in zip(batch, states):
+                cur = self._pending.get(sid)
+                if cur is not job or job.in_queue:
+                    continue  # superseded / re-queued while fetching
+                del self._pending[sid]
+                stored.append(job)
+                if job.to_host:
+                    self._host[sid] = state
+                    self._host.move_to_end(sid)
+                    self.spills["host"] += 1
+                    self._m_spill["host"].inc()
+                    dropped += self._cascade_overflow_locked(disk_writes)
+                if job.to_disk:
+                    disk_writes.append((sid, state))
+        if dropped:
+            self._m_lost["overflow"].inc(dropped)
+        self._flush_disk_writes(disk_writes)
+        # latency observed AFTER the disk writes (the histogram's help
+        # promises "stored", fsync included) and only for jobs that
+        # actually stored — superseded ones are not phantom spills
+        end = time.perf_counter()
+        for job in stored:
+            self._m_spill_lat.observe(end - job.t0)
+
+    def _cascade_overflow_locked(self, disk_writes: list) -> int:
+        """Pop host-tier overflow victims. Disk-bound victims PARK in
+        ``_evacuating`` (still fillable) until their write lands; the
+        rest are dropped honestly. Returns the dropped count."""
+        dropped = 0
+        while len(self._host) > self.host_entries:
+            vsid, vstate = self._host.popitem(last=False)
+            if (self._disk is not None
+                    and not vsid.startswith(PREFIX_SID_NAMESPACE)):
+                self._evacuating[vsid] = vstate
+                disk_writes.append((vsid, vstate))
+            else:
+                self.lost += 1
+                dropped += 1
+        return dropped
+
+    def _flush_disk_writes(self, writes: list) -> None:
+        """Write session files OUTSIDE the shared lock, with two honesty
+        guards: a write is SKIPPED when its session no longer exists
+        anywhere (discarded while queued — a stale file must not
+        resurrect it), and a file written concurrently with a discard is
+        deleted afterwards (``_dropped`` tombstones, alive only while a
+        flush runs). A failed write keeps the state in RAM
+        (``disk_error`` — durability lost, correctness kept)."""
+        if not writes:
+            return
+        with self._lock:
+            self._flushing += 1
+        try:
+            for sid, state in writes:
+                with self._lock:
+                    current = (sid in self._evacuating
+                               or sid in self._pending
+                               or sid in self._host or sid in self.cache)
+                if not current:
+                    continue  # discarded while queued: nothing to persist
+                try:
+                    self._write_disk(sid, state)
+                except OSError as e:
+                    # disk trouble loses durability, not correctness:
+                    # keep the state in RAM and keep the worker alive
+                    print(f"serve tiers: disk-tier write failed for "
+                          f"{sid!r}: {e}", flush=True)
+                    with self._lock:
+                        self.disk_errors += 1
+                        st = self._evacuating.pop(sid, None)
+                        if st is not None:
+                            self._host[sid] = st
+                            self._host.move_to_end(sid)
+                    self._m_lost["disk_error"].inc()
+                    continue
+                with self._lock:
+                    self._evacuating.pop(sid, None)
+                    undo = sid in self._dropped
+                if undo:
+                    # discard() raced the write: the file we just wrote
+                    # describes a session that ended — remove it
+                    self._disk.discard(sid)
+        finally:
+            with self._lock:
+                self._flushing -= 1
+                if not self._flushing:
+                    self._dropped.clear()
+
+    def _write_disk(self, sid: str, state: DetachedState) -> None:
+        self._disk.put(sid, state)
+        with self._lock:
+            self.spills["disk"] += 1
+        self._m_spill["disk"].inc()
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Block until every queued/in-flight spill has landed (True) or
+        the timeout expired (False) — the durability barrier for clean
+        shutdown and for tests/tools that must observe the disk tier."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._work:
+            while self._queue or self._in_flight:
+                self._ensure_worker_locked()
+                if deadline is None:
+                    self._work.wait(timeout=1.0)
+                else:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return False
+                    self._work.wait(timeout=min(left, 1.0))
+            return True
+
+    # ---- fill (promote back to the device tier) ------------------------
+
+    @property
+    def disk_dir(self) -> str | None:
+        """Disk-tier directory, or None — the router dedupes its
+        disk-residency stats per distinct directory."""
+        return None if self._disk is None else self._disk.directory
+
+    def has(self, sid: str) -> bool:
+        """Tier residency probe (the router's affinity extension): does
+        any tier hold restorable state for ``sid``?"""
+        with self._lock:
+            return self._has_locked(sid)
+
+    def has_memory(self, sid: str) -> bool:
+        """MEMORY-tier residency only (pending capture / host RAM /
+        evacuating overflow). The router prefers this over any disk
+        match: the replica holding a memory copy is the session's owner
+        with the freshest request boundary, while a shared disk file may
+        be an older not-yet-overwritten boundary."""
+        with self._lock:
+            job = self._pending.get(sid)
+            return ((job is not None and (job.to_host or job.to_disk))
+                    or sid in self._host or sid in self._evacuating)
+
+    def _has_locked(self, sid: str) -> bool:
+        job = self._pending.get(sid)
+        if job is not None and (job.to_host or job.to_disk):
+            return True
+        if sid in self._host or sid in self._evacuating:
+            return True
+        return self._disk is not None and self._disk.has(sid)
+
+    def resident_tier(self, sid: str) -> str | None:
+        """'pending' | 'host' | 'disk' | None — observability/tests."""
+        with self._lock:
+            if sid in self._pending and (self._pending[sid].to_host
+                                         or self._pending[sid].to_disk):
+                return "pending"
+            if sid in self._host or sid in self._evacuating:
+                return "host"
+            if self._disk is not None and self._disk.has(sid):
+                return "disk"
+            return None
+
+    def _fill_memory_locked(self, sid: str, idx, t0: float) -> bool:
+        """Restore from the in-memory tiers (pending capture, host RAM,
+        evacuating overflow) — called with the shared lock held."""
+        job = self._pending.get(sid)
+        if job is not None and (job.to_host or job.to_disk):
+            # device→device: gather the captured snapshot's slot row
+            # (index as an ARRAY so one gather program covers every
+            # slot value) and scatter into the new slot. Any pending
+            # capture is the freshest copy, whatever its destination
+            # flags — a to_disk-only job's file may not be written yet
+            if job.sliced:  # pressure-valve capture: already [L, H]
+                self.cache.write_slots(idx, job.h[:, None, :],
+                                       job.c[:, None, :])
+            else:
+                src = jnp.asarray([job.slot])
+                self.cache.write_slots(idx, job.h[:, src, :],
+                                       job.c[:, src, :])
+            job.to_host = False  # the disk leg (if any) still runs:
+            # the file stays the valid request-boundary checkpoint
+            if not job.to_disk and not job.in_queue:
+                del self._pending[sid]
+            self._host.pop(sid, None)
+            return self._count_fill_locked("host", t0)
+        state = self._host.pop(sid, None)
+        if state is None:
+            # overflow victim mid-evacuation: still RAM-resident (its
+            # disk write — which stays valid — may even land after this)
+            state = self._evacuating.get(sid)
+        if state is not None:
+            self.cache.write_slots(idx, state.h[:, None, :],
+                                   state.c[:, None, :])
+            return self._count_fill_locked("host", t0)
+        return False
+
+    def fill(self, sid: str, slot: int) -> bool:
+        """Restore ``sid``'s spilled state into the (already acquired —
+        and PINNED, so no concurrent eviction can reuse it) ``slot``:
+        pending capture (device→device — the spill fetch never ran),
+        host RAM, then disk. The disk read + sha256 verify runs OUTSIDE
+        the shared lock (a slow filesystem must not stall the scheduler
+        or the health probes). Returns False when no tier holds usable
+        state (miss, or a corrupt disk file — quarantined and counted;
+        the caller fails the continuation honestly)."""
+        t0 = time.perf_counter()
+        idx = np.asarray([slot])
+        with self._lock:
+            if self._fill_memory_locked(sid, idx, t0):
+                return True
+            if self._disk is None:
+                self.misses += 1
+                self._m_lost["miss"].inc()
+                return False
+        # disk branch: probe + read + verify all OUTSIDE the lock (get
+        # returns None for absent — no separate stat-under-lock)
+        try:
+            state = self._disk.get(sid, self.cache.num_layers,
+                                   self.cache.hidden_size)
+        except CorruptCheckpointError as e:
+            print(f"serve tiers: QUARANTINED corrupt session file "
+                  f"for {sid!r}: {e}", flush=True)
+            with self._lock:
+                self.corrupt += 1
+            self._m_lost["corrupt"].inc()
+            state = None
+        with self._lock:
+            if state is None:
+                self.misses += 1
+                self._m_lost["miss"].inc()
+                return False
+            self.cache.write_slots(idx, state.h[:, None, :],
+                                   state.c[:, None, :])
+            return self._count_fill_locked("disk", t0)
+
+    def _count_fill_locked(self, tier: str, t0: float) -> bool:
+        self.fills[tier] += 1
+        self._m_fill[tier].inc()
+        self._m_fill_lat.observe(time.perf_counter() - t0)
+        return True
+
+    def fill_ahead(self, sid: str) -> bool:
+        """Router fill-ahead: on an affinity-probe tier hit, promote the
+        session into a device slot NOW so the continuation's admission
+        finds it resident (the device copy dispatches async — by the
+        time the scheduler prefills, it is data-ordered anyway).
+        MEMORY tiers only: this runs under the router's global lock, so
+        a disk-resident session just routes home and admission does the
+        (out-of-lock) disk fill."""
+        with self._lock:
+            if sid in self.cache:
+                return True
+            if not self._has_locked(sid):
+                return False
+            job = self._pending.get(sid)
+            in_memory = ((job is not None and (job.to_host or job.to_disk))
+                         or sid in self._host or sid in self._evacuating)
+            if not in_memory:
+                return True  # disk-resident: admission fills on arrival
+            try:
+                slot, fresh = self.cache.acquire(sid)
+            except CacheFullError:
+                return False  # every slot pinned: admission will retry
+            if not fresh:
+                return True
+            if self._fill_memory_locked(sid, np.asarray([slot]),
+                                        time.perf_counter()):
+                return True
+            self.cache.release(sid)
+            return False
+
+    def discard(self, sid: str) -> None:
+        """Drop every tier's copy of ``sid`` (un-kept completion /
+        prefix-entry eviction: the owner is gone, a stale copy must not
+        resurrect it)."""
+        with self._lock:
+            job = self._pending.get(sid)
+            if job is not None:
+                job.to_host = job.to_disk = False
+                if not job.in_queue:
+                    del self._pending[sid]
+            self._host.pop(sid, None)
+            self._evacuating.pop(sid, None)
+            if self._flushing:
+                # a disk write for this sid may be mid-flight: tombstone
+                # it so the flusher deletes whatever it lands
+                self._dropped.add(sid)
+        if self._disk is not None:
+            self._disk.discard(sid)
+
+    # ---- replica retirement (router-driven) ----------------------------
+
+    def evacuate(self) -> tuple[int, list[tuple[str, DetachedState]]]:
+        """Move every tier-held session off this (retired) replica:
+        pending spills are fetched synchronously, then everything is
+        persisted to the SHARED disk tier when one exists (any live
+        replica can fill from it) or returned for the router to adopt
+        into a live replica's host tier. Returns ``(persisted_count,
+        homeless_entries)``. Prefix states are dropped — their entries
+        die with the replica."""
+        with self._lock:
+            jobs = [(sid, job) for sid, job in self._pending.items()
+                    if job.to_host or job.to_disk]
+            self._pending.clear()
+            self._queue.clear()
+            host = list(self._host.items()) + list(self._evacuating.items())
+            self._host.clear()
+            self._evacuating.clear()
+            self._work.notify_all()
+        states: dict[str, DetachedState] = {}
+        if jobs:
+            fetched = self.cache.fetch_detached_batch(
+                [(job.h, job.c, None if job.sliced else job.slot)
+                 for _, job in jobs])
+            states.update(
+                (sid, st) for (sid, _), st in zip(jobs, fetched))
+        states.update(host)  # same boundary where both exist
+        persisted = 0
+        homeless: list[tuple[str, DetachedState]] = []
+        for sid, state in states.items():
+            if sid.startswith(PREFIX_SID_NAMESPACE):
+                continue
+            if self._disk is not None:
+                try:
+                    self._write_disk(sid, state)
+                    persisted += 1
+                    continue
+                except OSError as e:
+                    # disk trouble mid-retirement must not abort the
+                    # router's requeue of the dead replica's work: the
+                    # session becomes HOMELESS (adopted into a live
+                    # replica's host tier) instead of crashing _retire
+                    print(f"serve tiers: evacuate disk write failed for "
+                          f"{sid!r}: {e}", flush=True)
+                    with self._lock:
+                        self.disk_errors += 1
+                    self._m_lost["disk_error"].inc()
+            homeless.append((sid, state))
+        return persisted, homeless
+
+    def adopt(self, sid: str, state: DetachedState) -> None:
+        """Insert a migrated session's state into this replica's host
+        tier (router retirement of a diskless peer)."""
+        disk_writes: list[tuple[str, DetachedState]] = []
+        dropped = 0
+        with self._lock:
+            self._host[sid] = state
+            self._host.move_to_end(sid)
+            self.spills["host"] += 1
+            dropped += self._cascade_overflow_locked(disk_writes)
+        self._m_spill["host"].inc()
+        if dropped:
+            self._m_lost["overflow"].inc(dropped)
+        self._flush_disk_writes(disk_writes)
+
+    # ---- views ---------------------------------------------------------
+
+    def session_ids(self) -> list[str]:
+        """Sids with restorable tier state (host + pending + disk)."""
+        with self._lock:
+            out = {sid for sid, j in self._pending.items()
+                   if j.to_host or j.to_disk}
+            out.update(self._host)
+            out.update(self._evacuating)
+            if self._disk is not None:
+                out.update(self._disk.sids())
+            return sorted(out)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "host_entries_max": self.host_entries,
+                "entries": {
+                    "pending": sum(1 for j in self._pending.values()
+                                   if j.to_host or j.to_disk),
+                    # evacuating overflow victims are still RAM-resident
+                    "host": len(self._host) + len(self._evacuating),
+                    "disk": 0 if self._disk is None else len(self._disk),
+                },
+                "disk_dir": None if self._disk is None
+                else self._disk.directory,
+                "spills": dict(self.spills),
+                "fills": dict(self.fills),
+                "misses": self.misses,
+                "corrupt": self.corrupt,
+                "lost": self.lost,
+                "disk_errors": self.disk_errors,
             }

@@ -28,6 +28,12 @@ treats it like a signal death — retryable, relaunched with ``--resume``.
 Size N well above the longest silent phase of the run (first XLA compile +
 the --log-every cadence).
 
+Serving children: ``supervise -- serve --http --session-dir d ...``
+relaunches a crashed server WITHOUT injecting ``--resume`` (a training
+flag serve's parser rejects); clients' kept sessions survive the restart
+through serve's own disk tier (``--session-dir``), resuming
+token-identically from their last completed request.
+
 Self-healing (resilience plane): restart delays back off exponentially
 with jitter (--restart-delay is the base, --max-delay the cap); known
 retryable exit codes (resilience/exit_codes.py: anomaly aborts, injected
@@ -207,8 +213,17 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
         raise SystemExit(
             f"--stall-timeout must be > 0, got {stall_timeout}"
         )
-    ckpt_dir = _checkpoint_dir_of(cli_args)
-    if ckpt_dir is None:
+    # a supervised SERVE child (``supervise -- serve --http ...``) is the
+    # serve-session resilience drill: relaunches must NOT inject --resume
+    # (the serve parser has no such flag — argparse would exit 2 and the
+    # deterministic-failure check would give up on a perfectly retryable
+    # server), and checkpoint-step forward progress is a training notion
+    # (serve's --checkpoint-dir is read-only params restore). Session
+    # continuity across the restart comes from serve's own disk tier
+    # (--session-dir, serve/state_cache.py SessionTiers).
+    serve_child = bool(cli_args) and cli_args[0] == "serve"
+    ckpt_dir = None if serve_child else _checkpoint_dir_of(cli_args)
+    if ckpt_dir is None and not serve_child:
         print("supervise: warning: no --checkpoint-dir — a crash will "
               "restart from step 0 (and forward-progress poison detection "
               "is off)", file=sys.stderr)
@@ -237,7 +252,7 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
     no_progress = 0
     while True:
         argv = list(cli_args)
-        if attempt > 0:
+        if attempt > 0 and not serve_child:
             # --resume-best is a ONE-TIME rewind (and mutually exclusive
             # with --resume in the CLI): after the first attempt performed
             # it, relaunches must continue the fine-tune's own lineage

@@ -44,10 +44,74 @@ from ..resilience import faults as _faults
 
 
 class CorruptCheckpointError(RuntimeError):
-    """A checkpoint file failed its sha256 sidecar check (torn write, bit
-    rot, or an injected ``ckpt_corrupt`` drill). ``restore_latest``
+    """A state-bearing file failed its sha256 sidecar check (torn write,
+    bit rot, or an injected ``ckpt_corrupt`` drill). ``restore_latest``
     quarantines the offending step and falls back to the newest valid
-    one; this type only escapes from paths with nothing to fall back to."""
+    one; the serve disk tier (serve/state_cache.py) quarantines the
+    session file and reports the state honestly lost; this type only
+    escapes from paths with nothing to fall back to."""
+
+
+def atomic_write(path: str, data: bytes, *, checksum: bool = False) -> None:
+    """fsync + tmp-write + rename: partial writes never count, and the
+    data is durable BEFORE the rename makes it visible (rename-first
+    ordering can leave a zero-length "complete" file after power loss
+    — exactly the torn state the restore path would then trust).
+    ``checksum=True`` additionally writes a ``<path>.sha256`` sidecar
+    (state-bearing files only) that :func:`read_verified` checks; any
+    PREVIOUS sidecar is removed before the payload rename and the new one
+    lands after it, so a crash anywhere in the sequence leaves a payload
+    (old or new) without a sidecar — verified as legacy/unchecked, never
+    as a false mismatch. This matters for OVERWRITTEN paths (best.msgpack,
+    serve session files): without the pre-remove, a crash between the two
+    renames would pair the new payload with the old file's hash and the
+    reader would quarantine a perfectly valid file.
+
+    The reusable durability core shared by training checkpoints and the
+    serve session disk tier (serve/state_cache.py)."""
+    # writer-unique tmp names: two processes/threads writing the SAME
+    # path concurrently (e.g. serve replicas checkpointing one session
+    # into a shared --session-dir during a retirement race) must not
+    # interleave inside one tmp file — each writes its own and the
+    # os.replace ordering decides, atomically, which full payload wins
+    uniq = f".{os.getpid()}.{threading.get_ident()}.tmp"
+    tmp = path + uniq
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    if checksum:
+        try:  # never pair new bytes w/ old hash (no exists/remove
+            os.remove(path + ".sha256")  # TOCTOU: a concurrent writer
+        except FileNotFoundError:  # may have removed it first)
+            pass
+    os.replace(tmp, path)
+    if checksum:
+        side_tmp = path + ".sha256" + uniq
+        with open(side_tmp, "wb") as f:
+            f.write(hashlib.sha256(data).hexdigest().encode())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(side_tmp, path + ".sha256")
+
+
+def read_verified(path: str) -> bytes:
+    """Read a state-bearing file, checking its sha256 sidecar when one
+    exists (pre-checksum files have none and are accepted). Raises
+    :class:`CorruptCheckpointError` on mismatch."""
+    with open(path, "rb") as f:
+        data = f.read()
+    side = path + ".sha256"
+    if os.path.exists(side):
+        with open(side) as f:
+            expect = f.read().strip()
+        got = hashlib.sha256(data).hexdigest()
+        if got != expect:
+            raise CorruptCheckpointError(
+                f"{path}: sha256 mismatch (expected {expect[:12]}…, "
+                f"got {got[:12]}…) — truncated or corrupted write"
+            )
+    return data
 
 
 def _sync(name: str) -> None:
@@ -429,36 +493,10 @@ class Checkpointer:
             self._best_meta_cache = None
             return None
 
-    @staticmethod
-    def _atomic_write(path: str, data: bytes, *, checksum: bool = False) -> None:
-        """fsync + tmp-write + rename: partial writes never count, and the
-        data is durable BEFORE the rename makes it visible (rename-first
-        ordering can leave a zero-length "complete" file after power loss
-        — exactly the torn state the restore path would then trust).
-        ``checksum=True`` additionally writes a ``<path>.sha256`` sidecar
-        (state-bearing files only) that restore verifies; any PREVIOUS
-        sidecar is removed before the payload rename and the new one lands
-        after it, so a crash anywhere in the sequence leaves a payload
-        (old or new) without a sidecar — verified as legacy/unchecked,
-        never as a false mismatch. This matters for OVERWRITTEN paths
-        (best.msgpack): without the pre-remove, a crash between the two
-        renames would pair the new payload with the old file's hash and
-        restore would quarantine a perfectly valid best."""
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        if checksum and os.path.exists(path + ".sha256"):
-            os.remove(path + ".sha256")  # never pair new bytes w/ old hash
-        os.replace(tmp, path)
-        if checksum:
-            side_tmp = path + ".sha256.tmp"
-            with open(side_tmp, "wb") as f:
-                f.write(hashlib.sha256(data).hexdigest().encode())
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(side_tmp, path + ".sha256")
+    # module-level atomic_write/read_verified (extracted so the serve
+    # session disk tier shares the exact durability core), bound as
+    # staticmethods to keep every existing call site working
+    _atomic_write = staticmethod(atomic_write)
 
     @staticmethod
     def _classified_parse(path: str, data: bytes, parse):
@@ -478,23 +516,7 @@ class Checkpointer:
                 f"{type(e).__name__}: {e}"
             ) from e
 
-    @staticmethod
-    def _read_verified(path: str) -> bytes:
-        """Read a state-bearing file, checking its sha256 sidecar when one
-        exists (pre-checksum checkpoints have none and are accepted)."""
-        with open(path, "rb") as f:
-            data = f.read()
-        side = path + ".sha256"
-        if os.path.exists(side):
-            with open(side) as f:
-                expect = f.read().strip()
-            got = hashlib.sha256(data).hexdigest()
-            if got != expect:
-                raise CorruptCheckpointError(
-                    f"{path}: sha256 mismatch (expected {expect[:12]}…, "
-                    f"got {got[:12]}…) — truncated or corrupted write"
-                )
-        return data
+    _read_verified = staticmethod(read_verified)
 
     def _quarantine_step(self, step: int, reason: str) -> None:
         """Rename every file of a corrupt step to ``*.quarantined`` so the

@@ -1,0 +1,25 @@
+"""graftlint fixture: clean twin of viol_tier_sync — the spill worker
+fetches ONLY through the designated fetch_detached point (allow-listed
+like the batcher's fetch_window), so the rule covers the thread without
+baselining it."""
+
+import numpy as np
+
+
+class SessionTiers:
+    def __init__(self, cache):
+        self.cache = cache
+        self.queue = []
+
+    def run(self, stop):
+        while not stop.is_set():
+            self.step()
+
+    def step(self):
+        if not self.queue:
+            return
+        sid, h, c = self.queue.pop()
+        # the designated device→host fetch of the spill plane — both the
+        # plain call and an np.asarray wrapped around it are blessed
+        state = np.asarray(self.cache.fetch_detached(h, c))
+        self.cache.store(sid, state)

@@ -1,0 +1,24 @@
+"""graftlint fixture: host-sync true positive in the TIER SPILL WORKER
+scope — a SessionTiers-named class whose run() closure performs a bare
+device→host fetch instead of going through the designated
+fetch_detached point."""
+
+import numpy as np
+
+
+class SessionTiers:
+    def __init__(self, cache):
+        self.cache = cache
+        self.queue = []
+
+    def run(self, stop):
+        while not stop.is_set():
+            self.step()
+
+    def step(self):
+        if not self.queue:
+            return
+        sid, h, c = self.queue.pop()
+        # stray sync in the spill worker: must go through fetch_detached
+        state = (np.asarray(h), np.asarray(c))
+        self.cache.store(sid, state)

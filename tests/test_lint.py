@@ -37,6 +37,7 @@ FIXTURES = os.path.join(_REPO, "tests", "lint_fixtures")
 #: fixture stem -> rule id it must (and must only) trigger
 VIOLATIONS = {
     "viol_host_sync": "host-sync",
+    "viol_tier_sync": "host-sync",
     "viol_lock_abba": "lock-order",
     "viol_lock_listener": "lock-order",
     "viol_warmup": "warmup-coverage",
@@ -49,6 +50,7 @@ VIOLATIONS = {
 
 CLEAN_TWINS = [
     "clean_host_sync",
+    "clean_tier_sync",
     "clean_lock_order",
     "clean_lock_shared_rlock",
     "clean_warmup",

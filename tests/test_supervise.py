@@ -32,6 +32,25 @@ def test_restart_injects_resume_then_succeeds():
     assert calls[2] == calls[1]
 
 
+def test_serve_child_relaunches_without_resume():
+    """A supervised SERVE child (``supervise -- serve --http ...``) must
+    be relaunched with its argv UNTOUCHED: serve's parser has no
+    --resume (argparse would exit 2 → wrongly classified deterministic),
+    and session continuity comes from serve's own --session-dir disk
+    tier instead."""
+    calls = []
+
+    def runner(argv):
+        calls.append(list(argv))
+        return 1 if len(calls) < 3 else 0
+
+    argv = ["serve", "--http", "--session-dir", "d",
+            "--checkpoint-dir", "ck"]
+    rc = supervise(argv, max_restarts=5, restart_delay=0.0, runner=runner)
+    assert rc == 0
+    assert calls == [argv, argv, argv]  # never mutated, never --resume'd
+
+
 def test_gives_up_after_max_restarts():
     calls = []
 

@@ -10,18 +10,22 @@ pipeline (PR 3's whole tentpole was deleting one stray per-token
    same scope (the ``fn = jax.jit(prefill_fn)`` idiom in serve/engine.py);
 2. **lax.scan bodies** — functions passed as the first argument to a
    ``lax.scan``/``jax.lax.scan`` call (window/step bodies);
-3. **the scheduler loop** — methods of scheduler classes (``Batcher``)
-   reachable from ``run``/``step``/``drain``: the continuous-batching
-   loop where one blocking fetch serialises every session's decode.
+3. **the scheduler loop** — methods of scheduler classes (``Batcher``,
+   and the tiered cache's spill worker ``SessionTiers``) reachable from
+   ``run``/``step``/``drain``: the continuous-batching loop where one
+   blocking fetch serialises every session's decode, and the spill
+   thread whose job is to keep the ONE device→host fetch of the spill
+   plane off the scheduler.
 
 Flagged syncs: ``np.asarray``/``np.array``, ``jax.device_get``,
 ``.item()``, ``.block_until_ready()``. In a traced body these are
 either a tracer error waiting to happen or a silent constant-fold; in
 the scheduler loop they stall the pipeline. The designated fetch points
-(``fetch_window`` — the documented ONLY sync of the windowed path — and
-the prefill/decode return fetches in the engine, which are outside these
-scopes) stay legal; anything else needs an explicit suppression with a
-reason.
+(``fetch_window`` — the documented ONLY sync of the windowed path;
+``fetch_detached`` — the spill worker's single designated device→host
+fetch, StateCache.fetch_detached — and the prefill/decode return
+fetches in the engine, which are outside these scopes) stay legal;
+anything else needs an explicit suppression with a reason.
 """
 
 from __future__ import annotations
@@ -31,12 +35,16 @@ import ast
 from .core import Finding, Rule, register
 from .model import ModuleInfo, Project
 
-#: classes whose run/step/drain closure is the serving hot loop
-SCHEDULER_CLASSES = {"Batcher"}
+#: classes whose run/step/drain closure is the serving hot loop (the
+#: batcher's scheduler iteration, and the tiered cache's spill worker —
+#: its whole point is owning the spill plane's one designated sync)
+SCHEDULER_CLASSES = {"Batcher", "SessionTiers"}
 _SCHEDULER_ENTRIES = {"run", "step", "drain"}
 #: attribute-call names that ARE the designated sync points — a direct
 #: np.asarray around them is the blessed fetch, not a stray sync
-_FETCH_ALLOWLIST = {"fetch_window"}
+#: (fetch_window: the windowed-decode readback; fetch_detached: the
+#: spill worker's single device→host fetch, StateCache.fetch_detached)
+_FETCH_ALLOWLIST = {"fetch_window", "fetch_detached"}
 _SYNC_ATTR_CALLS = {"item", "block_until_ready"}
 
 

@@ -6,6 +6,13 @@ Prometheus exposition parses (obs.parse_exposition — the same validator
 the tests use, so the wire contract is checked by the exact code that
 defines it) including the `replica` label on the serve families.
 
+Then the RESTART-WITH-SESSION-RESTORE drill (tiered cache, PR 8): a
+kept session is created, its write-behind disk-tier checkpoint
+(--session-dir) is awaited, the server is SIGKILLed (a real crash — no
+graceful flush), a fresh server is booted on the same session dir, and
+the pre-restart session's continuation must succeed from the disk tier
+(without it, the continuation fails "unknown session").
+
 Run by tools/verify.sh after the tier-1 gate. CPU, tiny model, pinned
 --decode-window 1 and two prefill buckets to keep the warmup lattice
 (compiled once PER replica) to a few seconds. Exit 0 on PASS, 1 on any
@@ -19,13 +26,16 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import re
 import subprocess
 import sys
+import tempfile
 import threading
 import time
+import urllib.error
 import urllib.request
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -40,6 +50,7 @@ _SERVE_ARGS = [
     "--hidden-units", "12", "--num-layers", "1",
     "--prefill-buckets", "4,8", "--batch-buckets", "1,2",
     "--decode-window", "1", "--prefix-cache", "off",
+    "--tiered-cache", "on",
     "--replicas", str(_REPLICAS),
 ]
 
@@ -52,15 +63,9 @@ def _fail(proc: subprocess.Popen, lines: list[str], why: str) -> int:
     return 1
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--timeout", type=float, default=180.0,
-                    help="seconds to wait for the server to come up "
-                         "(covers the CPU warmup compiles)")
-    args = ap.parse_args(argv)
-
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    cmd = [sys.executable, "-m", "lstm_tensorspark_tpu.cli", *_SERVE_ARGS]
+def _boot(cmd, env, timeout):
+    """Start a serve subprocess and wait for its address line. Returns
+    (proc, lines, base-url-or-None)."""
     proc = subprocess.Popen(cmd, cwd=_REPO, env=env, text=True,
                             stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     lines: list[str] = []
@@ -77,10 +82,42 @@ def main(argv=None) -> int:
         ready.set()  # EOF: unblock the waiter to report the death
 
     threading.Thread(target=pump, daemon=True).start()
+    if not ready.wait(timeout) or not url:
+        return proc, lines, None
+    return proc, lines, url[0]
+
+
+def _generate(base, body: dict, timeout=60):
+    req = urllib.request.Request(
+        base + "/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
     try:
-        if not ready.wait(args.timeout) or not url:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        # non-200 replies carry a JSON error body — return it so the
+        # caller can report WHY instead of dying on the HTTPError
+        try:
+            return json.loads(e.read())
+        except Exception:
+            return {"error": f"HTTP {e.code}"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--timeout", type=float, default=180.0,
+                    help="seconds to wait for the server to come up "
+                         "(covers the CPU warmup compiles)")
+    args = ap.parse_args(argv)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    session_dir = tempfile.mkdtemp(prefix="serve_smoke_sessions_")
+    cmd = [sys.executable, "-m", "lstm_tensorspark_tpu.cli", *_SERVE_ARGS,
+           "--session-dir", session_dir]
+    proc, lines, base = _boot(cmd, env, args.timeout)
+    try:
+        if base is None:
             return _fail(proc, lines, "server never reported its address")
-        base = url[0]
 
         with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
             health = json.loads(r.read())
@@ -91,13 +128,8 @@ def main(argv=None) -> int:
             return _fail(proc, lines,
                          f"/healthz replica fan-in wrong: {reps}")
 
-        body = json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 4,
-                           "greedy": True}).encode()
-        req = urllib.request.Request(
-            base + "/v1/generate", data=body,
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as r:
-            reply = json.loads(r.read())
+        reply = _generate(base, {"prompt": [1, 2, 3], "max_new_tokens": 4,
+                                 "greedy": True})
         if len(reply.get("tokens", [])) != 4 or "phases_ms" not in reply:
             return _fail(proc, lines, f"bad generate reply: {reply}")
         if reply.get("replica") not in range(_REPLICAS):
@@ -141,9 +173,42 @@ def main(argv=None) -> int:
             return _fail(proc, lines,
                          f"/metrics replica labels wrong: {seen} != {want}")
 
+        # ---- restart-with-session-restore drill (tiered cache) --------
+        # a kept session, its disk-tier checkpoint awaited, then a REAL
+        # crash (SIGKILL — no graceful flush) and a fresh server on the
+        # same --session-dir: the continuation must succeed from disk
+        kept = _generate(base, {"prompt": [1, 2, 3], "max_new_tokens": 4,
+                                "greedy": True, "keep_session": True})
+        sid = kept.get("session_id")
+        if not sid or len(kept.get("tokens", [])) != 4:
+            return _fail(proc, lines, f"bad keep_session reply: {kept}")
+        deadline = time.monotonic() + 30
+        while (not glob.glob(os.path.join(session_dir, "sess-*.state"))
+               and time.monotonic() < deadline):
+            time.sleep(0.2)  # write-behind checkpoint landing
+        if not glob.glob(os.path.join(session_dir, "sess-*.state")):
+            return _fail(proc, lines,
+                         "no disk-tier session checkpoint appeared in "
+                         f"{session_dir}")
+        proc.kill()  # SIGKILL: a crash, not a shutdown
+        proc.wait()
+
+        proc, lines, base = _boot(cmd, env, args.timeout)
+        if base is None:
+            return _fail(proc, lines,
+                         "restarted server never reported its address")
+        cont = _generate(base, {"prompt": [kept["tokens"][-1]],
+                                "max_new_tokens": 4, "greedy": True,
+                                "session_id": sid, "keep_session": True})
+        if "error" in cont or len(cont.get("tokens", [])) != 4:
+            return _fail(proc, lines,
+                         f"post-restart continuation of {sid!r} failed "
+                         f"(disk tier restore): {cont}")
+
         print(f"serve_smoke: PASS ({base}: healthz fan-in ({len(reps)} "
               f"replicas) + routed generate + stats + {len(fams)} metric "
-              "families validated)")
+              "families validated; kill -9 → restart → session "
+              f"{sid!r} continued from the disk tier)")
         proc.terminate()
         try:
             proc.wait(timeout=10)

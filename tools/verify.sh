@@ -20,8 +20,11 @@
 # Phase 2 — serve smoke: tools/serve_smoke.py boots the real
 # `cli serve --http --replicas 2` subprocess and validates the /healthz
 # replica fan-in, routed /v1/generate replies, /stats router+replica
-# sections, and the replica-labelled /metrics Prometheus exposition
-# (runs AFTER the timed suite on purpose — never concurrently with it).
+# sections, and the replica-labelled /metrics Prometheus exposition;
+# then the restart drill — kept session, disk-tier checkpoint awaited,
+# SIGKILL, fresh boot on the same --session-dir, continuation served
+# from the disk tier (runs AFTER the timed suite on purpose — never
+# concurrently with it).
 #
 # Usage: tools/verify.sh        (from anywhere; cd's to the repo root)
 # Exit:  graftlint's code on lint regressions (3), else tier1_diff's on
@@ -55,8 +58,9 @@ if [ "$gate" -ne 0 ]; then
   exit "$gate"
 fi
 
-# 420 s > the smoke's own worst-case internal budget (180 s boot wait +
-# 60 s generate + 3x30 s GETs) so its failure diagnostics always print
+# 660 s > the smoke's own worst-case internal budget (2x 180 s boot
+# waits — the restart drill boots twice — + 3x60 s generates + 3x30 s
+# GETs + 30 s checkpoint wait) so its failure diagnostics always print
 # before the outer kill fires
-JAX_PLATFORMS=cpu timeout -k 10 420 python tools/serve_smoke.py
+JAX_PLATFORMS=cpu timeout -k 10 660 python tools/serve_smoke.py
 exit $?
