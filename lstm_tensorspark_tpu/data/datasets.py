@@ -91,22 +91,24 @@ def _cached_word_stream(n_tokens: int, vocab_size: int, seed: int,
     cache_dir = os.path.join(cache_root, _CORPUS_FMT)
     path = os.path.join(
         cache_dir, f"words_{n_tokens}_{vocab_size}_{seed}_{noise}.txt")
-    if os.path.exists(path):
+    try:
+        # no exists() pre-check: another checkout's age-gated sweep can
+        # remove the file between the stat and the open (the TOCTOU
+        # class) — a missing cache is just the OSError miss below
+        with open(path, "r", encoding="ascii") as f:
+            stream = f.read().split()
+    except OSError:
+        stream = None  # no/unreadable cache: regenerate below
+    if stream is not None and len(stream) == n_tokens:
         try:
-            with open(path, "r", encoding="ascii") as f:
-                stream = f.read().split()
-            if len(stream) == n_tokens:
-                try:
-                    # a HIT must refresh mtime: reads alone don't, and the
-                    # age-gated sweep keys liveness off mtime — without
-                    # this, a daily-used foreign-version cache would still
-                    # look stale after the window and get swept
-                    os.utime(path, None)
-                except OSError:
-                    pass
-                return stream
+            # a HIT must refresh mtime: reads alone don't, and the
+            # age-gated sweep keys liveness off mtime — without this, a
+            # daily-used foreign-version cache would still look stale
+            # after the window and get swept
+            os.utime(path, None)
         except OSError:
-            pass  # regenerate below
+            pass
+        return stream
     text = generate(n_tokens, vocab_size, seed=seed, noise=noise)
     try:
         os.makedirs(cache_dir, exist_ok=True)

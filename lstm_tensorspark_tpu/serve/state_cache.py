@@ -478,8 +478,14 @@ class PrefixCache:
             slot, fresh = self.cache.acquire(entry.sid)
         except CacheFullError:
             return False  # transient: entry stays spilled, miss this time
+        # fill_memory, not fill: this runs with the shared cache lock
+        # HELD (lookup's reentrant RLock), where fill()'s out-of-lock
+        # disk read would silently re-enter the lock and stall every
+        # admission behind the filesystem (graftlint io-under-lock).
+        # Prefix states are host-only — the disk tier never holds them —
+        # so the memory-only fill is semantically identical.
         if fresh and (self.tiers is None
-                      or not self.tiers.fill(entry.sid, slot)):
+                      or not self.tiers.fill_memory(entry.sid, slot)):
             self.cache.release(entry.sid)
             self._by_sid.pop(entry.sid, None)
             self._entries.pop(entry.key, None)
@@ -541,8 +547,12 @@ class PrefixCache:
         self.cache.release(entry.sid)
         if self.tiers is not None:
             # drop any spilled copy too, or the tiers would hold state
-            # for an entry that no longer exists
-            self.tiers.discard(entry.sid)
+            # for an entry that no longer exists. Memory tiers only:
+            # this fires under the shared cache lock (insert's eviction
+            # loop), and prefix states never reach the disk tier — the
+            # full discard()'s file unlink would be IO under the hot
+            # lock for a file that cannot exist (graftlint io-under-lock)
+            self.tiers.discard_memory(entry.sid)
         self.evictions += 1
         self._m_evict.inc()
 
@@ -675,8 +685,11 @@ class _DiskTier:
     def _quarantine(self, sid: str | None, path: str) -> None:
         for p in (path, path + ".sha256"):
             try:
-                if os.path.exists(p):
-                    os.replace(p, p + ".quarantined")
+                # no exists() pre-check: the file can vanish between the
+                # stat and the rename (a peer replica quarantining the
+                # same corrupt file) — FileNotFoundError lands in the
+                # same best-effort OSError as every other race
+                os.replace(p, p + ".quarantined")
             except OSError:
                 pass  # best effort: a vanished file is already gone
         if sid is not None:
@@ -789,8 +802,10 @@ class _DiskTier:
         if path is not None:
             for p in (path, path + ".sha256"):
                 try:
-                    if os.path.exists(p):
-                        os.remove(p)
+                    # exists+remove is the TOCTOU the flush-vs-discard
+                    # race exercises for real: just remove, a vanished
+                    # file is already the desired state
+                    os.remove(p)
                 except OSError:
                     pass
 
@@ -1042,17 +1057,19 @@ class SessionTiers:
                     job.in_queue = False
                     batch.append((sid, job))
                 self._in_flight += len(batch)
-            if batch:
-                try:
+            try:
+                if batch:
                     self._spill_batch(batch)
-                finally:
-                    # decremented HERE — after the disk writes — so
-                    # flush() is a real durability barrier, and
-                    # decremented even if a write raised, so flush can
-                    # never wedge on a stuck in-flight count
-                    with self._work:
-                        self._in_flight -= len(batch)
-                        self._work.notify_all()
+            finally:
+                # decremented HERE — after the disk writes — so flush()
+                # is a real durability barrier, and decremented on EVERY
+                # path (the finally covers the empty batch with -= 0
+                # too, so the inc/dec pairing is unconditional — the
+                # graftlint resource-pairing contract), so flush can
+                # never wedge on a stuck in-flight count
+                with self._work:
+                    self._in_flight -= len(batch)
+                    self._work.notify_all()
 
     def _spill_batch(self, batch: list[tuple[str, _SpillJob]]) -> None:
         # the ONE designated device→host fetch of the spill plane
@@ -1301,6 +1318,36 @@ class SessionTiers:
         self._m_fill[tier].inc()
         self._m_fill_lat.observe(time.perf_counter() - t0)
         return True
+
+    def fill_memory(self, sid: str, slot: int) -> bool:
+        """Memory-tiers-only :meth:`fill` (pending capture / host RAM /
+        evacuating overflow — no disk leg). Safe to call with the shared
+        cache lock already held: PrefixCache._promote_locked restores
+        spilled prefix entries through this under the reentrant RLock,
+        where fill()'s out-of-lock disk read would stall the scheduler
+        behind the filesystem. Prefix states never reach the disk tier,
+        so for them this is the whole fill."""
+        t0 = time.perf_counter()
+        with self._lock:
+            if self._fill_memory_locked(sid, np.asarray([slot]), t0):
+                return True
+            self.misses += 1
+            self._m_lost["miss"].inc()
+            return False
+
+    def discard_memory(self, sid: str) -> None:
+        """Memory-tiers-only :meth:`discard` — drops pending/host/
+        evacuating copies but never touches the disk tier (no file IO,
+        safe under the shared cache lock). For sids that cannot have a
+        disk file (prefix/ namespace) this is the whole discard."""
+        with self._lock:
+            job = self._pending.get(sid)
+            if job is not None:
+                job.to_host = job.to_disk = False
+                if not job.in_queue:
+                    del self._pending[sid]
+            self._host.pop(sid, None)
+            self._evacuating.pop(sid, None)
 
     def fill_ahead(self, sid: str) -> bool:
         """Router fill-ahead: on an affinity-probe tier hit, promote the

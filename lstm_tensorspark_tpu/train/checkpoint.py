@@ -102,15 +102,21 @@ def read_verified(path: str) -> bytes:
     with open(path, "rb") as f:
         data = f.read()
     side = path + ".sha256"
-    if os.path.exists(side):
+    try:
         with open(side) as f:
             expect = f.read().strip()
-        got = hashlib.sha256(data).hexdigest()
-        if got != expect:
-            raise CorruptCheckpointError(
-                f"{path}: sha256 mismatch (expected {expect[:12]}…, "
-                f"got {got[:12]}…) — truncated or corrupted write"
-            )
+    except FileNotFoundError:
+        # pre-checksum files have no sidecar — accepted as legacy. The
+        # exists()-then-open TOCTOU this replaces could race a writer's
+        # sidecar swap (atomic_write removes the old sidecar before the
+        # payload rename) into a spurious "corrupt" verdict.
+        return data
+    got = hashlib.sha256(data).hexdigest()
+    if got != expect:
+        raise CorruptCheckpointError(
+            f"{path}: sha256 mismatch (expected {expect[:12]}…, "
+            f"got {got[:12]}…) — truncated or corrupted write"
+        )
     return data
 
 
@@ -312,8 +318,10 @@ class Checkpointer:
         # a single-process best supersedes any earlier SHARDED best: drop
         # its marker + shard files so the two artifact kinds never coexist
         # past a save (see _best_artifact for the crash-window tiebreak)
-        if os.path.exists(self._best_marker):
+        try:
             os.remove(self._best_marker)
+        except FileNotFoundError:
+            pass  # no sharded best to supersede
         for name in os.listdir(self.directory):
             if self._match_state_file(self._BEST_PROC_PAT, name):
                 os.remove(os.path.join(self.directory, name))
@@ -378,8 +386,10 @@ class Checkpointer:
                 if m and int(m.group(1)) != step:
                     os.remove(os.path.join(self.directory, name))
             for stale in (self._best_path, self._best_path + ".sha256"):
-                if os.path.exists(stale):
+                try:
                     os.remove(stale)
+                except FileNotFoundError:
+                    pass  # never existed (or a peer already removed it)
         _sync(f"best_done_{step}")
         self._best_meta_cache = {"step": step, "value": float(value)}
         return path
@@ -415,13 +425,18 @@ class Checkpointer:
                       f"{e}", flush=True)
                 for p in (self._best_path, self._best_path + ".sha256"):
                     try:
-                        if os.path.exists(p):
-                            os.replace(p, p + ".quarantined")
+                        os.replace(p, p + ".quarantined")
                     except OSError:
                         pass  # best effort; discovery will retry it
-        if os.path.exists(self._best_marker):
+        try:
             with open(self._best_marker) as f:
                 meta = json.loads(f.read())
+        except FileNotFoundError:
+            # no sharded best (the common single-process layout); the
+            # exists()-then-open this replaces could race a concurrent
+            # save_best's marker removal into a crash
+            pass
+        else:
             sharded = {"step": int(meta["step"]),
                        "value": float(meta["value"]),
                        "writers": int(meta["writers"])}
@@ -485,11 +500,10 @@ class Checkpointer:
                   f"(step {step}): {e}", flush=True)
             for p in [*paths, *(p + ".sha256" for p in paths),
                       self._best_marker]:
-                if os.path.exists(p):
-                    try:
-                        os.replace(p, p + ".quarantined")
-                    except OSError:
-                        pass
+                try:
+                    os.replace(p, p + ".quarantined")
+                except OSError:
+                    pass  # already gone (or a peer quarantined it first)
             self._best_meta_cache = None
             return None
 

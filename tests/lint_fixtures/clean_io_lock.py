@@ -1,0 +1,38 @@
+"""graftlint fixture: clean twin of viol_io_lock — the lock hold only
+snapshots in-memory state; reads, writes and the device fetch all run
+outside it. The metadata probe (os.path.exists) under the lock is the
+sanctioned deduped-residency-stat pattern and must NOT fire."""
+
+import os
+import threading
+
+
+class StateCache:
+    def __init__(self, directory):
+        self.directory = directory
+        self._lock = threading.Lock()
+        self._index = {}
+
+    def _path(self, sid):
+        return os.path.join(self.directory, sid)
+
+    def fill(self, sid):
+        with self._lock:
+            path = self._index.get(sid)
+        if path is None:
+            return None
+        with open(path, "rb") as f:  # IO outside the lock hold
+            return f.read()
+
+    def has(self, sid):
+        with self._lock:
+            # metadata probe: bounded, sanctioned under the hot lock
+            # (the router's deduped disk-residency stat)
+            return sid in self._index or os.path.exists(self._path(sid))
+
+    def store(self, sid, data):
+        path = self._path(sid)
+        with open(path, "wb") as f:
+            f.write(data)
+        with self._lock:
+            self._index[sid] = path

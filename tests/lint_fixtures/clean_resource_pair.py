@@ -1,0 +1,44 @@
+"""graftlint fixture: clean twin of viol_resource_pair — every acquire
+is released on every path (finally / with), and ownership transfers
+(handle stored or returned) stay silent."""
+
+
+class Spiller:
+    def __init__(self, cache, disk):
+        self.cache = cache
+        self.disk = disk
+        self._in_flight = 0
+        self._held = {}
+
+    def snapshot(self, sid):
+        self.cache.pin(sid)
+        try:
+            return self.disk.read(sid)
+        finally:
+            self.cache.unpin(sid)
+
+    def flush_one(self, sid, state):
+        self._in_flight += 1
+        try:
+            self.disk.write(sid, state)
+        finally:
+            self._in_flight -= 1
+
+    def adopt(self, sid):
+        # ownership transfer: the pin outlives this frame by design —
+        # the key is stored on the instance, so the site goes silent
+        self.cache.pin(sid)
+        self._held[sid] = True
+
+
+def read_config(path):
+    with open(path) as f:  # the with form manages the handle
+        return f.read()
+
+
+def append_line(path, line):
+    f = open(path, "a")
+    try:
+        f.write(line)
+    finally:
+        f.close()

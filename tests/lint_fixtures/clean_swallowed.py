@@ -1,0 +1,37 @@
+"""graftlint fixture: clean twin of viol_swallowed — scheduler-side
+failures either count a metric or are caught NARROWLY (expected-absence
+handling around a list remove stays legal), and catch-all-pass outside
+the scheduler closure is out of scope."""
+
+
+class Batcher:
+    def __init__(self, engine):
+        self.engine = engine
+        self.queue = []
+        self.failed = 0
+
+    def run(self, stop):
+        while not stop.is_set():
+            self.step()
+
+    def step(self):
+        if not self.queue:
+            return
+        req = self.queue.pop()
+        try:
+            self.engine.decode(req)
+        except Exception:
+            self.failed += 1  # counted: the failure has a surface
+        try:
+            self.queue.remove(req)
+        except ValueError:
+            pass  # narrow type documents the expected absence
+
+    def stats(self):
+        # not in the run/step/drain closure: client-side best-effort
+        # cleanup may stay silent
+        try:
+            return {"queued": len(self.queue), "failed": self.failed}
+        except Exception:
+            pass
+        return {}

@@ -1,0 +1,60 @@
+"""graftlint fixture: clean twin of viol_thread_lifecycle — one worker
+parked by a close() flag its loop reads, one joined by stop(), and a
+non-daemon writer the interpreter joins at exit (out of scope)."""
+
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._thread = None
+        self._queue = []
+        self._closed = False
+
+    def ensure_worker(self):
+        self._closed = False
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self.run, name="poller", daemon=True)
+            self._thread.start()
+
+    def run(self):
+        while not self._closed:
+            if self._queue:
+                self._queue.pop()
+
+    def close(self):
+        self._closed = True
+
+
+class Scheduler:
+    def __init__(self):
+        self.thread = None
+        self._stop = threading.Event()
+
+    def start(self):
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            pass
+
+    def stop(self):
+        self._stop.set()
+        self.thread.join(timeout=5.0)
+
+
+class Writer:
+    def __init__(self):
+        self._thread = None
+
+    def save(self, payload):
+        # non-daemon: the interpreter joins it at exit — out of scope
+        self._thread = threading.Thread(target=self._write,
+                                        args=(payload,))
+        self._thread.start()
+
+    def _write(self, payload):
+        del payload

@@ -1,0 +1,31 @@
+"""graftlint fixture: clean twin of viol_toctou — the operation runs
+unguarded and handles FileNotFoundError; pure existence probes and
+guards over a DIFFERENT path stay legal."""
+
+import os
+
+
+def drop_sidecar(path):
+    try:
+        os.remove(path + ".sha256")
+    except FileNotFoundError:
+        pass  # already the desired state
+
+
+def read_meta(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except FileNotFoundError:
+        return None
+
+
+def has_cache(path):
+    return os.path.exists(path)  # probe only: nothing guarded
+
+
+def promote(path):
+    if os.path.exists(path + ".complete"):
+        # guard and verb name DIFFERENT paths: the marker gates the
+        # payload rename, which is not the checked file
+        os.replace(path + ".tmp", path)

@@ -1,7 +1,9 @@
 """graftlint fixture: clean twin of viol_wallclock — monotonic for
 durations; the one legitimate wall-clock use (file-mtime comparison)
-carries a suppression with its reason."""
+carries a suppression with its reason; datetime.now() NOT used as a
+duration (a human-facing record stamp) stays legal."""
 
+import datetime
 import os
 import time
 
@@ -10,6 +12,17 @@ def timed_call(fn):
     t0 = time.monotonic()
     out = fn()
     return out, time.monotonic() - t0
+
+
+def stamp_record(payload):
+    # wall-clock for humans, never subtracted: not a duration
+    return {"at": datetime.datetime.now().isoformat(), **payload}
+
+
+def retention_cutoff(hours):
+    # now() minus a timedelta is a wall-clock INSTANT (age gate), the
+    # legitimate use — not a duration measurement
+    return datetime.datetime.now() - datetime.timedelta(hours=hours)
 
 
 def is_stale(path, max_age_s):

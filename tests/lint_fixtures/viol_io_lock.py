@@ -1,0 +1,43 @@
+"""graftlint fixture: io-under-lock true positives — a blocking file
+read directly inside the shared cache lock, and disk IO reached through
+a resolvable callee while the router's global lock is held (the class
+PR 8's review rounds fixed three times)."""
+
+import os
+import threading
+
+
+class StateCache:
+    def __init__(self, directory):
+        self.directory = directory
+        self._lock = threading.Lock()
+        self._index = {}
+
+    def fill(self, sid):
+        with self._lock:
+            path = os.path.join(self.directory, sid)
+            with open(path, "rb") as f:  # blocking read under the hot lock
+                data = f.read()
+            self._index[sid] = len(data)
+            return data
+
+
+class Store:
+    def __init__(self, directory):
+        self.directory = directory
+
+    def persist(self, sid):
+        src = os.path.join(self.directory, sid + ".tmp")
+        os.replace(src, os.path.join(self.directory, sid))
+
+
+class Router:
+    def __init__(self, store: Store):
+        self.store = store
+        self._lock = threading.Lock()
+
+    def retire(self, sid):
+        with self._lock:
+            # the callee resolves, and IT does the disk IO — the fsync
+            # still runs under the global admission lock
+            self.store.persist(sid)

@@ -1,0 +1,22 @@
+"""graftlint fixture: resource-pairing true positives — a pinned slot
+leaked on an exception path (the PR 7 leaked-pin class) and an in-flight
+counter whose decrement a raising disk write skips (the PR 8
+wedged-flush class: flush() waits on a count nobody will ever drop)."""
+
+
+class Spiller:
+    def __init__(self, cache, disk):
+        self.cache = cache
+        self.disk = disk
+        self._in_flight = 0
+
+    def snapshot(self, sid):
+        self.cache.pin(sid)
+        state = self.disk.read(sid)  # may raise: the pin leaks
+        self.cache.unpin(sid)
+        return state
+
+    def flush_one(self, sid, state):
+        self._in_flight += 1
+        self.disk.write(sid, state)  # may raise: the counter wedges
+        self._in_flight -= 1

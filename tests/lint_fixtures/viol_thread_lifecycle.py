@@ -1,0 +1,23 @@
+"""graftlint fixture: thread-lifecycle true positive — a daemon worker
+thread stored on an attribute and started, with NO stop/close/shutdown
+path that joins it or signals its loop (the PR 8 round-3 leaked-poller
+class: every retired stack leaks one forever-polling daemon)."""
+
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._thread = None
+        self._queue = []
+
+    def ensure_worker(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self.run, name="poller", daemon=True)
+            self._thread.start()
+
+    def run(self):
+        while True:
+            if self._queue:
+                self._queue.pop()

@@ -1,0 +1,19 @@
+"""graftlint fixture: toctou-fs true positives — exists()-guarded
+remove and open on the same path expression (the sidecar class PR 8
+round 3 converted to try/remove: the file can vanish between the two
+calls)."""
+
+import os
+
+
+def drop_sidecar(path):
+    side = path + ".sha256"
+    if os.path.exists(side):
+        os.remove(side)  # another writer can unlink it first
+
+
+def read_meta(path):
+    if os.path.exists(path):
+        with open(path) as f:
+            return f.read()
+    return None

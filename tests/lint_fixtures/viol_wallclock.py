@@ -1,10 +1,26 @@
-"""graftlint fixture: wallclock-timing true positive — a latency
-measured with the NTP-slewable wall clock."""
+"""graftlint fixture: wallclock-timing true positives — a latency
+measured with the NTP-slewable wall clock, the same read smuggled in
+via `from time import time` aliasing, and a datetime.now() subtraction
+used as a duration."""
 
+import datetime
 import time
+from time import time as now
 
 
 def timed_call(fn):
     t0 = time.time()
     out = fn()
     return out, time.time() - t0
+
+
+def alias_timed_call(fn):
+    t0 = now()
+    out = fn()
+    return out, now() - t0
+
+
+def dt_timed_call(fn):
+    t0 = datetime.datetime.now()
+    out = fn()
+    return out, datetime.datetime.now() - t0

@@ -58,7 +58,8 @@ def _run(cmd: list[str], timeout: float) -> int:
 
 
 def _final_record(jsonl: str) -> dict:
-    records = [json.loads(line) for line in open(jsonl)]
+    with open(jsonl) as f:
+        records = [json.loads(line) for line in f]
     finals = [r for r in records if r.get("note") == "final"]
     if not finals:
         raise AssertionError(f"no final record in {jsonl}")

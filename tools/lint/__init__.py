@@ -10,10 +10,15 @@ suppression policy and how to add a rule: docs/LINT.md.
 from . import core, model  # noqa: F401
 # importing the rule modules populates core.RULES
 from . import (  # noqa: F401
+    rules_except,
     rules_hostsync,
     rules_hygiene,
+    rules_iolock,
     rules_locks,
     rules_metrics,
+    rules_resources,
+    rules_threads,
+    rules_toctou,
     rules_warmup,
 )
 from .core import RULES, Finding, run_rules  # noqa: F401

@@ -12,7 +12,11 @@ from the one table (resilience/exit_codes.py):
 finding set (keeping existing justifications; new entries get a TODO a
 human must replace). ``--json PATH`` writes the machine-readable report
 (mirrors serve/loadgen.py --json) so finding counts can be trended next
-to the BENCH_*.json baselines.
+to the BENCH_*.json baselines; when a previous report exists at the
+same path the summary line grows per-rule ``d(rule)=±k`` deltas vs it.
+``--changed GIT_REF`` is the sub-second pre-commit mode: only files
+changed vs the ref plus their importers (from the project model) are
+analyzed — verify.sh phase 0 keeps the full-tree run.
 """
 
 from __future__ import annotations
@@ -30,6 +34,34 @@ from tools.lint import RULES, core, model  # noqa: E402
 
 DEFAULT_PATHS = ("lstm_tensorspark_tpu", "tools")
 DEFAULT_BASELINE = os.path.join(_REPO, "tools", "lint_baseline.txt")
+
+
+def _changed_files(ref: str, root: str) -> set[str] | None:
+    """Repo-relative ``.py`` files changed vs ``ref``: the diff (incl.
+    working-tree edits) PLUS untracked files — a brand-new module is
+    exactly the one most likely to carry fresh violations, and a plain
+    ``git diff`` would hide it until ``git add``. None (-> USAGE_RC)
+    when git cannot answer."""
+    import subprocess
+    files: set[str] = set()
+    for cmd in (["git", "-C", root, "diff", "--name-only", ref, "--",
+                 "*.py"],
+                ["git", "-C", root, "ls-files", "--others",
+                 "--exclude-standard", "--", "*.py"]):
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            print(f"lint: --changed: {' '.join(cmd[3:5])} failed: {e}",
+                  file=sys.stderr)
+            return None
+        if out.returncode != 0:
+            print(f"lint: --changed: {' '.join(cmd[3:5])} vs {ref!r} "
+                  f"failed: {out.stderr.strip()}", file=sys.stderr)
+            return None
+        files.update(ln.strip().replace(os.sep, "/")
+                     for ln in out.stdout.splitlines() if ln.strip())
+    return files
 
 
 def main(argv=None) -> int:
@@ -56,6 +88,11 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default=None,
                     help="repo root for relative finding paths (default: "
                          "inferred; fixture tests pass the fixture dir)")
+    ap.add_argument("--changed", default=None, metavar="GIT_REF",
+                    help="scoped pre-commit mode: lint only files "
+                         "changed vs GIT_REF plus their importers from "
+                         "the project model (verify.sh phase 0 keeps the "
+                         "full-tree run)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -80,8 +117,34 @@ def main(argv=None) -> int:
     root = os.path.abspath(args.root) if args.root else _REPO
 
     project = model.load_project(paths, root)
-    findings = core.run_rules(project, only)
     baseline = {} if args.no_baseline else core.load_baseline(args.baseline)
+    if args.changed is not None:
+        if args.update_baseline:
+            # write_baseline rewrites the WHOLE file from the current
+            # finding set — under a scoped run that would silently drop
+            # every out-of-scope entry and its hand-written
+            # justification, then fail the next full-tree gate
+            print("lint: --changed cannot be combined with "
+                  "--update-baseline (the rewrite needs the full-tree "
+                  "finding set)", file=sys.stderr)
+            return core.USAGE_RC
+        changed = _changed_files(args.changed, root)
+        if changed is None:
+            return core.USAGE_RC
+        scope = model.changed_closure(project, changed)
+        project = model.Project(
+            [m for m in project.modules if m.rel in scope])
+        # rules that need the full project universe (the metrics rule's
+        # docs-runbook check) consult this to stay silent in scoped mode
+        project.scoped = True
+        # baseline entries for files outside the scope are neither
+        # judged nor reported retired — this run never analyzed them
+        baseline = {k: v for k, v in baseline.items()
+                    if k.split(":", 1)[0] in scope}
+        print(f"lint: --changed {args.changed}: {len(changed)} changed "
+              f"file(s), {len(scope)} analyzed with importers",
+              file=sys.stderr)
+    findings = core.run_rules(project, only)
 
     if args.update_baseline:
         # ALWAYS read the file here, even under --no-baseline: the rewrite
@@ -95,7 +158,8 @@ def main(argv=None) -> int:
                     json_path=args.json)
         return 0
 
-    new, _retired = core.report(findings, baseline, json_path=args.json)
+    new, _retired = core.report(findings, baseline, json_path=args.json,
+                                scoped=args.changed is not None)
     return core.REGRESSION_RC if new else 0
 
 

@@ -22,6 +22,7 @@ reason in a comment next to the pragma (docs/LINT.md has the policy).
 
 from __future__ import annotations
 
+import ast
 import dataclasses
 import json
 import re
@@ -87,15 +88,50 @@ def register(rule_cls: type[Rule]) -> type[Rule]:
 _PRAGMA_RE = re.compile(r"#\s*graftlint:\s*disable=([a-z0-9_,\- ]+)")
 
 
+def _pragma_spans(module: ModuleInfo) -> list[tuple[int, int]]:
+    """Header spans a suppression must cover as a unit: a def/class's
+    decorator-to-signature block and a (possibly multi-line) ``with``
+    header. A pragma anywhere in the span — or on the line above it —
+    suppresses findings attributed to any line of the span, so
+    ``# graftlint: disable=`` above a decorated ``def`` (whose physical
+    line-above is the last decorator) and inside a wrapped ``with``
+    header both work. Cached on the module (one AST pass)."""
+    spans = getattr(module, "_graftlint_pragma_spans", None)
+    if spans is not None:
+        return spans
+    spans = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            start = min([d.lineno for d in node.decorator_list]
+                        + [node.lineno])
+            end = node.body[0].lineno - 1 if node.body else node.lineno
+            spans.append((start, max(start, end)))
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            end = node.body[0].lineno - 1 if node.body else node.lineno
+            if end > node.lineno:  # multi-line header only
+                spans.append((node.lineno, end))
+    module._graftlint_pragma_spans = spans  # type: ignore[attr-defined]
+    return spans
+
+
+def _pragma_names(module: ModuleInfo, line: int) -> set[str]:
+    m = _PRAGMA_RE.search(module.line(line))
+    if not m:
+        return set()
+    return {r.strip() for r in m.group(1).split(",")}
+
+
 def suppressed(module: ModuleInfo, line: int, rule_id: str) -> bool:
-    """True when the finding's line (or the line above it, for findings
-    on long wrapped statements) carries a disable pragma naming the
-    rule."""
-    for ln in (line, line - 1):
-        m = _PRAGMA_RE.search(module.line(ln))
-        if m and rule_id in {r.strip() for r in m.group(1).split(",")}:
-            return True
-    return False
+    """True when the finding's line, the line above it, or — for
+    findings inside a decorated-def / multi-line-``with`` header span —
+    any line of that span (or the line above the span) carries a
+    disable pragma naming the rule."""
+    candidates = {line, line - 1}
+    for start, end in _pragma_spans(module):
+        if start <= line <= end:
+            candidates.update(range(start - 1, end + 1))
+    return any(rule_id in _pragma_names(module, ln) for ln in candidates)
 
 
 def run_rules(project: Project,
@@ -158,7 +194,7 @@ def write_baseline(path: str, findings: list[Finding],
 # ---- report ------------------------------------------------------------
 
 def report(findings: list[Finding], baseline: dict[str, str],
-           *, json_path: str | None = None,
+           *, json_path: str | None = None, scoped: bool = False,
            out=None) -> tuple[list[Finding], list[str]]:
     """Print the human report; return (new findings, retired keys)."""
     if out is None:
@@ -172,7 +208,33 @@ def report(findings: list[Finding], baseline: dict[str, str],
     for k in retired:
         print(f"retired (fixed — tighten with --update-baseline): {k}",
               file=out)
+    deltas = ""
     if json_path:
+        # per-rule deltas vs the PREVIOUS report at this path, when one
+        # exists (verify.sh writes LINT_report.json in place each run, so
+        # the summary line trends finding movement next to BENCH_*.json).
+        # Scoped (--changed) runs neither compute deltas nor count as a
+        # trend point: partial counts vs full-tree counts would print
+        # large spurious deltas either way — the scoped flag in the
+        # payload tells the next full run to skip the comparison.
+        prev = None
+        try:
+            with open(json_path, encoding="utf-8") as f:
+                prev = json.load(f)
+        except (OSError, ValueError):
+            prev = None
+        by_rule = _by_rule(findings)
+        prev_by_rule = prev.get("by_rule") if isinstance(prev, dict) \
+            else None
+        if (not scoped and isinstance(prev_by_rule, dict)
+                and not prev.get("scoped")):
+            parts = []
+            for rule in sorted(set(by_rule) | set(prev_by_rule)):
+                d = by_rule.get(rule, 0) - int(prev_by_rule.get(rule, 0))
+                if d:
+                    parts.append(f"d({rule})={d:+d}")
+            if parts:
+                deltas = " " + " ".join(parts)
         payload = {
             "findings": [dataclasses.asdict(f) | {"key": f.key(),
                                                   "new": f.key() not in
@@ -181,13 +243,15 @@ def report(findings: list[Finding], baseline: dict[str, str],
             "new": len(new),
             "baseline": len(baseline),
             "retired": retired,
-            "by_rule": _by_rule(findings),
+            "by_rule": by_rule,
+            "scoped": scoped,
         }
         with open(json_path, "w", encoding="utf-8") as f:
             json.dump(payload, f, indent=2, sort_keys=True)
             f.write("\n")
     # the one summary line tools/verify.sh surfaces for its GRAFTLINT phase
-    print(f"GRAFTLINT new={len(new)} baseline={len(baseline)}", file=out)
+    print(f"GRAFTLINT new={len(new)} baseline={len(baseline)}" + deltas,
+          file=out)
     return new, retired
 
 

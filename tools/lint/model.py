@@ -42,6 +42,12 @@ class ModuleInfo:
         return ""
 
 
+def _classish(name: str) -> bool:
+    """CamelCase-shaped identifier, tolerating the private-class
+    convention (``_DiskTier``, ``_Session``)."""
+    return name.lstrip("_")[:1].isupper()
+
+
 def _annotation_names(node: ast.AST | None) -> list[str]:
     """Candidate class names in an annotation: ``Batcher | None`` →
     ["Batcher"], ``"ServeEngine"`` (string annotation) → ["ServeEngine"]."""
@@ -54,9 +60,9 @@ def _annotation_names(node: ast.AST | None) -> list[str]:
         except SyntaxError:
             return []
     for sub in ast.walk(node):
-        if isinstance(sub, ast.Name) and sub.id[:1].isupper():
+        if isinstance(sub, ast.Name) and _classish(sub.id):
             out.append(sub.id)
-        elif isinstance(sub, ast.Attribute) and sub.attr[:1].isupper():
+        elif isinstance(sub, ast.Attribute) and _classish(sub.attr):
             out.append(sub.attr)
     return out
 
@@ -68,9 +74,9 @@ def _value_type_names(value: ast.AST, param_types: dict[str, list[str]]
         return param_types.get(value.id, [])
     if isinstance(value, ast.Call):
         f = value.func
-        if isinstance(f, ast.Name) and f.id[:1].isupper():
+        if isinstance(f, ast.Name) and _classish(f.id):
             return [f.id]
-        if isinstance(f, ast.Attribute) and f.attr[:1].isupper():
+        if isinstance(f, ast.Attribute) and _classish(f.attr):
             return [f.attr]
         return []
     if isinstance(value, ast.IfExp):
@@ -104,14 +110,25 @@ class ClassInfo:
                           + meth.args.kwonlyargs)
             }
             for sub in ast.walk(meth):
-                if not isinstance(sub, ast.Assign):
+                if isinstance(sub, ast.Assign):
+                    targets, names = sub.targets, _value_type_names(
+                        sub.value, param_types)
+                elif isinstance(sub, ast.AnnAssign):
+                    # `self.tiers: SessionTiers | None = tiers` — the
+                    # annotation is the declared type; fall back to the
+                    # value's inferred type when the annotation names no
+                    # project class
+                    targets = [sub.target]
+                    names = (_annotation_names(sub.annotation)
+                             or (_value_type_names(sub.value, param_types)
+                                 if sub.value is not None else []))
+                else:
                     continue
-                for tgt in sub.targets:
+                for tgt in targets:
                     if (isinstance(tgt, ast.Attribute)
                             and isinstance(tgt.value, ast.Name)
                             and tgt.value.id == "self"
                             and tgt.attr not in self.attr_types):
-                        names = _value_type_names(sub.value, param_types)
                         if names:
                             self.attr_types[tgt.attr] = names
 
@@ -179,6 +196,30 @@ class Project:
         return None
 
 
+def self_call_closure(cls: ClassInfo, roots) -> set[str]:
+    """Method names reachable from ``roots`` through ``self.m()`` calls
+    (transitively). The ONE implementation of the scheduler/stop-path
+    closure walk shared by the host-sync, swallowed-exception and
+    thread-lifecycle rules — closure semantics must not drift apart
+    between them."""
+    out: set[str] = set()
+    stack = [r for r in roots if r in cls.methods]
+    while stack:
+        name = stack.pop()
+        if name in out:
+            continue
+        out.add(name)
+        for sub in ast.walk(cls.methods[name]):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == "self"
+                    and sub.func.attr in cls.methods
+                    and sub.func.attr not in out):
+                stack.append(sub.func.attr)
+    return out
+
+
 def local_alias_types(fn: ast.FunctionDef, project: Project,
                       cls: ClassInfo | None) -> dict[str, list[str]]:
     """Types of simple local aliases in one function body: parameters by
@@ -196,6 +237,358 @@ def local_alias_types(fn: ast.FunctionDef, project: Project,
             if got is not None:
                 out.setdefault(target, []).append(got.name)
     return out
+
+
+def _dotted_name(rel: str) -> str:
+    """Repo-relative path -> importable dotted name
+    (``lstm_tensorspark_tpu/serve/batcher.py`` ->
+    ``lstm_tensorspark_tpu.serve.batcher``; ``__init__.py`` names the
+    package)."""
+    parts = rel[:-3].split("/") if rel.endswith(".py") else rel.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _imported_names(module: ModuleInfo) -> set[str]:
+    """Dotted names this module imports, with relative imports resolved
+    against its own package."""
+    out: set[str] = set()
+    # the package context level-1 relative imports resolve against: for
+    # a plain module that is its CONTAINING package (pkg.sub.mod -> from
+    # . import x means pkg.sub.x); for an __init__.py the dotted name
+    # already IS the package
+    parts = _dotted_name(module.rel).split(".")
+    is_pkg = module.rel.endswith("__init__.py")
+    ctx = parts if is_pkg else parts[:-1]
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            out.update(a.name for a in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # from ..x import y: level 1 = own package, each extra
+                # level climbs one more
+                climb = node.level - 1
+                base_parts = ctx[: len(ctx) - climb] if climb <= len(
+                    ctx) else []
+                base = ".".join(base_parts)
+                if node.module:
+                    base = f"{base}.{node.module}" if base else node.module
+            else:
+                base = node.module or ""
+            if base:
+                out.add(base)
+            for a in node.names:
+                out.add(f"{base}.{a.name}" if base else a.name)
+    return out
+
+
+def changed_closure(project: Project, changed_rels: set[str]) -> set[str]:
+    """``changed_rels`` plus every analyzed module that imports one of
+    them, plus the modules the changed files themselves import (one hop
+    each way). The --changed scoped mode lints this closure only: a
+    signature/contract change shows up in the module or its importers,
+    and the changed files' own imports must be IN the model or
+    cross-module resolution degrades and invents findings the full-tree
+    gate doesn't have (a scoped run may only under-report, never
+    over-report). Full-tree coverage stays verify.sh phase 0's job."""
+    targets: set[str] = set()
+    for rel in changed_rels:
+        name = _dotted_name(rel)
+        if name:
+            targets.add(name)
+    out = set(changed_rels) & set(project.by_rel)
+    by_name = {_dotted_name(m.rel): m.rel for m in project.modules}
+    # imports OF the changed files (the resolution universe)
+    for rel in list(out):
+        for imported in _imported_names(project.by_rel[rel]):
+            for name, mrel in by_name.items():
+                if imported == name or imported.startswith(name + "."):
+                    out.add(mrel)
+    for module in project.modules:
+        if module.rel in out:
+            continue
+        for imported in _imported_names(module):
+            if any(imported == t or imported.startswith(t + ".")
+                   or t.startswith(imported + ".")
+                   for t in targets):
+                out.add(module.rel)
+                break
+    return out
+
+
+# ---- CFG-lite ----------------------------------------------------------
+#
+# A statement-granular control-flow graph per function: branch/loop
+# edges, try/except/finally edges, return/raise exits. Built for the
+# lifecycle rules (resource-pairing needs "is this resource released on
+# EVERY path out of the function, including exception exits"), and
+# deliberately small: nodes are statements, expression evaluation order
+# inside one statement is not modeled, and `finally` re-entry is
+# approximated (the finally body is built once; its last node gets extra
+# edges to EXIT/RAISE for the abnormal-exit flows routed through it).
+# The approximations all err toward EXTRA paths, which for a may-
+# analysis ("exists a path where the resource is still held") means a
+# rule can over-report only on code whose control flow is already too
+# clever — and the fixture suite pins the shapes that must stay silent.
+
+#: symbolic terminals (negative so they never collide with node ids)
+CFG_EXIT = -1   # normal completion: return / fall off the end
+CFG_RAISE = -2  # uncaught exception leaves the function
+
+
+def _own_exprs(stmt: ast.stmt) -> list[ast.AST]:
+    """The expressions evaluated BY this statement itself — excluding
+    nested statement bodies (those are their own CFG nodes) and nested
+    function definitions (separate execution contexts)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, ast.For):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        out: list[ast.AST] = []
+        for item in stmt.items:
+            out.append(item.context_expr)
+            if item.optional_vars is not None:
+                out.append(item.optional_vars)
+        return out
+    if isinstance(stmt, ast.Return):
+        return [] if stmt.value is None else [stmt.value]
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, (ast.Assign,)):
+        return [stmt.value, *stmt.targets]
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.value, stmt.target]
+    if isinstance(stmt, ast.AnnAssign):
+        return [e for e in (stmt.value, stmt.target) if e is not None]
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, (ast.Assert,)):
+        return [e for e in (stmt.test, stmt.msg) if e is not None]
+    if isinstance(stmt, ast.Delete):
+        return list(stmt.targets)
+    if isinstance(stmt, (ast.Try, ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []
+    return []
+
+
+def handler_catches_all(handler: ast.ExceptHandler) -> bool:
+    """Bare ``except`` or ``except Exception``/``BaseException`` (also
+    inside tuples/attribute forms) — the ONE catch-all definition shared
+    by the CFG's try wiring and the swallowed-exception rule."""
+    if handler.type is None:
+        return True
+    names = {n.attr if isinstance(n, ast.Attribute)
+             else getattr(n, "id", "")
+             for n in ast.walk(handler.type)}
+    return bool(names & {"Exception", "BaseException"})
+
+
+def stmt_may_raise(stmt: ast.stmt) -> bool:
+    """Whether this statement's OWN expressions can raise: any call (or
+    an explicit raise). Attribute/subscript errors are ignored — calls
+    are where IO, device work and lock operations live."""
+    if isinstance(stmt, ast.Raise):
+        return True
+    for expr in _own_exprs(stmt):
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                return True
+    return False
+
+
+class CFG:
+    """Per-function CFG (see the section comment above). Public surface:
+    ``stmts`` (node id -> statement), ``succ`` (normal-flow successor
+    ids / terminals), ``exc_succ`` (where control may go when the node's
+    own expressions raise), ``entry``."""
+
+    def __init__(self, fn: ast.FunctionDef):
+        self.stmts: list[ast.stmt] = []
+        self.succ: list[list[int]] = []
+        self.exc_succ: list[list[int]] = []
+        self.entry = self._build_body(fn.body, CFG_EXIT, (CFG_RAISE,),
+                                      None, None, None)
+
+    def _node(self, stmt: ast.stmt, exc: tuple[int, ...]) -> int:
+        nid = len(self.stmts)
+        self.stmts.append(stmt)
+        self.succ.append([])
+        self.exc_succ.append(list(exc) if stmt_may_raise(stmt) else [])
+        return nid
+
+    def _build_body(self, body: list[ast.stmt], follow: int,
+                    exc: tuple[int, ...], brk: int | None,
+                    cont: int | None, fin: int | None) -> int:
+        """Wire ``body`` so it flows to ``follow``; returns its entry.
+        ``fin`` is the innermost enclosing finally entry (within this
+        function): abnormal exits (return/break/continue) route through
+        it — the finally's tail carries the extra EXIT/RAISE edges."""
+        entry = follow
+        for stmt in reversed(body):
+            entry = self._build_stmt(stmt, entry, exc, brk, cont, fin)
+        return entry
+
+    def _build_stmt(self, stmt: ast.stmt, follow: int,
+                    exc: tuple[int, ...], brk: int | None,
+                    cont: int | None, fin: int | None) -> int:
+        if isinstance(stmt, ast.If):
+            nid = self._node(stmt, exc)
+            self.succ[nid].append(
+                self._build_body(stmt.body, follow, exc, brk, cont, fin))
+            self.succ[nid].append(
+                self._build_body(stmt.orelse, follow, exc, brk, cont,
+                                 fin)
+                if stmt.orelse else follow)
+            return nid
+        if isinstance(stmt, (ast.While, ast.For)):
+            nid = self._node(stmt, exc)
+            body_entry = self._build_body(stmt.body, nid, exc,
+                                          brk=follow, cont=nid, fin=fin)
+            self.succ[nid].append(body_entry)
+            self.succ[nid].append(
+                self._build_body(stmt.orelse, follow, exc, brk, cont,
+                                 fin)
+                if stmt.orelse else follow)
+            return nid
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            nid = self._node(stmt, exc)
+            self.succ[nid].append(
+                self._build_body(stmt.body, follow, exc, brk, cont, fin))
+            return nid
+        if isinstance(stmt, ast.Try):
+            return self._build_try(stmt, follow, exc, brk, cont, fin)
+        if isinstance(stmt, ast.Return):
+            nid = self._node(stmt, exc)
+            # a return inside try/finally runs the finally first (its
+            # tail has the extra EXIT edge)
+            self.succ[nid].append(CFG_EXIT if fin is None else fin)
+            return nid
+        if isinstance(stmt, ast.Raise):
+            nid = self._node(stmt, exc)
+            # normal flow ends here; the raise itself follows exc edges
+            return nid
+        if isinstance(stmt, ast.Break):
+            nid = self._node(stmt, exc)
+            self.succ[nid].append(follow if brk is None else brk)
+            return nid
+        if isinstance(stmt, ast.Continue):
+            nid = self._node(stmt, exc)
+            self.succ[nid].append(follow if cont is None else cont)
+            return nid
+        # nested defs/classes and simple statements: one node, straight
+        # through (nested bodies are separate execution contexts)
+        nid = self._node(stmt, exc)
+        self.succ[nid].append(follow)
+        return nid
+
+    def _build_try(self, stmt: ast.Try, follow: int, exc: tuple[int, ...],
+                   brk: int | None, cont: int | None,
+                   fin: int | None) -> int:
+        after = follow
+        fin_entry = None
+        if stmt.finalbody:
+            lo = len(self.stmts)
+            fin_entry = self._build_body(stmt.finalbody, after, exc,
+                                         brk, cont, fin)
+            # abnormal exits route through the finally too: give its
+            # last-reachable flow extra edges to EXIT and RAISE (the
+            # finally body was built once — this over-approximates by
+            # letting every execution "exit abnormally", which only adds
+            # paths, never hides one)
+            for nid in range(lo, len(self.stmts)):
+                succ = self.succ[nid]
+                if after in succ:
+                    succ.extend(t for t in (CFG_EXIT, CFG_RAISE)
+                                if t not in succ)
+            after = fin_entry
+        # everything leaving the try region abnormally runs the finally
+        # first: handler bodies' own exceptions (incl. a re-raise),
+        # else-body exceptions, and return/break/continue out of the
+        # body — without this, try/except-reraise/finally-release would
+        # read as skipping the release
+        inner_fin = fin_entry if fin_entry is not None else fin
+        inner_exc = (fin_entry,) if fin_entry is not None else exc
+        inner_brk = fin_entry if (fin_entry is not None
+                                  and brk is not None) else brk
+        inner_cont = fin_entry if (fin_entry is not None
+                                   and cont is not None) else cont
+        handler_entries = []
+        catch_all = False
+        for handler in stmt.handlers:
+            handler_entries.append(
+                self._build_body(handler.body, after, inner_exc,
+                                 inner_brk, inner_cont, inner_fin))
+            if handler_catches_all(handler):
+                catch_all = True
+        body_exc: tuple[int, ...] = tuple(handler_entries)
+        if not catch_all:
+            # unmatched exceptions escape the handlers: through the
+            # finally when there is one, else out of the function
+            body_exc += (fin_entry,) if fin_entry is not None else exc
+        elif not handler_entries and fin_entry is not None:
+            body_exc = (fin_entry,)
+        body_follow = after
+        if stmt.orelse:
+            # else runs only when the body completed without raising:
+            # wire body -> else -> after. Else-body exceptions are NOT
+            # caught by this try's handlers — they route through the
+            # finally (or out)
+            body_follow = self._build_body(stmt.orelse, after, inner_exc,
+                                           inner_brk, inner_cont,
+                                           inner_fin)
+        return self._build_body(stmt.body, body_follow, body_exc,
+                                inner_brk, inner_cont, inner_fin)
+
+
+# ---- resource registry --------------------------------------------------
+#
+# Acquire/release call shapes the lifecycle rules pair up. Each entry:
+# acquire method names -> (release method names, leak-tracked?). Plain
+# `acquire`/`release` is registered but NOT leak-tracked: StateCache's
+# acquire transfers ownership to the cache's own LRU table (an unpinned
+# slot is always reclaimable, so "not released" is routinely the correct
+# ownership transfer, e.g. kept sessions). Pinned slots and in-flight
+# counters are the leakable kinds — a pinned slot is unevictable and a
+# wedged counter blocks flush() forever (the PR 7/PR 8 classes).
+
+RESOURCE_PAIRS: dict[str, dict] = {
+    "pin": {"acquire": {"pin", "acquire_pinned"},
+            "release": {"unpin", "release"},
+            "tracked": True},
+    "slot": {"acquire": {"acquire"}, "release": {"release"},
+             "tracked": False},
+    "handle": {"acquire": {"open"}, "release": {"close"},
+               "tracked": True},
+    # thread start/stop pairing is structural (owner's stop()/close()
+    # must reach a join or a signal the worker loop reads) and lives in
+    # rules_threads rather than the per-function dataflow
+    "thread": {"acquire": {"start"}, "release": {"join", "close"},
+               "tracked": False},
+}
+
+
+def resource_kind_of_call(call: ast.Call) -> tuple[str, str] | None:
+    """('kind', 'acquire'|'release') for a call matching a tracked
+    resource shape, else None. ``open(...)`` matches as a Name call;
+    the slot/pin shapes as attribute calls (``cache.pin(sid)``)."""
+    f = call.func
+    name = (f.attr if isinstance(f, ast.Attribute)
+            else f.id if isinstance(f, ast.Name) else None)
+    if name is None:
+        return None
+    for kind, spec in RESOURCE_PAIRS.items():
+        if not spec["tracked"]:
+            continue
+        if name in spec["acquire"]:
+            if kind == "handle" and not isinstance(f, ast.Name):
+                continue  # only the builtin open(); obj.open() is opaque
+            return kind, "acquire"
+        if name in spec["release"]:
+            return kind, "release"
+    return None
 
 
 def load_project(paths: list[str], repo_root: str) -> Project:

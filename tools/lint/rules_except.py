@@ -1,0 +1,74 @@
+"""``swallowed-exception``: catch-all ``except: pass`` in scheduler /
+worker closures.
+
+The serve plane's scheduler and spill-worker threads are the ONLY
+execution context for their work — an exception swallowed there doesn't
+bubble to a client or a log, it just silently drops a request, a spill,
+or a checkpoint. The honest patterns this repo uses everywhere are: a
+metric/counter (``disk_errors += 1`` + ``serve_tier_lost_total``), a
+``print(..., flush=True)`` breadcrumb, a re-raise, or a NARROW
+exception type documenting the expected absence (``except ValueError``
+around a list remove). What must not land is ``except Exception:
+pass`` in the hot loop — the shape every review round has to hunt by
+hand.
+
+Scope (under-approximate): methods in the ``run``/``step``/``drain``
+closure of the designated scheduler classes (rules_hostsync
+``SCHEDULER_CLASSES`` — the same scope the host-sync rule polices),
+including nested worker closures defined inside them. A handler counts
+as swallowing when it catches everything (bare ``except``, ``except
+Exception``/``BaseException``) and its body is only ``pass`` /
+``continue``. Narrow types stay legal anywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .model import Project, handler_catches_all, self_call_closure
+from .rules_hostsync import _SCHEDULER_ENTRIES, SCHEDULER_CLASSES
+
+
+def _body_swallows(handler: ast.ExceptHandler) -> bool:
+    return all(isinstance(s, (ast.Pass, ast.Continue))
+               for s in handler.body)
+
+
+@register
+class SwallowedExceptionRule(Rule):
+    id = "swallowed-exception"
+    doc = ("Catch-all except with a pass/continue body inside the "
+           "scheduler hot loop (Batcher/SessionTiers run/step/drain "
+           "closures) — failures there have no other surface; count a "
+           "metric, log, or re-raise. Narrow exception types stay "
+           "legal.")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in project.modules:
+            for cls in module.classes.values():
+                if cls.name not in SCHEDULER_CLASSES:
+                    continue
+                for meth_name in sorted(self._closure(cls)):
+                    meth = cls.methods.get(meth_name)
+                    if meth is None:
+                        continue
+                    for sub in ast.walk(meth):
+                        if not isinstance(sub, ast.ExceptHandler):
+                            continue
+                        if handler_catches_all(sub) and _body_swallows(sub):
+                            what = ("bare except" if sub.type is None
+                                    else "except "
+                                    + ast.unparse(sub.type))
+                            findings.append(Finding(
+                                self.id, module.rel, sub.lineno,
+                                f"{what}: pass in scheduler hot path "
+                                f"{cls.name}.{meth_name}() swallows "
+                                "failures — count a metric, log, or "
+                                "re-raise"))
+        return findings
+
+    @staticmethod
+    def _closure(cls) -> set[str]:
+        return self_call_closure(cls, _SCHEDULER_ENTRIES)

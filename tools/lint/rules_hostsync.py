@@ -33,7 +33,7 @@ from __future__ import annotations
 import ast
 
 from .core import Finding, Rule, register
-from .model import ModuleInfo, Project
+from .model import ModuleInfo, Project, self_call_closure
 
 #: classes whose run/step/drain closure is the serving hot loop (the
 #: batcher's scheduler iteration, and the tiered cache's spill worker —
@@ -194,19 +194,4 @@ class HostSyncRule(Rule):
 
     @staticmethod
     def _closure(cls) -> set[str]:
-        out: set[str] = set()
-        stack = [m for m in _SCHEDULER_ENTRIES if m in cls.methods]
-        while stack:
-            name = stack.pop()
-            if name in out:
-                continue
-            out.add(name)
-            for sub in ast.walk(cls.methods[name]):
-                if (isinstance(sub, ast.Call)
-                        and isinstance(sub.func, ast.Attribute)
-                        and isinstance(sub.func.value, ast.Name)
-                        and sub.func.value.id == "self"
-                        and sub.func.attr in cls.methods
-                        and sub.func.attr not in out):
-                    stack.append(sub.func.attr)
-        return out
+        return self_call_closure(cls, _SCHEDULER_ENTRIES)

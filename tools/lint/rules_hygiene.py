@@ -82,21 +82,89 @@ class ExitCodeLiteralRule(Rule):
         return findings
 
 
+def _is_timedelta(node: ast.AST) -> bool:
+    """``timedelta(...)`` / ``datetime.timedelta(...)`` — subtracting a
+    timedelta from now() computes a wall-clock INSTANT (age gates,
+    retention cutoffs), not a duration; that is the legitimate use."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = (f.attr if isinstance(f, ast.Attribute)
+            else f.id if isinstance(f, ast.Name) else "")
+    return name == "timedelta"
+
+
+def _is_datetime_now(call: ast.Call) -> bool:
+    """``datetime.now()`` / ``datetime.datetime.now()`` / ``utcnow()``."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr in ("now", "utcnow")):
+        return False
+    recv = f.value
+    return ((isinstance(recv, ast.Name) and recv.id == "datetime")
+            or (isinstance(recv, ast.Attribute)
+                and recv.attr == "datetime"))
+
+
 @register
 class WallclockTimingRule(Rule):
     id = "wallclock-timing"
-    doc = ("time.time() in measurement code — durations, latencies and "
-           "backoff must use time.monotonic()/perf_counter() (wall clock "
-           "slews under NTP). Suppress with a reason where wall-clock "
-           "semantics are the point (file-mtime comparisons, record "
-           "timestamps for humans).")
+    doc = ("time.time() — also via `from time import time` aliases — "
+           "and datetime.now() subtractions in measurement code: "
+           "durations, latencies and backoff must use time.monotonic()/"
+           "perf_counter() (wall clock slews under NTP). Suppress with "
+           "a reason where wall-clock semantics are the point "
+           "(file-mtime comparisons, record timestamps for humans).")
 
     def run(self, project: Project) -> list[Finding]:
         findings: list[Finding] = []
         for module in project.modules:
+            # `from time import time [as alias]`: the bare-name spelling
+            # of the same wall-clock read must not dodge the rule
+            aliases: set[str] = set()
             for node in ast.walk(module.tree):
-                if (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Attribute)
+                if isinstance(node, ast.ImportFrom) \
+                        and node.module == "time":
+                    for a in node.names:
+                        if a.name == "time":
+                            aliases.add(a.asname or a.name)
+            # names bound to a datetime.now() result, PER FUNCTION scope
+            # (name reuse across functions must not cross-contaminate)
+            now_names: dict[int, set[str]] = {}
+            scopes: list[ast.AST] = [module.tree]
+            scopes.extend(n for n in ast.walk(module.tree)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)))
+            owner: dict[int, int] = {}  # id(node) -> scope index
+            for i, scope in enumerate(scopes):
+                names: set[str] = set()
+                for node in self._scope_walk(scope):
+                    owner.setdefault(id(node), i)
+                    if (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)
+                            and _is_datetime_now(node.value)):
+                        names.update(t.id for t in node.targets
+                                     if isinstance(t, ast.Name))
+                now_names[i] = names
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    if (isinstance(node, ast.BinOp)
+                            and isinstance(node.op, ast.Sub)
+                            and not any(_is_timedelta(side) for side
+                                        in (node.left, node.right))
+                            and any(
+                                (isinstance(side, ast.Call)
+                                 and _is_datetime_now(side))
+                                or (isinstance(side, ast.Name)
+                                    and side.id in now_names.get(
+                                        owner.get(id(node), 0), set()))
+                                for side in (node.left, node.right))):
+                        findings.append(Finding(
+                            self.id, module.rel, node.lineno,
+                            "datetime.now() used for a duration "
+                            "(subtraction) — wall clock slews; use "
+                            "time.monotonic()/perf_counter()"))
+                    continue
+                if (isinstance(node.func, ast.Attribute)
                         and node.func.attr == "time"
                         and isinstance(node.func.value, ast.Name)
                         and node.func.value.id == "time"):
@@ -105,7 +173,26 @@ class WallclockTimingRule(Rule):
                         "time.time() — use time.monotonic() (or "
                         "perf_counter) unless wall-clock semantics are "
                         "required (then suppress with the reason)"))
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in aliases):
+                    findings.append(Finding(
+                        self.id, module.rel, node.lineno,
+                        f"{node.func.id}() is `from time import time` — "
+                        "the same wall-clock read; use time.monotonic() "
+                        "(or perf_counter)"))
         return findings
+
+    @staticmethod
+    def _scope_walk(scope: ast.AST):
+        """Walk a scope's own nodes without descending into nested
+        function definitions (their locals are their own)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
 
 
 def _is_import_section_stmt(stmt: ast.stmt, *, first: bool) -> bool:

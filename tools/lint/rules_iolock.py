@@ -1,0 +1,211 @@
+"""``io-under-lock``: no blocking filesystem or device-sync call may
+execute — directly or through any resolvable callee — inside a
+``with``-region of the serve plane's designated hot locks.
+
+The hot locks are the ones every request crosses: the shared state/
+prefix/tier cache RLock, the batcher's scheduler lock, the router's
+global admission lock, and the disk tier's index lock. PR 8 fixed this
+class THREE TIMES in review (rounds 1–3): ``fill``'s disk read+verify
+under the shared cache lock, the eviction listener's ``has`` stat under
+the hot lock, and ``fill_ahead``'s potential file IO under the router's
+global lock. One fsync under the shared lock stalls every admission,
+health probe and scheduler iteration behind the filesystem.
+
+Blocking shapes: ``open``/``os.replace``/``os.remove``/``os.rename``/
+``os.unlink``/``os.listdir``/``os.scandir``/``os.makedirs``/
+``os.fsync``/``shutil.*``, the durability core ``atomic_write``/
+``read_verified``, ``time.sleep``, and the device syncs
+``jax.device_get`` / ``fetch_detached`` / ``fetch_detached_batch``.
+Metadata probes (``os.path.exists``/``os.stat``) are deliberately NOT
+in the set: the router's disk-residency probe does one deduped stat per
+session directory under its global lock by design (PR 8 round 3), and a
+stat is bounded in a way data IO is not.
+
+Resolution is the project model's (under-approximate): a callee the
+model cannot resolve is silent, so the rule misses rather than guesses.
+Lock identity comes from the lock-order rule's union-found world, so
+the shared-RLock alias (``PrefixCache._lock = cache._lock``) is one
+identity — holding it through ANY alias counts.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .model import ClassInfo, ModuleInfo, Project, local_alias_types
+from .rules_locks import _attr_chain_lock, analyze
+
+#: classes whose locks are the serve plane's hot locks (fixtures use the
+#: same names — matching mirrors rules_hostsync.SCHEDULER_CLASSES)
+HOT_LOCK_CLASSES = {"StateCache", "PrefixCache", "SessionTiers",
+                    "Batcher", "Router", "_DiskTier"}
+
+_BLOCKING_NAME_CALLS = {"open", "atomic_write", "read_verified"}
+_BLOCKING_OS_CALLS = {"replace", "remove", "rename", "unlink", "listdir",
+                      "scandir", "makedirs", "fsync"}
+_BLOCKING_ATTR_CALLS = {"atomic_write", "read_verified",
+                        "fetch_detached", "fetch_detached_batch"}
+
+
+def _blocking_desc(call: ast.Call) -> str | None:
+    """Short description when ``call`` is a blocking shape, else None."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id in _BLOCKING_NAME_CALLS:
+            return f"{f.id}()"
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv = f.value
+    if isinstance(recv, ast.Name):
+        if recv.id == "os" and f.attr in _BLOCKING_OS_CALLS:
+            return f"os.{f.attr}()"
+        if recv.id == "shutil":
+            return f"shutil.{f.attr}()"
+        if recv.id == "jax" and f.attr == "device_get":
+            return "jax.device_get()"
+        if recv.id == "time" and f.attr == "sleep":
+            return "time.sleep()"
+    if f.attr in _BLOCKING_ATTR_CALLS:
+        return f".{f.attr}()"
+    return None
+
+
+class _IoIndex:
+    """Per-function direct blocking shapes + transitive closure through
+    resolvable calls, memoized across the whole project."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self._memo: dict[tuple, str | None] = {}
+
+    def blocks_via(self, fn: ast.FunctionDef, cls: ClassInfo | None,
+                   module: ModuleInfo, _depth: int = 0) -> str | None:
+        """Description of a blocking call reachable from ``fn``, or
+        None. Depth-limited; cycles cut via the memo's in-progress
+        None."""
+        key = (module.rel, cls.name if cls else None, fn.name)
+        if key in self._memo:
+            return self._memo[key]
+        self._memo[key] = None  # cut recursion
+        found: str | None = None
+        if _depth <= 6:
+            ltypes = local_alias_types(fn, self.project, cls)
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                desc = _blocking_desc(sub)
+                if desc is not None:
+                    found = desc
+                    break
+                resolved = self.project.resolve_call(sub, module, cls,
+                                                     ltypes)
+                if resolved is None:
+                    continue
+                owner, callee = resolved
+                inner = self.blocks_via(
+                    callee, owner, owner.module if owner else module,
+                    _depth + 1)
+                if inner is not None:
+                    callee_disp = (f"{owner.name}.{callee.name}" if owner
+                                   else callee.name)
+                    found = f"{inner} via {callee_disp}"
+                    break
+        self._memo[key] = found
+        return found
+
+
+@register
+class IoUnderLockRule(Rule):
+    id = "io-under-lock"
+    doc = ("Blocking filesystem/device-sync calls (open, os.replace/"
+           "remove/listdir/fsync, atomic_write/read_verified, "
+           "jax.device_get, fetch_detached*) inside a with-region of a "
+           "designated hot lock (StateCache/PrefixCache/SessionTiers/"
+           "Batcher/Router/_DiskTier), directly or through any "
+           "resolvable callee.")
+
+    def run(self, project: Project) -> list[Finding]:
+        analysis = analyze(project)
+        world = analysis.world
+        hot_roots: set[str] = set()
+        for module in project.modules:
+            for cls in module.classes.values():
+                if cls.name not in HOT_LOCK_CLASSES:
+                    continue
+                for attr in world.class_lock_attrs(cls):
+                    root = world.root(cls, attr)
+                    if root is not None:
+                        hot_roots.add(root)
+        if not hot_roots:
+            return []
+        index = _IoIndex(project)
+        findings: list[Finding] = []
+        for module in project.modules:
+            for cls in module.classes.values():
+                for meth in cls.methods.values():
+                    findings.extend(self._scan(
+                        project, module, cls, meth, world, hot_roots,
+                        index))
+        return findings
+
+    def _scan(self, project, module, cls, fn, world, hot_roots,
+              index) -> list[Finding]:
+        findings: list[Finding] = []
+        local_types = local_alias_types(fn, project, cls)
+        where = f"{cls.name}.{fn.name}"
+
+        def walk(node: ast.AST, held: tuple[str, ...]) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    root = _attr_chain_lock(item.context_expr, project,
+                                            cls, local_types, world)
+                    if root is not None and root in hot_roots:
+                        acquired.append(root)
+                    else:
+                        walk(item.context_expr, held)
+                inner = held + tuple(a for a in acquired
+                                     if a not in held)
+                for stmt in node.body:
+                    walk(stmt, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: separate execution context (it may run on
+                # another thread without the lexical hold)
+                for stmt in node.body:
+                    walk(stmt, ())
+                return
+            if isinstance(node, ast.Call) and held:
+                desc = _blocking_desc(node)
+                if desc is not None:
+                    findings.append(Finding(
+                        self.id, module.rel, node.lineno,
+                        f"blocking {desc} runs inside the "
+                        f"{world.display(held[-1])} hot-lock region in "
+                        f"{where}() — move the IO outside the lock"))
+                else:
+                    resolved = project.resolve_call(node, module, cls,
+                                                    local_types)
+                    if resolved is not None:
+                        owner, callee = resolved
+                        via = index.blocks_via(
+                            callee, owner,
+                            owner.module if owner else module)
+                        if via is not None:
+                            callee_disp = (
+                                f"{owner.name}.{callee.name}"
+                                if owner else callee.name)
+                            findings.append(Finding(
+                                self.id, module.rel, node.lineno,
+                                f"{where}() calls {callee_disp} under "
+                                f"the {world.display(held[-1])} hot "
+                                f"lock, and it reaches blocking {via} — "
+                                "move the IO outside the lock"))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in fn.body:
+            walk(stmt, ())
+        return findings

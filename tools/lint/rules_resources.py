@@ -1,0 +1,429 @@
+"""``resource-pairing``: acquired resources must be released on every
+path out of the function — including exception exits.
+
+The resource registry (tools/lint/model.py ``RESOURCE_PAIRS``) pairs the
+acquire/release call shapes this repo's serve plane lives on:
+
+- **pinned slots** — ``pin(key)`` / ``acquire_pinned(key)`` paired with
+  ``unpin(key)`` / ``release(key)``. A pinned slot is unevictable, so a
+  path that exits without releasing wedges a cache slot forever — the
+  PR 7 "leaked pins" class (fail_inflight had to release every admitted
+  session's pin by hand after review caught it).
+- **in-flight counters** — ``self.x += e`` paired with ``self.x -= e``
+  in the same function. A raising call between the two skips the
+  decrement and wedges whoever waits on the counter — the PR 8 class
+  where a failed disk write could wedge ``flush()`` until the decrement
+  moved into ``run()``'s ``finally``.
+- **file handles** — ``f = open(...)`` paired with ``f.close()`` (the
+  ``with open(...)`` form never enters the analysis).
+
+Plain ``acquire``/``release`` is in the registry but deliberately NOT
+leak-tracked: StateCache.acquire transfers ownership to the cache's own
+LRU table, where an unpinned slot is always reclaimable — "acquired and
+not released" is the normal ownership transfer for kept sessions, not a
+leak.
+
+Per function: build the CFG-lite (model.py), then a may-analysis over
+it — a token is a finding when SOME path reaches the function's normal
+or exception exit still holding it. Exception edges carry ``pre ∩
+post`` state, so an acquire that raises was never acquired and a
+release that raises still counts as released (both under-approximate).
+
+Silence rules (under-approximate on purpose — docs/LINT.md):
+- counters activate only when the SAME function contains both the
+  ``+=`` and the ``-=`` of one attribute;
+- an acquire whose HANDLE (assignment result) is returned/yielded,
+  stored into an attribute/subscript, or passed to an unresolvable call
+  has transferred ownership and goes silent;
+- a KEY (e.g. the sid) that is returned/yielded or stored escapes too;
+  a key merely passed to calls stays tracked — unless the callee is
+  resolvable and its transitive closure contains a matching release
+  shape, in which case that call site counts as the release.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .model import (
+    CFG,
+    CFG_EXIT,
+    CFG_RAISE,
+    RESOURCE_PAIRS,
+    ClassInfo,
+    ModuleInfo,
+    Project,
+    local_alias_types,
+)
+
+
+class _Site:
+    """One tracked acquire site inside a function."""
+
+    __slots__ = ("kind", "key", "handles", "line", "display",
+                 "release_calls")
+
+    def __init__(self, kind: str, key: str | None, handles: set[str],
+                 line: int, display: str):
+        self.kind = kind
+        self.key = key          # ast.dump of the key expr (None: handle-only)
+        self.handles = handles  # local names bound to the acquire result
+        self.line = line
+        self.display = display
+        #: ids of Call nodes that count as this site's release (resolvable
+        #: callees whose closure releases the kind)
+        self.release_calls: set[int] = set()
+
+    def token(self) -> tuple:
+        return (self.kind, self.key, self.line)
+
+
+def _call_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _key_of(call: ast.Call) -> str | None:
+    if call.args:
+        return ast.dump(call.args[0])
+    return None
+
+
+def _key_root(expr: ast.AST) -> str | None:
+    """Root Name of a key expression (``entry.sid`` -> 'entry')."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+class _FnAnalysis:
+    """Escape/release classification + dataflow for one function."""
+
+    def __init__(self, project: Project, module: ModuleInfo,
+                 cls: ClassInfo | None, fn: ast.FunctionDef):
+        self.project = project
+        self.module = module
+        self.cls = cls
+        self.fn = fn
+        self.local_types = local_alias_types(fn, project, cls)
+        self._release_closure_memo: dict[tuple, bool] = {}
+
+    # -- interprocedural release resolution --------------------------------
+
+    def _closure_releases(self, kind: str, fn: ast.FunctionDef,
+                          cls: ClassInfo | None, module: ModuleInfo,
+                          _depth: int = 0) -> bool:
+        """Does ``fn`` (transitively, through resolvable calls) perform a
+        release-shape call for ``kind``?"""
+        key = (module.rel, cls.name if cls else None, fn.name, kind)
+        if key in self._release_closure_memo:
+            return self._release_closure_memo[key]
+        self._release_closure_memo[key] = False  # cut cycles
+        names = RESOURCE_PAIRS[kind]["release"]
+        found = False
+        if _depth <= 4:
+            ltypes = local_alias_types(fn, self.project, cls)
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _call_name(sub) in names:
+                    found = True
+                    break
+                resolved = self.project.resolve_call(sub, module, cls,
+                                                     ltypes)
+                if resolved is not None:
+                    owner, callee = resolved
+                    if self._closure_releases(
+                            kind, callee, owner,
+                            owner.module if owner else module,
+                            _depth + 1):
+                        found = True
+                        break
+        self._release_closure_memo[key] = found
+        return found
+
+    # -- site collection ---------------------------------------------------
+
+    def sites(self) -> list[_Site]:
+        """Tracked acquire sites, with escapes already filtered out."""
+        out: list[_Site] = []
+        # call-shape acquires; counters are collected separately
+        from .model import resource_kind_of_call
+        for stmt in self._stmts():
+            handles: set[str] = set()
+            calls = [e for e in self._stmt_exprs(stmt)
+                     if isinstance(e, ast.Call)]
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        handles.add(tgt.id)
+                    elif isinstance(tgt, ast.Tuple):
+                        handles.update(e.id for e in tgt.elts
+                                       if isinstance(e, ast.Name))
+            for call in calls:
+                got = resource_kind_of_call(call)
+                if got is None or got[1] != "acquire":
+                    continue
+                kind = got[0]
+                if kind == "handle" and not isinstance(stmt, ast.Assign):
+                    continue  # bare open() expr: no handle to leak-track
+                if (isinstance(stmt, ast.Assign)
+                        and any(not isinstance(t, (ast.Name, ast.Tuple))
+                                for t in stmt.targets)):
+                    continue  # result stored straight into an attr: escapes
+                key = None if kind == "handle" else _key_of(call)
+                if kind != "handle" and key is None:
+                    continue  # keyless pin: nothing to match a release on
+                disp = (f"{_call_name(call)}"
+                        f"({ast.unparse(call.args[0]) if call.args else ''})")
+                out.append(_Site(kind, key, set(handles), call.lineno,
+                                 disp))
+        # counters: attr += e paired with attr -= e in the same function
+        incs: dict[str, list[ast.AugAssign]] = {}
+        decs: set[str] = set()
+        for sub in ast.walk(self.fn):
+            if (isinstance(sub, ast.AugAssign)
+                    and isinstance(sub.target, ast.Attribute)):
+                tgt = ast.dump(sub.target)
+                if isinstance(sub.op, ast.Add):
+                    incs.setdefault(tgt, []).append(sub)
+                elif isinstance(sub.op, ast.Sub):
+                    decs.add(tgt)
+        for tgt, nodes in incs.items():
+            if tgt not in decs:
+                continue  # stats counter, not an in-flight gate
+            for node in nodes:
+                out.append(_Site("counter", tgt, set(), node.lineno,
+                                 ast.unparse(node.target) + " +="))
+        return self._filter_escapes(out)
+
+    def _stmts(self):
+        for sub in ast.walk(self.fn):
+            if isinstance(sub, ast.stmt):
+                yield sub
+
+    @staticmethod
+    def _stmt_exprs(stmt: ast.stmt):
+        from .model import _own_exprs
+        for expr in _own_exprs(stmt):
+            yield from ast.walk(expr)
+
+    def _filter_escapes(self, sites: list[_Site]) -> list[_Site]:
+        tracked: list[_Site] = []
+        for site in sites:
+            if site.kind == "counter":
+                tracked.append(site)
+                continue
+            root = None
+            if site.key is not None:
+                # recover the root name from any call arg matching the key
+                for sub in ast.walk(self.fn):
+                    if isinstance(sub, ast.Call) and sub.args \
+                            and ast.dump(sub.args[0]) == site.key:
+                        root = _key_root(sub.args[0])
+                        break
+            if self._escapes(site, root):
+                continue
+            tracked.append(site)
+        return tracked
+
+    def _escapes(self, site: _Site, key_root: str | None) -> bool:
+        watched = set(site.handles)
+        if key_root is not None:
+            watched_key = {key_root}
+        else:
+            watched_key = set()
+        release_names = RESOURCE_PAIRS[site.kind]["release"]
+        acquire_names = RESOURCE_PAIRS[site.kind]["acquire"]
+        for sub in ast.walk(self.fn):
+            if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+                val = getattr(sub, "value", None)
+                if val is not None and self._mentions(
+                        val, watched | watched_key):
+                    return True
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)) \
+                            and (self._mentions(sub.value,
+                                                watched | watched_key)
+                                 or self._mentions(
+                                     tgt, watched | watched_key)):
+                        # handle/key stored into an attribute/container
+                        # (value OR subscript key): ownership outlives
+                        # this frame
+                        return True
+            if isinstance(sub, ast.Call):
+                name = _call_name(sub)
+                if name in release_names or name in acquire_names:
+                    continue
+                resolved = self.project.resolve_call(
+                    sub, self.module, self.cls, self.local_types)
+                involves_handle = any(
+                    self._mentions(a, watched)
+                    for a in [*sub.args,
+                              *(kw.value for kw in sub.keywords)])
+                involves_key = any(
+                    self._mentions(a, watched_key) or (
+                        sub.args and site.key is not None
+                        and ast.dump(sub.args[0]) == site.key)
+                    for a in sub.args) if sub.args else False
+                if resolved is None:
+                    # unresolvable call taking the handle: ownership may
+                    # transfer through the object — go silent
+                    if involves_handle:
+                        return True
+                    continue
+                if involves_handle or involves_key:
+                    owner, callee = resolved
+                    if self._closure_releases(
+                            site.kind, callee, owner,
+                            owner.module if owner else self.module):
+                        site.release_calls.add(id(sub))
+        return False
+
+    @staticmethod
+    def _mentions(expr: ast.AST, names: set[str]) -> bool:
+        if not names:
+            return False
+        return any(isinstance(s, ast.Name) and s.id in names
+                   for s in ast.walk(expr))
+
+    # -- dataflow ----------------------------------------------------------
+
+    def leaks(self, sites: list[_Site]) -> list[tuple[_Site, str]]:
+        """(site, 'return'|'exception') for tokens held at an exit."""
+        if not sites:
+            return []
+        cfg = CFG(self.fn)
+        by_token = {s.token(): s for s in sites}
+        acq: list[set[tuple]] = []
+        rel: list[set[tuple]] = []
+        for stmt in cfg.stmts:
+            a: set[tuple] = set()
+            r: set[tuple] = set()
+            self._transfer(stmt, sites, a, r)
+            acq.append(a)
+            rel.append(r)
+        # may-analysis: IN = union over predecessor OUTs
+        n = len(cfg.stmts)
+        in_s: list[set] = [set() for _ in range(n)]
+        exit_held: set[tuple] = set()
+        raise_held: set[tuple] = set()
+        # iterate to fixpoint (monotone may-analysis over finite tokens)
+        changed = True
+        guard = 0
+        while changed and guard < 10 * (n + 1):
+            changed = False
+            guard += 1
+            for nid in range(n):
+                out = (in_s[nid] - rel[nid]) | acq[nid]
+                exc_state = in_s[nid] & out  # pre ∩ post
+                for succ in cfg.succ[nid]:
+                    if succ == CFG_EXIT:
+                        if not out <= exit_held:
+                            exit_held |= out
+                            changed = True
+                    elif succ == CFG_RAISE:
+                        if not out <= raise_held:
+                            raise_held |= out
+                            changed = True
+                    elif not out <= in_s[succ]:
+                        in_s[succ] |= out
+                        changed = True
+                for succ in cfg.exc_succ[nid]:
+                    if succ == CFG_RAISE:
+                        if not exc_state <= raise_held:
+                            raise_held |= exc_state
+                            changed = True
+                    elif succ >= 0 and not exc_state <= in_s[succ]:
+                        in_s[succ] |= exc_state
+                        changed = True
+        out = []
+        for tok in sorted(exit_held | raise_held,
+                          key=lambda t: (t[2], str(t))):
+            kind = ("return" if tok in exit_held else "exception")
+            out.append((by_token[tok], kind))
+        return out
+
+    def _transfer(self, stmt: ast.stmt, sites: list[_Site],
+                  acq: set, rel: set) -> None:
+        from .model import _own_exprs
+        if isinstance(stmt, ast.AugAssign) \
+                and isinstance(stmt.target, ast.Attribute):
+            tgt = ast.dump(stmt.target)
+            for site in sites:
+                if site.kind != "counter" or site.key != tgt:
+                    continue
+                if isinstance(stmt.op, ast.Add) \
+                        and stmt.lineno == site.line:
+                    acq.add(site.token())
+                elif isinstance(stmt.op, ast.Sub):
+                    rel.add(site.token())
+            return
+        for expr in _own_exprs(stmt):
+            for sub in ast.walk(expr):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = _call_name(sub)
+                key = _key_of(sub)
+                for site in sites:
+                    if site.kind == "counter":
+                        continue
+                    spec = RESOURCE_PAIRS[site.kind]
+                    if name in spec["acquire"] and (
+                            site.kind == "handle"
+                            or key == site.key) \
+                            and sub.lineno == site.line:
+                        acq.add(site.token())
+                    elif name in spec["release"]:
+                        if site.kind == "handle":
+                            # f.close(): receiver must be the handle
+                            f = sub.func
+                            if isinstance(f, ast.Attribute) \
+                                    and isinstance(f.value, ast.Name) \
+                                    and f.value.id in site.handles:
+                                rel.add(site.token())
+                        elif key == site.key:
+                            rel.add(site.token())
+                    elif id(sub) in site.release_calls:
+                        rel.add(site.token())
+
+
+@register
+class ResourcePairingRule(Rule):
+    id = "resource-pairing"
+    doc = ("A pinned slot, in-flight counter increment, or opened file "
+           "handle must be released on EVERY path out of the function, "
+           "including exception exits (try/finally or with). Handles/"
+           "keys that escape (returned, stored, passed to unresolvable "
+           "calls) transfer ownership and are exempt.")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in project.modules:
+            units: list[tuple[ClassInfo | None, ast.FunctionDef]] = []
+            units.extend((None, fn) for fn in module.functions.values())
+            for cls in module.classes.values():
+                units.extend((cls, m) for m in cls.methods.values())
+            for cls, fn in units:
+                ana = _FnAnalysis(project, module, cls, fn)
+                sites = ana.sites()
+                if not sites:
+                    continue
+                where = f"{cls.name}.{fn.name}" if cls else fn.name
+                for site, how in ana.leaks(sites):
+                    noun = {"pin": "pinned slot", "counter": "counter",
+                            "handle": "file handle"}[site.kind]
+                    path = ("an exception" if how == "exception"
+                            else "a return")
+                    findings.append(Finding(
+                        self.id, module.rel, site.line,
+                        f"{noun} {site.display} acquired in {where}() is "
+                        f"not released on {path} path — release in a "
+                        "finally (or on every branch)"))
+        return findings

@@ -1,0 +1,210 @@
+"""``thread-lifecycle``: every daemon thread a class starts and keeps a
+handle to must be stoppable from that class's ``stop()``/``close()``.
+
+The PR 8 round-3 class: every retired serve stack leaked one
+forever-polling spill-worker daemon pinning the engine's arrays, until
+``SessionTiers.close()`` learned to park it. ``daemon=True`` means the
+interpreter won't join the thread at exit — so if the OWNER doesn't
+provide a stop path, nobody does, and long-lived processes (supervise
+restarts, test suites, replica retirement) accumulate pollers.
+
+Matched shape: inside a class method, a ``threading.Thread(...,
+daemon=True)`` construction whose handle is stored on an attribute
+(``self._thread = Thread(...)`` or ``t = Thread(...); obj.thread = t``)
+and started. The OWNING class must have a method named ``stop`` /
+``close`` / ``shutdown`` / ``__exit__`` whose transitive self-call
+closure either:
+
+- calls ``.join()`` on an attribute with the same name the handle was
+  stored under, or
+- writes (or ``.set()``s / ``notify*``s) an attribute that the thread's
+  TARGET method reads — the ``self._closed = True`` + worker-loop-
+  checks-it protocol (target resolvable as a method of the same class).
+
+Threads held only in locals (loadgen workers joined in-function,
+supervise's log pump) and non-daemon threads (the interpreter joins
+them — checkpoint's async writer) are out of scope. An UNRESOLVABLE
+target does not excuse the owner: the stored handle is the stop
+affordance, so the join path is still required.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .model import ClassInfo, Project, self_call_closure
+
+_STOP_NAMES = {"stop", "close", "shutdown", "__exit__"}
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    name = (f.attr if isinstance(f, ast.Attribute)
+            else f.id if isinstance(f, ast.Name) else None)
+    return name == "Thread"
+
+
+def _kw(call: ast.Call, name: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_daemon(call: ast.Call) -> bool:
+    val = _kw(call, "daemon")
+    return isinstance(val, ast.Constant) and val.value is True
+
+
+class _Started:
+    __slots__ = ("attr", "target", "line", "cls")
+
+    def __init__(self, attr: str, target: ast.AST | None, line: int,
+                 cls: ClassInfo):
+        self.attr = attr      # attribute name the handle is stored under
+        self.target = target  # the Thread(target=...) expression
+        self.line = line
+        self.cls = cls
+
+
+def _collect_started(cls: ClassInfo) -> list[_Started]:
+    """Daemon threads stored on an attribute and started, per class.
+    Store and start accumulate CLASS-wide: the common idiom constructs
+    the Thread in ``__init__`` and starts it from ``start()``, and the
+    pairing must survive the method boundary."""
+    stored: dict[int, _Started] = {}  # id(ctor call) -> record
+    started_ids: set[int] = set()
+    started_attrs: set[str] = set()  # obj.attr.start() receivers
+    for meth in cls.methods.values():
+        # local name -> Thread ctor call (for the t = Thread(); x.t = t;
+        # t.start() split form) — locals do NOT cross methods
+        local_ctors: dict[str, ast.Call] = {}
+        for sub in ast.walk(meth):
+            if isinstance(sub, ast.Assign) and isinstance(sub.value,
+                                                          ast.Call) \
+                    and _is_thread_ctor(sub.value) \
+                    and _is_daemon(sub.value):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        local_ctors[tgt.id] = sub.value
+                    elif isinstance(tgt, ast.Attribute):
+                        stored[id(sub.value)] = _Started(
+                            tgt.attr, _kw(sub.value, "target"),
+                            sub.lineno, cls)
+            elif isinstance(sub, ast.Assign):
+                # x.attr = t   (t previously bound to a Thread ctor)
+                if isinstance(sub.value, ast.Name) \
+                        and sub.value.id in local_ctors:
+                    ctor = local_ctors[sub.value.id]
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Attribute):
+                            stored[id(ctor)] = _Started(
+                                tgt.attr, _kw(ctor, "target"),
+                                sub.lineno, cls)
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "start":
+                recv = sub.func.value
+                if isinstance(recv, ast.Name) \
+                        and recv.id in local_ctors:
+                    started_ids.add(id(local_ctors[recv.id]))
+                elif isinstance(recv, ast.Attribute):
+                    started_attrs.add(recv.attr)
+    return [rec for cid, rec in stored.items()
+            if cid in started_ids or rec.attr in started_attrs]
+
+
+def _stop_closure(cls: ClassInfo) -> list[ast.FunctionDef]:
+    """stop/close/shutdown methods plus their transitive self-calls."""
+    return [cls.methods[n]
+            for n in sorted(self_call_closure(cls, _STOP_NAMES))]
+
+
+def _joins_attr(stop_methods: list[ast.FunctionDef], attr: str) -> bool:
+    for meth in stop_methods:
+        for sub in ast.walk(meth):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "join"
+                    and isinstance(sub.func.value, ast.Attribute)
+                    and sub.func.value.attr == attr):
+                return True
+    return False
+
+
+def _signalled_attrs(stop_methods: list[ast.FunctionDef]) -> set[str]:
+    """Attributes a stop-closure method writes or signals (.set(),
+    .notify(), .notify_all()) — candidate worker-loop stop flags."""
+    out: set[str] = set()
+    for meth in stop_methods:
+        for sub in ast.walk(meth):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        out.add(tgt.attr)
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in ("set", "notify", "notify_all")
+                    and isinstance(sub.func.value, ast.Attribute)):
+                out.add(sub.func.value.attr)
+    return out
+
+
+def _target_reads(cls: ClassInfo, target: ast.AST | None) -> set[str]:
+    """self-attributes the resolved thread target method reads (its
+    transitive self-call closure included)."""
+    if not (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and target.attr in cls.methods):
+        return set()
+    seen: set[str] = set()
+    reads: set[str] = set()
+    stack = [target.attr]
+    while stack:
+        name = stack.pop()
+        if name in seen or name not in cls.methods:
+            continue
+        seen.add(name)
+        for sub in ast.walk(cls.methods[name]):
+            if (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"):
+                reads.add(sub.attr)
+                if sub.attr in cls.methods:
+                    stack.append(sub.attr)
+    return reads
+
+
+@register
+class ThreadLifecycleRule(Rule):
+    id = "thread-lifecycle"
+    doc = ("A daemon thread stored on an attribute and started must be "
+           "stoppable: the owning class needs a stop/close/shutdown "
+           "whose closure joins the handle or signals a flag/condition "
+           "the thread's target loop reads. Daemon threads nobody can "
+           "stop outlive every retire/restart (the PR 8 leaked-poller "
+           "class).")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in project.modules:
+            for cls in module.classes.values():
+                started = _collect_started(cls)
+                if not started:
+                    continue
+                stop_methods = _stop_closure(cls)
+                signalled = _signalled_attrs(stop_methods)
+                for rec in started:
+                    if _joins_attr(stop_methods, rec.attr):
+                        continue
+                    if signalled & _target_reads(cls, rec.target):
+                        continue
+                    findings.append(Finding(
+                        self.id, module.rel, rec.line,
+                        f"{cls.name}.{rec.attr} holds a started daemon "
+                        "thread but no stop()/close()/shutdown() path "
+                        "joins it or signals a flag its loop reads — "
+                        "the thread outlives every stop"))
+        return findings

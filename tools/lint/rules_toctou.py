@@ -1,0 +1,105 @@
+"""``toctou-fs``: an ``exists()``/``stat()`` result guarding a
+``remove``/``replace``/``rename``/``open`` on the SAME path expression.
+
+The file can vanish (or appear) between the check and the use — another
+process, another replica on a shared ``--session-dir``, or the keep-N
+cleanup racing a restore. This repo has hit the class for real: PR 8
+round 3 turned the training checkpoints' sidecar ``exists``+``remove``
+into try/remove precisely because two writers racing one path could
+interleave between the two calls. The honest pattern is to just do the
+operation and handle ``FileNotFoundError`` (which the guarded code must
+be prepared for anyway — the guard only narrows the window, it never
+closes it).
+
+Matched shape (lexical, deliberately narrow): an ``if`` whose test
+contains a NON-negated ``os.path.exists(P)`` / ``os.path.isfile(P)`` /
+``os.stat(P)`` / ``os.lstat(P)``, and whose body contains
+``os.remove(P)`` / ``os.unlink(P)`` / ``os.replace(P, ...)`` /
+``os.rename(P, ...)`` / ``open(P, ...)`` with a syntactically identical
+``P``. Negated guards (``if not exists: ...``), guards feeding
+different paths, and interprocedural uses stay silent — the rule
+under-approximates, it does not guess.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .model import Project
+
+_CHECKS = {"exists", "isfile", "stat", "lstat"}
+#: verb -> which arg positions name the guarded path
+_VERBS = {"remove": (0,), "unlink": (0,), "replace": (0,),
+          "rename": (0,), "open": (0,)}
+
+
+def _check_paths(test: ast.AST) -> list[str]:
+    """Dumps of path args of non-negated exists/stat calls in a test."""
+    out: list[str] = []
+
+    def walk(node: ast.AST, negated: bool) -> None:
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            walk(node.operand, not negated)
+            return
+        if (not negated and isinstance(node, ast.Call) and node.args
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CHECKS):
+            out.append(ast.dump(node.args[0]))
+        for child in ast.iter_child_nodes(node):
+            walk(child, negated)
+
+    walk(test, False)
+    return out
+
+
+def _verb_of(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "open":
+        return "open"
+    if isinstance(f, ast.Attribute) and f.attr in _VERBS \
+            and f.attr != "open":
+        return f.attr
+    return None
+
+
+@register
+class ToctouFsRule(Rule):
+    id = "toctou-fs"
+    doc = ("exists()/stat() result guarding a remove/replace/rename/"
+           "open on the same path expression — the file can vanish "
+           "between check and use; do the operation and handle "
+           "FileNotFoundError instead.")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        seen: set[tuple] = set()  # nested ifs can guard one verb twice
+        for module in project.modules:
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.If):
+                    continue
+                guarded = set(_check_paths(node.test))
+                if not guarded:
+                    continue
+                for sub in ast.walk(ast.Module(body=node.body,
+                                               type_ignores=[])):
+                    if not isinstance(sub, ast.Call) or not sub.args:
+                        continue
+                    verb = _verb_of(sub)
+                    if verb is None:
+                        continue
+                    for pos in _VERBS[verb]:
+                        if pos < len(sub.args) \
+                                and ast.dump(sub.args[pos]) in guarded:
+                            ident = (module.rel, sub.lineno, verb)
+                            if ident in seen:
+                                break
+                            seen.add(ident)
+                            findings.append(Finding(
+                                self.id, module.rel, sub.lineno,
+                                f"exists()-guarded {verb}() on the same "
+                                f"path ({ast.unparse(sub.args[pos])}) — "
+                                "the file can vanish between check and "
+                                "use; use try/except FileNotFoundError"))
+                            break
+        return findings

@@ -5,10 +5,14 @@
 # Phase 0 — GRAFTLINT: `python -m tools.lint` (AST invariant analyzer,
 # docs/LINT.md) over lstm_tensorspark_tpu/ + tools/, gated on
 # tools/lint_baseline.txt. Prints its own `GRAFTLINT new=N baseline=M`
-# summary line and exits REGRESSION_RC (3) on NEW findings — the run
-# aborts HERE, before the ~15 min suite, because a lint regression is a
-# deterministic fail and the feedback should be seconds, not minutes.
-# Pure CPU/AST, sequenced BEFORE the timed suite so it cannot perturb it.
+# summary line — with per-rule `d(rule)=±k` deltas vs the previous
+# LINT_report.json when one exists (the report is rewritten in place
+# each run, trendable next to BENCH_*.json) — and exits REGRESSION_RC
+# (3) on NEW findings — the run aborts HERE, before the ~15 min suite,
+# because a lint regression is a deterministic fail and the feedback
+# should be seconds, not minutes (phase-0 budget: 10 s; see
+# docs/OPERATIONS.md). Pure CPU/AST, sequenced BEFORE the timed suite
+# so it cannot perturb it.
 #
 # Phase 1 — tier-1: the ROADMAP.md "Tier-1 verify" line exactly (same
 # timeout, same pytest flags, same DOTS_PASSED accounting), then gated
@@ -36,7 +40,7 @@
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 2
 
-python -m tools.lint
+python -m tools.lint --json LINT_report.json
 lint_rc=$?
 if [ "$lint_rc" -ne 0 ]; then
   echo "verify: graftlint gate failed (rc=$lint_rc) — fix or baseline" \
