@@ -1254,7 +1254,42 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="concurrent decode sessions (<= --num-slots)")
     p.add_argument("--queue-size", type=int, default=64,
                    help="bounded submit queue; beyond it requests are "
-                        "rejected (HTTP 429)")
+                        "rejected (HTTP 429). This is the PRIORITY-class "
+                        "bound; best-effort sheds earlier "
+                        "(--best-effort-queue-frac)")
+    p.add_argument("--class-weights", type=str, default="4,1",
+                   help="weighted-dequeue shares 'priority,best_effort' "
+                        "(serve/batcher.py): out of every P+B admissions "
+                        "with both classes waiting, P are priority — the "
+                        "SLO lever that keeps priority TTFT flat while a "
+                        "best-effort burst queues")
+    p.add_argument("--best-effort-queue-frac", type=float, default=0.5,
+                   help="best-effort requests are 429-shed once the live "
+                        "queue reaches this fraction of --queue-size "
+                        "(priority keeps the remaining headroom); sheds "
+                        "carry Retry-After from the live queue-wait p99")
+    p.add_argument("--deadline-priority-s", type=float, default=0,
+                   help="default request deadline (seconds) for the "
+                        "priority class; expiry is enforced at admission, "
+                        "in the queue and at decode-window boundaries, "
+                        "producing an honest 'timeout' outcome with "
+                        "partial output. 0 = no default (clients can "
+                        "still send deadline_s / X-Deadline-S)")
+    p.add_argument("--deadline-best-effort-s", type=float, default=0,
+                   help="default request deadline (seconds) for the "
+                        "best_effort class; 0 = no default")
+    p.add_argument("--replica-stale-s", type=float, default=60.0,
+                   help="scheduler-heartbeat staleness bound (seconds) "
+                        "before a replica counts wedged: excluded from "
+                        "fresh routing and /healthz health (previously a "
+                        "hardcoded 60 s)")
+    p.add_argument("--replica-sweep-s", type=float, default=0,
+                   help="periodic replica death-sweep interval (seconds): "
+                        "retire dead replicas (requeue/migrate) within "
+                        "this bound even on a quiet server with no "
+                        "traffic or probes. 0 = piggyback-only (the "
+                        "previous behavior: sweeps run on every submit "
+                        "and health probe)")
     p.add_argument("--decode-window", type=str, default="auto",
                    help="multi-token decode window: 'auto' (adaptive "
                         "ladder 1/4/8 — large windows in steady-state "
@@ -1349,6 +1384,22 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="--idle-churn popularity exponent: session rank r "
                         "is drawn with weight (r+1)^-s (higher = hotter "
                         "hot set)")
+    p.add_argument("--priority-frac", type=float, default=1.0,
+                   help="loadgen: fraction of traffic submitted as the "
+                        "priority class (the rest best_effort, "
+                        "interleaved) — per-class shed/retry/TTFT "
+                        "percentiles land in the report's 'classes' "
+                        "section")
+    p.add_argument("--deadline-s", type=float, default=0,
+                   help="loadgen: per-request deadline in seconds "
+                        "(server-side; expiry = honest timeout with "
+                        "partial output). 0 = none")
+    p.add_argument("--retry-max", type=int, default=0,
+                   help="loadgen: retry a 429 shed up to N times, "
+                        "sleeping the server's Retry-After floored by "
+                        "the shared capped exponential backoff + jitter "
+                        "(resilience/backoff.py). 0 = count sheds, no "
+                        "retry")
     p.add_argument("--json", type=str, default=None,
                    help="also write the loadgen report (machine-readable "
                         "JSON) to this path")
@@ -1516,11 +1567,32 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
         )
         for i in range(n_replicas)
     ]
+    try:
+        wp, wb = (int(x) for x in args.class_weights.split(","))
+    except ValueError:
+        raise SystemExit(
+            f"--class-weights: expected 'P,B' positive ints, got "
+            f"{args.class_weights!r}")
+    if wp < 1 or wb < 1:
+        # fail in ms with the flag's own message — not a Batcher
+        # traceback mid-stack-build
+        raise SystemExit(
+            f"--class-weights: weights must be >= 1, got "
+            f"{args.class_weights!r}")
     server = ServeServer(engines if n_replicas > 1 else engines[0],
                          max_active=args.max_active,
                          queue_size=args.queue_size,
                          window_ladder=_parse_window_ladder(args.decode_window),
-                         prefill_chunk=args.prefill_chunk or None)
+                         prefill_chunk=args.prefill_chunk or None,
+                         class_weights=(wp, wb),
+                         health_stale_after=args.replica_stale_s,
+                         best_effort_queue_frac=args.best_effort_queue_frac,
+                         sweep_interval=args.replica_sweep_s or None,
+                         deadline_defaults={
+                             "priority": args.deadline_priority_s or None,
+                             "best_effort":
+                                 args.deadline_best_effort_s or None,
+                         })
     return params, cfg, server
 
 
@@ -1658,6 +1730,9 @@ def _serve_loadgen(args) -> int:
                 seed=args.seed, shared_prefix_len=args.shared_prefix_len,
                 inject_prompt_len=args.inject_prompt_len,
                 inject_delay_s=args.inject_delay,
+                priority_frac=args.priority_frac,
+                deadline_s=args.deadline_s or None,
+                retry_max=args.retry_max,
             )
     # aggregate across replicas — a --replicas N run spreads traffic, and
     # replica-0-only counters would silently halve every number vs /stats

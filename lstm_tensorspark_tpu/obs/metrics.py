@@ -468,6 +468,11 @@ class _NullInstrument:
     def labels(self, **kv):
         return self
 
+    def aggregate_over(self, label: str) -> dict:
+        # mirrors _Family.aggregate_over for disabled telemetry: the
+        # router reads the queue-wait p99 through this to size Retry-After
+        return {}
+
     @property
     def value(self) -> float:
         return 0.0

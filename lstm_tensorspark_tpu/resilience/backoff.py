@@ -1,0 +1,31 @@
+"""Capped exponential backoff with jitter — the ONE implementation.
+
+Shared by the crash supervisor (restart delays between child relaunches)
+and the serve loadgen client (retry delays after a 429 shed, floored by
+the server's ``Retry-After`` hint). Keeping a single function is the
+point: two backoff curves that drift apart make incident math lie —
+"the client retried after X" must mean the same X everywhere.
+
+No jax, no project imports: the supervisor imports this before any
+accelerator runtime exists.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+def backoff_delay(base: float, attempt: int, *, cap: float = 30.0,
+                  jitter: float = 0.5, rand=None) -> float:
+    """Delay for ``attempt`` (1-based): exponential from ``base`` with up
+    to ``+jitter`` fractional randomization, then capped — the cap bounds
+    the SLEPT delay, jitter included (an operator's cap flag is a
+    promise, not a suggestion). Jitter de-synchronizes a fleet of
+    retriers hammering a shared resource (filesystem, coordinator, an
+    overloaded serve router) after a common-cause failure; ``rand`` is
+    injectable for deterministic tests."""
+    if base <= 0:
+        return 0.0
+    delay = base * (2.0 ** max(attempt - 1, 0))
+    r = random.random() if rand is None else rand()
+    return min(delay * (1.0 + jitter * r), cap)

@@ -27,6 +27,29 @@ Spec grammar — semicolon-separated ``kind@arg`` clauses:
   ``ServeEngine.decode`` call of the process;
 - ``seed@S``         seed for the corruption byte schedule (default 0).
 
+Serve-plane faults (chaos drills for the replicated/tiered serve stack —
+``tools/chaos_serve.py``; counts start at arming, fire once per process):
+
+- ``replica_die@R[xK]``   raise :class:`InjectedFault` out of replica R's
+  Kth scheduler step after arming (default 1) — the scheduler thread
+  exits, the router must retire the replica (requeue / migrate / honest
+  in-flight failure);
+- ``replica_wedge@R[xK]`` replica R's Kth step after arming blocks for
+  ``wedge_secs`` — thread alive, heartbeat stale (the wedge case);
+- ``wedge_secs@S``        wedge duration in seconds (default 120);
+- ``disk_write_err@N``    the Nth disk-tier session write raises
+  ``OSError`` (durability lost, correctness kept —
+  ``serve_tier_lost_total{reason="disk_error"}``);
+- ``disk_read_err@N``     the Nth disk-tier session read raises
+  ``OSError`` (an honest miss/"state lost", never wrong tokens);
+- ``session_corrupt@N``   truncate + byte-flip the session file of the
+  Nth successful disk-tier write AFTER it lands (the sha256 verify must
+  quarantine it at fill time);
+- ``spill_stall@N[xS]``   the Nth spill-worker batch sleeps S seconds
+  (default 1) before its device fetch — the write-behind stall drill;
+- ``slow_readback@N[xMS]`` the Nth decode-window readback sleeps MS
+  milliseconds (default 250) — slow device→host fetch.
+
 Step numbers are the 1-based global optimizer step about to be computed —
 resume-stable, so a restarted child reasons in the same coordinates.
 
@@ -49,13 +72,21 @@ from __future__ import annotations
 import os
 import re
 import sys
+import threading
+import time
 
 from .exit_codes import FAULT_CRASH_RC
 
 ENV_VAR = "LSTM_TSP_FAULTS"
 
 _KINDS = ("crash", "nan_grads", "ckpt_corrupt", "data_error", "serve_error",
-          "seed")
+          "seed", "replica_die", "replica_wedge", "wedge_secs",
+          "disk_write_err", "disk_read_err", "session_corrupt",
+          "spill_stall", "slow_readback")
+
+#: kinds whose ``xK`` suffix is meaningful (everything else rejects it)
+_XK_KINDS = ("nan_grads", "replica_die", "replica_wedge", "spill_stall",
+             "slow_readback")
 
 
 class InjectedFault(RuntimeError):
@@ -88,6 +119,26 @@ class FaultPlane:
         self.serve_error_calls: set[int] = set()
         self._serve_calls = 0
         self._fired_mem: set[str] = set()
+        # serve-plane schedules (counts start at arming — the in-process
+        # drill arms mid-run to target an exact moment deterministically)
+        self.replica_die: dict[int, int] = {}    # replica -> its Kth step
+        self.replica_wedge: dict[int, int] = {}  # replica -> its Kth step
+        self.wedge_secs = 120
+        self.disk_write_err_calls: set[int] = set()
+        self.disk_read_err_calls: set[int] = set()
+        self.session_corrupt_writes: set[int] = set()
+        self.spill_stall_batches: dict[int, int] = {}   # batch N -> seconds
+        self.slow_readback_calls: dict[int, int] = {}   # call N -> millis
+        # serve hooks fire from several threads (scheduler threads, the
+        # spill worker, HTTP threads) — count under one small lock so
+        # "fires exactly once at the Nth call" stays true under races
+        self._serve_lock = threading.Lock()
+        self._step_counts: dict[int, int] = {}
+        self._disk_writes = 0
+        self._disk_reads = 0
+        self._disk_puts_ok = 0
+        self._spill_batches = 0
+        self._readback_calls = 0
         nan: list[int] = []
         for raw in spec.split(";"):
             clause = raw.strip()
@@ -104,8 +155,10 @@ class FaultPlane:
                 raise ValueError(
                     f"unknown fault kind {kind!r} (kinds: {', '.join(_KINDS)})"
                 )
-            if k is not None and kind != "nan_grads":
-                raise ValueError(f"{clause!r}: xK burst only with nan_grads")
+            if k is not None and kind not in _XK_KINDS:
+                raise ValueError(
+                    f"{clause!r}: xK suffix only with "
+                    f"{', '.join(_XK_KINDS)}")
             if kind == "seed":
                 self.seed = n
             elif kind == "crash":
@@ -118,6 +171,22 @@ class FaultPlane:
                 self.data_error_steps.add(n)
             elif kind == "serve_error":
                 self.serve_error_calls.add(n)
+            elif kind == "replica_die":
+                self.replica_die[n] = int(k or 1)
+            elif kind == "replica_wedge":
+                self.replica_wedge[n] = int(k or 1)
+            elif kind == "wedge_secs":
+                self.wedge_secs = n
+            elif kind == "disk_write_err":
+                self.disk_write_err_calls.add(n)
+            elif kind == "disk_read_err":
+                self.disk_read_err_calls.add(n)
+            elif kind == "session_corrupt":
+                self.session_corrupt_writes.add(n)
+            elif kind == "spill_stall":
+                self.spill_stall_batches[n] = int(k or 1)
+            elif kind == "slow_readback":
+                self.slow_readback_calls[n] = int(k or 250)
         self.nan_grad_steps = tuple(sorted(set(nan)))
 
     # ---- one-shot bookkeeping -----------------------------------------
@@ -239,6 +308,107 @@ class FaultPlane:
                 f"injected serve-engine exception on decode call "
                 f"{self._serve_calls}")
 
+    # ---- serve-plane hooks (chaos_serve drills) ------------------------
+
+    def serve_step_hook(self, replica: int) -> None:
+        """Called at the top of every ``Batcher.step``: fire the replica's
+        scheduled death (InjectedFault → the scheduler thread exits → the
+        router retires it) or wedge (block with the heartbeat stale while
+        ``is_alive()`` stays true — the case /healthz must out) at its Kth
+        step since arming."""
+        die = self.replica_die.get(replica)
+        wedge = self.replica_wedge.get(replica)
+        if die is None and wedge is None:
+            return
+        with self._serve_lock:
+            n = self._step_counts.get(replica, 0) + 1
+            self._step_counts[replica] = n
+        if die is not None and n == die:
+            self._announce(
+                f"replica {replica} scheduler death on its step {n}")
+            raise InjectedFault(
+                f"injected replica {replica} scheduler death (step {n})")
+        if wedge is not None and n == wedge:
+            self._announce(
+                f"replica {replica} wedged for {self.wedge_secs}s "
+                f"on its step {n}")
+            time.sleep(self.wedge_secs)
+
+    def serve_disk_hook(self, op: str) -> None:
+        """Fire an ``OSError`` out of the Nth disk-tier session write or
+        read. Placed so the error takes the SAME path a real filesystem
+        failure would: a failed write counts ``disk_error`` and keeps the
+        state in RAM; a failed read is an honest miss ("state lost")."""
+        if op == "write":
+            if not self.disk_write_err_calls:
+                return
+            with self._serve_lock:
+                self._disk_writes += 1
+                fire = self._disk_writes in self.disk_write_err_calls
+                n = self._disk_writes
+        else:
+            if not self.disk_read_err_calls:
+                return
+            with self._serve_lock:
+                self._disk_reads += 1
+                fire = self._disk_reads in self.disk_read_err_calls
+                n = self._disk_reads
+        if fire:
+            self._announce(f"disk-tier {op} OSError on call {n}")
+            raise OSError(f"injected disk-tier {op} failure (call {n})")
+
+    def maybe_corrupt_session(self, path: str) -> None:
+        """Truncate + byte-flip the session file of the Nth SUCCESSFUL
+        disk-tier write, after it lands — the damage the embedded sha256
+        must catch at fill time (quarantine + honest "state lost")."""
+        if not self.session_corrupt_writes:
+            return
+        with self._serve_lock:
+            self._disk_puts_ok += 1
+            if self._disk_puts_ok not in self.session_corrupt_writes:
+                return
+            n = self._disk_puts_ok
+        size = os.path.getsize(path)
+        keep = max(size // 2, 1)
+        with open(path, "r+b") as f:
+            f.truncate(keep)
+            pos = (self.seed * 2654435761 + n) % keep
+            f.seek(pos)
+            byte = f.read(1)
+            f.seek(pos)
+            f.write(bytes([(byte[0] ^ 0xFF) if byte else 0xFF]))
+        self._announce(
+            f"corrupted session file {os.path.basename(path)} "
+            f"(write {n}: {size} -> {keep} bytes + byte flip)")
+
+    def serve_spill_hook(self) -> None:
+        """Stall the Nth spill-worker batch before its device fetch — the
+        write-behind delay drill (flush() must still be a real barrier,
+        fills must keep finding the pending capture)."""
+        if not self.spill_stall_batches:
+            return
+        with self._serve_lock:
+            self._spill_batches += 1
+            n = self._spill_batches
+            secs = self.spill_stall_batches.get(n)
+        if secs:
+            self._announce(f"spill worker stalled {secs}s on batch {n}")
+            time.sleep(secs)
+
+    def serve_readback_hook(self) -> None:
+        """Delay the Nth decode-window readback (slow device→host fetch):
+        the scheduler must absorb it as latency, never as a wrong
+        token or a health flap below the staleness bound."""
+        if not self.slow_readback_calls:
+            return
+        with self._serve_lock:
+            self._readback_calls += 1
+            n = self._readback_calls
+            ms = self.slow_readback_calls.get(n)
+        if ms:
+            self._announce(f"readback delayed {ms}ms on fetch {n}")
+            time.sleep(ms / 1000.0)
+
 
 # ---- module singleton ---------------------------------------------------
 
@@ -300,6 +470,41 @@ def serve_decode_hook() -> None:
     plane = _active
     if plane is not None:
         plane.serve_decode_hook()
+
+
+def serve_step_hook(replica: int) -> None:
+    """Unarmed-safe scheduler-step hook (Batcher.step)."""
+    plane = _active
+    if plane is not None:
+        plane.serve_step_hook(replica)
+
+
+def serve_disk_hook(op: str) -> None:
+    """Unarmed-safe disk-tier IO hook (_DiskTier.put/get)."""
+    plane = _active
+    if plane is not None:
+        plane.serve_disk_hook(op)
+
+
+def maybe_corrupt_session(path: str) -> None:
+    """Unarmed-safe post-write session-file corruption hook."""
+    plane = _active
+    if plane is not None:
+        plane.maybe_corrupt_session(path)
+
+
+def serve_spill_hook() -> None:
+    """Unarmed-safe spill-worker batch hook (SessionTiers)."""
+    plane = _active
+    if plane is not None:
+        plane.serve_spill_hook()
+
+
+def serve_readback_hook() -> None:
+    """Unarmed-safe decode-window readback hook (Batcher)."""
+    plane = _active
+    if plane is not None:
+        plane.serve_readback_hook()
 
 
 def maybe_corrupt_checkpoint(path: str, step: int) -> None:

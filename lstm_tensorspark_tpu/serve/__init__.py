@@ -59,14 +59,22 @@ CLI: ``python -m lstm_tensorspark_tpu.cli serve --selftest`` (see cli.py).
 
 from .state_cache import CacheFullError, PrefixCache, SessionTiers, StateCache
 from .engine import PAD_TOKEN, DecodeWindow, SamplingParams, ServeEngine
-from .batcher import Batcher, QueueFullError, Request
+from .batcher import (
+    CLASSES,
+    Batcher,
+    DeadlineExceededError,
+    QueueFullError,
+    Request,
+)
 from .router import Replica, Router
 from .server import InprocessClient, ServeServer
 from .loadgen import replica_sweep, run_loadgen, run_longtail
 
 __all__ = [
     "Batcher",
+    "CLASSES",
     "CacheFullError",
+    "DeadlineExceededError",
     "DecodeWindow",
     "InprocessClient",
     "PAD_TOKEN",

@@ -58,6 +58,22 @@ Backpressure: the submit queue is bounded; a full queue raises
 active set is bounded by ``max_active`` (≤ cache slots, so admission can
 always pin a slot without evicting another active session).
 
+**Admission classes + deadlines** (the serve robustness plane): every
+request carries an admission class (``priority`` default /
+``best_effort``) and an optional deadline. The class queues are served
+by weighted round-robin (``class_weights``, default 4:1 — FIFO within a
+class, and exactly the old FIFO when only one class waits), so a
+best-effort flood cannot starve priority traffic; the router above
+additionally sheds best-effort at a smaller queue bound with an honest
+``Retry-After``. Deadlines are enforced where they can still save work:
+expired queued requests are REAPED before consuming a slot or a prefill
+dispatch, mid-prefill expiry stops burning chunks, and decode honors
+the deadline at window boundaries — settling the request with the
+partial output under its own ``timeout`` outcome
+(``serve_requests_total{outcome="timeout"}`` +
+``serve_deadline_expired_total{stage=}``), never a wedged client
+(tests/test_serve_deadline.py).
+
 The scheduler is single-threaded by design — `step()` is driven either by
 the server's background thread (`run`) or directly by tests (`drain`);
 `submit` may be called from any thread.
@@ -80,13 +96,75 @@ from collections import deque
 
 import numpy as np
 
+from ..resilience import faults as _faults
 from ..utils import tracing
 from .engine import GREEDY, PAD_TOKEN, DecodeWindow, SamplingParams, ServeEngine
 from .state_cache import PREFIX_SID_NAMESPACE
 
+#: admission classes, in dequeue-priority order. "priority" is the
+#: default (a class-less client gets the old FIFO behavior and the
+#: stricter SLO); "best_effort" is shed first under overload and served
+#: at the smaller weighted-dequeue share.
+CLASSES = ("priority", "best_effort")
+
+
+def retry_after_from_p99(p99, fullness: float) -> float:
+    """The ONE Retry-After policy, shared by the router's shed path and
+    the batcher's own queue bound: the measured queue-wait p99 (the
+    drain-time evidence) scaled by how full the queue is (0.5 + fullness
+    — 1.5x at a full queue), clamped to [0.05 s, 30 s], with a
+    conservative 0.25 s floor when no samples exist yet (cold server) or
+    the estimate is NaN."""
+    base = (float(p99) if isinstance(p99, (int, float)) and p99 == p99
+            else 0.0)
+    if base <= 0:
+        base = 0.25
+    return float(min(max(base * (0.5 + fullness), 0.05), 30.0))
+
+
+def register_shed_instruments(reg):
+    """Resolve the shed instruments both admission layers record into —
+    one registration site, so the name/labels/help can never drift
+    between the router and the batcher (metrics-consistency). Returns
+    ``(shed_by_class, retry_after_histogram)``."""
+    fam = reg.counter(
+        "serve_shed_total",
+        "429 sheds by admission class (best_effort sheds at its "
+        "smaller queue bound while priority keeps the headroom)",
+        labelnames=("class",))
+    # "class" is a Python keyword, so the kwarg must go through ** —
+    # which the analyzer cannot resolve against the registration
+    # graftlint: disable=metrics-consistency
+    shed = {c: fam.labels(**{"class": c}) for c in CLASSES}
+    retry_hist = reg.histogram(
+        "serve_retry_after_seconds",
+        "Retry-After hints attached to 429 sheds, computed from the "
+        "live queue-wait p99 (drain estimate, not a fixed constant)")
+    return shed, retry_hist
+
 
 class QueueFullError(RuntimeError):
-    """Admission control: the bounded submit queue is full (HTTP 429)."""
+    """Admission control: the bounded submit queue is full, or the
+    shedding policy rejected this class (HTTP 429). ``retry_after_s``
+    (when set by the router) is the server's live drain estimate from
+    the queue-wait p99 histogram — the client's honest retry hint."""
+
+    def __init__(self, msg: str, retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline lapsed server-side. ``request`` carries
+    whatever partial output was generated before expiry — the HTTP layer
+    returns it with an honest ``deadline_exceeded`` body instead of
+    wedging the client until its own timeout."""
+
+    def __init__(self, request: "Request"):
+        super().__init__(
+            f"request {request.id} deadline exceeded after "
+            f"{len(request.tokens)} token(s)")
+        self.request = request
 
 
 class Request:
@@ -105,6 +183,8 @@ class Request:
         keep_session: bool = False,
         eos_id: int | None = None,
         use_prefix: bool = True,
+        klass: str = "priority",
+        deadline_s: float | None = None,
     ):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
@@ -125,6 +205,23 @@ class Request:
         # measurement probes must not perturb (or be flattered by) the
         # shared cache
         self.use_prefix = use_prefix
+        if klass not in CLASSES:
+            raise ValueError(
+                f"unknown admission class {klass!r} (classes: "
+                f"{', '.join(CLASSES)})")
+        self.klass = klass
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        self.deadline_s = deadline_s
+        # absolute perf_counter deadline, stamped at FIRST submission so
+        # the budget covers queue wait; a requeued request (replica
+        # death) keeps its original deadline — the client's budget does
+        # not reset because a replica died
+        self.deadline: float | None = None
+        # honest server-side expiry: the request settled with whatever
+        # tokens were already generated (partial output), counted under
+        # serve_requests_total{outcome="timeout"}
+        self.timed_out = False
         self.id = next(Request._ids)
         # replica index this request was routed to (serve/router.py) —
         # None until routed (or forever, for a direct Batcher.submit).
@@ -151,6 +248,12 @@ class Request:
         # the latency cost of windowing measurable (loadgen p50/p99 ITL)
         # instead of guessed.
         self.t_tokens: list[float] = []
+
+    def expired(self, now: float | None = None) -> bool:
+        """True once the (submit-stamped) deadline has lapsed."""
+        if self.deadline is None:
+            return False
+        return (time.perf_counter() if now is None else now) > self.deadline
 
     def itl_gaps(self) -> list[float]:
         """Inter-token latencies (seconds): gaps between consecutive
@@ -232,6 +335,10 @@ class Batcher:
     #: lattice stays tiny; (1,) disables windowing (pure K=1 path).
     DEFAULT_WINDOW_LADDER = (1, 4, 8)
 
+    #: default weighted-dequeue shares (priority, best_effort): out of
+    #: every 5 admissions with both classes waiting, 4 are priority.
+    DEFAULT_CLASS_WEIGHTS = (4, 1)
+
     def __init__(
         self,
         engine: ServeEngine,
@@ -241,6 +348,7 @@ class Batcher:
         queue_size: int = 64,
         window_ladder: tuple[int, ...] = DEFAULT_WINDOW_LADDER,
         prefill_chunk: int | None = None,
+        class_weights: tuple[int, int] = DEFAULT_CLASS_WEIGHTS,
     ):
         if max_active < 1:
             raise ValueError(f"max_active must be >= 1, got {max_active}")
@@ -275,6 +383,11 @@ class Batcher:
                 f"of prefix stride {engine.prefix.stride} — chunks would be "
                 "truncated to stride alignment; pick a compatible chunk or "
                 "disable the prefix cache")
+        if (len(class_weights) != len(CLASSES)
+                or any(int(w) < 1 for w in class_weights)):
+            raise ValueError(
+                f"class_weights needs one positive weight per class "
+                f"{CLASSES}, got {class_weights!r}")
         # rung 1 is always present: _pick_window falls back to it (near
         # budget end, pipelined tails), and warmup(windows=ladder) must
         # precompile every size the scheduler can dispatch
@@ -294,7 +407,22 @@ class Batcher:
         # the in-flight decode window: (DecodeWindow handles, its rows'
         # sessions in packed order). Owned by the scheduler thread only.
         self._pending: tuple[DecodeWindow, list[_Session]] | None = None
-        self._queue: deque[Request] = deque()
+        # one bounded queue PER admission class; dequeue is weighted
+        # round-robin over the non-empty ones (the wrr sequence below),
+        # so a best-effort flood can no longer starve priority traffic
+        # the way the old single FIFO did. The queue_size bound covers
+        # the SUM — the router's class-aware shed policy sits above.
+        self.class_weights = tuple(int(w) for w in class_weights)
+        self._queues: dict[str, deque[Request]] = {
+            c: deque() for c in CLASSES}
+        self._wrr_seq: tuple[str, ...] = tuple(
+            c for c, w in zip(CLASSES, self.class_weights)
+            for _ in range(w))
+        self._wrr_idx = 0
+        # True while any queued request MAY carry a deadline — gates the
+        # per-iteration queue reap so deadline-less workloads never pay
+        # the scan (set by submit, cleared when a scan finds none left)
+        self._deadlines_queued = False
         self._active: list[_Session] = []
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -303,6 +431,7 @@ class Batcher:
         self.completed = 0
         self.rejected = 0
         self.failed = 0
+        self.timed_out = 0  # deadline expiries (queue/prefill/decode)
         self.tokens_generated = 0
         self.windows_dispatched: dict[int, int] = {}  # K -> dispatch count
         self.windows_pipelined = 0  # dispatched ahead of a pending fetch
@@ -366,6 +495,22 @@ class Batcher:
         self._m_req_completed = fam.labels(outcome="completed", replica=rl)
         self._m_req_failed = fam.labels(outcome="failed", replica=rl)
         self._m_req_rejected = fam.labels(outcome="rejected", replica=rl)
+        # honest deadline expiry is its OWN outcome (partial output,
+        # never "failed" — the client got every token that was ready)
+        self._m_req_timeout = fam.labels(outcome="timeout", replica=rl)
+        fam = reg.counter(
+            "serve_deadline_expired_total",
+            "request deadlines that lapsed, by the pipeline stage that "
+            "reaped them (queue = before any slot/prefill was spent)",
+            labelnames=("stage", "replica"))
+        self._m_deadline = {s: fam.labels(stage=s, replica=rl)
+                            for s in ("queue", "prefill", "decode")}
+        # the batcher-level bound can fire too (direct submits; a wedged
+        # replica's own queue filling on the affinity path while the
+        # router's non-stale sum stays low) — those 429s must carry the
+        # same Retry-After + shed accounting as the router's (one shared
+        # registration + one shared policy, so the layers cannot drift)
+        self._m_shed, self._m_retry_after = register_shed_instruments(reg)
 
     # ---- client side ---------------------------------------------------
 
@@ -383,11 +528,21 @@ class Batcher:
                 "(enable prefill_chunk to serve longer prompts)"
             )
         with self._lock:
-            if len(self._queue) >= self.queue_size:
+            if self._qlen_locked() >= self.queue_size:
+                # same honest-429 contract as the router's shed path:
+                # Retry-After from the measured queue wait, counted under
+                # serve_shed_total — a 429 from THIS layer (direct
+                # submits; a wedged replica's own queue filling while the
+                # router's non-stale sum stays low) must not be a
+                # second-class reply clients cannot back off from
+                retry = self._retry_after_locked()
                 self.rejected += 1
                 self._m_req_rejected.inc()
+                self._m_shed[req.klass].inc()
+                self._m_retry_after.observe(retry)
                 raise QueueFullError(
-                    f"submit queue full ({self.queue_size} pending)"
+                    f"submit queue full ({self.queue_size} pending); "
+                    f"retry after {retry:.2f}s", retry_after_s=retry
                 )
             if req.t_submit is None:
                 # first submission; a REQUEUED request (router: replica
@@ -401,20 +556,37 @@ class Batcher:
                 # `requeued` counter makes explicit)
                 req.t_submit = time.perf_counter()
                 self.submitted += 1
-            self._queue.append(req)
+                if req.deadline_s is not None:
+                    # the absolute deadline starts at FIRST submission
+                    # (covers queue wait); requeues keep the original
+                    req.deadline = req.t_submit + req.deadline_s
+            if req.deadline is not None:
+                self._deadlines_queued = True  # arms the _admit reap
+            self._queues[req.klass].append(req)
             self._work.notify()
 
+    def _qlen_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _retry_after_locked(self) -> float:
+        """Honest Retry-After for a full-queue 429 at THIS layer: this
+        scheduler's queue-wait p99 through the shared policy
+        (:func:`retry_after_from_p99`) at fullness 1.0 — the bound only
+        fires when the queue IS full."""
+        s = self._m_queue_wait.summary() or {}
+        return retry_after_from_p99(s.get("p99"), 1.0)
+
     def queued(self) -> int:
-        """Requests waiting for admission (the router sums this across
-        replicas for the GLOBAL queue bound)."""
+        """Requests waiting for admission, summed over the class queues
+        (the router sums this across replicas for the GLOBAL bound)."""
         with self._lock:
-            return len(self._queue)
+            return self._qlen_locked()
 
     def load(self) -> int:
         """Routing weight: queued + admitted work on this scheduler, read
         under one lock hold (the router's least-loaded pick)."""
         with self._lock:
-            return (len(self._queue) + len(self._active)
+            return (self._qlen_locked() + len(self._active)
                     + len(self._prefilling))
 
     # ---- replica retirement (router-driven; see serve/router.py) -------
@@ -427,10 +599,14 @@ class Batcher:
 
     def drain_queue(self) -> list[Request]:
         """Remove and return every not-yet-admitted request (the router
-        requeues them onto live replicas)."""
+        requeues them onto live replicas), oldest-submitted first so the
+        requeue preserves rough arrival order across the class queues."""
         with self._lock:
-            out = list(self._queue)
-            self._queue.clear()
+            out = [r for q in self._queues.values() for r in q]
+            for q in self._queues.values():
+                q.clear()
+        out.sort(key=lambda r: (r.t_submit if r.t_submit is not None
+                                else float("inf"), r.id))
         return out
 
     def fail_inflight(self, reason: str) -> int:
@@ -470,12 +646,17 @@ class Batcher:
         a decode advance for every active session). Returns True when any
         work was done."""
         self.last_heartbeat = time.monotonic()
+        # chaos drills: an armed replica_die/replica_wedge fault fires
+        # here — the InjectedFault propagates out of run() and kills this
+        # scheduler thread (death the router must retire), or the wedge
+        # blocks with the heartbeat stale (the /healthz wedge case)
+        _faults.serve_step_hook(self.replica)
         t0 = time.perf_counter()
         did = self._admit()
         did = self._prefill_step() or did
         did = self._decode_all() or did
         with self._lock:
-            queued, active = len(self._queue), len(self._active)
+            queued, active = self._qlen_locked(), len(self._active)
             prefilling = len(self._prefilling)
         self._m_queue_depth.set(queued)
         self._m_active.set(active)
@@ -489,28 +670,76 @@ class Batcher:
 
     def _admit(self) -> bool:
         admit: list[Request] = []
+        dropped: list[Request] = []
+        reaped: list[Request] = []
+        now = time.perf_counter()
         with self._lock:
+            # deadline reap across the WHOLE queue first: an expired
+            # request must be settled here — never allowed to consume a
+            # state-cache slot or burn a prefill dispatch further down.
+            # One rebuild pass per class (not remove() per victim —
+            # O(k·n) under the submit-shared lock during exactly the
+            # mass-expiry bursts deadlines exist for), gated so
+            # deadline-less workloads never pay the scan at all.
+            if self._deadlines_queued:
+                still_armed = False
+                for q in self._queues.values():
+                    keep: list[Request] = []
+                    for r in q:
+                        if r.expired(now):
+                            reaped.append(r)
+                        else:
+                            keep.append(r)
+                            still_armed = (still_armed
+                                           or r.deadline is not None)
+                    if len(keep) != len(q):
+                        q.clear()
+                        q.extend(keep)
+                self._deadlines_queued = still_armed
             busy_sids = {s.sid for s in self._active}
             busy_sids.update(p.sess.sid for p in self._prefilling)
             capacity = min(
                 self.max_active - len(self._active) - len(self._prefilling),
                 self.engine.max_batch,
             )
-            while self._queue and len(admit) < capacity:
-                head = self._queue[0]
+            nwrr = len(self._wrr_seq)
+            while len(admit) < capacity:
+                # weighted round-robin over the non-empty class queues:
+                # within a class the order stays FIFO, and with one class
+                # waiting this degrades to exactly the old FIFO
+                cls = jpos = None
+                for i in range(nwrr):
+                    j = (self._wrr_idx + i) % nwrr
+                    if self._queues[self._wrr_seq[j]]:
+                        cls, jpos = self._wrr_seq[j], j
+                        break
+                if cls is None:
+                    break
+                head = self._queues[cls][0]
                 if head.cancelled:
                     # abandoned by its client (timeout): drop instead of
-                    # spending decode steps on tokens nobody reads
-                    self._queue.popleft()
-                    self._fail(head, "cancelled before admission")
+                    # spending decode steps on tokens nobody reads. A
+                    # drop is not a service — the wrr cursor stays put.
+                    self._queues[cls].popleft()
+                    dropped.append(head)
                     continue
                 # one prefill batch = one sampling config (compile key);
-                # strict FIFO at the head keeps admission starvation-free
+                # FIFO at the picked head keeps admission starvation-free
                 if admit and head.sampling.key() != admit[0].sampling.key():
                     break
-                admit.append(self._queue.popleft())
+                self._queues[cls].popleft()
+                self._wrr_idx = (jpos + 1) % nwrr
+                admit.append(head)
+        for r in dropped:
+            self._fail(r, "cancelled before admission")
+        for r in reaped:
+            # queue-only lifetime: the phase timeline records exactly the
+            # submit→reap span, nothing else (tests pin this)
+            if r.t_submit is not None:
+                r.phases.append(("queue", r.t_submit, now))
+            self._settle_timeout(r, "queue")
         if not admit:
-            return False
+            return bool(dropped or reaped)
 
         now = time.perf_counter()
         for req in admit:
@@ -687,9 +916,14 @@ class Batcher:
         running sessions by one chunk's latency per token."""
         if not self._prefilling:
             return False
+        now = time.perf_counter()
         for p in list(self._prefilling):
             if p.sess.req.cancelled:
                 self._abort_prefilling(p, "cancelled during prefill")
+            elif p.sess.req.expired(now):
+                # mid-prefill expiry (chunked prefills span iterations):
+                # stop burning chunk dispatches on a dead deadline
+                self._abort_prefilling(p, None, timeout=True)
         while self._prefilling:
             batch, final = self._select_prefill_batch()
             self._dispatch_prefill(batch, final)
@@ -759,7 +993,8 @@ class Batcher:
                 with self._lock:
                     self._active.append(s)
 
-    def _abort_prefilling(self, p: _Prefilling, error: str) -> None:
+    def _abort_prefilling(self, p: _Prefilling, error: str | None,
+                          *, timeout: bool = False) -> None:
         with self._lock:
             try:
                 self._prefilling.remove(p)
@@ -769,7 +1004,10 @@ class Batcher:
             self.engine.prefix.release(p.entry)
             p.entry = None
         self.engine.cache.release(p.sess.sid)
-        self._fail(p.sess.req, error)
+        if timeout:
+            self._settle_timeout(p.sess.req, "prefill")
+        else:
+            self._fail(p.sess.req, error)
 
     def _decode_all(self) -> bool:
         did = False
@@ -784,12 +1022,23 @@ class Batcher:
             active = list(self._active)
         if not active:
             return did
+        now = time.perf_counter()
         for s in active:
             if s.req.cancelled:  # abandoned mid-decode: free the slot now
                 self._retire(s)
                 self.engine.cache.release(s.sid)
                 self._fail(s.req, "cancelled mid-decode")
-        active = [s for s in active if not s.req.cancelled]
+            elif s.req.expired(now):
+                # deadline at a decode boundary: settle with the tokens
+                # already delivered (honest partial output). The session
+                # is NOT kept even under keep_session — dispatch-ahead
+                # windows may have advanced the device state past the
+                # returned tokens, and a continuation from an
+                # indeterminate position could silently double-decode.
+                self._retire(s)
+                self._release_timed_out_session(s)
+                self._settle_timeout(s.req, "decode")
+        active = [s for s in active if not s.req.done.is_set()]
         if not active:
             return True
         # pack by sampling config, chunk to the engine's largest batch
@@ -807,7 +1056,8 @@ class Batcher:
                 # a non-empty prefilling set pins K=1 like a non-empty
                 # queue: decode must yield to the next prefill chunk every
                 # iteration, or chunking's bounded-stall guarantee dies
-                queue_empty = not self._queue and not self._prefilling
+                queue_empty = (not self._qlen_locked()
+                               and not self._prefilling)
             if queue_empty:
                 k = self._pick_window(min(s.remaining for s in active))
                 if k > 1:
@@ -879,10 +1129,16 @@ class Batcher:
         win, sessions = self._pending
         self._pending = None
         with self._lock:
-            queue_empty = not self._queue and not self._prefilling
+            queue_empty = (not self._qlen_locked()
+                           and not self._prefilling)
             same_rows = self._active == sessions
-        cancelled = any(s.req.cancelled for s in sessions)
-        if pipeline and queue_empty and same_rows and not cancelled:
+        now0 = time.perf_counter()
+        # an expired (or cancelled/settled) row stops the pipeline: its
+        # window boundary is where the deadline is honored, not deferred
+        # behind yet another dispatched window
+        stop = any(s.req.cancelled or s.req.done.is_set()
+                   or s.req.expired(now0) for s in sessions)
+        if pipeline and queue_empty and same_rows and not stop:
             # remaining budgets as of AFTER the unfetched window, assuming
             # full consumption (rows that EOS'd early are latched frozen on
             # device, so overestimating their budget is harmless)
@@ -902,7 +1158,10 @@ class Batcher:
                 self.windows_pipelined += 1
                 self._pending = (nxt, list(sessions))
         # the pipeline's only sync point: blocks on window i while window
-        # i+1 (if dispatched above) runs on device
+        # i+1 (if dispatched above) runs on device. Chaos drills inject
+        # slow-readback latency here (the scheduler must absorb it as
+        # latency, never as wrong tokens).
+        _faults.serve_readback_hook()
         t_fetch = time.perf_counter()
         toks = self.engine.fetch_window(win)
         now = time.perf_counter()
@@ -924,6 +1183,14 @@ class Batcher:
             if s.remaining == 0:
                 self._retire(s)
                 self._finish(s)
+            elif s.req.expired(now):
+                # window boundary = deadline boundary: this window's
+                # tokens were delivered above, the request settles now
+                # with that partial output (see the _decode_all sweep
+                # for why the session is never kept)
+                self._retire(s)
+                self._release_timed_out_session(s)
+                self._settle_timeout(s.req, "decode")
 
     def _fail_chunk(self, sessions: list[_Session], error: str) -> None:
         for s in sessions:
@@ -947,6 +1214,22 @@ class Batcher:
         self.tokens_generated += 1
         if s.req.eos_id is not None and tok == s.req.eos_id:
             s.remaining = 0
+
+    def _release_timed_out_session(self, s: _Session) -> None:
+        """Release a deadline-expired session's slot AND its tier copies.
+        The client received PARTIAL tokens this turn, so a tier copy from
+        the LAST COMPLETED boundary would resurrect the conversation
+        WITHOUT them — a later continuation would silently decode a
+        context inconsistent with what the client already displayed.
+        Discarding makes that continuation fail "unknown session"
+        loudly instead (the client re-sends its full history, exactly
+        like after an un-kept completion). Contrast the FAILURE paths,
+        which deliberately keep tier copies: a failed request delivered
+        nothing, so the last completed boundary IS its token-identical
+        recovery point."""
+        self.engine.cache.release(s.sid)
+        if self.engine.tiers is not None:
+            self.engine.tiers.discard(s.sid)
 
     def _retire(self, s: _Session) -> None:
         with self._lock:
@@ -991,6 +1274,20 @@ class Batcher:
         self._emit_timeline(req)
         req.done.set()
 
+    def _settle_timeout(self, req: Request, stage: str) -> None:
+        """Settle a deadline-expired request: its own outcome family
+        (never "failed" — the client gets every token that was ready as
+        a partial reply), counted by the stage that reaped it."""
+        req.timed_out = True
+        req.t_done = time.perf_counter()
+        self.timed_out += 1
+        self._m_req_timeout.inc()
+        m = self._m_deadline.get(stage)
+        if m is not None:
+            m.inc()
+        self._emit_timeline(req)
+        req.done.set()
+
     @staticmethod
     def _emit_timeline(req: Request) -> None:
         """Emit the request's phase timeline into the installed Chrome
@@ -1022,7 +1319,7 @@ class Batcher:
             if self.step():
                 continue
             with self._work:
-                if not self._queue and not self._active:
+                if not self._qlen_locked() and not self._active:
                     self._work.wait(timeout=idle_wait)
             # idle cycles beat the heartbeat too: "no traffic" and "thread
             # stuck" must look different to /healthz
@@ -1044,7 +1341,8 @@ class Batcher:
         # from this (client-thread) path is a data race — and a snapshot
         # whose fields come from different instants lies under load
         with self._lock:
-            queued, active = len(self._queue), len(self._active)
+            queued, active = self._qlen_locked(), len(self._active)
+            queued_by_class = {c: len(q) for c, q in self._queues.items()}
             prefilling = len(self._prefilling)
             submitted, rejected = self.submitted, self.rejected
         return {
@@ -1053,6 +1351,9 @@ class Batcher:
             "completed": self.completed,
             "rejected": rejected,
             "failed": self.failed,
+            "timed_out": self.timed_out,
+            "queued_by_class": queued_by_class,
+            "class_weights": list(self.class_weights),
             "tokens_generated": self.tokens_generated,
             "queued": queued,
             "active": active,

@@ -46,8 +46,9 @@ import time
 
 import numpy as np
 
+from ..resilience.backoff import backoff_delay
 from ..utils import span
-from .batcher import QueueFullError
+from .batcher import CLASSES, DeadlineExceededError, QueueFullError
 from .engine import GREEDY, SamplingParams
 from .server import InprocessClient, ServeServer
 
@@ -91,6 +92,37 @@ def _per_replica(results: list[dict]) -> dict:
         d["completed"] += 1
         d["tokens"] += r["tokens"]
     return out
+
+
+def _class_report(recs: list[dict], shed: int, retried: int,
+                  timeouts: int) -> dict:
+    """Per-admission-class slice of a run: completion/shed/retry/timeout
+    counts plus TTFT and latency percentiles — the evidence the
+    burst-shedding gate compares (priority p99 TTFT holds its SLO while
+    best_effort sheds; BENCH_serve_r04.json)."""
+    ttft = sorted(r["ttft_s"] for r in recs if r["ttft_s"] is not None)
+    lat = sorted(r["latency_s"] for r in recs)
+
+    def pct(vals, p):
+        # None (JSON null), never NaN: the classes section is ALWAYS
+        # present, so a zero-traffic class in a default single-class run
+        # must not make every --json report unparseable to strict
+        # RFC-8259 consumers (json.dump writes bare NaN)
+        if not vals:
+            return None
+        return round(_percentile(vals, p) * 1e3, 3)
+
+    return {
+        "completed": len(recs),
+        "shed": shed,
+        "retried": retried,
+        "timeouts": timeouts,
+        "tokens": sum(r["tokens"] for r in recs),
+        "p50_ttft_ms": pct(ttft, 50),
+        "p99_ttft_ms": pct(ttft, 99),
+        "p50_latency_ms": pct(lat, 50),
+        "p99_latency_ms": pct(lat, 99),
+    }
 
 
 #: prefix-cache stats() keys that are per-replica CONFIG, not counters —
@@ -166,6 +198,11 @@ def run_loadgen(
     shared_prefix_len: int = 0,
     inject_prompt_len: int = 0,
     inject_delay_s: float = 0.25,
+    priority_frac: float = 1.0,
+    deadline_s: float | None = None,
+    retry_max: int = 0,
+    retry_base_s: float = 0.05,
+    retry_cap_s: float = 2.0,
 ) -> dict:
     """Drive a started :class:`ServeServer`; returns the report dict.
 
@@ -174,38 +211,79 @@ def run_loadgen(
     with a prompt of that length is submitted ``inject_delay_s`` seconds
     into the run — the head-of-line-blocking probe (does a max-bucket
     prefill mid-run stall everyone else's ITL?); it is reported under
-    ``"injected"`` and EXCLUDED from the pooled latency stats."""
+    ``"injected"`` and EXCLUDED from the pooled latency stats.
+
+    ``priority_frac``: share of traffic submitted as the "priority"
+    admission class; the rest goes "best_effort" (interleaved, so a
+    burst mixes both). ``deadline_s`` rides on every request.
+    ``retry_max > 0``: a 429 shed is retried up to that many times,
+    sleeping the server's ``Retry-After`` hint floored by the SHARED
+    capped exponential backoff + jitter (resilience/backoff.py — the
+    supervisor's curve, one implementation), both capped at
+    ``retry_cap_s``; per-class shed/retried/timeout counts land in the
+    report's ``classes`` section."""
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be 'closed' or 'open', got {mode!r}")
+    if not 0.0 <= priority_frac <= 1.0:
+        raise ValueError(
+            f"priority_frac must be in [0, 1], got {priority_frac}")
     client = InprocessClient(server)
     total = sessions * requests_per_session
     prompts = _random_prompts(total, prompt_len, vocab_size, seed,
                               shared_prefix_len)
+    n_priority = int(round(sessions * priority_frac))
     results: list[dict] = []
     rejected = [0]
     failed = [0]
+    shed = {c: 0 for c in CLASSES}
+    retried = {c: 0 for c in CLASSES}
+    timeouts = {c: 0 for c in CLASSES}
     lock = threading.Lock()
     prefix_before = prefix_totals(server)
     router_before = server.router.stats()
 
-    def one_request(prompt) -> None:
+    def one_request(prompt, klass: str = "priority") -> None:
         t0 = time.perf_counter()
-        try:
-            req = server.generate(
-                prompt, max_new_tokens=max_new_tokens, sampling=sampling,
-                timeout=timeout,
-            )
-        except QueueFullError:
-            with lock:
-                rejected[0] += 1
-            return
-        except Exception:
-            # a timeout or scheduler-side failure must not kill the worker
-            # thread (its remaining requests would silently vanish from
-            # the report) — count it and keep the loop going
-            with lock:
-                failed[0] += 1
-            return
+        attempt = 0
+        while True:
+            try:
+                req = server.generate(
+                    prompt, max_new_tokens=max_new_tokens,
+                    sampling=sampling, timeout=timeout, klass=klass,
+                    deadline_s=deadline_s,
+                )
+                break
+            except QueueFullError as e:
+                if attempt >= retry_max:
+                    # shed for good: an honest 429 the client accepted
+                    with lock:
+                        rejected[0] += 1
+                        shed[klass] += 1
+                    return
+                attempt += 1
+                with lock:
+                    retried[klass] += 1
+                # honor Retry-After, floored by the shared backoff curve
+                # (jittered so a shed burst doesn't re-arrive in
+                # lockstep), both capped at retry_cap_s
+                hint = getattr(e, "retry_after_s", None) or 0.0
+                time.sleep(min(
+                    max(hint, backoff_delay(retry_base_s, attempt,
+                                            cap=retry_cap_s)),
+                    retry_cap_s))
+            except DeadlineExceededError:
+                # server-side expiry: honest partial output, counted as
+                # a timeout for this class — not a failure
+                with lock:
+                    timeouts[klass] += 1
+                return
+            except Exception:
+                # a timeout or scheduler-side failure must not kill the
+                # worker thread (its remaining requests would silently
+                # vanish from the report) — count it and keep going
+                with lock:
+                    failed[0] += 1
+                return
         rec = {
             "latency_s": time.perf_counter() - t0,
             "ttft_s": (req.t_first_token - req.t_submit)
@@ -213,6 +291,7 @@ def run_loadgen(
             "tokens": len(req.tokens),
             "itl_s": req.itl_gaps(),
             "replica": req.replica,
+            "klass": klass,
         }
         with lock:
             results.append(rec)
@@ -252,8 +331,12 @@ def run_loadgen(
             inject_thread.start()
         if mode == "closed":
             def worker(wid: int) -> None:
+                # per-session class: the first n_priority sessions are
+                # priority, the rest best-effort
+                klass = "priority" if wid < n_priority else "best_effort"
                 for r in range(requests_per_session):
-                    one_request(prompts[wid * requests_per_session + r])
+                    one_request(prompts[wid * requests_per_session + r],
+                                klass)
 
             threads = [
                 threading.Thread(target=worker, args=(i,), daemon=True)
@@ -268,12 +351,17 @@ def run_loadgen(
                 raise ValueError("open-loop mode needs rate > 0 (req/s)")
             threads = []
             for i, prompt in enumerate(prompts):
+                # interleaved class pattern (period = sessions): a burst
+                # carries both classes throughout, not one then the other
+                klass = ("priority"
+                         if (i % max(sessions, 1)) < n_priority
+                         else "best_effort")
                 target = t_start + i / rate
                 delay = target - time.perf_counter()
                 if delay > 0:
                     time.sleep(delay)
                 t = threading.Thread(
-                    target=one_request, args=(prompt,), daemon=True
+                    target=one_request, args=(prompt, klass), daemon=True
                 )
                 t.start()
                 threads.append(t)
@@ -286,6 +374,21 @@ def run_loadgen(
         if inject_thread is not None:
             inject_thread.join()
     report = _report(results, rejected[0], failed[0], wall, mode, sessions)
+    # per-class accounting (shed/retried/timeout + TTFT percentiles):
+    # always present so report consumers have a stable shape; a
+    # single-class run simply shows zeros for the other class
+    report["timeouts"] = sum(timeouts.values())
+    report["requests"] += report["timeouts"]
+    report["priority_frac"] = priority_frac
+    if deadline_s is not None:
+        report["deadline_s"] = deadline_s
+    if retry_max:
+        report["retry_max"] = retry_max
+    report["classes"] = {
+        c: _class_report([r for r in results if r.get("klass") == c],
+                         shed[c], retried[c], timeouts[c])
+        for c in CLASSES
+    }
     if rate:
         report["offered_rate_rps"] = rate
     report["prompt_len"] = prompt_len
@@ -302,6 +405,10 @@ def run_loadgen(
         "routed": {k: ra["routed"][k] - rb["routed"].get(k, 0)
                    for k in ra["routed"]},
         "rejected": ra["rejected"] - rb["rejected"],
+        "shed_by_class": {
+            c: ra["shed_by_class"][c] - rb.get("shed_by_class", {}).get(c, 0)
+            for c in ra.get("shed_by_class", {})
+        },
         "requeued": ra["requeued"] - rb["requeued"],
         "failed_on_death": ra["failed_on_death"] - rb["failed_on_death"],
         "migrated_sessions":

@@ -72,7 +72,14 @@ import itertools
 import threading
 import time
 
-from .batcher import Batcher, QueueFullError, Request
+from .batcher import (
+    CLASSES,
+    Batcher,
+    QueueFullError,
+    Request,
+    register_shed_instruments,
+    retry_after_from_p99,
+)
 from .engine import ServeEngine
 from .state_cache import PREFIX_SID_NAMESPACE
 
@@ -110,13 +117,25 @@ class Router:
     """Admission front for a set of replicas (module docstring)."""
 
     def __init__(self, replicas: list[Replica], *, queue_size: int = 64,
-                 stale_after: float = 60.0, registry=None):
+                 stale_after: float = 60.0,
+                 best_effort_frac: float = 0.5, registry=None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         if queue_size < 1:
             raise ValueError(f"queue_size must be >= 1, got {queue_size}")
+        if not 0.0 < best_effort_frac <= 1.0:
+            raise ValueError(
+                f"best_effort_frac must be in (0, 1], got {best_effort_frac}")
         self.replicas = list(replicas)
         self.queue_size = queue_size
+        # SLO-aware shedding: best-effort requests are 429'd once the
+        # live queue reaches this smaller bound, so a best-effort burst
+        # sheds while the priority class keeps the remaining headroom —
+        # the honest degradation the old single fixed bound couldn't
+        # express (it shed both classes indiscriminately, FIFO)
+        self.best_effort_frac = float(best_effort_frac)
+        self._best_effort_bound = max(
+            1, int(round(queue_size * best_effort_frac)))
         # heartbeat-staleness bound for ROUTING (mirrors the server's
         # health_stale_after): a wedged replica must stop receiving fresh
         # sessions — they would hang to client timeout while holding
@@ -131,6 +150,7 @@ class Router:
         # replica that is about to serve
         self._stopping = True
         self.rejected = 0            # global-bound 429s
+        self.shed = {c: 0 for c in CLASSES}  # 429s by admission class
         self.requeued = 0            # dead-replica queue → live replica
         self.failed_on_death = 0     # in-flight requests failed honestly
         self.migrated_sessions = 0   # idle kept sessions detach/restored
@@ -163,13 +183,31 @@ class Router:
         self._m_migrated = reg.counter(
             "serve_router_migrated_sessions_total",
             "idle kept sessions moved off dead replicas via detach/restore")
+        # shared with the batcher's own queue bound: one registration
+        # site + one policy function, so the two layers can never hint
+        # different Retry-After curves for the same queue state
+        self._m_shed, self._m_retry_after = register_shed_instruments(reg)
+        # the live queue-wait histogram family (registered by the
+        # batchers, same name/labels/buckets — idempotent): its p99 IS
+        # the drain-time evidence Retry-After is computed from
+        self._qwait = reg.histogram(
+            "serve_queue_wait_seconds", "submit → admission wait",
+            labelnames=("replica",))
 
     # ---- client side ---------------------------------------------------
 
     def submit(self, req: Request) -> None:
         """Admit + route one request, or raise :class:`QueueFullError`
-        (global bound; HTTP 429) / ``RuntimeError`` when no replica is
-        live. Called from client/HTTP threads."""
+        (SLO-aware shed; HTTP 429 with ``retry_after_s``) /
+        ``RuntimeError`` when no replica is live. Called from client/HTTP
+        threads.
+
+        Shedding is class-aware: ``best_effort`` requests are rejected
+        once the live queue reaches ``best_effort_frac * queue_size``,
+        ``priority`` only at the full bound — so a burst degrades by
+        shedding the cheap class first. Every shed carries a
+        ``Retry-After`` computed from the live queue-wait p99 histogram
+        (the measured drain time), not a made-up constant."""
         self.sweep()
         with self._lock:
             live = [r for r in self.replicas if r.alive()]
@@ -181,14 +219,34 @@ class Router:
             # stranded entries would permanently shrink the fleet's
             # effective admission capacity until restart. If the wedge
             # recovers, a transient overshoot of the bound drains normally.
-            if sum(r.batcher.queued() for r in live
-                   if not r.stale(self.stale_after)) >= self.queue_size:
+            queued = sum(r.batcher.queued() for r in live
+                         if not r.stale(self.stale_after))
+            bound = (self._best_effort_bound
+                     if req.klass == "best_effort" else self.queue_size)
+            if queued >= bound:
+                retry = self._retry_after_locked(queued)
                 self.rejected += 1
+                self.shed[req.klass] += 1
                 self._m_rejected.inc()
                 self._m_rejected_outcome.inc()
+                self._m_shed[req.klass].inc()
+                self._m_retry_after.observe(retry)
                 raise QueueFullError(
-                    f"submit queue full ({self.queue_size} pending)")
+                    f"submit queue full for class {req.klass!r} "
+                    f"({queued} pending >= bound {bound}); retry after "
+                    f"{retry:.2f}s", retry_after_s=retry)
             self._dispatch_locked(req, live)
+
+    def _retry_after_locked(self, queued: int) -> float:
+        """Honest Retry-After (seconds) for a shed: the fleet's queue-wait
+        p99 — the measured time a queued request recently waited for
+        admission — through the shared policy
+        (:func:`~.batcher.retry_after_from_p99`) at the current queue
+        fullness."""
+        agg = self._qwait.aggregate_over("replica")
+        s = agg.get("") or {}
+        return retry_after_from_p99(
+            s.get("p99"), queued / max(self.queue_size, 1))
 
     def _dispatch_locked(self, req: Request, live: list[Replica]) -> None:
         self._submit_to_locked(req, self._pick_locked(req, live))
@@ -432,6 +490,8 @@ class Router:
                 "routed": {str(k): v
                            for k, v in sorted(self.routed.items())},
                 "rejected": self.rejected,
+                "shed_by_class": dict(self.shed),
+                "best_effort_bound": self._best_effort_bound,
                 "requeued": self.requeued,
                 "failed_on_death": self.failed_on_death,
                 "migrated_sessions": self.migrated_sessions,

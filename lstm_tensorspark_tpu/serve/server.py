@@ -53,15 +53,22 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .batcher import Batcher, QueueFullError, Request
+from .batcher import (
+    CLASSES,
+    Batcher,
+    DeadlineExceededError,
+    QueueFullError,
+    Request,
+)
 from .engine import GREEDY, SamplingParams, ServeEngine
 from .router import Replica, Router
 
 #: aggregated batcher counters summed across replicas in stats(); config
 #: fields (window ladder etc.) are taken from replica 0 instead
 _SUMMED_BATCHER_KEYS = (
-    "submitted", "completed", "rejected", "failed", "tokens_generated",
+    "submitted", "completed", "rejected", "failed", "timed_out",
     "queued", "active", "prefilling", "windows_pipelined",
+    "tokens_generated",
     "prefill_chunks_dispatched", "prefix_resumed", "prefix_tokens_saved",
 )
 
@@ -85,11 +92,35 @@ class ServeServer:
     server sits far below the default."""
 
     def __init__(self, engine, batcher: Batcher | None = None,
-                 health_stale_after: float = 60.0, **batcher_kw):
+                 health_stale_after: float = 60.0,
+                 best_effort_queue_frac: float = 0.5,
+                 deadline_defaults: dict | None = None,
+                 sweep_interval: float | None = None, **batcher_kw):
         engines = (list(engine) if isinstance(engine, (list, tuple))
                    else [engine])
         if not engines:
             raise ValueError("ServeServer needs at least one engine")
+        if sweep_interval is not None and sweep_interval <= 0:
+            raise ValueError(
+                f"sweep_interval must be > 0 or None, got {sweep_interval}")
+        # per-class default deadlines (seconds): applied in generate()
+        # when the request names none — the serve plane's promise that
+        # NO admitted request can wait/decode forever. None per class =
+        # no default (the shipped default, back-compat).
+        self.deadline_defaults = {c: None for c in CLASSES}
+        if deadline_defaults:
+            for c, v in deadline_defaults.items():
+                if c not in CLASSES:
+                    raise ValueError(f"unknown admission class {c!r}")
+                if v is not None and v < 0:
+                    raise ValueError(
+                        f"deadline_defaults[{c!r}] must be >= 0 or None, "
+                        f"got {v}")
+                # 0 normalizes to None (the CLI's 0-means-none
+                # convention) HERE, at construction — otherwise every
+                # request of the class would fail Request validation at
+                # runtime with a client-blaming 400
+                self.deadline_defaults[c] = v if v else None
         if batcher is not None and len(engines) > 1:
             raise ValueError(
                 "an explicit batcher only makes sense for a single-replica "
@@ -108,8 +139,17 @@ class ServeServer:
         # the router's check is the only one that ever fires
         self.router = Router(
             self.replicas, queue_size=self.replicas[0].batcher.queue_size,
-            stale_after=health_stale_after, registry=engines[0].metrics)
+            stale_after=health_stale_after,
+            best_effort_frac=best_effort_queue_frac,
+            registry=engines[0].metrics)
         self.health_stale_after = health_stale_after
+        # optional periodic death sweep: the sweep normally piggybacks on
+        # submits and health probes, so a dead replica on a QUIET server
+        # is only retired when the next probe lands — an interval makes
+        # retirement (requeue/migrate) happen within sweep_interval even
+        # with no traffic and no prober
+        self.sweep_interval = sweep_interval
+        self._sweep_thread: threading.Thread | None = None
         self._stop = threading.Event()
 
     # ---- single-replica views (back-compat + convenience) --------------
@@ -154,7 +194,18 @@ class ServeServer:
         # `t.start()` would see a not-yet-alive thread and retire a
         # replica that is about to serve
         self.router.set_stopping(False)
+        if self.sweep_interval is not None:
+            t = threading.Thread(target=self._sweep_loop,
+                                 name="serve-death-sweeper", daemon=True)
+            self._sweep_thread = t
+            t.start()
         return self
+
+    def _sweep_loop(self) -> None:
+        # stop() sets self._stop, which this loop's wait reads — the
+        # thread parks within one interval of a shutdown
+        while not self._stop.wait(self.sweep_interval):
+            self.router.sweep()
 
     def stop(self) -> None:
         # mark the stop BEFORE joining: the router's death sweep must not
@@ -162,6 +213,9 @@ class ServeServer:
         # start requeueing a shutting-down server's work
         self.router.set_stopping(True)
         self._stop.set()
+        if self._sweep_thread is not None:
+            self._sweep_thread.join(timeout=10.0)
+            self._sweep_thread = None
         for r in self.replicas:
             if r.thread is not None:
                 r.thread.join(timeout=10.0)
@@ -207,16 +261,32 @@ class ServeServer:
         eos_id: int | None = None,
         use_prefix: bool = True,
         timeout: float = 120.0,
+        klass: str = "priority",
+        deadline_s: float | None = None,
     ) -> Request:
         """Submit and block until the request completes; returns the filled
         :class:`Request` (``.tokens``, ``.session_id``, ``.replica``,
-        timestamps). Raises :class:`QueueFullError` (backpressure),
-        ``TimeoutError``, or ``RuntimeError`` on a scheduler-side
-        failure."""
+        timestamps). Raises :class:`QueueFullError` (backpressure/shed —
+        carries ``retry_after_s``), :class:`DeadlineExceededError` (the
+        server-side deadline lapsed; ``.request`` holds the partial
+        output), ``TimeoutError`` (client-side wait bound), or
+        ``RuntimeError`` on a scheduler-side failure.
+
+        ``deadline_s`` defaults to the server's per-class policy
+        (``deadline_defaults``); an EXPLICIT ``deadline_s <= 0`` opts out
+        of that default (the CLI's documented 0-means-none semantics —
+        without it a client on a defaulted server could never request an
+        unbounded run). The absolute deadline is stamped at submission
+        and enforced at admission, in the queue, and at every
+        decode-window boundary."""
+        if deadline_s is None:
+            deadline_s = self.deadline_defaults.get(klass)
+        elif deadline_s <= 0:
+            deadline_s = None  # explicit opt-out of the per-class default
         req = Request(
             prompt, max_new_tokens, sampling=sampling,
             session_id=session_id, keep_session=keep_session, eos_id=eos_id,
-            use_prefix=use_prefix,
+            use_prefix=use_prefix, klass=klass, deadline_s=deadline_s,
         )
         self.router.submit(req)
         if not req.done.wait(timeout):
@@ -227,6 +297,10 @@ class ServeServer:
             raise TimeoutError(
                 f"request {req.id} not completed within {timeout:.0f}s"
             )
+        if req.timed_out:
+            # honest server-side expiry: the partial output rides on the
+            # exception — the HTTP layer returns it, never a wedged client
+            raise DeadlineExceededError(req)
         if req.error is not None:
             raise RuntimeError(req.error)
         return req
@@ -246,16 +320,20 @@ class ServeServer:
             if not agg:
                 # seed from THIS snapshot (not a second stats() call) so
                 # the aggregate and replicas[0]'s detail in one reply
-                # describe the same instant; deep-copy the merged dict so
+                # describe the same instant; deep-copy the merged dicts so
                 # summing never mutates replica 0's reported view
                 agg = dict(b)
                 agg["windows_dispatched"] = dict(b["windows_dispatched"])
+                agg["queued_by_class"] = dict(b["queued_by_class"])
                 continue
             for k in _SUMMED_BATCHER_KEYS:
                 agg[k] += b[k]
             for k, v in b["windows_dispatched"].items():
                 agg["windows_dispatched"][k] = (
                     agg["windows_dispatched"].get(k, 0) + v)
+            for k, v in b["queued_by_class"].items():
+                agg["queued_by_class"][k] = (
+                    agg["queued_by_class"].get(k, 0) + v)
         agg.pop("replica", None)  # the aggregate is not one replica's view
         rt = self.router.stats()
         # router-level 429s are THE backpressure count of the replicated
@@ -427,13 +505,34 @@ class _Handler(BaseHTTPRequestHandler):
     def _serve(self) -> ServeServer:
         return self.server.serve  # type: ignore[attr-defined]
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict,
+               headers: dict | None = None) -> None:
         data = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
+
+    def _error(self, http_status: int, code: str, message: str, *,
+               retryable: bool, retry_after_s: float | None = None,
+               **extra) -> None:
+        """ONE error shape for every non-200 reply, so clients can branch
+        on a stable contract instead of parsing prose: ``error`` (the
+        human message — the key every pre-existing client reads),
+        ``code`` (stable machine token), ``retryable``, and
+        ``retry_after_s`` where the server has an honest estimate (also
+        sent as the standard ``Retry-After`` header on 429s)."""
+        body = {"error": message, "code": code, "retryable": bool(retryable),
+                "retry_after_s": retry_after_s, **extra}
+        headers = None
+        if retry_after_s is not None:
+            # delta-seconds per RFC 9110 (integer, rounded up — the body
+            # keeps the precise float)
+            headers = {"Retry-After": str(max(1, int(-(-retry_after_s // 1))))}
+        self._reply(http_status, body, headers)
 
     def do_GET(self) -> None:
         if self.path == "/healthz":
@@ -460,11 +559,13 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(data)
         else:
-            self._reply(404, {"error": f"no route {self.path}"})
+            self._error(404, "not_found", f"no route {self.path}",
+                        retryable=False)
 
     def do_POST(self) -> None:
         if self.path != "/v1/generate":
-            self._reply(404, {"error": f"no route {self.path}"})
+            self._error(404, "not_found", f"no route {self.path}",
+                        retryable=False)
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -473,10 +574,20 @@ class _Handler(BaseHTTPRequestHandler):
             max_new = int(body.get("max_new_tokens", 16))
             sampling = _sampling_from_body(body)
             timeout = float(body.get("timeout", 120.0))
+            # deadline: body field wins, the X-Deadline-S header is the
+            # proxy-friendly alternative; absent both, the server's
+            # per-class default applies (ServeServer.deadline_defaults)
+            deadline_s = body.get("deadline_s")
+            if deadline_s is None:
+                hdr = self.headers.get("X-Deadline-S")
+                deadline_s = None if hdr is None else float(hdr)
+            deadline_s = None if deadline_s is None else float(deadline_s)
+            klass = str(body.get("class", "priority"))
         except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
             # TypeError included: {"max_new_tokens": null} etc. must be a
             # 400, not a handler crash that resets the connection
-            self._reply(400, {"error": f"bad request: {e}"})
+            self._error(400, "bad_request", f"bad request: {e}",
+                        retryable=False)
             return
         t0 = time.perf_counter()
         try:
@@ -486,25 +597,46 @@ class _Handler(BaseHTTPRequestHandler):
                 keep_session=bool(body.get("keep_session", False)),
                 eos_id=body.get("eos_id"),
                 use_prefix=bool(body.get("use_prefix", True)),
-                timeout=timeout,
+                timeout=timeout, klass=klass, deadline_s=deadline_s,
             )
         except QueueFullError as e:
-            self._reply(429, {"error": str(e)})
+            # the shed path: retryable by definition, with the router's
+            # live drain estimate as the honest Retry-After
+            self._error(429, "queue_full", str(e), retryable=True,
+                        retry_after_s=getattr(e, "retry_after_s", None))
+            return
+        except DeadlineExceededError as e:
+            # server-side deadline expiry: an honest timeout WITH the
+            # partial output — the client keeps every token that was
+            # ready, and can branch on code="deadline_exceeded"
+            r = e.request
+            self._error(504, "deadline_exceeded", str(e), retryable=True,
+                        tokens=list(r.tokens),
+                        deadline_s=r.deadline_s,
+                        phases_ms=r.phase_summary_ms())
             return
         except (ValueError, TypeError, RuntimeError) as e:
             # TypeError: a null/wrong-typed prompt surfaces from
             # np.asarray inside Request — still the client's fault
-            code = 500 if isinstance(e, RuntimeError) else 400
-            self._reply(code, {"error": f"{type(e).__name__}: {e}"})
+            if isinstance(e, RuntimeError):
+                self._error(500, "internal", f"{type(e).__name__}: {e}",
+                            retryable=False)
+            else:
+                self._error(400, "bad_request",
+                            f"{type(e).__name__}: {e}", retryable=False)
             return
         except TimeoutError as e:
-            self._reply(504, {"error": str(e)})
+            # the client-side wait bound (distinct from the server-side
+            # deadline): the request was CANCELLED, nothing useful to
+            # return, but retrying re-sends the work — mark retryable
+            self._error(504, "client_timeout", str(e), retryable=True)
             return
         gaps = req.itl_gaps()
         self._reply(200, {
             "tokens": list(req.tokens),
             "session_id": req.session_id,
             "replica": req.replica,
+            "class": req.klass,
             "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
             "ttft_ms": round((req.t_first_token - req.t_submit) * 1e3, 3)
             if req.t_first_token and req.t_submit else None,

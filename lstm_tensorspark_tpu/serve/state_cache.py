@@ -57,6 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..resilience import faults as _faults
 from ..train.checkpoint import CorruptCheckpointError, atomic_write, read_verified
 
 
@@ -728,6 +729,11 @@ class _DiskTier:
             return len(self._index)
 
     def put(self, sid: str, state: DetachedState) -> None:
+        # chaos drills: an armed disk_write_err fault raises OSError here
+        # — the same path a full/failing filesystem takes, so callers'
+        # disk_error accounting (durability lost, correctness kept) is
+        # exercised for real
+        _faults.serve_disk_hook("write")
         body = (state.h.astype(np.float32).tobytes()
                 + state.c.astype(np.float32).tobytes())
         # the sha256 lives IN the header, not a sidecar: a session file
@@ -741,6 +747,10 @@ class _DiskTier:
         payload = json.dumps(meta).encode() + b"\n" + body
         path = self._path(sid)
         atomic_write(path, payload)
+        # chaos drills: session_corrupt damages the COMPLETED file (the
+        # bit-rot/torn-write class the embedded sha256 must catch at
+        # fill time with a quarantine + honest "state lost")
+        _faults.maybe_corrupt_session(path)
         with self._lock:
             self._index[sid] = path
 
@@ -761,6 +771,10 @@ class _DiskTier:
             with self._lock:
                 self._index[sid] = path
         try:
+            # chaos drills: disk_read_err raises OSError inside this try
+            # — the same honest-miss path a vanished/unreadable file
+            # takes ("state lost", never wrong tokens)
+            _faults.serve_disk_hook("read")
             data = read_verified(path)
         except CorruptCheckpointError:
             self._quarantine(sid, path)
@@ -1072,6 +1086,11 @@ class SessionTiers:
                     self._work.notify_all()
 
     def _spill_batch(self, batch: list[tuple[str, _SpillJob]]) -> None:
+        # chaos drills: spill_stall delays this batch (runs on the worker
+        # thread, OUTSIDE the shared lock) — the write-behind-delay drill:
+        # flush() must still be a real barrier and fills must keep
+        # finding the pending capture while the worker sleeps
+        _faults.serve_spill_hook()
         # the ONE designated device→host fetch of the spill plane
         # (StateCache.fetch_detached_batch; graftlint host-sync
         # allow-list): full-snapshot fetch + numpy slot extraction —

@@ -49,7 +49,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import random
 import subprocess
 import sys
 import threading
@@ -57,7 +56,13 @@ import time
 
 from . import obs
 from .resilience import ckpt_layout
+from .resilience.backoff import backoff_delay
 from .resilience.exit_codes import POISON_RC, RETRYABLE_RCS, USAGE_RC
+
+__all__ = ["backoff_delay", "supervise", "main"]  # backoff_delay is
+# re-exported on purpose: it moved to resilience/backoff.py (the serve
+# loadgen's 429 retry path shares the one implementation) and existing
+# callers/tests keep importing it from here.
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -94,22 +99,6 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("cli_args", nargs=argparse.REMAINDER,
                    help="-- followed by the training CLI flags")
     return p
-
-
-def backoff_delay(base: float, attempt: int, *, cap: float = 30.0,
-                  jitter: float = 0.5, rand=None) -> float:
-    """Restart delay for ``attempt`` (1-based): exponential from ``base``
-    with up to ``+jitter`` fractional randomization, then capped — the cap
-    bounds the SLEPT delay, jitter included (an operator's --max-delay is
-    a promise, not a suggestion). Jitter de-synchronizes a fleet of
-    supervisors hammering a shared resource (filesystem, coordinator)
-    after a common-cause failure; ``rand`` is injectable for
-    deterministic tests."""
-    if base <= 0:
-        return 0.0
-    delay = base * (2.0 ** max(attempt - 1, 0))
-    r = random.random() if rand is None else rand()
-    return min(delay * (1.0 + jitter * r), cap)
 
 
 def latest_checkpoint_step(directory: str) -> int | None:

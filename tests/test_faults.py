@@ -37,11 +37,68 @@ def test_spec_parsing_full_grammar():
     "crash",              # no @N
     "crash@x",            # non-numeric
     "explode@3",          # unknown kind
-    "crash@3x2",          # burst only valid for nan_grads
+    "crash@3x2",          # xK suffix invalid for crash
+    "disk_write_err@1x2",  # xK suffix invalid for disk faults
 ])
 def test_spec_parse_errors(bad):
     with pytest.raises(ValueError):
         faults.FaultPlane(bad)
+
+
+def test_serve_spec_parsing():
+    p = faults.FaultPlane(
+        "replica_die@1x3; replica_wedge@0x5; wedge_secs@7;"
+        "disk_write_err@2; disk_read_err@4; session_corrupt@1;"
+        "spill_stall@2x3; slow_readback@5x100"
+    )
+    assert p.replica_die == {1: 3}
+    assert p.replica_wedge == {0: 5}
+    assert p.wedge_secs == 7
+    assert p.disk_write_err_calls == {2}
+    assert p.disk_read_err_calls == {4}
+    assert p.session_corrupt_writes == {1}
+    assert p.spill_stall_batches == {2: 3}
+    assert p.slow_readback_calls == {5: 100}
+    # defaults: xK omitted
+    q = faults.FaultPlane("replica_die@0;spill_stall@1;slow_readback@1")
+    assert q.replica_die == {0: 1}
+    assert q.spill_stall_batches == {1: 1}
+    assert q.slow_readback_calls == {1: 250}
+
+
+def test_replica_die_hook_fires_on_that_replicas_kth_step():
+    p = faults.FaultPlane("replica_die@1x2")
+    p.serve_step_hook(0)  # other replica: never fires
+    p.serve_step_hook(1)  # replica 1 step 1: not yet
+    with pytest.raises(faults.InjectedFault):
+        p.serve_step_hook(1)  # replica 1 step 2: dies
+    p.serve_step_hook(1)  # past the scheduled step: no re-fire
+    p.serve_step_hook(0)
+
+
+def test_disk_hooks_fire_on_nth_call_only():
+    p = faults.FaultPlane("disk_write_err@2;disk_read_err@1")
+    p.serve_disk_hook("write")
+    with pytest.raises(OSError):
+        p.serve_disk_hook("write")
+    p.serve_disk_hook("write")  # once only
+    with pytest.raises(OSError):
+        p.serve_disk_hook("read")
+    p.serve_disk_hook("read")
+
+
+def test_session_corrupt_damages_nth_write(tmp_path):
+    p = faults.FaultPlane("session_corrupt@2;seed@3")
+    a, b = tmp_path / "a.state", tmp_path / "b.state"
+    payload = b'{"sid": "x"}\n' + b"\x01" * 64
+    for f in (a, b):
+        f.write_bytes(payload)
+    p.maybe_corrupt_session(str(a))  # write 1: untouched
+    p.maybe_corrupt_session(str(b))  # write 2: truncated + flipped
+    assert a.read_bytes() == payload
+    damaged = b.read_bytes()
+    assert len(damaged) == len(payload) // 2
+    assert damaged != payload[: len(damaged)]
 
 
 def test_arm_from_env(monkeypatch, tmp_path):

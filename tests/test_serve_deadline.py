@@ -1,0 +1,390 @@
+"""Request deadlines end-to-end (serve robustness plane): queue-expiry
+reaping without a slot or prefill spent, decode-window-boundary expiry
+with honest partial output, per-class default deadlines, SLO-aware
+class shedding with Retry-After, weighted dequeue, the loadgen client's
+Retry-After-honoring backoff, and the uniform HTTP error-body contract.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from lstm_tensorspark_tpu.models import LMConfig, init_lm
+from lstm_tensorspark_tpu.obs import MetricsRegistry
+from lstm_tensorspark_tpu.serve import (
+    DeadlineExceededError,
+    QueueFullError,
+    Request,
+    ServeEngine,
+    ServeServer,
+    run_loadgen,
+)
+
+_CFG = LMConfig(vocab_size=29, hidden_size=16, num_layers=1)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_lm(jax.random.PRNGKey(3), _CFG)
+
+
+def _server(params, registry=None, n=1, batch_buckets=(1, 2, 4), **kw):
+    reg = registry if registry is not None else MetricsRegistry()
+    engines = [
+        ServeEngine(params, _CFG, num_slots=8, prefill_buckets=(4, 8),
+                    batch_buckets=batch_buckets, rng_seed=i, registry=reg)
+        for i in range(n)
+    ]
+    kw.setdefault("max_active", 4)
+    kw.setdefault("queue_size", 8)
+    return ServeServer(engines if n > 1 else engines[0], **kw)
+
+
+# ---- queue-expiry reaping (the no-wasted-prefill contract) -------------
+
+
+def test_queue_expired_request_reaped_without_slot_or_prefill(params):
+    """A request whose deadline lapses while QUEUED settles as a timeout
+    without ever consuming a state-cache slot or a prefill dispatch, its
+    serve_requests_total{outcome="timeout"} counter increments, and its
+    phase timeline records the queue-only lifetime."""
+    reg = MetricsRegistry()
+    server = _server(params, registry=reg)
+    b = server.batcher
+    req = Request([1, 2, 3], 4, deadline_s=0.05)
+    b.submit(req)
+    assert req.deadline is not None  # stamped at submission
+    time.sleep(0.12)
+    cache_before = server.engine.cache.stats()
+    prefills_before = server.engine.num_compiles("prefill")
+    b.step()  # unstarted server: the test drives the scheduler directly
+    assert req.done.is_set() and req.timed_out
+    assert req.error is None and req.tokens == []
+    # no slot was acquired, no prefill program dispatched
+    after = server.engine.cache.stats()
+    assert after["live_sessions"] == cache_before["live_sessions"]
+    assert after["pinned"] == cache_before["pinned"]
+    assert server.engine.num_compiles("prefill") == prefills_before
+    # phase timeline: the queue-only lifetime, nothing else
+    assert [p[0] for p in req.phases] == ["queue"]
+    assert req.phases[0][1] == req.t_submit
+    s = reg.summaries()
+    assert s['serve_requests_total{outcome="timeout",replica="0"}'] == 1
+    assert s['serve_deadline_expired_total{stage="queue",replica="0"}'] == 1
+    assert b.stats()["timed_out"] == 1
+
+
+def test_queue_expiry_reaps_behind_the_head(params):
+    """Expiry is reaped from ANYWHERE in the queue, not just the head —
+    a live long-deadline request ahead of it must not shield it."""
+    server = _server(params)
+    b = server.batcher
+    live = Request([1, 2], 2)
+    doomed = Request([3, 4], 2, deadline_s=0.05)
+    b.submit(live)
+    b.submit(doomed)
+    time.sleep(0.12)
+    b.drain()
+    assert doomed.timed_out and doomed.tokens == []
+    assert not live.timed_out and len(live.tokens) == 2
+
+
+def test_decode_boundary_expiry_returns_partial_output(params):
+    """A deadline lapsing mid-decode settles at the next window boundary
+    with the tokens already generated — partial output, own outcome,
+    never a wedged client; the session is not kept."""
+    server = _server(params)
+    with server:
+        with pytest.raises(DeadlineExceededError) as ei:
+            server.generate([1, 2, 3], max_new_tokens=100000,
+                            deadline_s=0.15, keep_session=True,
+                            timeout=30.0)
+    req = ei.value.request
+    assert req.timed_out
+    assert 0 < len(req.tokens) < 100000  # partial, not empty, not full
+    # not kept: the slot was released (no live session remains)
+    assert server.engine.cache.stats()["live_sessions"] == 0
+
+
+def test_timed_out_kept_session_discards_tier_copies(params, tmp_path):
+    """A kept session whose LATER turn times out with partial output is
+    fully discarded — device slot AND tier copies. The tier checkpoint
+    from the last COMPLETED turn lacks the partial tokens the client
+    already displayed, so resurrecting it would silently decode an
+    inconsistent conversation; the honest outcome is a loud
+    "unknown session" on the next continuation."""
+    reg = MetricsRegistry()
+    engine = ServeEngine(params, _CFG, num_slots=8, prefill_buckets=(4, 8),
+                         batch_buckets=(1, 2, 4), registry=reg,
+                         session_dir=str(tmp_path))
+    server = ServeServer(engine, max_active=4, queue_size=8)
+    with server:
+        r1 = server.generate([1, 2, 3], max_new_tokens=2,
+                             keep_session=True, timeout=30.0)
+        sid = r1.session_id
+        engine.tiers.flush(timeout=15.0)  # turn-1 checkpoint on disk
+        with pytest.raises(DeadlineExceededError) as ei:
+            server.generate([r1.tokens[-1]], max_new_tokens=100000,
+                            session_id=sid, keep_session=True,
+                            deadline_s=0.2, timeout=30.0)
+        assert len(ei.value.request.tokens) > 0  # partial output shown
+        with pytest.raises(RuntimeError, match="unknown session"):
+            server.generate([1], max_new_tokens=2, session_id=sid,
+                            timeout=30.0)
+
+
+def test_per_class_default_deadline_applied(params):
+    server = _server(params,
+                     deadline_defaults={"best_effort": 0.05})
+    with server:
+        # priority: no default — completes
+        r = server.generate([1, 2, 3], max_new_tokens=2, timeout=30.0)
+        assert len(r.tokens) == 2 and r.deadline_s is None
+        # best_effort inherits the 50 ms default and times out on a
+        # budget far larger than 50 ms of CPU decode
+        with pytest.raises(DeadlineExceededError) as ei:
+            server.generate([1, 2, 3], max_new_tokens=100000,
+                            klass="best_effort", timeout=30.0)
+        assert ei.value.request.deadline_s == 0.05
+
+
+def test_explicit_zero_deadline_opts_out_of_default(params):
+    """deadline_s <= 0 (the CLI's documented 0-means-none semantics) is
+    an explicit opt-out of the per-class default — without it a client
+    on a defaulted server could never request an unbounded run."""
+    server = _server(params, deadline_defaults={"priority": 0.05})
+    with server:
+        with pytest.raises(DeadlineExceededError):
+            server.generate([1, 2, 3], max_new_tokens=100000, timeout=30.0)
+        r = server.generate([1, 2, 3], max_new_tokens=4, deadline_s=0,
+                            timeout=30.0)
+        assert len(r.tokens) == 4 and r.deadline_s is None
+
+
+def test_default_loadgen_report_is_strict_json(params):
+    """A default (single-class) run's always-present classes section
+    must serialize as strict RFC-8259 JSON — the zero-traffic class
+    reports null percentiles, never NaN."""
+    server = _server(params)
+    with server:
+        report = run_loadgen(server, vocab_size=_CFG.vocab_size,
+                             sessions=2, requests_per_session=1,
+                             prompt_len=4, max_new_tokens=4)
+    json.dumps(report, allow_nan=False)  # raises on any NaN/Inf
+    assert report["classes"]["best_effort"]["p99_ttft_ms"] is None
+    assert report["classes"]["priority"]["p99_ttft_ms"] is not None
+
+
+def test_request_validates_class_and_deadline():
+    with pytest.raises(ValueError):
+        Request([1], 1, klass="vip")
+    with pytest.raises(ValueError):
+        Request([1], 1, deadline_s=0.0)
+
+
+# ---- weighted dequeue + class shedding ---------------------------------
+
+
+def test_weighted_dequeue_prefers_priority(params):
+    """With both classes queued, one admission round serves them in the
+    configured weight ratio (default 4:1) instead of pure FIFO."""
+    server = _server(params, max_active=5, queue_size=16,
+                     batch_buckets=(1, 2, 4, 8))  # capacity 5 fits a bucket
+    b = server.batcher
+    reqs = ([Request([1, 2], 1, klass="best_effort") for _ in range(5)]
+            + [Request([1, 2], 1) for _ in range(5)])
+    for r in reqs:
+        b.submit(r)  # all best_effort submitted FIRST
+    b.step()  # capacity 5: weighted pick must take 4 priority + 1 be
+    done_p = sum(1 for r in reqs if r.klass == "priority"
+                 and r.done.is_set())
+    done_b = sum(1 for r in reqs if r.klass == "best_effort"
+                 and r.done.is_set())
+    assert (done_p, done_b) == (4, 1)
+    b.drain()  # everyone is eventually served — weighted, not starved
+    assert all(r.done.is_set() and r.error is None for r in reqs)
+
+
+def test_router_sheds_best_effort_first_with_retry_after(params):
+    """best_effort 429s at best_effort_frac * queue_size while priority
+    keeps the full bound; sheds carry a positive retry_after_s and land
+    in shed_by_class + serve_shed_total."""
+    reg = MetricsRegistry()
+    server = _server(params, registry=reg, queue_size=8,
+                     best_effort_queue_frac=0.5)
+    for _ in range(4):
+        server.router.submit(Request([1, 2], 2))
+    with pytest.raises(QueueFullError) as ei:
+        server.router.submit(Request([1, 2], 2, klass="best_effort"))
+    assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+    for _ in range(4):  # priority still admits up to the full bound
+        server.router.submit(Request([1, 2], 2))
+    with pytest.raises(QueueFullError) as ei2:
+        server.router.submit(Request([1, 2], 2))
+    assert ei2.value.retry_after_s and ei2.value.retry_after_s > 0
+    st = server.router.stats()
+    assert st["shed_by_class"] == {"priority": 1, "best_effort": 1}
+    assert st["best_effort_bound"] == 4
+    s = reg.summaries()
+    assert s['serve_shed_total{class="best_effort"}'] == 1
+    assert s['serve_shed_total{class="priority"}'] == 1
+    assert s["serve_retry_after_seconds"]["count"] == 2
+
+
+def test_batcher_level_429_also_carries_retry_after(params):
+    """The per-replica queue bound (direct submits; a wedged replica's
+    queue filling on the affinity path) honors the same contract as the
+    router's shed: retry_after_s attached + serve_shed_total counted —
+    no second-class 429s."""
+    reg = MetricsRegistry()
+    server = _server(params, registry=reg, queue_size=1)
+    b = server.batcher
+    b.submit(Request([1, 2], 2))
+    with pytest.raises(QueueFullError) as ei:
+        b.submit(Request([1, 2], 2, klass="best_effort"))
+    assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+    s = reg.summaries()
+    assert s['serve_shed_total{class="best_effort"}'] == 1
+    assert s["serve_retry_after_seconds"]["count"] == 1
+
+
+def test_retry_after_scales_with_queue_wait_p99(params):
+    """Retry-After is computed from the live queue-wait p99 histogram —
+    a server whose queue recently waited ~2 s hints a retry near that,
+    not a made-up constant."""
+    reg = MetricsRegistry()
+    server = _server(params, registry=reg, queue_size=4)
+    # seed the queue-wait histogram the way 2 s waits would
+    fam = reg.histogram("serve_queue_wait_seconds",
+                        labelnames=("replica",))
+    for _ in range(50):
+        fam.labels(replica="0").observe(2.0)
+    for _ in range(4):
+        server.router.submit(Request([1, 2], 2))
+    with pytest.raises(QueueFullError) as ei:
+        server.router.submit(Request([1, 2], 2))
+    # p99 estimate lands inside the (1.0, 2.5] bucket (~2.5), scaled by
+    # the 1.5x full-queue factor — near 3.75 s, nowhere near the cold
+    # 0.25 s floor. The point: the hint tracks the MEASURED wait.
+    assert 2.0 <= ei.value.retry_after_s <= 4.5
+
+
+def test_requeued_request_keeps_its_deadline(params):
+    """A replica-death requeue must not reset the client's budget: the
+    absolute deadline survives the second submit()."""
+    server = _server(params)
+    b = server.batcher
+    req = Request([1, 2], 2, deadline_s=30.0)
+    b.submit(req)
+    deadline = req.deadline
+    assert deadline is not None
+    drained = b.drain_queue()
+    assert drained == [req]
+    b.submit(req)  # the router's requeue path re-enters here
+    assert req.deadline == deadline
+    assert b.stats()["submitted"] == 1  # not double-counted
+
+
+# ---- loadgen client: Retry-After honoring + per-class report -----------
+
+
+def test_loadgen_retries_sheds_with_backoff_and_reports_classes(params):
+    """The loadgen client honors Retry-After (shared capped-backoff
+    helper) and its JSON summary carries per-class shed/retried counts
+    (the satellite contract)."""
+    server = _server(params, queue_size=2, max_active=1,
+                     best_effort_queue_frac=0.5)
+    with server:
+        report = run_loadgen(
+            server, vocab_size=_CFG.vocab_size, sessions=6,
+            requests_per_session=2, prompt_len=4, max_new_tokens=8,
+            mode="open", rate=400.0, seed=0, priority_frac=0.5,
+            retry_max=2, retry_base_s=0.01, retry_cap_s=0.1,
+        )
+    assert set(report["classes"]) == {"priority", "best_effort"}
+    for cls in report["classes"].values():
+        assert {"completed", "shed", "retried", "timeouts",
+                "p99_ttft_ms"} <= set(cls)
+    total_retried = sum(c["retried"] for c in report["classes"].values())
+    assert total_retried >= 1  # the burst overruns queue_size=2
+    # accounting closes: every request completed, shed, failed or timed out
+    assert report["requests"] == (
+        report["completed"] + report["rejected"] + report["failed"]
+        + report["timeouts"])
+
+
+# ---- uniform HTTP error bodies (satellite: stable client contract) -----
+
+
+def _post(base, body, headers=None):
+    req = urllib.request.Request(
+        base + "/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_http_error_bodies_are_uniform(params):
+    """Every non-200 reply carries the same machine-readable shape:
+    error (message), code, retryable, retry_after_s — 429s also send the
+    standard Retry-After header; deadline 504s carry partial tokens."""
+    from lstm_tensorspark_tpu.serve.server import make_http_server
+
+    server = _server(params, queue_size=2,
+                     deadline_defaults={"best_effort": 0.15})
+    httpd = make_http_server(server, port=0)
+    host, port = httpd.server_address[:2]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://{host}:{port}"
+    try:
+        # 400: bad request
+        code, _, body = _post(base, {"prompt": None})
+        assert code == 400
+        assert body["code"] == "bad_request" and body["retryable"] is False
+        assert body["retry_after_s"] is None and "error" in body
+        # 404: unknown route, same shape
+        req = urllib.request.Request(base + "/nope")
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("404 expected")
+        except urllib.error.HTTPError as e:
+            nf = json.loads(e.read())
+        assert nf["code"] == "not_found" and nf["retryable"] is False
+        # 429 with Retry-After header: fill the UNSTARTED server's queue
+        for _ in range(2):
+            server.router.submit(Request([1, 2], 2))
+        code, headers, body = _post(
+            base, {"prompt": [1, 2], "max_new_tokens": 2})
+        assert code == 429
+        assert body["code"] == "queue_full" and body["retryable"] is True
+        assert body["retry_after_s"] > 0
+        assert int(headers["Retry-After"]) >= 1
+        # 504 deadline_exceeded WITH partial tokens (the server must be
+        # serving for decode to start; X-Deadline-S drives the deadline)
+        with server:
+            # drain the 429 section's stale queue + compile the programs
+            # first, so the deadline budget is spent DECODING, not on
+            # first-traffic XLA compiles
+            code, _, warm = _post(
+                base, {"prompt": [1, 2, 3], "max_new_tokens": 8,
+                       "greedy": True})
+            assert code == 200, warm
+            code, _, body = _post(
+                base, {"prompt": [1, 2, 3], "max_new_tokens": 100000,
+                       "greedy": True},
+                headers={"X-Deadline-S": "0.3"})
+        assert code == 504
+        assert body["code"] == "deadline_exceeded"
+        assert body["retryable"] is True
+        assert len(body["tokens"]) > 0  # the partial output rode along
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

@@ -1,0 +1,426 @@
+#!/usr/bin/env python
+"""Serve-plane chaos drill: machine-check the robustness invariants the
+replicated/tiered serve stack promises, under INJECTED faults
+(resilience/faults.py serve kinds), deterministically on CPU.
+
+Four phases, each building a fresh in-process stack from one fixed seed:
+
+1. **replica death** — a 2-replica ``--session-dir`` stack serves kept
+   conversations; the replica owning them is killed mid-run
+   (``replica_die@RxK``); the router's retirement (detach/restore
+   migration + shared-disk persistence) must lose ZERO kept sessions and
+   every continuation must be token-identical to an uninterrupted run.
+2. **disk errors** — an injected ``disk_write_err`` on the write-behind
+   checkpoint must surface as
+   ``serve_tier_lost_total{reason="disk_error"}`` with correct tokens
+   still served (durability lost, correctness kept); an injected
+   ``session_corrupt`` must be QUARANTINED at fill time on a fresh boot
+   and fail the continuation honestly — never wrong tokens.
+3. **latency faults** — ``slow_readback`` + ``spill_stall`` inject
+   delays into the decode-window fetch and the spill worker; outputs
+   stay token-identical and ``flush()`` stays a real durability barrier.
+4. **burst shed** — a 4x open-loop burst with mixed admission classes:
+   the priority class p99 TTFT must hold the configured SLO while
+   best-effort sheds with honest ``Retry-After`` 429s; the same burst is
+   replayed with the old indiscriminate-FIFO settings for contrast, and
+   both land in BENCH_serve_r04.json (``--json``).
+
+Wired into tools/verify.sh after the serve smoke (sequenced, never
+concurrent with the timed suite). Exit 0 on PASS, 1 on any violated
+invariant, with the failing invariant + the fault spec that reproduces
+it printed (see docs/OPERATIONS.md "Chaos drill failed").
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_serve.py [--json OUT] \
+        [--slo-ms 1000] [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+from lstm_tensorspark_tpu.models import LMConfig, init_lm  # noqa: E402
+from lstm_tensorspark_tpu.obs import MetricsRegistry  # noqa: E402
+from lstm_tensorspark_tpu.resilience import faults  # noqa: E402
+from lstm_tensorspark_tpu.serve import (  # noqa: E402
+    ServeEngine,
+    ServeServer,
+    run_loadgen,
+)
+
+_CFG = LMConfig(vocab_size=41, hidden_size=16, num_layers=1)
+_SEED = 3  # params seed — every stack (chaos + reference) shares params
+
+
+def _build(params, n, *, session_dir=None, num_slots=8, max_active=4,
+           queue_size=16, **server_kw):
+    reg = MetricsRegistry()
+    engines = [
+        ServeEngine(params, _CFG, num_slots=num_slots,
+                    prefill_buckets=(4, 8), batch_buckets=(1, 2, 4),
+                    rng_seed=i, registry=reg, session_dir=session_dir,
+                    replica=i)
+        for i in range(n)
+    ]
+    return ServeServer(engines if n > 1 else engines[0],
+                       max_active=max_active, queue_size=queue_size,
+                       **server_kw)
+
+
+def _create_kept(server, i):
+    """One kept session with a per-index prompt; returns (sid, tokens,
+    home replica)."""
+    r = server.generate([i + 1, i + 2, 3], max_new_tokens=4,
+                        keep_session=True)
+    return r.session_id, list(r.tokens), r.replica
+
+
+def _continue_kept(server, sid, last_tok):
+    r = server.generate([last_tok], max_new_tokens=4, session_id=sid,
+                        keep_session=True)
+    return list(r.tokens)
+
+
+def _reference_tokens(params, n_sessions, turns):
+    """The uninterrupted single-replica run of the same conversation
+    schedule — the token-identity oracle for every fault phase."""
+    ref = _build(params, 1)
+    out = []
+    with ref:
+        sids = []
+        for i in range(n_sessions):
+            sid, toks, _ = _create_kept(ref, i)
+            sids.append(sid)
+            out.append(toks)
+        for _ in range(turns):
+            for i, sid in enumerate(sids):
+                out[i].extend(_continue_kept(ref, sid, out[i][-1]))
+    return out
+
+
+# ---- phase 1: replica death --------------------------------------------
+
+
+def _phase_replica_death(params, seed, failures):
+    work = tempfile.mkdtemp(prefix="chaos_serve_death_")
+    n_sessions = 4
+    res = {"sessions": n_sessions}
+    try:
+        srv = _build(params, 2, session_dir=work)
+        with srv:
+            sids, toks, homes = [], [], []
+            for i in range(n_sessions):
+                sid, t, home = _create_kept(srv, i)
+                sids.append(sid)
+                toks.append(t)
+                homes.append(home)
+            for i, sid in enumerate(sids):  # one pre-death turn
+                toks[i].extend(_continue_kept(srv, sid, toks[i][-1]))
+            victim = homes[0]
+            spec = f"replica_die@{victim}x1;seed@{seed}"
+            res["fault_spec"] = spec
+            res["victim"] = victim
+            res["victim_sessions"] = sum(1 for h in homes if h == victim)
+            faults.arm(spec)
+            t = srv.replicas[victim].thread
+            t.join(timeout=15.0)
+            faults.disarm()
+            if t.is_alive():
+                failures.append(
+                    f"replica_death: {spec} never killed the scheduler")
+                return res
+            srv.health()  # piggybacked sweep retires + migrates
+            lost = 0
+            for i, sid in enumerate(sids):  # post-death continuations
+                try:
+                    toks[i].extend(_continue_kept(srv, sid, toks[i][-1]))
+                except Exception as e:
+                    lost += 1
+                    failures.append(
+                        f"replica_death: kept session {sid!r} lost after "
+                        f"{spec}: {type(e).__name__}: {e}")
+            res["lost_sessions"] = lost
+            res["router"] = {
+                k: srv.router.stats()[k]
+                for k in ("retired", "migrated_sessions", "lost_sessions",
+                          "requeued", "failed_on_death")}
+        ref = _reference_tokens(params, n_sessions, turns=2)
+        res["token_identical"] = toks == ref
+        if toks != ref:
+            failures.append(
+                f"replica_death: continuations diverged from the "
+                f"uninterrupted run (spec {res['fault_spec']})")
+    finally:
+        faults.disarm()
+        shutil.rmtree(work, ignore_errors=True)
+    return res
+
+
+# ---- phase 2: disk-tier faults -----------------------------------------
+
+
+def _phase_disk_faults(params, seed, failures):
+    res = {}
+    # ---- write error: durability lost, correctness kept ----------------
+    work = tempfile.mkdtemp(prefix="chaos_serve_disk_")
+    try:
+        srv = _build(params, 1, session_dir=work)
+        with srv:
+            sid, toks, _ = _create_kept(srv, 0)
+            srv.engine.tiers.flush(timeout=15.0)
+            spec = f"disk_write_err@1;seed@{seed}"
+            res["write_fault_spec"] = spec
+            faults.arm(spec)
+            toks.extend(_continue_kept(srv, sid, toks[-1]))
+            srv.engine.tiers.flush(timeout=15.0)
+            faults.disarm()
+            ts = srv.engine.tiers.stats()
+            res["disk_errors"] = ts["disk_errors"]
+            key = 'serve_tier_lost_total{reason="disk_error",replica="0"}'
+            res["disk_error_metric"] = srv.engine.metrics.summaries().get(
+                key, 0)
+            if ts["disk_errors"] < 1 or res["disk_error_metric"] < 1:
+                failures.append(
+                    f"disk_faults: {spec} did not surface as "
+                    f"serve_tier_lost_total{{reason=\"disk_error\"}} "
+                    f"(stats {ts['disk_errors']}, metric "
+                    f"{res['disk_error_metric']})")
+            # correctness kept: the state never left RAM/device
+            toks.extend(_continue_kept(srv, sid, toks[-1]))
+        ref = _reference_tokens(params, 1, turns=2)
+        res["write_token_identical"] = [toks] == ref
+        if [toks] != ref:
+            failures.append(
+                f"disk_faults: tokens diverged after a failed disk write "
+                f"(spec {spec}) — durability trouble must never cost "
+                "correctness")
+    finally:
+        faults.disarm()
+        shutil.rmtree(work, ignore_errors=True)
+    # ---- corrupt session file: quarantine + honest loss ----------------
+    work = tempfile.mkdtemp(prefix="chaos_serve_corrupt_")
+    try:
+        spec = f"session_corrupt@1;seed@{seed}"
+        res["corrupt_fault_spec"] = spec
+        faults.arm(spec)
+        srv = _build(params, 1, session_dir=work)
+        with srv:
+            sid, toks, _ = _create_kept(srv, 0)
+            srv.engine.tiers.flush(timeout=15.0)
+        faults.disarm()
+        # fresh boot on the same dir — the restart that must detect it
+        srv2 = _build(params, 1, session_dir=work)
+        with srv2:
+            honest = False
+            try:
+                _continue_kept(srv2, sid, toks[-1])
+                failures.append(
+                    f"disk_faults: corrupt session file served a "
+                    f"continuation (spec {spec}) — wrong tokens risk")
+            except RuntimeError as e:
+                honest = "unknown session" in str(e)
+                if not honest:
+                    failures.append(
+                        f"disk_faults: corrupt-file continuation failed "
+                        f"with the wrong error: {e}")
+            res["honest_failure"] = honest
+            ts = srv2.engine.tiers.stats()
+            # the corruption is detected at whichever layer reads it
+            # first: a damaged HEADER is quarantined by the fresh boot's
+            # startup scan (the continuation then counts a miss), a
+            # damaged BODY passes the scan and is quarantined at fill
+            # time (counted corrupt). Both are the honest path.
+            res["corrupt_counted"] = ts["corrupt"]
+            res["miss_counted"] = ts["misses"]
+        quarantined = glob.glob(os.path.join(work, "*.quarantined"))
+        res["quarantined"] = len(quarantined)
+        if not quarantined:
+            failures.append(
+                f"disk_faults: no *.quarantined file after {spec}")
+        if res["corrupt_counted"] + res["miss_counted"] < 1:
+            failures.append(
+                "disk_faults: the corrupt file's continuation was "
+                "counted neither corrupt nor miss")
+    finally:
+        faults.disarm()
+        shutil.rmtree(work, ignore_errors=True)
+    return res
+
+
+# ---- phase 3: latency faults (slow readback, spill stall) ---------------
+
+
+def _phase_latency_faults(params, seed, failures):
+    res = {}
+    work = tempfile.mkdtemp(prefix="chaos_serve_latency_")
+    try:
+        spec = f"slow_readback@1x200;spill_stall@1x1;seed@{seed}"
+        res["fault_spec"] = spec
+        # 2 slots + 3 kept sessions forces evictions (spills) and fills
+        srv = _build(params, 1, session_dir=work, num_slots=2,
+                     max_active=2)
+        faults.arm(spec)
+        toks = []
+        with srv:
+            sids = []
+            for i in range(3):
+                sid, t, _ = _create_kept(srv, i)
+                sids.append(sid)
+                toks.append(t)
+            for _ in range(2):
+                for i, sid in enumerate(sids):
+                    toks[i].extend(_continue_kept(srv, sid, toks[i][-1]))
+            flushed = srv.engine.tiers.flush(timeout=30.0)
+            res["flush_ok"] = bool(flushed)
+            if not flushed:
+                failures.append(
+                    f"latency_faults: flush() wedged under {spec} — the "
+                    "durability barrier must survive a stalled worker")
+        faults.disarm()
+        # reference needs the same slot pressure (3 sessions over 2
+        # slots re-prefill nothing — tiers restore exactly), so the
+        # plain 1-replica reference with ample slots is still the oracle
+        ref = _reference_tokens(params, 3, turns=2)
+        res["token_identical"] = toks == ref
+        if toks != ref:
+            failures.append(
+                f"latency_faults: tokens diverged under {spec} — "
+                "injected latency must never change output")
+    finally:
+        faults.disarm()
+        shutil.rmtree(work, ignore_errors=True)
+    return res
+
+
+# ---- phase 4: burst shed (SLO-aware vs indiscriminate FIFO) -------------
+
+
+def _burst(params, *, rate, seed, slo_aware: bool):
+    """One open-loop burst at ``rate`` req/s, 25% priority traffic.
+    ``slo_aware=False`` replays it with the pre-PR settings (even
+    dequeue weights, one shared bound) for the BENCH contrast."""
+    kw = (dict(class_weights=(4, 1), best_effort_queue_frac=0.5)
+          if slo_aware else
+          dict(class_weights=(1, 1), best_effort_queue_frac=1.0))
+    srv = _build(params, 2, queue_size=16, **kw)
+    with srv:
+        srv.warmup(prompt_lens=(4,))
+        report = run_loadgen(
+            srv, vocab_size=_CFG.vocab_size, sessions=8,
+            requests_per_session=8, prompt_len=4, max_new_tokens=8,
+            mode="open", rate=rate, seed=seed, priority_frac=0.25,
+            retry_max=1, retry_base_s=0.02, retry_cap_s=0.25,
+        )
+    return {
+        "mode": "slo_aware" if slo_aware else "fifo",
+        "offered_rate_rps": rate,
+        "completed": report["completed"],
+        "rejected": report["rejected"],
+        "classes": report["classes"],
+        "router": report["router"],
+    }
+
+
+def _phase_burst_shed(params, seed, slo_ms, failures):
+    res = {"slo_ms": slo_ms}
+    # calibrate sustainable throughput on the same stack shape
+    cal_srv = _build(params, 2, queue_size=16)
+    with cal_srv:
+        cal_srv.warmup(prompt_lens=(4,))
+        cal = run_loadgen(cal_srv, vocab_size=_CFG.vocab_size, sessions=4,
+                          requests_per_session=4, prompt_len=4,
+                          max_new_tokens=8, seed=seed)
+    capacity = max(cal["requests_per_sec"], 1.0)
+    rate = 4.0 * capacity
+    res["capacity_rps"] = capacity
+    res["burst_rate_rps"] = rate
+    res["slo_aware"] = _burst(params, rate=rate, seed=seed, slo_aware=True)
+    res["fifo"] = _burst(params, rate=rate, seed=seed + 1, slo_aware=False)
+    pr = res["slo_aware"]["classes"]["priority"]
+    be = res["slo_aware"]["classes"]["best_effort"]
+    if be["shed"] < 1:
+        failures.append(
+            "burst_shed: a 4x burst shed ZERO best-effort requests — "
+            "the SLO-aware policy never engaged")
+    if pr["shed"] > 0:
+        failures.append(
+            f"burst_shed: {pr['shed']} PRIORITY requests shed while "
+            "best-effort headroom existed — the class bound is inverted")
+    p99 = pr["p99_ttft_ms"]
+    res["priority_p99_ttft_ms"] = p99
+    res["best_effort_p99_ttft_ms"] = be["p99_ttft_ms"]
+    if p99 is None or not p99 == p99 or p99 > slo_ms:
+        failures.append(
+            f"burst_shed: priority p99 TTFT {p99} ms missed the "
+            f"{slo_ms} ms SLO under the 4x burst")
+    ra = res["slo_aware"]["router"].get("shed_by_class", {})
+    res["retry_after_honored"] = (
+        be["retried"] >= 1 and ra.get("best_effort", 0) >= 1)
+    if be["retried"] < 1:
+        failures.append(
+            "burst_shed: the loadgen client never retried a shed — "
+            "Retry-After honoring is untested by this run")
+    return res
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", type=str, default=None,
+                    help="write the machine-readable drill report here "
+                         "(BENCH_serve_r04.json in CI)")
+    ap.add_argument("--slo-ms", type=float, default=1000.0,
+                    help="priority-class p99 TTFT SLO under the 4x burst "
+                         "(CPU-noise-tolerant default)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="fault seed (reproduces the corruption bytes and "
+                         "the workload)")
+    args = ap.parse_args(argv)
+
+    t_start = time.monotonic()
+    params = init_lm(jax.random.PRNGKey(_SEED), _CFG)
+    failures: list[str] = []
+    summary = {"note": "chaos_serve", "seed": args.seed}
+    summary["replica_death"] = _phase_replica_death(params, args.seed,
+                                                    failures)
+    summary["disk_faults"] = _phase_disk_faults(params, args.seed, failures)
+    summary["latency_faults"] = _phase_latency_faults(params, args.seed,
+                                                      failures)
+    summary["burst_shed"] = _phase_burst_shed(params, args.seed,
+                                              args.slo_ms, failures)
+    summary["wall_s"] = round(time.monotonic() - t_start, 1)
+    summary["result"] = "PASS" if not failures else "FAIL"
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+        print(f"chaos_serve: report written to {args.json}",
+              file=sys.stderr)
+    print(f"chaos_serve: {summary['result']} in {summary['wall_s']}s"
+          + (f" — {len(failures)} violated invariant(s)" if failures
+             else ""),
+          file=sys.stderr)
+    for f in failures:
+        print(f"chaos_serve: FAIL {f}", file=sys.stderr)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

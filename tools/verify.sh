@@ -30,10 +30,19 @@
 # from the disk tier (runs AFTER the timed suite on purpose — never
 # concurrently with it).
 #
+# Phase 3 — serve chaos drill: tools/chaos_serve.py machine-checks the
+# robustness invariants under INJECTED faults (replica death loses zero
+# kept sessions token-identically; disk errors lose durability but
+# never correctness; corrupt session files quarantine + fail honestly;
+# priority p99 TTFT holds its SLO under a 4x burst while best-effort
+# sheds with honest Retry-After 429s) and rewrites BENCH_serve_r04.json
+# — sequenced after the smoke, never concurrent with the timed suite;
+# ~30 s budget, 300 s hard cap.
+#
 # Usage: tools/verify.sh        (from anywhere; cd's to the repo root)
 # Exit:  graftlint's code on lint regressions (3), else tier1_diff's on
 #        gate failure (3 regression, 2 usage, 76 liveness), else the
-#        serve smoke's (0 ok, 1 fail).
+#        serve smoke's, else the chaos drill's (0 ok, 1 fail).
 #
 # Run it with nothing else executing: CPU contention flakes the
 # convergence-threshold tests (ROADMAP.md).
@@ -67,4 +76,15 @@ fi
 # GETs + 30 s checkpoint wait) so its failure diagnostics always print
 # before the outer kill fires
 JAX_PLATFORMS=cpu timeout -k 10 660 python tools/serve_smoke.py
+smoke=$?
+if [ "$smoke" -ne 0 ]; then
+  exit "$smoke"
+fi
+
+# serve chaos drill (sequenced after the smoke — never concurrent with
+# the timed suite): ~30 s measured; 300 s cap covers a loaded CI box.
+# Rewrites BENCH_serve_r04.json in place (the checked-in burst-shedding
+# trajectory datapoint).
+JAX_PLATFORMS=cpu timeout -k 10 300 python tools/chaos_serve.py \
+  --json BENCH_serve_r04.json
 exit $?
