@@ -1298,6 +1298,18 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "to pin the per-token path (lowest inter-token "
                         "latency; see docs/OPERATIONS.md). Every window "
                         "size is one XLA compile key per batch bucket.")
+    p.add_argument("--decode-kernel", type=str, default="auto",
+                   help="decode-window kernel: 'scan' (the lax.scan "
+                        "window), 'pallas' (fused VMEM-resident window "
+                        "kernel, ops/pallas_decode.py — interpreter mode "
+                        "off-TPU, token-identical but slow there), or "
+                        "'auto' (pallas on TPU when the VMEM plan fits, "
+                        "scan otherwise). With --loadgen a comma list "
+                        "(e.g. 'pallas,scan') runs the kernel comparison "
+                        "instead: same workload per kernel, tokens/s + "
+                        "ITL deltas + greedy parity "
+                        "(BENCH_serve_r05.json). See docs/OPERATIONS.md "
+                        "for when to pin 'scan'.")
     p.add_argument("--prefix-cache", type=str, default="on",
                    choices=["on", "off"],
                    help="shared-prompt prefix-state cache: fresh prompts "
@@ -1469,6 +1481,28 @@ def _parse_replicas(spec: str, flag: str = "--replicas") -> tuple[int, ...]:
     return levels
 
 
+def _parse_decode_kernels(spec: str) -> tuple[str, ...]:
+    kernels = tuple(dict.fromkeys(
+        k.strip() for k in spec.split(",") if k.strip()))
+    from .serve.engine import DECODE_KERNELS
+
+    bad = [k for k in kernels if k not in DECODE_KERNELS]
+    if not kernels or bad:
+        raise SystemExit(
+            f"--decode-kernel: expected one of {DECODE_KERNELS} (or a "
+            f"comma list for the --loadgen comparison), got {spec!r}")
+    return kernels
+
+
+def _single_decode_kernel(args) -> str:
+    kernels = _parse_decode_kernels(getattr(args, "decode_kernel", "auto"))
+    if len(kernels) > 1:
+        raise SystemExit(
+            f"--decode-kernel {args.decode_kernel!r}: a comma list is the "
+            "--loadgen comparison mode; this mode needs a single kernel")
+    return kernels[0]
+
+
 def _single_replica_count(args, mode: str) -> int:
     levels = _parse_replicas(args.replicas)
     if len(levels) > 1:
@@ -1558,6 +1592,7 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
             host_tier_entries=args.host_tier_entries,
             session_dir=args.session_dir,
             replica=i,
+            decode_kernel=_single_decode_kernel(args),
             # one registry argument scopes the whole serve stack's
             # telemetry (engine, caches, batcher, router, /metrics);
             # off = no-op instruments
@@ -1677,7 +1712,15 @@ def _serve_loadgen(args) -> int:
               f"< --prompt-len {args.prompt_len} (each prompt needs >= 1 "
               "unshared token)", file=sys.stderr)
         return 2
+    kernels = _parse_decode_kernels(args.decode_kernel)
     replica_levels = _parse_replicas(args.replicas)
+    if len(kernels) > 1:
+        if len(replica_levels) > 1 or args.idle_churn:
+            print("error: --decode-kernel comparison runs at one replica "
+                  "count without --idle-churn", file=sys.stderr)
+            return 2
+        return _serve_loadgen_kernel_sweep(args, kernels,
+                                           replica_levels[0])
     if args.idle_churn:
         if len(replica_levels) > 1:
             print("error: --idle-churn runs at one replica count "
@@ -1844,6 +1887,55 @@ def _serve_loadgen_longtail(args, n_replicas: int) -> int:
             json.dump(out, f, indent=1, sort_keys=True)
         print(f"loadgen: report written to {args.json}", file=sys.stderr)
     return 0
+
+
+def _serve_loadgen_kernel_sweep(args, kernels: tuple[str, ...],
+                                n_replicas: int = 1) -> int:
+    """``serve --loadgen --decode-kernel pallas,scan``: the decode-kernel
+    comparison — the same closed-loop workload on a fresh stack per
+    kernel, tokens/s + TTFT/ITL deltas + greedy token parity in one
+    machine-readable report (the BENCH_serve_r05.json probe)."""
+    import copy
+    import json
+
+    from .serve.loadgen import kernel_sweep
+
+    if args.mode != "closed":
+        print("error: --decode-kernel comparison is closed-loop only",
+              file=sys.stderr)
+        return 2
+    sampling = _serve_sampling(args)
+
+    def make_server(kern):
+        from .obs import MetricsRegistry
+
+        a = copy.copy(args)
+        a.decode_kernel = kern
+        reg = (None if getattr(args, "telemetry", "on") == "off"
+               else MetricsRegistry())
+        # honor a plain --replicas N: each kernel's stack is built at the
+        # requested replica count, not silently at 1
+        return _build_serve_stack(a, n_replicas, registry=reg)[2]
+
+    out = kernel_sweep(
+        make_server, vocab_size=args.vocab_size, kernels=kernels,
+        sessions=args.sessions,
+        requests_per_session=args.requests_per_session,
+        prompt_len=args.prompt_len, max_new_tokens=args.max_new_tokens,
+        sampling=sampling, seed=args.seed,
+    )
+    print(json.dumps(out))
+    vs = out.get("pallas_vs_scan", {})
+    print(f"kernel sweep: tokens/s "
+          f"{ {k: r['tokens_per_sec'] for k, r in out['kernels'].items()} }, "
+          f"pallas/scan ratio {vs.get('tokens_per_sec_ratio', 'n/a')}, "
+          f"p99 ITL delta {vs.get('p99_itl_delta_ms', 'n/a')} ms, "
+          f"parity_ok {out.get('parity_ok', 'n/a')}", file=sys.stderr)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        print(f"loadgen: report written to {args.json}", file=sys.stderr)
+    return 0 if out.get("parity_ok", True) else 1
 
 
 def _serve_loadgen_replica_sweep(args, levels: tuple[int, ...]) -> int:

@@ -1,0 +1,292 @@
+"""Fused Pallas TPU decode-window kernel for the serve plane.
+
+The serve engine's windowed decode (`serve/engine.py decode_window`) is a
+`lax.scan` over the fused cell + head + sampler: XLA executes K small
+programs per window, each round-tripping the [B, H] carries and the
+[B, V] logits through HBM, plus a gather/scatter pair at the window
+boundaries. This kernel runs the WHOLE window in one `pallas_call`:
+
+- the h/c carries of every layer stay RESIDENT IN VMEM across the K
+  steps (the paper's O(1) recurrent-state thesis applied to serving:
+  an LSTM session's entire decode state is [L, H] — it fits VMEM with
+  room to spare, unlike a transformer's KV cache);
+- the per-row EOS / budget / finished latches live in VMEM registers
+  across the steps — exactly the `decode_window` latch algebra, so a
+  window is always safe to run past a row's end (frozen carries, PAD
+  output);
+- the embedding lookup is a one-hot MXU matmul (the standard TPU
+  gather-free embedding — ops/embedding.py does the same for training),
+  the gates are the fused-kernel matmuls of `ops/lstm_cell.lstm_step`,
+  and the head + sampler run in-kernel, so the ONLY HBM traffic per
+  window is weights in (once), token block + row summary out.
+
+**Token-identical sampling.** Greedy is an in-kernel argmax over the
+f32-cast logits — bit-identical to `models/generate.sample_logits`.
+Temperature sampling uses the Gumbel-argmax identity that
+`jax.random.categorical` itself is built on: the (traced) wrapper draws
+``gumbel(rng_k, [B, V])`` noise per step with the SAME split chain the
+scan path feeds `sample_logits`, and the kernel computes
+``argmax(logits/max(t, 1e-6) + noise)`` — float addition is commutative
+bit-exactly, so the sampled tokens match the scan window token for
+token (tests/test_pallas_decode.py). Top-k / top-p truncation would
+need an in-kernel sort; those configs fall back to the scan window
+(`ServeEngine` counts the fallback honestly).
+
+**Interpreter-mode fallback**: off-TPU the kernel runs under
+``interpret=True`` — the same kernel body executed by XLA on CPU — so
+tier-1 proves token parity vs the scan window and `models/generate.py`
+without hardware; `tests_tpu/test_pallas_decode_tpu.py` is the
+compiled-Mosaic parity + perf gate. Interpreted execution is SLOWER
+than the scan path (it exists for correctness coverage, not speed) —
+`--decode-kernel auto` therefore resolves to ``scan`` off-TPU.
+
+VMEM plan (`plan_fits` — the serve twin of `ops/pallas_lstm.py`'s
+`_plan_fwd` accounting, same 12 MiB budget): weights (embedding, L
+fused layer kernels, head) + carries + the [K, B, V] noise block
+(sampled mode only) + the [B, V] logits/one-hot working set must fit;
+shapes that do not (huge vocab x large batch bucket x deep window)
+fall back to the scan window per compile key. docs/OPERATIONS.md
+carries the budget table.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: emitted for a dead row's steps — MUST equal serve/engine.py PAD_TOKEN
+#: (imported there and asserted equal at engine init; kept literal here
+#: so ops/ stays import-independent of serve/)
+PAD_TOKEN = -1
+
+_VMEM_BUDGET = 12 * 2**20  # bytes; conservative vs ~16 MiB/core
+
+
+def sampling_supported(temperature: float, top_k, top_p, greedy: bool) -> bool:
+    """Which sampling configs the kernel reproduces bit-exactly: greedy
+    (in-kernel argmax) and pure temperature sampling (Gumbel-argmax with
+    wrapper-drawn noise). Top-k/top-p need an in-kernel sort — those
+    dispatch the scan window instead."""
+    if greedy:
+        return True
+    return top_k is None and top_p is None
+
+
+def plan_bytes(batch_b: int, window: int, num_layers: int, hidden: int,
+               embed: int, vocab: int, *, sampled: bool,
+               pbytes: int = 4) -> int:
+    """VMEM bytes the kernel needs resident (no grid — one invocation
+    holds everything). Mirrors the `ops/pallas_lstm.py` cost-model
+    style: every operand + output + the [B, V] working set, counted
+    once (nothing streams)."""
+    v = vocab * embed * pbytes                      # embedding table
+    v += (embed + (num_layers - 1) * hidden) * 4 * hidden * pbytes  # Ws
+    v += num_layers * hidden * 4 * hidden * pbytes  # Us
+    v += num_layers * 4 * hidden * 4                # biases (f32)
+    v += hidden * vocab * pbytes + vocab * 4        # head kernel + bias
+    v += 4 * num_layers * batch_b * hidden * 4      # h/c in + out
+    v += window * batch_b * 4                       # token block out
+    v += 4 * batch_b * 4 * 4                        # row vectors (latches)
+    if sampled:
+        v += window * batch_b * vocab * 4           # gumbel noise block
+    # working set: one-hot + logits + gate pre-activations (live values)
+    v += 2 * batch_b * vocab * 4
+    v += batch_b * 4 * hidden * 4
+    return v
+
+
+def plan_fits(batch_b: int, window: int, num_layers: int, hidden: int,
+              embed: int, vocab: int, *, sampled: bool,
+              pbytes: int = 4) -> bool:
+    return plan_bytes(batch_b, window, num_layers, hidden, embed, vocab,
+                      sampled=sampled, pbytes=pbytes) <= _VMEM_BUDGET
+
+
+def _decode_window_kernel(*refs, num_layers: int, hidden: int, vocab: int,
+                          window: int, temperature: float, greedy: bool,
+                          sampled: bool, ldtype):
+    """One fused decode window. Carries, latches and the token block all
+    live in VMEM for the K python-unrolled steps; the latch algebra is
+    the scan window's, verbatim (serve/engine.py `window_fn.step`):
+
+    - rows alive at step entry emit this step's token and commit its
+      carry update (the EOS-emitting step still writes carries);
+    - dead rows emit PAD_TOKEN, keep frozen carries, and feed token 0
+      forward (the value never matters — but a PAD embedding one-hot
+      would be all-zeros, which is equally harmless and exactly what
+      the comparison produces for -1).
+    """
+    L = num_layers
+    H = hidden
+    idx = 0
+    emb_ref = refs[idx]; idx += 1
+    layer_refs = []
+    for _ in range(L):
+        layer_refs.append((refs[idx], refs[idx + 1], refs[idx + 2]))
+        idx += 3
+    head_ref = refs[idx]; idx += 1
+    hb_ref = refs[idx]; idx += 1
+    h0_ref = refs[idx]; idx += 1
+    c0_ref = refs[idx]; idx += 1
+    tok_ref = refs[idx]; idx += 1
+    alive_ref = refs[idx]; idx += 1
+    rem_ref = refs[idx]; idx += 1
+    eos_ref = refs[idx]; idx += 1
+    noise_ref = None
+    if sampled:
+        noise_ref = refs[idx]; idx += 1
+    (toks_ref, next_ref, alive_out_ref, rem_out_ref,
+     h_out_ref, c_out_ref) = refs[idx:idx + 6]
+
+    tok = tok_ref[0]                  # [B] int32
+    alive = alive_ref[0] != 0         # [B] bool
+    rem = rem_ref[0]                  # [B] int32
+    eos = eos_ref[0]                  # [B] int32 (-1 = none)
+    B = tok.shape[0]
+    hs = [h0_ref[l] for l in range(L)]
+    cs = [c0_ref[l] for l in range(L)]
+
+    for k in range(window):
+        # embedding gather as a one-hot MXU matmul (exact: 1.0 * row +
+        # zeros — bit-identical to jnp.take's row copy)
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, (B, vocab), 1)
+                  == tok[:, None]).astype(jnp.float32)
+        x = jnp.dot(onehot, emb_ref[:].astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+        if emb_ref.dtype != jnp.float32:
+            # mirror decode_one: jnp.take yields the embedding's dtype,
+            # and lstm_step casts x to the kernel dtype from THERE —
+            # narrow back so the downstream cast chain is identical
+            x = x.astype(emb_ref.dtype)
+        new_hs, new_cs = [], []
+        for l, (w_ref, u_ref, b_ref) in enumerate(layer_refs):
+            # ops/lstm_cell.lstm_step on fused kernels, op for op
+            dtype = w_ref.dtype
+            z = jnp.dot(x.astype(dtype), w_ref[:],
+                        preferred_element_type=jnp.float32)
+            z = z + jnp.dot(hs[l].astype(dtype), u_ref[:],
+                            preferred_element_type=jnp.float32)
+            z = z + b_ref[0]
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = f * cs[l] + i * g
+            h_new = o * jnp.tanh(c_new)
+            new_hs.append(h_new)
+            new_cs.append(c_new)
+            x = h_new
+        # head + sampler (models/generate.decode_one + sample_logits):
+        # same dtype chain — near-tied logits must argmax identically
+        logits = (
+            jnp.dot(x.astype(head_ref.dtype), head_ref[:],
+                    preferred_element_type=ldtype)
+            + hb_ref[0].astype(ldtype)
+        ).astype(jnp.float32)
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            if temperature != 1.0:
+                logits = logits / max(temperature, 1e-6)
+            # Gumbel-argmax == jax.random.categorical (float addition is
+            # commutative bit-exactly; the wrapper drew noise with the
+            # scan path's exact split chain)
+            nxt = jnp.argmax(logits + noise_ref[k], axis=-1).astype(jnp.int32)
+        # the scan window's latch algebra, verbatim
+        emit = alive
+        out_tok = jnp.where(emit, nxt, PAD_TOKEN).astype(jnp.int32)
+        new_rem = rem - emit.astype(rem.dtype)
+        hit_eos = emit & (eos >= 0) & (nxt == eos)
+        new_alive = emit & ~hit_eos & (new_rem > 0)
+        hs = [jnp.where(emit[:, None], hn, ho)
+              for ho, hn in zip(hs, new_hs)]
+        cs = [jnp.where(emit[:, None], cn, co)
+              for co, cn in zip(cs, new_cs)]
+        tok = jnp.where(new_alive, nxt, 0).astype(jnp.int32)
+        alive = new_alive
+        rem = new_rem
+        toks_ref[k] = out_tok
+
+    # the per-row summary the scheduler tick reads (one tiny readback
+    # per window instead of Python bookkeeping per row)
+    next_ref[0] = tok
+    alive_out_ref[0] = alive.astype(jnp.int32)
+    rem_out_ref[0] = rem
+    for l in range(L):
+        h_out_ref[l] = hs[l].astype(jnp.float32)
+        c_out_ref[l] = cs[l].astype(jnp.float32)
+
+
+def decode_window_call(params, fused_layers, cfg, h_in, c_in, tokens,
+                       alive, remaining, eos_ids, noise, *, window: int,
+                       temperature: float, greedy: bool,
+                       interpret: bool):
+    """Trace-level entry (called inside the engine's jitted wrapper):
+    run one fused decode window over the GATHERED carries.
+
+    ``h_in``/``c_in`` [L, B, H] f32; ``tokens``/``remaining``/``eos_ids``
+    [B] int32; ``alive`` [B] bool; ``noise`` [K, B, V] f32 gumbel draws
+    (None when greedy). Returns ``(h_out, c_out, toks [K, B] int32,
+    next_tok [B] int32, alive_out [B] bool, rem_out [B] int32)`` — the
+    exact shapes/dtypes the scan window produces, so the two kernels are
+    interchangeable behind one `DecodeWindow`."""
+    L, B, H = h_in.shape
+    V = cfg.vocab_size
+    E = cfg.embed
+    sampled = not greedy
+    head = params["head"]
+    head_kernel = (params["embedding"].T if cfg.tie_embeddings
+                   else head["kernel"])
+
+    operands = [params["embedding"]]
+    in_specs = [pl.BlockSpec(memory_space=pltpu.VMEM)]
+    for fused in fused_layers:
+        operands += [fused.kernel, fused.recurrent,
+                     fused.bias.reshape(1, -1)]
+        in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM)] * 3
+    operands += [
+        head_kernel, head["bias"].reshape(1, -1),
+        h_in, c_in,
+        tokens.reshape(1, -1).astype(jnp.int32),
+        alive.reshape(1, -1).astype(jnp.int32),
+        remaining.reshape(1, -1).astype(jnp.int32),
+        eos_ids.reshape(1, -1).astype(jnp.int32),
+    ]
+    in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM)] * 8
+    if sampled:
+        operands.append(noise)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.VMEM))
+
+    out_shape = [
+        jax.ShapeDtypeStruct((window, B), jnp.int32),   # token block
+        jax.ShapeDtypeStruct((1, B), jnp.int32),        # next token
+        jax.ShapeDtypeStruct((1, B), jnp.int32),        # alive summary
+        jax.ShapeDtypeStruct((1, B), jnp.int32),        # remaining summary
+        jax.ShapeDtypeStruct((L, B, H), jnp.float32),   # h out
+        jax.ShapeDtypeStruct((L, B, H), jnp.float32),   # c out
+    ]
+    out_specs = [pl.BlockSpec(memory_space=pltpu.VMEM)] * 6
+
+    toks, next_tok, alive_out, rem_out, h_out, c_out = pl.pallas_call(
+        functools.partial(
+            _decode_window_kernel, num_layers=L, hidden=H, vocab=V,
+            window=window, temperature=temperature, greedy=greedy,
+            sampled=sampled, ldtype=cfg.ldtype,
+        ),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*operands)
+    # E is only consulted by plan_fits; asserted here so a config whose
+    # layer-0 width disagrees with the embedding table fails loudly at
+    # trace time instead of producing shape errors inside the kernel
+    assert params["embedding"].shape == (V, E), (params["embedding"].shape,
+                                                 (V, E))
+    return (h_out, c_out, toks, next_tok[0],
+            alive_out[0].astype(bool), rem_out[0])

@@ -742,6 +742,12 @@ class Batcher:
             return bool(dropped or reaped)
 
         now = time.perf_counter()
+        # admitted requests that need a tier fill (continuation whose
+        # session is no longer device-resident): collected through the
+        # loop and restored in ONE batched gather+scatter program
+        # (SessionTiers.fill_batch) instead of a per-session dispatch —
+        # the per-continuation admission cost under session churn
+        records: list[list] = []  # [req, sid, slot, fresh, needs_fill]
         for req in admit:
             req.t_admit = now
             if req.t_submit is not None:
@@ -779,17 +785,30 @@ class Batcher:
             except Exception as e:  # cache exhausted by pinned slots
                 self._fail(req, f"{type(e).__name__}: {e}")
                 continue
-            if req.session_id is not None and fresh:
-                # explicit continuation of a session no longer in a
-                # device slot: a tiered engine restores the spilled state
-                # (pending spill capture / host RAM / verified disk read)
-                # into the fresh slot — the exact pre-eviction carries,
-                # so the continuation decodes token-identically. Nothing
-                # restorable (never created, spilled copy lost, corrupt
-                # disk file quarantined): silently decoding from zero
-                # state would return wrong tokens — fail loudly.
-                tiers = self.engine.tiers
-                if tiers is None or not tiers.fill(sid, slot):
+            # explicit continuation of a session no longer in a device
+            # slot: a tiered engine restores the spilled state (pending
+            # spill capture / host RAM / verified disk read) into the
+            # fresh PINNED slot — the exact pre-eviction carries, so the
+            # continuation decodes token-identically. The restore itself
+            # is deferred to ONE fill_batch call below. Nothing
+            # restorable (never created, spilled copy lost, corrupt disk
+            # file quarantined): silently decoding from zero state would
+            # return wrong tokens — fail loudly.
+            needs_fill = req.session_id is not None and fresh
+            if needs_fill and self.engine.tiers is None:
+                self.engine.cache.release(sid)
+                self._fail(req, f"unknown session {sid!r} (expired, "
+                                "never created, or its spilled state "
+                                "was lost; re-send the full prompt)")
+                continue
+            records.append([req, sid, slot, fresh, needs_fill])
+        fill_res = {}
+        if any(r[4] for r in records):
+            fill_res = self.engine.tiers.fill_batch(
+                [(sid, slot) for _, sid, slot, _, nf in records if nf])
+        for req, sid, slot, fresh, needs_fill in records:
+            if needs_fill:
+                if not fill_res.get(sid):
                     self.engine.cache.release(sid)
                     self._fail(req, f"unknown session {sid!r} (expired, "
                                     "never created, or its spilled state "
@@ -1163,13 +1182,18 @@ class Batcher:
         # latency, never as wrong tokens).
         _faults.serve_readback_hook()
         t_fetch = time.perf_counter()
-        toks = self.engine.fetch_window(win)
+        # ONE transfer for the token block AND the per-row summary the
+        # window program latched on device (remaining budget + liveness):
+        # the scheduler tick trusts the device latches instead of
+        # re-deriving them per token host-side — with the fused Pallas
+        # kernel those latches lived in VMEM for the whole window
+        toks, dev_rem, dev_alive = self.engine.fetch_window_summary(win)
         now = time.perf_counter()
         # dispatch→fetch-complete: how long the window's tokens took to
         # reach the host after its program was dispatched (device compute
         # + readback, minus whatever the scheduler overlapped)
         self._m_readback.observe(now - win.t_dispatch)
-        for s, row in zip(sessions, toks):
+        for i, (s, row) in enumerate(zip(sessions, toks)):
             if s.req.cancelled or s.req.done.is_set():
                 continue  # the cancel sweep / a prior window settled it
             s.req.phases.append(("decode_window", win.t_dispatch, t_fetch))
@@ -1180,6 +1204,12 @@ class Batcher:
                 self._append_token(s, int(tok), now)
                 if s.remaining == 0:
                     break
+            if not dev_alive[i] or dev_rem[i] <= 0:
+                # the device latch is the liveness authority (EOS hit or
+                # budget exhausted inside the window); the host token
+                # walk above agrees by construction — _append_token's
+                # bookkeeping mirrors the same latch rules
+                s.remaining = 0
             if s.remaining == 0:
                 self._retire(s)
                 self._finish(s)

@@ -79,6 +79,7 @@ from jax import lax
 from .. import obs
 from ..models.generate import decode_one, fuse_layers, sample_logits
 from ..models.lstm_lm import LMConfig, _head_kernel, lm_backbone
+from ..ops import pallas_decode
 from ..resilience import faults as _faults
 from .state_cache import DetachedState, PrefixCache, SessionTiers, StateCache
 
@@ -86,6 +87,14 @@ from .state_cache import DetachedState, PrefixCache, SessionTiers, StateCache
 # budget-exhausted / batch padding): the host stops distributing a row's
 # tokens at the first PAD_TOKEN. -1 cannot collide with a vocab id.
 PAD_TOKEN = -1
+assert pallas_decode.PAD_TOKEN == PAD_TOKEN  # one wire contract, two files
+
+#: decode_kernel choices: "scan" = the lax.scan window; "pallas" = the
+#: fused VMEM-resident window kernel (ops/pallas_decode.py; interpreter
+#: mode off-TPU so CPU tier-1 proves parity); "auto" = pallas on TPU
+#: when the VMEM plan fits, scan otherwise (interpreted pallas is a
+#: correctness path, not a fast one).
+DECODE_KERNELS = ("auto", "pallas", "scan")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -164,6 +173,7 @@ class ServeEngine:
         replica: int = 0,
         registry=None,
         device=None,
+        decode_kernel: str = "auto",
     ):
         # serving never rematerialises (same override as generate())
         if cfg.remat_chunk is not None:
@@ -215,11 +225,35 @@ class ServeEngine:
         # without limit
         self.max_sampling_configs = max_sampling_configs
         self._sampling_keys: set[tuple] = set()
+        # ---- decode-kernel selection (ops/pallas_decode.py) ----------
+        # resolved ONCE here to "pallas" or "scan"; per-dispatch the
+        # pallas path still falls back to the scan window for sampling
+        # configs / shapes the kernel does not cover (counted honestly
+        # in decode_window_scan_fallbacks — a silent switch would make
+        # the measured speedup a lie).
+        if decode_kernel not in DECODE_KERNELS:
+            raise ValueError(
+                f"decode_kernel must be one of {DECODE_KERNELS}, got "
+                f"{decode_kernel!r}")
+        platform = (device.platform if device is not None
+                    else jax.default_backend())
+        if decode_kernel == "auto":
+            # off-TPU the interpreted kernel is a correctness path, not
+            # a fast one — auto stays on the scan window there
+            use_pallas = (platform == "tpu" and pallas_decode.plan_fits(
+                self.batch_buckets[-1], 8, cfg.num_layers,
+                cfg.hidden_size, cfg.embed, cfg.vocab_size, sampled=True))
+            self.decode_kernel = "pallas" if use_pallas else "scan"
+        else:
+            self.decode_kernel = decode_kernel
+        self._pallas_interpret = platform != "tpu"
+        self.decode_window_scan_fallbacks = 0  # pallas→scan dispatches
         self.compile_counts: dict[tuple, int] = defaultdict(int)
         self._prefill_fns: dict[tuple, callable] = {}
         self._prefill_chunk_fns: dict[tuple, callable] = {}
         self._decode_fns: dict[tuple, callable] = {}
         self._decode_window_fns: dict[tuple, callable] = {}
+        self._decode_window_pallas_fns: dict[tuple, callable] = {}
         self._rng = jax.random.PRNGKey(rng_seed)
         self._dummy_rng = jax.random.PRNGKey(0)
         self._lock = threading.RLock()
@@ -239,7 +273,7 @@ class ServeEngine:
         self._m_compiles = {
             phase: fam.labels(phase=phase)
             for phase in ("prefill", "prefill_chunk", "decode",
-                          "decode_window")
+                          "decode_window", "decode_window_pallas")
         }
 
     # ---- limits --------------------------------------------------------
@@ -460,6 +494,83 @@ class ServeEngine:
         self._decode_window_fns[key] = fn
         return fn
 
+    def _get_decode_window_pallas_fn(self, batch_b: int, window: int,
+                                     sampling: SamplingParams):
+        """The fused Pallas decode window (ops/pallas_decode.py): same
+        host-facing signature and handle shapes as the scan window fn,
+        so `decode_window`/`decode_window_next` can dispatch either per
+        compile key and the batcher's pipeline never knows which kernel
+        produced a `DecodeWindow`. Compile-key family
+        ``("decode_window_pallas", bucket, K, sampling)`` — covered by
+        `warmup` through the same `decode_window` calls."""
+        key = (batch_b, window, sampling.key())
+        fn = self._decode_window_pallas_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        count_key = ("decode_window_pallas", batch_b, window,
+                     sampling.key())
+        interpret = self._pallas_interpret
+
+        def window_fn(params, fused, h_cache, c_cache, slots, tokens,
+                      alive, remaining, eos_ids, rng):
+            with self._counts_lock:
+                self.compile_counts[count_key] += 1
+            self._m_compiles["decode_window_pallas"].inc()
+            h_in = h_cache[:, slots, :]
+            c_in = c_cache[:, slots, :]
+            noise = None
+            if not sampling.greedy:
+                # the scan window's EXACT rng discipline: one split per
+                # step, categorical == Gumbel-argmax — drawing the
+                # noise here (traced, outside the kernel) keeps the
+                # sampled tokens bit-identical to sample_logits
+                rngs = jax.random.split(rng, window)
+                noise = jnp.stack([
+                    jax.random.gumbel(r, (batch_b, cfg.vocab_size),
+                                      jnp.float32)
+                    for r in rngs
+                ])
+            h_out, c_out, toks, next_tok, alive_out, rem_out = (
+                pallas_decode.decode_window_call(
+                    params, fused, cfg, h_in, c_in, tokens, alive,
+                    remaining, eos_ids, noise, window=window,
+                    temperature=sampling.temperature,
+                    greedy=sampling.greedy, interpret=interpret))
+            h_cache = h_cache.at[:, slots, :].set(h_out)
+            c_cache = c_cache.at[:, slots, :].set(c_out)
+            toks = jnp.moveaxis(toks, 0, 1)  # [K, B] → [B, K]
+            return h_cache, c_cache, toks, next_tok, alive_out, rem_out
+
+        fn = jax.jit(window_fn)
+        self._decode_window_pallas_fns[key] = fn
+        return fn
+
+    def _pallas_window_ok(self, batch_b: int, window: int,
+                          sampling: SamplingParams) -> bool:
+        cfg = self.cfg
+        return (pallas_decode.sampling_supported(
+                    sampling.temperature, sampling.top_k, sampling.top_p,
+                    sampling.greedy)
+                and pallas_decode.plan_fits(
+                    batch_b, window, cfg.num_layers, cfg.hidden_size,
+                    cfg.embed, cfg.vocab_size,
+                    sampled=not sampling.greedy))
+
+    def _window_fn_for(self, batch_b: int, window: int,
+                       sampling: SamplingParams):
+        """Pick the window program for this compile key: the fused
+        Pallas kernel when selected AND it covers this (shape, sampling)
+        — otherwise the scan window, with the fallback counted (a
+        silently-switched kernel would fake the measured speedup)."""
+        if self.decode_kernel == "pallas":
+            if self._pallas_window_ok(batch_b, window, sampling):
+                return self._get_decode_window_pallas_fn(
+                    batch_b, window, sampling)
+            with self._counts_lock:
+                self.decode_window_scan_fallbacks += 1
+        return self._get_decode_window_fn(batch_b, window, sampling)
+
     # ---- host-facing steps --------------------------------------------
 
     @staticmethod
@@ -614,7 +725,7 @@ class ServeEngine:
         alive_p[:n] = rem_p[:n] > 0
 
         with self._lock:
-            fn = self._get_decode_window_fn(batch_b, window, sampling)
+            fn = self._window_fn_for(batch_b, window, sampling)
             rng = self._next_rng(sampling)
             slots_d = jnp.asarray(slots_p)
             eos_d = jnp.asarray(eos_p)
@@ -644,8 +755,7 @@ class ServeEngine:
         if not self._warming:
             _faults.serve_decode_hook()
         with self._lock:
-            fn = self._get_decode_window_fn(prev.batch_b, window,
-                                            prev.sampling)
+            fn = self._window_fn_for(prev.batch_b, window, prev.sampling)
             rng = self._next_rng(prev.sampling)
             h, c, toks, next_tok, alive, rem = fn(
                 self.params, self.fused_layers, self.cache.h, self.cache.c,
@@ -664,6 +774,22 @@ class ServeEngine:
         int32 (padding rows stripped; ``PAD_TOKEN`` after a row's EOS or
         budget end). The ONLY sync point of the windowed decode path."""
         return np.asarray(jax.device_get(win.tokens))[: win.n]
+
+    @staticmethod
+    def fetch_window_summary(
+            win: DecodeWindow) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Fetch the token block AND the per-row on-device scheduler
+        summary in ONE transfer: ``(tokens [n, K], remaining [n],
+        alive [n])``. The window program already latched EOS/budget per
+        row on device, so the scheduler tick reads this summary instead
+        of re-deriving liveness host-side per token — same single sync
+        point as :meth:`fetch_window` (graftlint host-sync allow-list),
+        one ``device_get`` for all three arrays."""
+        toks, rem, alive = jax.device_get(
+            (win.tokens, win.remaining, win.alive))
+        n = win.n
+        return (np.asarray(toks)[:n], np.asarray(rem)[:n],
+                np.asarray(alive)[:n])
 
     def warmup(self, sampling: SamplingParams = GREEDY,
                prompt_lens: tuple[int, ...] = (1,),
@@ -714,10 +840,16 @@ class ServeEngine:
                         sampling=sampling, window=k,
                     )
                     self.fetch_window(win)
+            if self.tiers is not None:
+                # the tier-fill scatter lattice is warmup-covered like
+                # every other program family: a continuation burst must
+                # never pay a mid-traffic compile for its batched fill
+                self.tiers.warmup_fills(self.batch_buckets[-1])
         finally:
             self._warming = False
         return (len(self._prefill_fns) + len(self._prefill_chunk_fns)
-                + len(self._decode_fns) + len(self._decode_window_fns))
+                + len(self._decode_fns) + len(self._decode_window_fns)
+                + len(self._decode_window_pallas_fns))
 
     # ---- session lifecycle (thin wrappers over the cache) -------------
 
@@ -750,7 +882,10 @@ class ServeEngine:
     def stats(self) -> dict:
         with self._counts_lock:
             compiles = dict(self.compile_counts)
+            fallbacks = self.decode_window_scan_fallbacks
         return {
+            "decode_kernel": self.decode_kernel,
+            "decode_window_scan_fallbacks": fallbacks,
             "cache": self.cache.stats(),
             "prefix_cache": None if self.prefix is None else self.prefix.stats(),
             "tiers": None if self.tiers is None else self.tiers.stats(),

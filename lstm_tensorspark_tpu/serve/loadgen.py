@@ -790,3 +790,75 @@ def replica_sweep(
     if parity:
         out["parity_ok"] = all(parity[n] == parity[base] for n in levels)
     return out
+
+
+def kernel_sweep(
+    make_server,
+    *,
+    vocab_size: int,
+    kernels: tuple[str, ...] = ("scan", "pallas"),
+    sessions: int = 8,
+    requests_per_session: int = 4,
+    prompt_len: int = 8,
+    max_new_tokens: int = 16,
+    sampling: SamplingParams = GREEDY,
+    seed: int = 0,
+    parity_prompts: int = 4,
+) -> dict:
+    """Decode-kernel comparison (``cli serve --loadgen --decode-kernel
+    pallas,scan``; the BENCH_serve_r05.json probe): the SAME closed-loop
+    workload on a fresh ``make_server(kernel)`` stack per kernel, with
+    tokens/s + TTFT/ITL percentiles per kernel, the pallas-vs-scan
+    deltas, and greedy token parity across kernels — the decode window
+    must produce the SAME stream whichever kernel computes it.
+
+    Off-TPU the pallas kernel runs in interpreter mode, which is slower
+    than the scan window by construction — the report records the honest
+    ratio either way (the speed claim belongs to real hardware,
+    tests_tpu/)."""
+    kernels = tuple(dict.fromkeys(kernels))  # dedupe, keep order
+    if not kernels:
+        raise ValueError("kernels must name at least one decode kernel")
+    check_parity = parity_prompts > 0 and sampling.greedy
+    probes = (_random_prompts(parity_prompts, prompt_len, vocab_size,
+                              seed + 4242) if check_parity else [])
+    out: dict = {"kernels": {}}
+    parity: dict[str, list[list[int]]] = {}
+    fallbacks: dict[str, int] = {}
+    for kern in kernels:
+        server = make_server(kern)
+        with server:
+            with span("kernel_sweep_warmup", kernel=kern):
+                server.warmup(sampling, prompt_lens=(prompt_len,))
+            out["kernels"][kern] = run_loadgen(
+                server, vocab_size=vocab_size, sessions=sessions,
+                requests_per_session=requests_per_session,
+                prompt_len=prompt_len, max_new_tokens=max_new_tokens,
+                sampling=sampling, seed=seed,
+            )
+            if probes:
+                parity[kern] = [
+                    list(server.generate(p, max_new_tokens=max_new_tokens,
+                                         sampling=sampling).tokens)
+                    for p in probes
+                ]
+            es = server.engine.stats()
+            out["kernels"][kern]["decode_kernel"] = es["decode_kernel"]
+            fallbacks[kern] = es["decode_window_scan_fallbacks"]
+    out["scan_fallbacks"] = fallbacks
+    if "scan" in out["kernels"] and "pallas" in out["kernels"]:
+        s, p = out["kernels"]["scan"], out["kernels"]["pallas"]
+        out["pallas_vs_scan"] = {
+            "tokens_per_sec_ratio": round(
+                p["tokens_per_sec"] / (s["tokens_per_sec"] or 1e-9), 3),
+            "p50_itl_delta_ms": round(
+                p["p50_itl_ms"] - s["p50_itl_ms"], 3),
+            "p99_itl_delta_ms": round(
+                p["p99_itl_ms"] - s["p99_itl_ms"], 3),
+            "p50_ttft_delta_ms": round(
+                p["p50_ttft_ms"] - s["p50_ttft_ms"], 3),
+        }
+    if parity:
+        base = kernels[0]
+        out["parity_ok"] = all(parity[k] == parity[base] for k in kernels)
+    return out

@@ -65,6 +65,28 @@ class CacheFullError(RuntimeError):
     """No free slot and every occupied slot is pinned."""
 
 
+# jitted h/c slot scatter: the eager ``.at[].set()`` pair costs ~1 ms of
+# dispatch overhead per call on CPU (two un-jitted ops each tracing
+# through the eager path) — measured as the dominant per-continuation
+# fill cost in the BENCH_serve_r05 hot-set re-gate. One jitted program
+# (cached per shape; fill batches are power-of-two padded so the shape
+# set stays tiny) makes a warm fill dispatch sub-millisecond.
+@jax.jit
+def _scatter_slots(h, c, idx, hs, cs):
+    return (h.at[:, idx, :].set(hs.astype(h.dtype)),
+            c.at[:, idx, :].set(cs.astype(c.dtype)))
+
+
+# jitted gather+scatter for pending-capture fills: rows gathered from an
+# immutable captured snapshot and scattered into the live arrays as ONE
+# program (the eager form paid two slice ops + two scatter ops of
+# dispatch overhead per fill)
+@jax.jit
+def _gather_scatter_slots(h, c, src_h, src_c, src, dst):
+    return (h.at[:, dst, :].set(src_h[:, src, :].astype(h.dtype)),
+            c.at[:, dst, :].set(src_c[:, src, :].astype(c.dtype)))
+
+
 #: session-id namespace for prefix-cache backing slots. Client-facing
 #: layers (batcher Request) reject ids under it: a client naming a prefix
 #: entry's session would inherit — and corrupt — the shared prefix state.
@@ -233,11 +255,24 @@ class StateCache:
             return self.h[:, idx, :], self.c[:, idx, :]
 
     def write_slots(self, slots, h, c) -> None:
-        """Scatter (h, c) each ``[L, B, H]`` into ``slots`` [B]."""
+        """Scatter (h, c) each ``[L, B, H]`` into ``slots`` [B] — one
+        jitted program (see ``_scatter_slots``), so a tier fill on the
+        admission path costs a cheap jit dispatch, not two eager ops."""
         idx = jnp.asarray(slots, jnp.int32)
         with self._lock:
-            self.h = self.h.at[:, idx, :].set(h)
-            self.c = self.c.at[:, idx, :].set(c)
+            self.h, self.c = _scatter_slots(self.h, self.c, idx,
+                                            jnp.asarray(h), jnp.asarray(c))
+
+    def gather_scatter(self, dst_slots, src_h, src_c, src_slots) -> None:
+        """Gather ``src_slots`` rows from a CAPTURED snapshot pair
+        (``src_h``/``src_c`` — immutable functional snapshots, possibly
+        generations old) and scatter them into ``dst_slots`` of the live
+        arrays, as one jitted program (the pending-capture tier fill)."""
+        src = jnp.asarray(src_slots, jnp.int32)
+        dst = jnp.asarray(dst_slots, jnp.int32)
+        with self._lock:
+            self.h, self.c = _gather_scatter_slots(
+                self.h, self.c, src_h, src_c, src, dst)
 
     def copy_slot(self, src: int, dst: int) -> None:
         """O(1) on-device copy of one slot's carries (src read, dst
@@ -598,6 +633,12 @@ class PrefixCache:
                 "spilled": self.spilled,
                 "promoted": self.promoted,
             }
+
+
+def _pad_pow2(n: int) -> int:
+    """Next power of two >= n — the fill-batch bucket lattice (a handful
+    of compiled scatter shapes instead of one per distinct batch size)."""
+    return 1 << max(0, n - 1).bit_length()
 
 
 class _SpillJob:
@@ -1273,9 +1314,7 @@ class SessionTiers:
                 self.cache.write_slots(idx, job.h[:, None, :],
                                        job.c[:, None, :])
             else:
-                src = jnp.asarray([job.slot])
-                self.cache.write_slots(idx, job.h[:, src, :],
-                                       job.c[:, src, :])
+                self.cache.gather_scatter(idx, job.h, job.c, [job.slot])
             job.to_host = False  # the disk leg (if any) still runs:
             # the file stays the valid request-boundary checkpoint
             if not job.to_disk and not job.in_queue:
@@ -1338,6 +1377,161 @@ class SessionTiers:
         self._m_fill_lat.observe(time.perf_counter() - t0)
         return True
 
+    def fill_batch(self, pairs) -> dict[str, bool]:
+        """Batched :meth:`fill`: restore MANY sessions' spilled states
+        into their (already acquired AND PINNED) slots with ONE scatter
+        program per source class, instead of one gather+scatter dispatch
+        per session — the admission path's per-continuation device cost
+        under session churn, which is exactly the hot-set-ratio gate's
+        overhead (BENCH_serve_r05.json re-gate).
+
+        ``pairs`` is ``[(sid, slot), ...]`` with UNIQUE sids (admission
+        guarantees it — one in-flight request per session). Returns
+        ``{sid: filled}``. Three phases:
+
+        1. under the shared lock: classify each sid's freshest source
+           (pending capture / host RAM / evacuating overflow / disk
+           candidate) and do ALL the tier-dict bookkeeping — one lock
+           hold for the whole batch, no device dispatch inside it (the
+           per-session ``fill`` dispatched its scatter under the lock);
+        2. outside the lock: disk reads + sha256 verify (per file, as
+           before — the filesystem must never stall the scheduler);
+        3. one stacked host→device scatter for every host/disk state,
+           and one gather+scatter per distinct pending-capture array
+           pair (usually one — jobs captured from the same cache
+           generation share the arrays).
+
+        Token-identity with per-session fills is pinned by
+        tests/test_serve_tiers.py."""
+        pairs = list(pairs)
+        if not pairs:
+            return {}
+        t0 = time.perf_counter()
+        results = {sid: False for sid, _ in pairs}
+        host_fills: list[tuple[str, int, DetachedState]] = []
+        dev_fills: list[tuple[str, int, object, object, int | None]] = []
+        disk_cands: list[tuple[str, int]] = []
+        misses = 0
+        with self._lock:
+            for sid, slot in pairs:
+                job = self._pending.get(sid)
+                if job is not None and (job.to_host or job.to_disk):
+                    # freshest copy; the disk leg (if any) still runs —
+                    # the file stays the valid request-boundary
+                    # checkpoint (same bookkeeping as fill())
+                    dev_fills.append((sid, slot, job.h, job.c,
+                                      None if job.sliced else job.slot))
+                    job.to_host = False
+                    if not job.to_disk and not job.in_queue:
+                        del self._pending[sid]
+                    self._host.pop(sid, None)
+                    continue
+                state = self._host.pop(sid, None)
+                if state is None:
+                    # overflow victim mid-evacuation: still RAM-resident
+                    # (its in-flight disk write stays valid — no pop)
+                    state = self._evacuating.get(sid)
+                if state is not None:
+                    host_fills.append((sid, slot, state))
+                elif self._disk is not None:
+                    disk_cands.append((sid, slot))
+                else:
+                    misses += 1
+        # phase 2: MEMORY-sourced fills complete first — their states are
+        # already in RAM / captured on device, so they must never wait
+        # behind batch-mates' filesystem IO (and their fill-latency
+        # samples keep fill()'s per-source semantics: host-class numbers
+        # never include a disk read). One stacked scatter for the host/
+        # evacuating states, PADDED to a power-of-two bucket (extra rows
+        # re-write row 0's state into the scratch slot — harmless by
+        # definition): without the bucket, every distinct batch size N
+        # would trace a fresh XLA scatter program MID-RUN, and the
+        # compile (tens of ms) lands on exactly the admission latency
+        # the batching exists to remove (measured: fill p99 0.76 s
+        # unbucketed vs sub-ms warm).
+        if host_fills:
+            idx = [slot for _, slot, _ in host_fills]
+            hs = [st.h for _, _, st in host_fills]
+            cs = [st.c for _, _, st in host_fills]
+            n = _pad_pow2(len(host_fills))
+            idx += [self.cache.scratch_slot] * (n - len(host_fills))
+            hs += [hs[0]] * (n - len(host_fills))
+            cs += [cs[0]] * (n - len(host_fills))
+            self.cache.write_slots(np.asarray(idx), np.stack(hs, axis=1),
+                                   np.stack(cs, axis=1))
+        # pending captures — one gather+scatter per distinct captured
+        # array pair (immutable snapshots; usually ONE — jobs captured
+        # from the same cache generation share the arrays), bucket-padded
+        # the same way (src padding repeats src[0]; dst padding targets
+        # the scratch slot). Sliced pressure-valve captures are [L, H]
+        # handles, scattered individually.
+        groups: dict[tuple[int, int], list] = {}
+        for ent in dev_fills:
+            groups.setdefault((id(ent[2]), id(ent[3])), []).append(ent)
+        for ents in groups.values():
+            full = [e for e in ents if e[4] is not None]
+            if full:
+                dst = [e[1] for e in full]
+                src = [e[4] for e in full]
+                n = _pad_pow2(len(full))
+                dst += [self.cache.scratch_slot] * (n - len(full))
+                src += [src[0]] * (n - len(full))
+                self.cache.gather_scatter(np.asarray(dst), full[0][2],
+                                          full[0][3], np.asarray(src))
+            for sid, slot, h, c, _ in (e for e in ents if e[4] is None):
+                self.cache.write_slots(np.asarray([slot]),
+                                       h[:, None, :], c[:, None, :])
+        end_mem = time.perf_counter()
+        # phase 3: disk reads + sha256 verify OUTSIDE the lock, then the
+        # disk states' own stacked scatter — disk-class latency samples
+        # cover the read+verify, memory-class ones (above) do not
+        disk_states: list[tuple[str, int, DetachedState]] = []
+        for sid, slot in disk_cands:
+            state = None
+            try:
+                state = self._disk.get(sid, self.cache.num_layers,
+                                       self.cache.hidden_size)
+            except CorruptCheckpointError as e:
+                print(f"serve tiers: QUARANTINED corrupt session file "
+                      f"for {sid!r}: {e}", flush=True)
+                with self._lock:
+                    self.corrupt += 1
+                self._m_lost["corrupt"].inc()
+            if state is None:
+                misses += 1
+            else:
+                disk_states.append((sid, slot, state))
+        if disk_states:
+            idx = [slot for _, slot, _ in disk_states]
+            hs = [st.h for _, _, st in disk_states]
+            cs = [st.c for _, _, st in disk_states]
+            n = _pad_pow2(len(disk_states))
+            idx += [self.cache.scratch_slot] * (n - len(disk_states))
+            hs += [hs[0]] * (n - len(disk_states))
+            cs += [cs[0]] * (n - len(disk_states))
+            self.cache.write_slots(np.asarray(idx), np.stack(hs, axis=1),
+                                   np.stack(cs, axis=1))
+        end_disk = time.perf_counter()
+        n_host = len(host_fills) + len(dev_fills)
+        n_disk = len(disk_states)
+        with self._lock:
+            self.fills["host"] += n_host
+            self.fills["disk"] += n_disk
+            self.misses += misses
+        if n_host:
+            self._m_fill["host"].inc(n_host)
+        if n_disk:
+            self._m_fill["disk"].inc(n_disk)
+        if misses:
+            self._m_lost["miss"].inc(misses)
+        for sid, _, *_rest in (*host_fills, *dev_fills):
+            results[sid] = True
+            self._m_fill_lat.observe(end_mem - t0)
+        for sid, _, _ in disk_states:
+            results[sid] = True
+            self._m_fill_lat.observe(end_disk - t0)
+        return results
+
     def fill_memory(self, sid: str, slot: int) -> bool:
         """Memory-tiers-only :meth:`fill` (pending capture / host RAM /
         evacuating overflow — no disk leg). Safe to call with the shared
@@ -1367,6 +1561,30 @@ class SessionTiers:
                     del self._pending[sid]
             self._host.pop(sid, None)
             self._evacuating.pop(sid, None)
+
+    def warmup_fills(self, max_batch: int) -> None:
+        """Pre-compile the fill-path scatter lattice: one
+        ``_scatter_slots`` + ``_gather_scatter_slots`` program per
+        power-of-two batch size up to ``max_batch`` (fill batches are
+        padded onto exactly these shapes). Called from
+        ``ServeEngine.warmup`` so the first real continuation burst is
+        never charged a mid-traffic XLA compile — the same discipline as
+        the engine's program lattice (and what the BENCH_serve_r05
+        re-gate measured as a 0.76 s fill p99 outlier without it). All
+        writes target the scratch slot: harmless by definition."""
+        L, H = self.cache.num_layers, self.cache.hidden_size
+        scratch = self.cache.scratch_slot
+        n = 1
+        while True:
+            idx = np.full((n,), scratch)
+            z = np.zeros((L, n, H), np.float32)
+            self.cache.write_slots(idx, z, z)
+            with self._lock:
+                h, c = self.cache.h, self.cache.c
+            self.cache.gather_scatter(idx, h, c, idx)
+            if n >= max(1, max_batch):
+                break
+            n *= 2
 
     def fill_ahead(self, sid: str) -> bool:
         """Router fill-ahead: on an affinity-probe tier hit, promote the

@@ -1,0 +1,27 @@
+"""graftlint fixture: clean twin of viol_decode_sync — the scheduler
+reads the decode window's token block + on-device summary ONLY through
+the designated fetch_window_summary point (allow-listed alongside
+fetch_window), so the one-sync-per-window contract survives the Pallas
+kernel's extra summary arrays."""
+
+import numpy as np
+
+
+class Batcher:
+    def __init__(self, engine):
+        self.engine = engine
+        self.pending = None
+
+    def run(self, stop):
+        while not stop.is_set():
+            self.step()
+
+    def step(self):
+        if self.pending is None:
+            return
+        win = self.pending
+        self.pending = None
+        # the designated readback — both the plain call and an
+        # np.asarray wrapped around it are blessed
+        toks = np.asarray(self.engine.fetch_window_summary(win)[0])
+        self.engine.distribute(toks)

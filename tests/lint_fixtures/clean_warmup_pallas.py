@@ -1,0 +1,36 @@
+"""graftlint fixture: clean twin of viol_warmup_pallas — warmup()
+reaches the window dispatcher that covers BOTH kernel families (scan and
+pallas), so whichever kernel the engine resolved to, its window programs
+are compiled before traffic."""
+
+
+class MiniEngine:
+    def __init__(self, decode_kernel="scan"):
+        self.decode_kernel = decode_kernel
+        self.compile_counts = {}
+        self._fns = {}
+
+    def _get_window_fn(self, bucket, k):
+        count_key = ("decode_window", bucket, k)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def _get_window_pallas_fn(self, bucket, k):
+        count_key = ("decode_window_pallas", bucket, k)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def decode_window(self, tokens, k):
+        if self.decode_kernel == "pallas":
+            return self._get_window_pallas_fn(len(tokens), k)(tokens)
+        return self._get_window_fn(len(tokens), k)(tokens)
+
+    def warmup(self, ks=(1, 4)):
+        # warms through the dispatcher: every family a real dispatch can
+        # reach is reachable from here, whichever kernel is resolved
+        out = None
+        for k in ks:
+            out = self.decode_window([0], k)
+        return out

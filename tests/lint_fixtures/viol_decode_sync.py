@@ -1,0 +1,26 @@
+"""graftlint fixture: host-sync true positive on the Pallas decode-window
+readback path — the scheduler closure fetches the window's on-device
+summary with a bare jax.device_get instead of going through the
+designated fetch_window_summary point."""
+
+import jax
+
+
+class Batcher:
+    def __init__(self, engine):
+        self.engine = engine
+        self.pending = None
+
+    def run(self, stop):
+        while not stop.is_set():
+            self.step()
+
+    def step(self):
+        if self.pending is None:
+            return
+        win = self.pending
+        self.pending = None
+        # stray sync: the summary must come through fetch_window_summary
+        toks, rem, alive = jax.device_get(
+            (win.tokens, win.remaining, win.alive))
+        self.engine.distribute(toks, rem, alive)

@@ -1,0 +1,33 @@
+"""graftlint fixture: warmup-coverage true positive for a SECOND window
+kernel family — the engine grows a ("decode_window_pallas", ...) compile
+family next to the scan window's, but warmup() only dispatches the scan
+path: the first pallas-served request pays the kernel's XLA compile
+mid-traffic."""
+
+
+class MiniEngine:
+    def __init__(self, decode_kernel="scan"):
+        self.decode_kernel = decode_kernel
+        self.compile_counts = {}
+        self._fns = {}
+
+    def _get_window_fn(self, bucket, k):
+        count_key = ("decode_window", bucket, k)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def _get_window_pallas_fn(self, bucket, k):
+        count_key = ("decode_window_pallas", bucket, k)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def decode_window(self, tokens, k):
+        if self.decode_kernel == "pallas":
+            return self._get_window_pallas_fn(len(tokens), k)(tokens)
+        return self._get_window_fn(len(tokens), k)(tokens)
+
+    def warmup(self):
+        # only the scan family: a pallas engine compiles mid-traffic
+        return self._get_window_fn(1, 4)([0])

@@ -38,6 +38,8 @@ FIXTURES = os.path.join(_REPO, "tests", "lint_fixtures")
 VIOLATIONS = {
     "viol_host_sync": "host-sync",
     "viol_tier_sync": "host-sync",
+    "viol_decode_sync": "host-sync",
+    "viol_warmup_pallas": "warmup-coverage",
     "viol_lock_abba": "lock-order",
     "viol_lock_listener": "lock-order",
     "viol_warmup": "warmup-coverage",
@@ -58,6 +60,8 @@ VIOLATIONS = {
 CLEAN_TWINS = {
     "clean_host_sync": "host-sync",
     "clean_tier_sync": "host-sync",
+    "clean_decode_sync": "host-sync",
+    "clean_warmup_pallas": "warmup-coverage",
     "clean_lock_order": "lock-order",
     "clean_lock_shared_rlock": "lock-order",
     "clean_warmup": "warmup-coverage",

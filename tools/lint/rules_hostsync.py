@@ -42,9 +42,13 @@ SCHEDULER_CLASSES = {"Batcher", "SessionTiers"}
 _SCHEDULER_ENTRIES = {"run", "step", "drain"}
 #: attribute-call names that ARE the designated sync points — a direct
 #: np.asarray around them is the blessed fetch, not a stray sync
-#: (fetch_window: the windowed-decode readback; fetch_detached: the
-#: spill worker's single device→host fetch, StateCache.fetch_detached)
-_FETCH_ALLOWLIST = {"fetch_window", "fetch_detached"}
+#: (fetch_window: the windowed-decode readback; fetch_window_summary:
+#: the same single sync extended with the per-row on-device scheduler
+#: summary the fused Pallas decode window latches — one device_get for
+#: tokens + remaining + alive; fetch_detached: the spill worker's
+#: single device→host fetch, StateCache.fetch_detached)
+_FETCH_ALLOWLIST = {"fetch_window", "fetch_window_summary",
+                    "fetch_detached"}
 _SYNC_ATTR_CALLS = {"item", "block_until_ready"}
 
 

@@ -53,6 +53,17 @@ _SERVE_ARGS = [
     "--tiered-cache", "on",
     "--replicas", str(_REPLICAS),
 ]
+# the pallas fallback boot: one replica, windowed ladder (so decode
+# actually dispatches the fused window kernel), interpreter mode on CPU;
+# tiers off to keep the extra boot to a couple of seconds
+_PALLAS_ARGS = [
+    "serve", "--http", "--port", "0", "--vocab-size", "31",
+    "--hidden-units", "12", "--num-layers", "1",
+    "--prefill-buckets", "4,8", "--batch-buckets", "1,2",
+    "--decode-window", "4", "--prefix-cache", "off",
+    "--tiered-cache", "off", "--decode-kernel", "pallas",
+    "--replicas", "1",
+]
 
 
 def _fail(proc: subprocess.Popen, lines: list[str], why: str) -> int:
@@ -204,11 +215,39 @@ def main(argv=None) -> int:
             return _fail(proc, lines,
                          f"post-restart continuation of {sid!r} failed "
                          f"(disk tier restore): {cont}")
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
 
-        print(f"serve_smoke: PASS ({base}: healthz fan-in ({len(reps)} "
-              f"replicas) + routed generate + stats + {len(fams)} metric "
-              "families validated; kill -9 → restart → session "
-              f"{sid!r} continued from the disk tier)")
+        # ---- pallas decode-kernel boot (interpreter-mode fallback) ----
+        # one boot with --decode-kernel pallas: off-TPU the fused window
+        # kernel runs interpreted (ops/pallas_decode.py) — this keeps
+        # the fallback path from rotting in CI, and the greedy tokens
+        # must be IDENTICAL to the scan-window reply above (same model
+        # flags/seed — the kernel must not change a single token)
+        scan_base = base  # the (now-killed) 2-replica scan server's URL
+        pallas_cmd = [sys.executable, "-m", "lstm_tensorspark_tpu.cli",
+                      *_PALLAS_ARGS]
+        proc, lines, base = _boot(pallas_cmd, env, args.timeout)
+        if base is None:
+            return _fail(proc, lines,
+                         "--decode-kernel pallas server never reported "
+                         "its address")
+        preply = _generate(base, {"prompt": [1, 2, 3], "max_new_tokens": 4,
+                                  "greedy": True})
+        if preply.get("tokens") != reply.get("tokens"):
+            return _fail(proc, lines,
+                         "pallas decode-window tokens diverge from the "
+                         f"scan window: {preply.get('tokens')} != "
+                         f"{reply.get('tokens')}")
+
+        print(f"serve_smoke: PASS ({scan_base}: healthz fan-in "
+              f"({len(reps)} replicas) + routed generate + stats + "
+              f"{len(fams)} metric families validated; kill -9 → restart "
+              f"→ session {sid!r} continued from the disk tier; {base}: "
+              "--decode-kernel pallas boot token-identical)")
         proc.terminate()
         try:
             proc.wait(timeout=10)
