@@ -72,6 +72,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat-chunk", type=int, default=None,
                    help="jax.checkpoint chunk size over time (long sequences)")
     p.add_argument("--scan-unroll", type=int, default=1)
+    p.add_argument("--bptt-mode", type=str, default="auto",
+                   choices=["auto", "assoc", "sequential"],
+                   help="backward pass through the recurrence "
+                        "(ops/parallel_scan.py): 'assoc' = parallel-scan "
+                        "BPTT (associative scan of per-step adjoint "
+                        "operators, O(log T) depth), 'sequential' = the "
+                        "ordinary reverse scan, 'auto' = assoc only when "
+                        "the memory plan fits and T is long enough "
+                        "(docs/OPERATIONS.md 'BPTT mode')")
     p.add_argument("--use-pallas", action="store_true",
                    help="fused Pallas recurrence kernel (TPU, B%%8==0; any H — "
                         "padded/tiled internally). Its fused backward saves "
@@ -343,10 +352,21 @@ def main(argv=None) -> int:
                     print(f"warning: could not write --trace file: {e}")
         # final registry snapshot into the JSONL: the run's step-time /
         # tokens-per-sec / anomalous-step telemetry (obs/), same numbers a
-        # live /metrics scrape would show
+        # live /metrics scrape would show. The bptt context rides along
+        # (requested mode string + trace/fallback counts) so a supervised
+        # restart can detect a bptt-mode flip between resume legs.
         from .obs import REGISTRY
 
-        logger.log_registry(REGISTRY)
+        extra = None
+        if getattr(args, "bptt_mode", None):
+            from .ops import parallel_scan
+
+            pstats = parallel_scan.assoc_stats()
+            extra = {"bptt_mode": args.bptt_mode,
+                     "bptt_assoc_traces": pstats["assoc_traces"],
+                     "bptt_sequential_fallbacks":
+                         pstats["sequential_fallbacks"]}
+        logger.log_registry(REGISTRY, extra=extra)
     return rc
 
 
@@ -813,6 +833,7 @@ def _run_lm(args, logger) -> int:
         scan_unroll=args.scan_unroll,
         use_pallas=args.use_pallas,
         logits_dtype=args.logits_dtype,
+        bptt=args.bptt_mode,
     )
 
     if max(args.tensor_parallel, args.seq_parallel, args.pipeline_stages) > 1:

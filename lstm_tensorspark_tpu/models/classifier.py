@@ -41,6 +41,10 @@ class ClassifierConfig:
     # forward AND reversed scans of the bi-LSTM; falls back per-layer when
     # shapes/platform don't fit the kernel's VMEM cost model
     use_pallas: bool = False
+    # BPTT mode for both directions' scans (ops/parallel_scan.py):
+    # "sequential" | "assoc" | "auto" — the T=400 IMDB config is exactly
+    # the long-chain shape the assoc backward targets
+    bptt: str = "sequential"
 
     @property
     def embed(self) -> int:
@@ -90,6 +94,7 @@ def classifier_forward(
         ((h_fwd, _), ys_f), ((h_bwd, _), ys_b) = bidir_lstm_scan(
             pf, pb, xs, mask=mask, compute_dtype=cdtype,
             remat_chunk=cfg.remat_chunk, use_pallas=cfg.use_pallas,
+            bptt=cfg.bptt,
         )
         xs = jnp.concatenate([ys_f, ys_b], axis=-1)
         if i < cfg.num_layers - 1 and cfg.dropout > 0.0 and not deterministic:

@@ -46,6 +46,11 @@ class LMConfig:
     # fused Pallas recurrence kernel (ops/pallas_lstm.py) when shapes/platform
     # allow; falls back to lax.scan per layer otherwise
     use_pallas: bool = False
+    # BPTT mode for the recurrence (ops/parallel_scan.py): "sequential",
+    # "assoc" (parallel-scan backward), or "auto" (assoc when the memory
+    # plan fits and T is long enough). Library default stays sequential;
+    # `cli train --bptt-mode` defaults to auto.
+    bptt: str = "sequential"
     # dtype of the materialized [B,T,V] logits array. At the word-LM vocab
     # sizes every pass over that array is an HBM-bandwidth cost (fwd write,
     # logsumexp read, dlogits write + three backward reads — ~300 MB each
@@ -132,6 +137,7 @@ def lm_backbone(
         remat_chunk=cfg.remat_chunk,
         unroll=cfg.scan_unroll,
         use_pallas=cfg.use_pallas,
+        bptt=cfg.bptt,
     )
 
 

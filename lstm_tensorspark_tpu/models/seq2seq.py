@@ -35,6 +35,10 @@ class Seq2SeqConfig:
     # decoder scan (the autoregressive inference decode stays a lax.scan —
     # its per-step projection feedback cannot be hoisted into one kernel)
     use_pallas: bool = False
+    # BPTT mode for the encoder scan (ops/parallel_scan.py); the decoder
+    # scans stay sequential — the forecast horizon is short, below any
+    # shape where the assoc backward pays
+    bptt: str = "sequential"
 
     @property
     def cdtype(self):
@@ -72,7 +76,7 @@ def encode(params, context: jax.Array, cfg: Seq2SeqConfig):
     carries, _ = stacked_lstm_scan(
         params["encoder"], context,
         compute_dtype=cdtype, remat_chunk=cfg.remat_chunk,
-        use_pallas=cfg.use_pallas,
+        use_pallas=cfg.use_pallas, bptt=cfg.bptt,
     )
     return carries
 

@@ -8,6 +8,7 @@ from .lstm_cell import (
 from .embedding import embed_lookup, selected_logits
 from .scan import (auto_lstm_scan, bidir_lstm_scan, lstm_scan,
                    stacked_lstm_scan)
+from .parallel_scan import assoc_lstm_scan, resolve_bptt
 from .masking import sequence_mask, masked_mean, reverse_sequences
 
 __all__ = [
@@ -16,8 +17,10 @@ __all__ = [
     "fuse_params",
     "lstm_step",
     "lstm_step_unfused",
+    "assoc_lstm_scan",
     "auto_lstm_scan",
     "bidir_lstm_scan",
+    "resolve_bptt",
     "embed_lookup",
     "selected_logits",
     "lstm_scan",

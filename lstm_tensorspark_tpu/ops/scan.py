@@ -14,6 +14,19 @@ inside chunks during the backward pass — the scan-with-remat crux kernel.
 Variable-length sequences (SURVEY.md §7): a boolean ``mask`` freezes the carry
 at padded steps, so the final (h, c) is each sequence's state at its true end,
 and reversed scans over right-padded batches stay correct.
+
+BPTT modes (``bptt=``): ``"sequential"`` (default) differentiates through
+the scan with the ordinary reverse-mode transpose — a T-deep chain;
+``"assoc"`` swaps in the parallel-scan backward of ops/parallel_scan.py
+(BPPSA-style: the adjoint chain is an associative scan of per-step
+Jacobian operators, O(log T) depth); ``"auto"`` picks assoc only when the
+`parallel_scan.plan_bytes` memory model fits and T >= its threshold,
+counting every fallback. Forward values are identical in every mode.
+
+Masked + remat interaction: both the mask reshape and the chunked scan
+require ``T % remat_chunk == 0`` — a silent tail chunk would give the two
+bptt modes different step groupings for the same inputs, so indivisible
+T raises instead (same error from `parallel_scan.assoc_lstm_scan`).
 """
 
 from __future__ import annotations
@@ -42,6 +55,7 @@ def lstm_scan(
     remat_chunk: int | None = None,
     compute_dtype=None,
     unroll: int = 1,
+    bptt: str = "sequential",
 ):
     """Run the LSTM over a batch of sequences.
 
@@ -56,11 +70,25 @@ def lstm_scan(
       compute_dtype: e.g. ``jnp.bfloat16`` for the matmuls; cell state and
         accumulation stay float32.
       unroll: `lax.scan` unroll factor (amortises loop overhead on TPU).
+      bptt: ``"sequential"`` | ``"assoc"`` | ``"auto"`` — how the backward
+        pass runs (module docstring; ops/parallel_scan.py). Values are
+        mode-independent; gradients agree to numerical tolerance
+        (tests/test_parallel_scan.py, tests/test_property_scan.py).
 
     Returns:
       ``((h_T, c_T), ys)`` with ``ys`` ``[B, T, H]`` (hidden state per step).
     """
     B, T, _ = xs.shape
+    if bptt != "sequential":
+        from .parallel_scan import assoc_lstm_scan, resolve_bptt
+
+        if resolve_bptt(bptt, B, T, params.hidden_size,
+                        remat_chunk=remat_chunk) == "assoc":
+            return assoc_lstm_scan(
+                params, xs, carry, mask=mask, reverse=reverse,
+                remat_chunk=remat_chunk, compute_dtype=compute_dtype,
+                unroll=unroll,
+            )
     fused = fuse_params(params, compute_dtype=compute_dtype)
     if carry is None:
         carry = zero_carry(B, params.hidden_size)
@@ -100,7 +128,10 @@ def lstm_scan(
         )
     else:
         if T % remat_chunk != 0:
-            raise ValueError(f"T={T} not divisible by remat_chunk={remat_chunk}")
+            raise ValueError(
+                f"T={T} not divisible by remat_chunk={remat_chunk} — a "
+                "tail chunk would silently change remat (and bptt-mode) "
+                "semantics; pad or pick a divisor")
         n_chunks = T // remat_chunk
 
         def chunk_fn(c, chunk_inputs):
@@ -134,6 +165,7 @@ def auto_lstm_scan(
     compute_dtype=None,
     remat_chunk: int | None = None,
     unroll: int = 1,
+    bptt: str = "sequential",
 ):
     """`lstm_scan` with optional fused-Pallas dispatch.
 
@@ -143,7 +175,20 @@ def auto_lstm_scan(
     and seq2seq decoder recurrences take the fused path too; otherwise
     falls back to the plain `lax.scan`. Same signature contract as
     `lstm_scan`; returns ``((hT, cT), ys)``.
+
+    Precedence with ``bptt``: an EXPLICIT ``bptt="assoc"`` wins over the
+    Pallas forward dispatch (the caller asked for the parallel-scan
+    backward, which the fused forward kernel does not provide);
+    ``bptt="auto"`` defers to the Pallas kernel when it engages — pinning
+    one fast path must not silently disable the other — and only
+    consults the assoc plan on the `lstm_scan` fallback.
     """
+    if bptt == "assoc":
+        return lstm_scan(
+            params, xs, carry, mask=mask, reverse=reverse,
+            compute_dtype=compute_dtype, remat_chunk=remat_chunk,
+            unroll=unroll, bptt=bptt,
+        )
     if use_pallas:
         from .pallas_lstm import pallas_lstm_scan, supported
 
@@ -158,6 +203,7 @@ def auto_lstm_scan(
     return lstm_scan(
         params, xs, carry, mask=mask, reverse=reverse,
         compute_dtype=compute_dtype, remat_chunk=remat_chunk, unroll=unroll,
+        bptt=bptt,
     )
 
 
@@ -171,6 +217,7 @@ def bidir_lstm_scan(
     compute_dtype=None,
     remat_chunk: int | None = None,
     unroll: int = 1,
+    bptt: str = "sequential",
 ):
     """Both directions of one bi-LSTM layer (VERDICT r3 item 2).
 
@@ -187,7 +234,9 @@ def bidir_lstm_scan(
     """
     import os
 
-    if (use_pallas and remat_chunk is None
+    # explicit assoc wins over the stacked-direction fused forward, same
+    # precedence as auto_lstm_scan (auto defers to the kernels)
+    if (use_pallas and remat_chunk is None and bptt != "assoc"
             and os.environ.get("LSTM_TSP_NO_BIDIR_FUSE") != "1"):
         from .pallas_bilstm import bilstm_supported, pallas_bilstm_scan
 
@@ -204,10 +253,12 @@ def bidir_lstm_scan(
     out_f = auto_lstm_scan(
         params_fwd, xs, mask=mask, use_pallas=use_pallas,
         compute_dtype=compute_dtype, remat_chunk=remat_chunk, unroll=unroll,
+        bptt=bptt,
     )
     out_b = auto_lstm_scan(
         params_bwd, xs, mask=mask, reverse=True, use_pallas=use_pallas,
         compute_dtype=compute_dtype, remat_chunk=remat_chunk, unroll=unroll,
+        bptt=bptt,
     )
     return out_f, out_b
 
@@ -241,6 +292,7 @@ def stacked_lstm_scan(
             compute_dtype=scan_kwargs.get("compute_dtype"),
             remat_chunk=scan_kwargs.get("remat_chunk"),
             unroll=scan_kwargs.get("unroll", 1),
+            bptt=scan_kwargs.get("bptt", "sequential"),
         )
         finals.append(final)
         if idx < n - 1 and dropout_rate > 0.0 and not deterministic:

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.lstm_cell import LSTMParams, fuse_params, lstm_step, zero_carry
+from ..ops.scan import lstm_scan
 
 
 def sp_lstm_scan(
@@ -54,6 +55,7 @@ def sp_lstm_scan(
     uniform: bool = False,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
+    bptt: str = "sequential",
 ) -> jax.Array:
     """Wavefront LSTM scan over a sequence-sharded batch.
 
@@ -73,7 +75,14 @@ def sp_lstm_scan(
     exactly when "model" is unused. Falls back to the plain scan when
     the kernel's cost model rejects the shard shape.
     ``pallas_interpret`` forces the kernel in interpret mode (CPU parity
-    tests of the kernel-in-wavefront composition)."""
+    tests of the kernel-in-wavefront composition).
+
+    ``bptt`` != "sequential" routes each local chunk through
+    `ops.scan.lstm_scan` with the parallel-scan backward knob — the
+    device's T/S time-chunk is the natural tile of the assoc scan tree
+    (ops/parallel_scan.py), and the assoc path contains no collectives,
+    so it is legal inside the manual shard exactly like the Pallas
+    kernel. The default keeps the original inline scan untouched."""
     S = lax.axis_size(axis)
     s = lax.axis_index(axis)
     B, C, _ = xs_local.shape
@@ -90,6 +99,10 @@ def sp_lstm_scan(
         pbytes = 2 if compute_dtype == jnp.bfloat16 else 4
         use_kernel = pallas_interpret or supported(
             b, H, param_dtype_bytes=pbytes)
+    if bptt == "assoc":
+        # explicit assoc wins over the fused forward kernel — the same
+        # precedence as auto_lstm_scan ("auto" defers to the kernel)
+        use_kernel = False
 
     def chunk_scan(carry, x_chunk):
         """One microbatch's pass over the local chunk: [b, C, D] -> [b, C, H]."""
@@ -100,6 +113,14 @@ def sp_lstm_scan(
                 interpret=pallas_interpret,
             )
             return new_carry, ys
+        if bptt != "sequential":
+            # parallel-scan backward over the local chunk (resolved per
+            # shard shape; "auto" falls back to the inline scan below
+            # through lstm_scan's own resolution)
+            return lstm_scan(
+                params, x_chunk, carry, compute_dtype=compute_dtype,
+                remat_chunk=remat_chunk, unroll=unroll, bptt=bptt,
+            )
         xs_t = jnp.moveaxis(x_chunk, 0, 1)  # [C, b, D]
 
         def step(c, x):

@@ -63,6 +63,9 @@ def sp_lm_loss(params, batch, cfg: LMConfig, *, seq_axis: str = "seq",
             # fused kernel per local chunk — only when the caller made
             # every mesh axis manual (no TP; see make_sharded_lm_train_step)
             use_pallas=use_pallas,
+            # parallel-scan backward over each local chunk (the SP chunk
+            # is the assoc tree's tile); collective-free, shard-legal
+            bptt=cfg.bptt,
         )
         if use_dropout and idx < n - 1:
             from ..ops.masking import dropout_with_key

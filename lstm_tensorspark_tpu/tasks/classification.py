@@ -37,6 +37,7 @@ def run_classifier(args, logger) -> int:
         compute_dtype=args.compute_dtype,
         remat_chunk=args.remat_chunk,
         use_pallas=args.use_pallas,
+        bptt=getattr(args, "bptt_mode", "sequential"),
     )
 
     def loss_fn(params, batch, dropout_rng):

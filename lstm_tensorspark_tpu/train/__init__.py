@@ -2,6 +2,7 @@ from .optimizer import make_optimizer
 from .loop import TrainState, make_train_step, make_eval_step, train_loop
 from .multistep import make_multi_train_step, make_dp_multi_train_step
 from .device_step import (
+    TrainStepCompileCache,
     make_device_train_step,
     make_device_dp_train_step,
     make_device_lm_train_step,
@@ -9,6 +10,7 @@ from .device_step import (
 )
 
 __all__ = [
+    "TrainStepCompileCache",
     "make_optimizer",
     "TrainState",
     "make_train_step",

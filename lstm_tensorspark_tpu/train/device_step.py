@@ -325,6 +325,51 @@ def make_device_lm_train_step(
     return _jit_step(step, jit, donate)
 
 
+class TrainStepCompileCache:
+    """Keyed train-step executables with trace-time compile counting and
+    a warmup path — the serve engine's compile-key discipline applied to
+    the training side. A (bucket, bptt_mode) step program that first
+    traces mid-measurement charges one timed sample a full XLA compile
+    (the exact failure class `tools/bench_train_scan.py` pairs runs to
+    avoid); the ``("train_step", bucket, bptt_mode)`` family is gated by
+    graftlint's warmup-coverage rule like the serve families, so an
+    unwarmed consumer cannot land.
+
+    ``builder(bucket, bptt_mode)`` must return an UNJITTED step
+    ``(state, batch) -> (state', metrics)`` (e.g. `make_train_step`
+    with ``jit=False``); this cache owns the jit so the trace-time
+    counter sits inside the traced callable.
+    """
+
+    def __init__(self, builder):
+        self._builder = builder
+        self._fns: dict = {}
+        self.compile_counts: dict = {}
+
+    def step_fn(self, bucket, bptt_mode: str):
+        key = (bucket, bptt_mode)
+        if key not in self._fns:
+            raw = self._builder(bucket, bptt_mode)
+
+            def counted(state, batch, _raw=raw, _key=key):
+                # bumped at TRACE time (python side effect inside the
+                # jitted callable) — one count per compiled program
+                count_key = ("train_step", _key[0], _key[1])
+                self.compile_counts[count_key] = (
+                    self.compile_counts.get(count_key, 0) + 1)
+                return _raw(state, batch)
+
+            self._fns[key] = jax.jit(counted)
+        return self._fns[key]
+
+    def warmup(self, cases):
+        """Dispatch each ``(bucket, bptt_mode, state, batch)`` once so
+        every program in the lattice compiles before timed traffic."""
+        for bucket, mode, state, batch in cases:
+            out = self.step_fn(bucket, mode)(state, batch)
+            jax.block_until_ready(jax.tree.leaves(out)[0])
+
+
 def make_device_dp_lm_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,

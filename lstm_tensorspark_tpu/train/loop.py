@@ -26,6 +26,7 @@ import numpy as np
 import optax
 
 from .. import obs
+from ..ops import parallel_scan as _pscan
 from ..resilience import faults as _faults
 
 
@@ -385,12 +386,7 @@ def train_loop(
     anomalous window extends the consecutive run, a partially anomalous
     one resets it (it contained at least one finite step).
     """
-    t0 = time.perf_counter()
-    window_start = t0
-    last_metrics = None
-    anomalous_total = 0
-    anomalous_consec = 0
-    best_val = best_init
+    window_start = time.perf_counter()
     # telemetry (obs/): step-time/tokens-per-sec recorded at the log
     # cadence from the SAME window timings the JSONL records use (no
     # extra host sync); anomalous steps counted wherever the scalar is
@@ -406,8 +402,60 @@ def train_loop(
     _m_anomalous = obs.REGISTRY.counter(
         "train_anomalous_steps_total",
         "non-finite steps whose update was skipped")
+    # bptt-mode observability (ops/parallel_scan.py): traces happen on the
+    # first dispatch inside this loop, so the fallback delta across the
+    # loop captures this run's resolutions. Surfaced in metrics_snapshot
+    # (cli.py adds the requested mode string) so a supervised restart can
+    # detect a bptt-mode flip between resume legs.
+    _m_bptt_fb = obs.REGISTRY.counter(
+        "train_bptt_assoc_fallbacks_total",
+        "auto bptt resolutions that fell back to the sequential backward")
+    _m_bptt_tr = obs.REGISTRY.counter(
+        "train_bptt_assoc_traces_total",
+        "scans traced with the associative-scan backward")
+    _bptt0 = _pscan.assoc_stats()
     if num_steps is not None and num_steps <= 0:
         return state  # eval-only budget: never pull a batch from the feed
+    try:
+        state = _run_train_loop(
+            state, train_step, batches, num_steps=num_steps,
+            log_every=log_every, logger=logger, eval_fn=eval_fn,
+            eval_every=eval_every, checkpoint_fn=checkpoint_fn,
+            checkpoint_every=checkpoint_every,
+            tokens_per_batch=tokens_per_batch, steps_per_call=steps_per_call,
+            fused_eval=fused_eval, flops_per_token=flops_per_token,
+            peak_tflops=peak_tflops, best_fn=best_fn,
+            best_metric=best_metric, best_mode=best_mode, best_init=best_init,
+            anomaly_limit=anomaly_limit, window_start=window_start,
+            _m_step=_m_step, _m_tps=_m_tps, _m_steps=_m_steps,
+            _m_anomalous=_m_anomalous,
+        )
+    finally:
+        # counted on every exit path — an anomaly abort's final
+        # metrics_snapshot must still carry the bptt evidence
+        _b = _pscan.assoc_stats()
+        fb = _b["sequential_fallbacks"] - _bptt0["sequential_fallbacks"]
+        tr = _b["assoc_traces"] - _bptt0["assoc_traces"]
+        if fb:
+            _m_bptt_fb.inc(fb)
+        if tr:
+            _m_bptt_tr.inc(tr)
+    return state
+
+
+def _run_train_loop(
+    state, train_step, batches, *, num_steps, log_every, logger, eval_fn,
+    eval_every, checkpoint_fn, checkpoint_every, tokens_per_batch,
+    steps_per_call, fused_eval, flops_per_token, peak_tflops, best_fn,
+    best_metric, best_mode, best_init, anomaly_limit, window_start,
+    _m_step, _m_tps, _m_steps, _m_anomalous,
+):
+    """The drive loop proper (split from `train_loop` so the bptt trace
+    accounting above wraps every exit path in one place)."""
+    last_metrics = None
+    anomalous_total = 0
+    anomalous_consec = 0
+    best_val = best_init
     for i, batch in enumerate(batches):
         if num_steps is not None and i >= num_steps:
             break

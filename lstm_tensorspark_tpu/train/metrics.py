@@ -41,12 +41,17 @@ class MetricsLogger:
             )
             print(parts, file=self.stream, flush=True)
 
-    def log_registry(self, registry, note: str = "metrics_snapshot") -> None:
+    def log_registry(self, registry, note: str = "metrics_snapshot",
+                     extra: dict | None = None) -> None:
         """One flat record of the registry's current state (histograms as
-        ``name_count``/``name_sum``/``name_p50``/``name_p99`` keys)."""
+        ``name_count``/``name_sum``/``name_p50``/``name_p99`` keys).
+        ``extra`` merges run-level context the registry cannot carry —
+        e.g. the requested ``bptt_mode`` string next to the numeric
+        assoc-trace/fallback counters, so supervised restarts can diff
+        the record across resume legs."""
         snap = registry.snapshot()
-        if snap:
-            self.log({"note": note, **snap})
+        if snap or extra:
+            self.log({"note": note, **snap, **(extra or {})})
 
     def close(self) -> None:
         if self._fh:

@@ -1,0 +1,27 @@
+"""graftlint fixture: clean twin of viol_warmup_train — warmup()
+dispatches every ``("train_step", bucket, bptt_mode)`` program in the
+lattice, so no training-step executable compiles inside a timed
+sample."""
+
+
+class MiniStepCache:
+    def __init__(self):
+        self.compile_counts = {}
+        self._fns = {}
+
+    def step_fn(self, bucket, bptt_mode):
+        count_key = ("train_step", bucket, bptt_mode)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda s, b: (s, b))
+
+    def run(self, state, batch, bucket, bptt_mode):
+        return self.step_fn(bucket, bptt_mode)(state, batch)
+
+    def warmup(self, state, batch, buckets=((1, 8),),
+               modes=("sequential", "assoc")):
+        out = None
+        for bucket in buckets:
+            for mode in modes:
+                out = self.step_fn(bucket, mode)(state, batch)
+        return out

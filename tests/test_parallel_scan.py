@@ -1,0 +1,344 @@
+"""Parallel-scan BPTT (ops/parallel_scan.py): gradient parity of
+``bptt="assoc"`` against the sequential VJP across the acceptance matrix
+({1,2}-layer x {masked, unmasked} x {remat on/off} x bidir), the
+fp64-validated tolerance case, the auto-resolution policy + `plan_bytes`
+memory model, the remat-divisibility contract shared by both modes, and
+the trace-time counters surfaced in metrics_snapshot records.
+
+Tolerance rationale (see test_fp64_validates_f32_tolerances): both the
+sequential VJP and the assoc backward are f32 computations that differ
+from the f64 ground truth by < ~2e-5 relative on these shapes; the
+parity tolerances below (5e-4 rel / 5e-5 abs for f32) sit an order of
+magnitude above that envelope, so a real algebra bug cannot hide inside
+accumulated rounding."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lstm_tensorspark_tpu.ops import (
+    bidir_lstm_scan,
+    init_lstm_params,
+    lstm_scan,
+    lstm_step_unfused,
+    stacked_lstm_scan,
+)
+from lstm_tensorspark_tpu.ops import parallel_scan
+
+
+F32_TOL = dict(rtol=5e-4, atol=5e-5)
+BF16_TOL = dict(rtol=3e-2, atol=3e-3)
+
+
+def _mk_mask(rng, B, T):
+    lens = rng.randint(1, T + 1, size=B)
+    return jnp.asarray((np.arange(T)[None, :] < lens[:, None]), jnp.float32)
+
+
+def _stacked_loss(layer_params, xs, mask, *, bptt, remat_chunk=None,
+                  compute_dtype=None):
+    def loss(params_and_xs):
+        lp, x = params_and_xs
+        finals, ys = stacked_lstm_scan(
+            lp, x, mask=mask, bptt=bptt, remat_chunk=remat_chunk,
+            compute_dtype=compute_dtype,
+        )
+        out = jnp.sum(ys ** 2)
+        for (h, c) in finals:
+            out = out + jnp.sum(h * 0.5) + jnp.sum(c * 0.25)
+        return out
+    return loss
+
+
+@pytest.mark.parametrize("layers", [1, 2])
+@pytest.mark.parametrize("masked", [False, True])
+@pytest.mark.parametrize("remat", [None, 4])
+def test_grad_parity_stacked(layers, masked, remat):
+    """The acceptance matrix: {1,2}-layer x {masked, unmasked} x
+    {remat on/off} — assoc grads allclose to the sequential VJP."""
+    rng = np.random.RandomState(layers * 10 + int(masked) * 3 + (remat or 0))
+    B, T, D, H = 3, 16, 5, 6
+    keys = jax.random.split(jax.random.PRNGKey(7), layers)
+    lp = [init_lstm_params(keys[0], D, H)]
+    for k in keys[1:]:
+        lp.append(init_lstm_params(k, H, H))
+    xs = jnp.asarray(rng.randn(B, T, D), jnp.float32)
+    mask = _mk_mask(rng, B, T) if masked else None
+
+    g_seq = jax.grad(_stacked_loss(lp, xs, mask, bptt="sequential",
+                                   remat_chunk=remat))((lp, xs))
+    g_asc = jax.grad(_stacked_loss(lp, xs, mask, bptt="assoc",
+                                   remat_chunk=remat))((lp, xs))
+    for a, b in zip(jax.tree.leaves(g_asc), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), **F32_TOL)
+
+
+@pytest.mark.parametrize("masked", [False, True])
+def test_grad_parity_bidir(masked):
+    """bidir_lstm_scan: both directions' grads agree across modes (the
+    reversed scan exercises the flip plumbing in assoc_lstm_scan)."""
+    rng = np.random.RandomState(17 + int(masked))
+    B, T, D, H = 2, 12, 4, 5
+    pf = init_lstm_params(jax.random.PRNGKey(0), D, H)
+    pb = init_lstm_params(jax.random.PRNGKey(1), D, H)
+    xs = jnp.asarray(rng.randn(B, T, D), jnp.float32)
+    mask = _mk_mask(rng, B, T) if masked else None
+
+    def loss(bptt):
+        def L(args):
+            f, b, x = args
+            ((hf, cf), ysf), ((hb, cb), ysb) = bidir_lstm_scan(
+                f, b, x, mask=mask, bptt=bptt)
+            return (jnp.sum(ysf ** 2) + jnp.sum(ysb ** 2)
+                    + jnp.sum(hf) + jnp.sum(hb)
+                    + 0.5 * (jnp.sum(cf) + jnp.sum(cb)))
+        return L
+
+    g_seq = jax.grad(loss("sequential"))((pf, pb, xs))
+    g_asc = jax.grad(loss("assoc"))((pf, pb, xs))
+    for a, b in zip(jax.tree.leaves(g_asc), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), **F32_TOL)
+
+
+def test_bf16_params_fp32_grads_parity():
+    """compute_dtype=bfloat16 (bf16 matmuls, f32 accumulation/grads):
+    the two backwards agree within the bf16 rounding envelope."""
+    rng = np.random.RandomState(23)
+    B, T, D, H = 2, 16, 4, 8
+    lp = [init_lstm_params(jax.random.PRNGKey(2), D, H)]
+    xs = jnp.asarray(rng.randn(B, T, D), jnp.float32)
+    mask = _mk_mask(rng, B, T)
+    g_seq = jax.grad(_stacked_loss(lp, xs, mask, bptt="sequential",
+                                   compute_dtype=jnp.bfloat16))((lp, xs))
+    g_asc = jax.grad(_stacked_loss(lp, xs, mask, bptt="assoc",
+                                   compute_dtype=jnp.bfloat16))((lp, xs))
+    for a, b in zip(jax.tree.leaves(g_asc), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), **BF16_TOL)
+        assert a.dtype == b.dtype  # grads stay in the param/input dtype
+
+
+def test_forward_values_identical():
+    """The assoc path only swaps the VJP: forward ys and final carries
+    match the sequential scan to f32 round-off."""
+    rng = np.random.RandomState(5)
+    B, T, D, H = 3, 24, 4, 6
+    p = init_lstm_params(jax.random.PRNGKey(5), D, H)
+    xs = jnp.asarray(rng.randn(B, T, D), jnp.float32)
+    mask = _mk_mask(rng, B, T)
+    for kw in (dict(), dict(mask=mask), dict(mask=mask, reverse=True)):
+        (h1, c1), ys1 = lstm_scan(p, xs, bptt="sequential", **kw)
+        (h2, c2), ys2 = lstm_scan(p, xs, bptt="assoc", **kw)
+        np.testing.assert_allclose(np.asarray(ys1), np.asarray(ys2),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h2),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_fp64_validates_f32_tolerances():
+    """Ground the parity tolerances in fp64: a step-at-a-time f64 oracle
+    (lstm_step_unfused is dtype-generic) gives the true gradient; BOTH
+    f32 backwards must sit within the envelope the parity tests assume.
+    This is what makes the F32_TOL above a validated bound rather than a
+    number that happens to pass."""
+    rng = np.random.RandomState(31)
+    B, T, D, H = 2, 16, 4, 6
+    p32 = init_lstm_params(jax.random.PRNGKey(3), D, H)
+    xs32 = jnp.asarray(rng.randn(B, T, D), jnp.float32)
+
+    jax.config.update("jax_enable_x64", True)
+    try:
+        p64 = jax.tree.map(lambda a: jnp.asarray(np.asarray(a), jnp.float64),
+                           p32)
+        xs64 = jnp.asarray(np.asarray(xs32), jnp.float64)
+
+        def oracle_loss(args):
+            p, x = args
+            h = jnp.zeros((B, H), x.dtype)
+            c = jnp.zeros((B, H), x.dtype)
+            out = jnp.zeros((), x.dtype)
+            for t in range(T):
+                (h, c), _ = lstm_step_unfused(p, (h, c), x[:, t])
+                out = out + jnp.sum(h ** 2)
+            return out + jnp.sum(h * 0.5) + jnp.sum(c * 0.25)
+
+        g64 = jax.jit(jax.grad(oracle_loss))((p64, xs64))
+        g64 = [np.asarray(a, np.float64) for a in jax.tree.leaves(g64)]
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+    def f32_loss(bptt):
+        def L(args):
+            p, x = args
+            (h, c), ys = lstm_scan(p, x, bptt=bptt)
+            return jnp.sum(ys ** 2) + jnp.sum(h * 0.5) + jnp.sum(c * 0.25)
+        return L
+
+    g_seq = jax.tree.leaves(jax.grad(f32_loss("sequential"))((p32, xs32)))
+    g_asc = jax.tree.leaves(jax.grad(f32_loss("assoc"))((p32, xs32)))
+    for ga, gs, gt in zip(g_asc, g_seq, g64):
+        # both f32 paths inside the envelope the parity tolerance assumes
+        np.testing.assert_allclose(np.asarray(gs, np.float64), gt,
+                                   rtol=5e-5, atol=5e-6)
+        np.testing.assert_allclose(np.asarray(ga, np.float64), gt,
+                                   rtol=5e-5, atol=5e-6)
+
+
+# ---- policy / plan / counters ----
+
+
+def test_resolve_bptt_policy(monkeypatch):
+    st0 = parallel_scan.assoc_stats()
+    # explicit modes honored as written
+    assert parallel_scan.resolve_bptt("sequential", 8, 400, 64) == "sequential"
+    assert parallel_scan.resolve_bptt("assoc", 8, 8, 64) == "assoc"
+    # auto below the T threshold -> sequential, counted
+    assert parallel_scan.resolve_bptt("auto", 8, 32, 64) == "sequential"
+    # auto long enough + plan fits -> assoc
+    assert parallel_scan.resolve_bptt("auto", 8, 400, 64) == "assoc"
+    # plan miss (budget forced to 0) -> sequential, counted
+    monkeypatch.setenv("LSTM_TSP_ASSOC_BUDGET_MB", "0")
+    assert parallel_scan.resolve_bptt("auto", 8, 400, 64) == "sequential"
+    st1 = parallel_scan.assoc_stats()
+    assert st1["sequential_fallbacks"] - st0["sequential_fallbacks"] == 2
+    with pytest.raises(ValueError, match="bptt="):
+        parallel_scan.resolve_bptt("parallel", 8, 400, 64)
+
+
+def test_plan_bytes_model():
+    # monotone in every dimension
+    base = parallel_scan.plan_bytes(8, 400, 64)
+    assert parallel_scan.plan_bytes(16, 400, 64) > base
+    assert parallel_scan.plan_bytes(8, 800, 64) > base
+    assert parallel_scan.plan_bytes(8, 400, 128) > base
+    # the dense chunk-operator term dominates at large H (the reason the
+    # plan gates assoc at all): quadratic-in-H growth
+    assert (parallel_scan.plan_bytes(8, 400, 256)
+            > 8 * parallel_scan.plan_bytes(8, 400, 64))
+    # imdb_bilstm's H=256 x B=64 shape must MISS the default budget (auto
+    # stays sequential there until a TPU-sized budget is configured)
+    assert not parallel_scan.plan_fits(64, 400, 256)
+    assert parallel_scan.plan_fits(8, 400, 64)
+
+
+def test_pick_tile():
+    assert parallel_scan.pick_tile(400) == 16
+    assert parallel_scan.pick_tile(400, remat_chunk=25) == 25  # fwd chunking wins
+    assert parallel_scan.pick_tile(400, remat_chunk=7) == 16   # non-divisor ignored
+    assert parallel_scan.pick_tile(7) == 7                     # prime -> one chunk
+    assert parallel_scan.pick_tile(1) == 1
+
+
+def test_remat_divisibility_raises_in_both_modes():
+    """The satellite contract: T not divisible by remat_chunk fails
+    loudly in EVERY bptt mode — a silent tail chunk could give the modes
+    different step groupings for identical inputs."""
+    p = init_lstm_params(jax.random.PRNGKey(0), 3, 4)
+    xs = jnp.zeros((2, 10, 3), jnp.float32)
+    for mode in ("sequential", "assoc"):
+        with pytest.raises(ValueError, match="not divisible by remat_chunk"):
+            lstm_scan(p, xs, remat_chunk=4, bptt=mode)
+
+
+def test_assoc_trace_counter_and_metrics_snapshot(tmp_path):
+    """The trace-time counters reach the metrics_snapshot JSONL record
+    (the supervised-restart mode-flip signal): train a hand-driven step
+    with bptt='assoc', then log a registry snapshot with the cli-style
+    extra dict and check the record round-trips."""
+    from lstm_tensorspark_tpu import obs
+    from lstm_tensorspark_tpu.train.loop import (
+        init_train_state, make_train_step, train_loop)
+    from lstm_tensorspark_tpu.train.metrics import MetricsLogger
+    import optax
+
+    rng = np.random.RandomState(0)
+    p = [init_lstm_params(jax.random.PRNGKey(0), 4, 4)]
+    xs = jnp.asarray(rng.randn(2, 16, 4), jnp.float32)
+
+    def loss_fn(params, batch, rng_):
+        _, ys = stacked_lstm_scan(params, batch, bptt="assoc")
+        return jnp.sum(ys ** 2), {"loss": jnp.sum(ys ** 2)}
+
+    opt = optax.sgd(1e-2)
+    state = init_train_state(p, opt, jax.random.PRNGKey(1))
+    step = make_train_step(loss_fn, opt)
+    tr_counter = obs.REGISTRY.counter(
+        "train_bptt_assoc_traces_total",
+        "scans traced with the associative-scan backward")
+    before = tr_counter.value
+    train_loop(state, step, iter([xs]), num_steps=1, log_every=0)
+    assert tr_counter.value >= before + 1
+
+    path = tmp_path / "m.jsonl"
+    with MetricsLogger(str(path), quiet=True) as logger:
+        logger.log_registry(
+            obs.REGISTRY,
+            extra={"bptt_mode": "assoc",
+                   **{f"bptt_{k}": v
+                      for k, v in parallel_scan.assoc_stats().items()}})
+    rec = json.loads(path.read_text().splitlines()[-1])
+    assert rec["bptt_mode"] == "assoc"
+    assert rec["bptt_assoc_traces"] >= 1
+    assert "train_bptt_assoc_traces_total" in rec
+
+
+def test_train_step_compile_cache_warm_lattice():
+    """TrainStepCompileCache (train/device_step.py): warmup traces each
+    (bucket, bptt_mode) program exactly once, replays hit the cached
+    executable (no re-trace), and the compile-key family is the
+    graftlint-gated ``("train_step", bucket, bptt_mode)`` shape."""
+    import optax
+    from lstm_tensorspark_tpu.train import TrainStepCompileCache
+    from lstm_tensorspark_tpu.train.loop import (
+        init_train_state, make_train_step)
+
+    opt = optax.sgd(0.1)
+    p = [init_lstm_params(jax.random.PRNGKey(0), 4, 4)]
+
+    def builder(bucket, bptt_mode):
+        def loss_fn(params, batch, rng_):
+            _, ys = stacked_lstm_scan(params, batch, bptt=bptt_mode)
+            return jnp.sum(ys ** 2), {"loss": jnp.sum(ys ** 2)}
+        return make_train_step(loss_fn, opt, jit=False)
+
+    cache = TrainStepCompileCache(builder)
+    batch = jnp.zeros((2, 8, 4), jnp.float32)
+    bucket = (2, 8, 4)
+    state = init_train_state(p, opt, jax.random.PRNGKey(1))
+    cache.warmup([(bucket, m, state, batch)
+                  for m in ("sequential", "assoc")])
+    assert cache.compile_counts == {
+        ("train_step", bucket, "sequential"): 1,
+        ("train_step", bucket, "assoc"): 1,
+    }
+    # replay: cached executable, count unchanged
+    cache.step_fn(bucket, "assoc")(state, batch)
+    assert cache.compile_counts[("train_step", bucket, "assoc")] == 1
+
+
+def test_carry_and_stateful_parity():
+    """Nonzero initial carries (stateful TBPTT windows) flow correct
+    gradients through the assoc backward, including the carry grad."""
+    rng = np.random.RandomState(11)
+    B, T, D, H = 2, 16, 4, 6
+    p = init_lstm_params(jax.random.PRNGKey(4), D, H)
+    xs = jnp.asarray(rng.randn(B, T, D), jnp.float32)
+    c0 = (jnp.asarray(rng.randn(B, H), jnp.float32),
+          jnp.asarray(rng.randn(B, H), jnp.float32))
+    mask = _mk_mask(rng, B, T)
+
+    def loss(bptt):
+        def L(args):
+            pp, x, cc = args
+            (h, c), ys = lstm_scan(pp, x, cc, mask=mask, bptt=bptt)
+            return jnp.sum(ys ** 2) + jnp.sum(h) + jnp.sum(c * 0.5)
+        return L
+
+    g_seq = jax.grad(loss("sequential"))((p, xs, c0))
+    g_asc = jax.grad(loss("assoc"))((p, xs, c0))
+    for a, b in zip(jax.tree.leaves(g_asc), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), **F32_TOL)

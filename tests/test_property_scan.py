@@ -15,6 +15,7 @@ from lstm_tensorspark_tpu.ops import (
     init_lstm_params,
     lstm_scan,
     lstm_step_unfused,
+    stacked_lstm_scan,
 )
 
 
@@ -128,3 +129,54 @@ def test_pallas_interpret_matches_plain_random_config(case):
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(c1), np.asarray(c2),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("case", CASES[:8])
+def test_assoc_bptt_matches_sequential_random_config(case):
+    """bptt="assoc" (ops/parallel_scan.py) vs the sequential VJP on
+    jointly-drawn random (T, H, layers, mask pattern, dtype) configs —
+    value AND gradient, fp32 and bf16-params/fp32-grads. The joint draw
+    covers interaction surfaces (mask x layers x tile split x dtype)
+    the targeted matrix in tests/test_parallel_scan.py fixes one at a
+    time; tolerances are the fp64-validated ones from that file."""
+    rng = np.random.RandomState(3000 + case)
+    B = int(rng.choice([1, 2, 4]))
+    T = int(rng.choice([2, 6, 9, 16, 24, 32]))
+    D = int(rng.choice([3, 8]))
+    H = int(rng.choice([4, 8, 16]))
+    layers = int(rng.choice([1, 2]))
+    use_mask = bool(rng.rand() < 0.5)
+    bf16 = bool(rng.rand() < 0.3)
+    cdtype = jnp.bfloat16 if bf16 else None
+
+    keys = jax.random.split(jax.random.PRNGKey(case), layers)
+    lp = [init_lstm_params(keys[0], D, H)]
+    for k in keys[1:]:
+        lp.append(init_lstm_params(k, H, H))
+    xs = jnp.asarray(rng.randn(B, T, D), jnp.float32)
+    mask = None
+    if use_mask:
+        lens = rng.randint(1, T + 1, size=B)
+        mask = jnp.asarray(
+            (np.arange(T)[None, :] < lens[:, None]), jnp.float32
+        )
+
+    def loss(bptt):
+        def L(args):
+            params, x = args
+            finals, ys = stacked_lstm_scan(
+                params, x, mask=mask, bptt=bptt, compute_dtype=cdtype)
+            out = jnp.sum(ys ** 2)
+            for (h, c) in finals:
+                out = out + jnp.sum(h) + 0.5 * jnp.sum(c)
+            return out
+        return L
+
+    v_seq, g_seq = jax.value_and_grad(loss("sequential"))((lp, xs))
+    v_asc, g_asc = jax.value_and_grad(loss("assoc"))((lp, xs))
+    np.testing.assert_allclose(np.asarray(v_asc), np.asarray(v_seq),
+                               rtol=1e-5, atol=1e-5)
+    tol = (dict(rtol=3e-2, atol=3e-3) if bf16
+           else dict(rtol=5e-4, atol=5e-5))
+    for a, b in zip(jax.tree.leaves(g_asc), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), **tol)

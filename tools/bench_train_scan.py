@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Training-perf trajectory, first datapoint: parallel-scan BPTT vs the
+sequential chain on the T=400 bucket (ISSUE-13 acceptance; writes
+BENCH_train_scan_r01.json).
+
+One command, CPU-runnable, same discipline as tools/bench_serve.py:
+
+- **paired runs** — each of ``--pairs`` trials times BOTH bptt modes
+  back to back on the same jitted steps and data, so slow machine drift
+  cancels inside a pair; the reported ratio is the median of the
+  per-pair ratios;
+- **warmup before any timing** — both (bucket, bptt_mode) programs go
+  through `TrainStepCompileCache.warmup` (train/device_step.py), so no
+  timed sample ever pays an XLA compile (the compile-key lattice is
+  asserted warm afterwards);
+- **grad-parity checksum** — one batch's gradients computed under both
+  modes must be allclose at the fp64-validated tolerances from
+  tests/test_parallel_scan.py; the report carries max-abs-diff and a
+  grad-sum checksum so two bench runs can be diffed for numerical drift,
+  and parity failure fails the tool (exit 1);
+- **peak-memory estimate from the plan model** — `parallel_scan.
+  plan_bytes` for the assoc working set (the number `bptt="auto"` gates
+  on), next to the measured numbers.
+
+The CPU ratio is an HONEST datapoint, not the gate: the assoc backward
+trades O(H) extra dense-compose FLOPs for O(T/log T) less dependency
+depth, which pays on a latency-bound accelerator chain and usually does
+NOT on a throughput-bound CPU. The >= 1.0x gate lives in
+tests_tpu/test_parallel_scan_tpu.py (real hardware).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_train_scan.py \
+        [--out BENCH_train_scan_r01.json] [--bptt-mode assoc,sequential]
+
+Run it with nothing else executing (same discipline as the tier-1
+suite: CPU contention corrupts latency percentiles).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from lstm_tensorspark_tpu.models import LMConfig, init_lm  # noqa: E402
+from lstm_tensorspark_tpu.models.lstm_lm import lm_loss  # noqa: E402
+from lstm_tensorspark_tpu.ops import parallel_scan  # noqa: E402
+from lstm_tensorspark_tpu.train import TrainStepCompileCache  # noqa: E402
+from lstm_tensorspark_tpu.train.loop import (  # noqa: E402
+    init_train_state,
+    make_train_step,
+)
+
+# the T=400 IMDB bucket (ROADMAP open item 2(b)); H/B sized so the assoc
+# plan fits the default budget AND a CPU pair finishes in seconds — the
+# TPU gate (tests_tpu/) runs the H=128 shape
+DEFAULTS = dict(vocab=89, hidden=64, layers=1, batch=16, seq=400)
+STEPS_PER_RUN = 3
+# grad-parity tolerances: fp64-validated in tests/test_parallel_scan.py
+PARITY_TOL = dict(rtol=5e-4, atol=5e-5)
+
+
+def _build_cache(dims):
+    def builder(bucket, bptt_mode):
+        _B, T, _H = bucket
+        cfg = LMConfig(vocab_size=dims["vocab"], hidden_size=dims["hidden"],
+                       num_layers=dims["layers"], bptt=bptt_mode)
+
+        def loss_fn(params, batch, rng):
+            return lm_loss(params, batch, cfg)
+
+        return make_train_step(loss_fn, _OPT, jit=False)
+
+    return TrainStepCompileCache(builder)
+
+
+_OPT = optax.sgd(0.1)
+
+
+def _batch(rng, dims):
+    toks = rng.randint(0, dims["vocab"],
+                       size=(dims["batch"], dims["seq"] + 1)).astype(np.int32)
+    return {"inputs": jnp.asarray(toks[:, :-1]),
+            "targets": jnp.asarray(toks[:, 1:])}
+
+
+def _grad_parity(dims, batch):
+    """One batch's grads under both modes: allclose + checksums."""
+    out = {}
+    for mode in ("sequential", "assoc"):
+        cfg = LMConfig(vocab_size=dims["vocab"], hidden_size=dims["hidden"],
+                       num_layers=dims["layers"], bptt=mode)
+        grads = jax.grad(
+            lambda p: lm_loss(p, batch, cfg)[0])(
+                init_lm(jax.random.PRNGKey(0), cfg))
+        out[mode] = [np.asarray(g, np.float64) for g in jax.tree.leaves(grads)]
+    max_abs = max(
+        float(np.max(np.abs(a - b)))
+        for a, b in zip(out["assoc"], out["sequential"]))
+    ok = all(
+        np.allclose(a, b, **PARITY_TOL)
+        for a, b in zip(out["assoc"], out["sequential"]))
+    checksum = float(sum(np.sum(np.abs(g)) for g in out["assoc"]))
+    return {"parity_ok": bool(ok), "max_abs_diff": max_abs,
+            "grad_abs_checksum": round(checksum, 6),
+            "tolerances": PARITY_TOL}
+
+
+def run_bench(dims, modes, pairs, out_path):
+    rng = np.random.RandomState(0)
+    bucket = (dims["batch"], dims["seq"], dims["hidden"])
+    cache = _build_cache(dims)
+    cfg0 = LMConfig(vocab_size=dims["vocab"], hidden_size=dims["hidden"],
+                    num_layers=dims["layers"])
+    batch = _batch(rng, dims)
+    states = {m: init_train_state(init_lm(jax.random.PRNGKey(1), cfg0), _OPT,
+                                  jax.random.PRNGKey(2)) for m in modes}
+    print(f"warmup: {len(modes)} train-step programs at bucket {bucket}",
+          file=sys.stderr)
+    cache.warmup([(bucket, m, states[m], batch) for m in modes])
+    for m in modes:
+        assert cache.compile_counts.get(("train_step", bucket, m)) == 1, (
+            "warmup must have traced each program exactly once",
+            cache.compile_counts)
+
+    tokens = dims["batch"] * dims["seq"] * STEPS_PER_RUN
+    per_mode = {m: {"tokens_per_sec": [], "step_seconds": []} for m in modes}
+    pair_ratios = []
+    for p in range(pairs):
+        pair_tps = {}
+        for m in modes:
+            step = cache.step_fn(bucket, m)
+            state = states[m]
+            t0 = time.perf_counter()
+            for _ in range(STEPS_PER_RUN):
+                state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            dt = time.perf_counter() - t0
+            per_mode[m]["tokens_per_sec"].append(tokens / dt)
+            per_mode[m]["step_seconds"].append(dt / STEPS_PER_RUN)
+            pair_tps[m] = tokens / dt
+        if "assoc" in pair_tps and "sequential" in pair_tps:
+            pair_ratios.append(pair_tps["assoc"] / pair_tps["sequential"])
+        print(f"pair {p}: " + " ".join(
+            f"{m}={pair_tps[m]:,.0f} tok/s" for m in modes), file=sys.stderr)
+
+    # no mid-timing compiles: the counts warmup asserted must be unchanged
+    for m in modes:
+        assert cache.compile_counts.get(("train_step", bucket, m)) == 1, (
+            "a program re-traced mid-timing", cache.compile_counts)
+
+    parity = _grad_parity(dims, batch)
+    tile = parallel_scan.pick_tile(dims["seq"])
+    report = {
+        "bench": "train_scan",
+        "revision": "r01",
+        "backend": jax.default_backend(),
+        "config": {**dims, "steps_per_run": STEPS_PER_RUN, "pairs": pairs,
+                   "compute_dtype": "float32"},
+        "modes": {
+            m: {
+                "tokens_per_sec_median": statistics.median(
+                    per_mode[m]["tokens_per_sec"]),
+                "step_seconds_p50": statistics.median(
+                    per_mode[m]["step_seconds"]),
+            } for m in modes
+        },
+        "ratio_assoc_vs_sequential": (
+            statistics.median(pair_ratios) if pair_ratios else None),
+        "pair_ratios": pair_ratios,
+        "plan": {
+            "tile": tile,
+            "n_chunks": dims["seq"] // tile,
+            "assoc_plan_bytes": parallel_scan.plan_bytes(
+                dims["batch"], dims["seq"], dims["hidden"]),
+            "budget_bytes": parallel_scan._budget_bytes(),
+            "fits": parallel_scan.plan_fits(
+                dims["batch"], dims["seq"], dims["hidden"]),
+        },
+        "grad_parity": parity,
+        "gate": {
+            # the speed claim is the TPU gate's
+            # (tests_tpu/test_parallel_scan_tpu.py >= 1.0x); the CPU
+            # ratio is the honest trajectory datapoint — the assoc
+            # backward spends O(H) extra FLOPs to cut dependency depth,
+            # which a throughput-bound CPU does not reward
+            "tpu_gate": "tests_tpu/test_parallel_scan_tpu.py (>= 1.0x)",
+            "cpu_ratio_is_honest_datapoint": True,
+            "parity_required": True,
+        },
+    }
+    with open(out_path, "w") as fh:
+        json.dump(report, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    ratio = report["ratio_assoc_vs_sequential"]
+    ratio_s = "n/a (single mode)" if ratio is None else f"{ratio:.3f}x"
+    print(f"wrote {out_path}: ratio assoc/sequential = "
+          f"{ratio_s}, parity_ok={parity['parity_ok']} "
+          f"(max_abs_diff={parity['max_abs_diff']:.2e})", file=sys.stderr)
+    return 0 if parity["parity_ok"] else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=os.path.join(
+        _REPO, "BENCH_train_scan_r01.json"))
+    ap.add_argument("--bptt-mode", default="assoc,sequential",
+                    help="comma list of modes to pair (default both)")
+    ap.add_argument("--pairs", type=int, default=5)
+    ap.add_argument("--hidden", type=int, default=DEFAULTS["hidden"])
+    ap.add_argument("--batch", type=int, default=DEFAULTS["batch"])
+    ap.add_argument("--seq", type=int, default=DEFAULTS["seq"])
+    args = ap.parse_args(argv)
+    modes = [m.strip() for m in args.bptt_mode.split(",") if m.strip()]
+    for m in modes:
+        if m not in ("assoc", "sequential"):
+            ap.error(f"--bptt-mode entries must be assoc|sequential, got {m}")
+    dims = dict(DEFAULTS, hidden=args.hidden, batch=args.batch, seq=args.seq)
+    return run_bench(dims, modes, args.pairs, args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
