@@ -1311,6 +1311,32 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "traffic or probes. 0 = piggyback-only (the "
                         "previous behavior: sweeps run on every submit "
                         "and health probe)")
+    p.add_argument("--mesh-shards", type=int, default=1,
+                   help="tensor-parallel SHARDS per replica: each "
+                        "replica's engine shards its params and "
+                        "state-cache slots over a mesh_shards-device "
+                        "('model',) mesh (GSPMD — parallel/"
+                        "tensor_parallel.py specs), so a model one chip "
+                        "cannot hold serves behind the router as one "
+                        "replica. Replicas get disjoint device groups "
+                        "when the host has replicas*shards devices, and "
+                        "share one group otherwise. Token-identical to "
+                        "a single-device engine (greedy AND sampled). "
+                        "On CPU use XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N for "
+                        "virtual devices. 1 = off")
+    p.add_argument("--remote-replica", action="append", default=[],
+                   metavar="URL",
+                   help="add a REMOTE replica behind the router: the "
+                        "base URL of a peer `cli serve --http` process "
+                        "(repeatable). Generate RPCs ride its "
+                        "/v1/generate, liveness its /replica/heartbeat, "
+                        "session affinity its /replica/has_session — so "
+                        "admission becomes a front-of-fleet tier. Share "
+                        "one --session-dir across hosts and a killed "
+                        "host loses no kept session (continuations fill "
+                        "from the shared disk tier on survivors; "
+                        "docs/OPERATIONS.md 'Mesh serving')")
     p.add_argument("--decode-window", type=str, default="auto",
                    help="multi-token decode window: 'auto' (adaptive "
                         "ladder 1/4/8 — large windows in steady-state "
@@ -1591,6 +1617,25 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
                     if getattr(args, "telemetry", "on") == "off"
                     else REGISTRY)
     devices = jax.devices()
+    shards = int(getattr(args, "mesh_shards", 1) or 1)
+    if shards < 1:
+        raise SystemExit(f"--mesh-shards must be >= 1, got {shards}")
+    if shards > 1 and len(devices) < shards:
+        raise SystemExit(
+            f"--mesh-shards {shards} needs {shards} devices, host has "
+            f"{len(devices)} (on CPU set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=N)")
+
+    def _mesh_devices(i: int):
+        """Replica i's device group: disjoint groups when the host has
+        replicas*shards devices (mesh-per-replica), the shared leading
+        group otherwise (thread-per-replica over one mesh — the CPU
+        virtual-device analog of thread-per-replica on one chip)."""
+        if shards == 1:
+            return None
+        if len(devices) >= n_replicas * shards:
+            return devices[i * shards:(i + 1) * shards]
+        return devices[:shards]
     engines = [
         ServeEngine(
             params, cfg,
@@ -1618,8 +1663,12 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
             # telemetry (engine, caches, batcher, router, /metrics);
             # off = no-op instruments
             registry=registry,
-            # device-per-replica when the host has more than one
-            device=devices[i % len(devices)] if len(devices) > 1 else None,
+            # mesh-per-replica (--mesh-shards > 1) or device-per-replica
+            # when the host has more than one device
+            mesh_shards=shards,
+            mesh_devices=_mesh_devices(i),
+            device=(devices[i % len(devices)]
+                    if shards == 1 and len(devices) > 1 else None),
         )
         for i in range(n_replicas)
     ]
@@ -1648,7 +1697,9 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
                              "priority": args.deadline_priority_s or None,
                              "best_effort":
                                  args.deadline_best_effort_s or None,
-                         })
+                         },
+                         remote_replicas=tuple(
+                             getattr(args, "remote_replica", []) or ()))
     return params, cfg, server
 
 

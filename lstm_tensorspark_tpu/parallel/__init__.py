@@ -3,6 +3,7 @@ from .mesh import (
     local_device_count,
     make_hybrid_mesh,
     make_mesh,
+    make_serve_mesh,
     slice_groups,
 )
 from .zero import (
@@ -34,6 +35,7 @@ __all__ = [
     "unstack_lm_params",
     "make_hybrid_mesh",
     "make_mesh",
+    "make_serve_mesh",
     "make_zero1_opt_init",
     "make_zero1_train_step",
     "zero1_tp_opt_specs",

@@ -121,6 +121,26 @@ def make_hybrid_mesh(
     return make_mesh(dp, tp, sp, pp, devices=ordered)
 
 
+def make_serve_mesh(shards: int, *, devices=None) -> Mesh:
+    """One-axis ``("model",)`` mesh for a tensor-parallel SERVE engine
+    (serve/engine.py ``mesh_shards``): the hidden/gate dimension of one
+    replica's params and state-cache slots shards over these devices,
+    with XLA deriving the per-step collectives from the same
+    `tensor_parallel.lm_param_specs` annotations training uses. Distinct
+    from :func:`make_mesh` on purpose — a serve replica owns a small,
+    explicit device group (disjoint groups per replica behind the
+    router), not the whole host's device set."""
+    if shards < 1:
+        raise ValueError(f"mesh shards must be >= 1, got {shards}")
+    devices = list(devices) if devices is not None else jax.devices()
+    if len(devices) < shards:
+        raise ValueError(
+            f"mesh of {shards} shards needs {shards} devices, have "
+            f"{len(devices)} (on CPU, set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=N for virtual ones)")
+    return Mesh(np.asarray(devices[:shards]), ("model",))
+
+
 def distributed_init(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

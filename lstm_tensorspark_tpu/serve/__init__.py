@@ -32,11 +32,20 @@ into a serving engine:
   iteration — a long prompt cannot stall running sessions' decode);
 - ``router``: the data-parallel admission front (``--replicas N``) —
   N engine+batcher replicas (thread-per-replica on CPU, device-per-
-  replica on TPU), session→replica affinity so recurrent-state slots
+  replica on TPU, mesh-per-replica with ``--mesh-shards`` — a
+  tensor-parallel engine whose params/state shard H across a device
+  group), session→replica affinity so recurrent-state slots
   and prefix entries stay replica-local, one global bounded admission
   queue (429), and honest replica-death handling (queued work requeued,
   in-flight failed loudly, idle kept sessions migrated via
   detach/restore);
+- ``remote``: the remote-replica RPC transport (``--remote-replica
+  URL``) — a peer serve PROCESS satisfying the same router-facing
+  surface over the stdlib HTTP endpoint (generate RPCs on
+  ``/v1/generate``, liveness on ``/replica/heartbeat``, affinity on
+  ``/replica/has_session``), so the admission router becomes a
+  front-of-fleet tier and replica death generalises to host death
+  (kept sessions fail over through the shared ``--session-dir`` tier);
 - ``server``: stdlib ThreadingHTTPServer JSON endpoint + in-process
   client over the replica set, with ``GET /metrics`` Prometheus
   exposition of the stack's telemetry registry (obs/, ``replica``-
@@ -67,8 +76,9 @@ from .batcher import (
     Request,
 )
 from .router import Replica, Router
+from .remote import RemoteBatcher, RemoteReplica
 from .server import InprocessClient, ServeServer
-from .loadgen import replica_sweep, run_loadgen, run_longtail
+from .loadgen import mesh_sweep, replica_sweep, run_loadgen, run_longtail
 
 __all__ = [
     "Batcher",
@@ -80,6 +90,8 @@ __all__ = [
     "PAD_TOKEN",
     "PrefixCache",
     "QueueFullError",
+    "RemoteBatcher",
+    "RemoteReplica",
     "Replica",
     "Request",
     "Router",
@@ -88,6 +100,7 @@ __all__ = [
     "ServeServer",
     "SessionTiers",
     "StateCache",
+    "mesh_sweep",
     "replica_sweep",
     "run_loadgen",
     "run_longtail",

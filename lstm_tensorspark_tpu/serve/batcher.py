@@ -90,6 +90,7 @@ once at construction; each record site costs a lock + an add.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -427,6 +428,15 @@ class Batcher:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._sid_counter = itertools.count()
+        # auto-minted session ids must be unique across the FLEET, not
+        # just this scheduler: with remote replicas (serve/remote.py)
+        # every serve process has a replica 0, and two processes minting
+        # "s0-0" for different clients would cross their affinity probes
+        # AND alias each other's session files on a shared --session-dir
+        # (hash(sid) names the file — a collision silently decodes the
+        # other conversation's state). A per-process random component
+        # makes the namespace collision-free without any coordination.
+        self._sid_prefix = f"s{self.replica}.{os.urandom(3).hex()}"
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
@@ -758,14 +768,14 @@ class Batcher:
                 # auto ids share a namespace with client-chosen ones:
                 # skip any id the cache already holds, or an anonymous
                 # request could silently inherit (and overwrite) a kept
-                # session's carries. The replica index is baked in so the
-                # ids are unique ACROSS a replicated server — the router
-                # resolves session affinity by probing every replica's
-                # cache, and two replicas independently minting "s0"
-                # would alias two different clients' conversations.
-                sid = f"s{self.replica}-{next(self._sid_counter)}"
+                # session's carries. The prefix bakes in the replica
+                # index AND a per-process random component so the ids
+                # are unique across a replicated server and across the
+                # fleet's processes (see __init__ — the router and the
+                # shared disk tier both key on the sid).
+                sid = f"{self._sid_prefix}-{next(self._sid_counter)}"
                 while sid in self.engine.cache:
-                    sid = f"s{self.replica}-{next(self._sid_counter)}"
+                    sid = f"{self._sid_prefix}-{next(self._sid_counter)}"
             if sid in busy_sids:
                 # two in-flight requests on one session would share a cache
                 # slot and corrupt each other's carries — reject the

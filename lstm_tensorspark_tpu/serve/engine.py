@@ -62,6 +62,18 @@ program, exactly as in `make_generate_fn`); the batcher groups requests by
 sampling draws from an engine-global rng chain — reproducible for a fixed
 submission order, but not per-session; greedy decode is deterministic and
 is the parity-tested mode.
+
+**Mesh (tensor-parallel) engine** (``mesh_shards > 1``): the replica's
+params and cache slots shard their hidden/gate dimension over a one-axis
+``("model",)`` device mesh using the training-side GSPMD specs
+(parallel/tensor_parallel.py) — the same jit programs then run sharded
+with XLA deriving the per-step h all-gather and logits psum from the
+placements, so a model too large for one chip serves behind the router
+as just another replica. Compile-key families grow a trailing shard
+axis (``("decode_window", bucket, K, sampling, shards)``); the Pallas
+window kernel is single-device and falls back to the scan program,
+loudly and counted (tests/test_serve_mesh.py pins token-identical
+greedy AND sampled parity vs the single-device engine).
 """
 
 from __future__ import annotations
@@ -174,6 +186,8 @@ class ServeEngine:
         registry=None,
         device=None,
         decode_kernel: str = "auto",
+        mesh_shards: int = 1,
+        mesh_devices=None,
     ):
         # serving never rematerialises (same override as generate())
         if cfg.remat_chunk is not None:
@@ -184,8 +198,44 @@ class ServeEngine:
         # so N replicas spread across jax.devices() compute concurrently
         # (uncommitted host inputs follow the committed operands)
         self.device = device
-        if device is not None:
-            params = jax.device_put(params, device)
+        # ---- mesh-per-replica: tensor-parallel engine ----------------
+        # mesh_shards > 1 shards THIS replica's params and cache slots
+        # over a one-axis ("model",) mesh (parallel/mesh.make_serve_mesh)
+        # using the exact GSPMD specs training uses
+        # (parallel/tensor_parallel.lm_param_specs: gate kernels
+        # column-sharded [D, H/P], recurrent [H, H/P], head row-sharded
+        # [H/P, V], embedding replicated) — XLA derives the per-step h
+        # all-gather and the logits psum from the placements, so every
+        # existing jit program (prefill/decode/decode_window) runs
+        # sharded UNCHANGED and the batcher/router never know. The model
+        # no longer has to fit one chip; behind the router a mesh
+        # replica is just another replica.
+        self.mesh_shards = int(mesh_shards)
+        self.mesh = None
+        cache_sharding = None
+        if self.mesh_shards > 1:
+            if device is not None:
+                raise ValueError(
+                    "mesh_shards > 1 owns its own device group — do not "
+                    "also pass device= (device-per-replica placement)")
+            if cfg.hidden_size % self.mesh_shards != 0:
+                raise ValueError(
+                    f"hidden_size {cfg.hidden_size} is not divisible by "
+                    f"mesh_shards {self.mesh_shards} — the gate/hidden "
+                    "dimension shards evenly or not at all")
+            from ..parallel.mesh import make_serve_mesh
+            from ..parallel.tensor_parallel import place_lm_params
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.mesh = make_serve_mesh(self.mesh_shards,
+                                        devices=mesh_devices)
+            params = place_lm_params(params, self.mesh)
+            # cache slots shard over the hidden axis exactly like h: the
+            # gather-by-slot, the step, and the scatter-back all stay on
+            # the shard-local rows, with no resharding at window entry
+            cache_sharding = NamedSharding(self.mesh, P(None, None, "model"))
+        elif mesh_devices is not None:
+            raise ValueError("mesh_devices needs mesh_shards > 1")
         self.params = params
         self.fused_layers = fuse_layers(params, cfg)  # once, at init
         self.prefill_buckets = tuple(sorted(prefill_buckets))
@@ -196,7 +246,8 @@ class ServeEngine:
         # argument scopes the whole stack
         self.metrics = obs.REGISTRY if registry is None else registry
         self.cache = StateCache(cfg.num_layers, num_slots, cfg.hidden_size,
-                                registry=self.metrics, device=device)
+                                registry=self.metrics, device=device,
+                                sharding=cache_sharding)
         # tiered session-state cache (state_cache.SessionTiers): device
         # slots stay tier 0; LRU-evicted sessions spill async to host RAM
         # with a durable disk tier below (``session_dir`` — also what a
@@ -235,19 +286,46 @@ class ServeEngine:
             raise ValueError(
                 f"decode_kernel must be one of {DECODE_KERNELS}, got "
                 f"{decode_kernel!r}")
-        platform = (device.platform if device is not None
-                    else jax.default_backend())
+        if self.mesh is not None:
+            platform = self.mesh.devices.flat[0].platform
+        else:
+            platform = (device.platform if device is not None
+                        else jax.default_backend())
         if decode_kernel == "auto":
             # off-TPU the interpreted kernel is a correctness path, not
-            # a fast one — auto stays on the scan window there
-            use_pallas = (platform == "tpu" and pallas_decode.plan_fits(
+            # a fast one — auto stays on the scan window there; a SHARDED
+            # engine resolves to scan too (the fused kernel is a
+            # single-device program — it cannot read sharded carries)
+            use_pallas = (platform == "tpu" and self.mesh_shards == 1
+                          and pallas_decode.plan_fits(
                 self.batch_buckets[-1], 8, cfg.num_layers,
                 cfg.hidden_size, cfg.embed, cfg.vocab_size, sampled=True))
             self.decode_kernel = "pallas" if use_pallas else "scan"
         else:
             self.decode_kernel = decode_kernel
+        if self.decode_kernel == "pallas" and self.mesh_shards > 1:
+            # the EXPLICIT pallas pick on a mesh engine: honored as a
+            # request, unsatisfiable as a program — every window falls
+            # back to the scan program (counted per dispatch in
+            # decode_window_scan_fallbacks via _pallas_window_ok), and
+            # this boot-time line says so before the first request pays
+            # attention to the counter. Loud fallback, never a crash or
+            # a silent resolve.
+            print(
+                f"serve: --decode-kernel pallas is not supported on a "
+                f"{self.mesh_shards}-shard mesh engine (the fused window "
+                "kernel is single-device) — every decode window falls "
+                "back to the scan program, counted in "
+                "decode_window_scan_fallbacks", flush=True)
         self._pallas_interpret = platform != "tpu"
         self.decode_window_scan_fallbacks = 0  # pallas→scan dispatches
+        # sharded engines grow a trailing shard axis on every compile-key
+        # family — ("decode_window", bucket, K, sampling, shards) — so a
+        # mixed fleet's aggregated /stats can never conflate a sharded
+        # program with a single-device one; single-device engines keep
+        # the legacy arity (shards == 1 adds nothing to the key)
+        self._shard_suffix: tuple = (
+            (self.mesh_shards,) if self.mesh_shards > 1 else ())
         self.compile_counts: dict[tuple, int] = defaultdict(int)
         self._prefill_fns: dict[tuple, callable] = {}
         self._prefill_chunk_fns: dict[tuple, callable] = {}
@@ -341,7 +419,8 @@ class ServeEngine:
         if fn is not None:
             return fn
         cfg = self.cfg
-        count_key = ("prefill", batch_b, len_b, sampling.key())
+        count_key = ("prefill", batch_b, len_b, sampling.key(),
+                     *self._shard_suffix)
 
         def prefill_fn(params, h_cache, c_cache, src_slots, dst_slots,
                        fresh, prompts, lengths, rng):
@@ -384,7 +463,7 @@ class ServeEngine:
         fn = self._prefill_chunk_fns.get(key)
         if fn is not None:
             return fn
-        count_key = ("prefill_chunk", batch_b, len_b)
+        count_key = ("prefill_chunk", batch_b, len_b, *self._shard_suffix)
 
         def chunk_fn(params, h_cache, c_cache, src_slots, dst_slots, fresh,
                      prompts, lengths):
@@ -406,7 +485,7 @@ class ServeEngine:
         if fn is not None:
             return fn
         cfg = self.cfg
-        count_key = ("decode", batch_b, sampling.key())
+        count_key = ("decode", batch_b, sampling.key(), *self._shard_suffix)
 
         def decode_fn(params, fused, h_cache, c_cache, slots, tokens, rng):
             with self._counts_lock:
@@ -439,7 +518,8 @@ class ServeEngine:
         if fn is not None:
             return fn
         cfg = self.cfg
-        count_key = ("decode_window", batch_b, window, sampling.key())
+        count_key = ("decode_window", batch_b, window, sampling.key(),
+                     *self._shard_suffix)
 
         def window_fn(params, fused, h_cache, c_cache, slots, tokens,
                       alive, remaining, eos_ids, rng):
@@ -509,7 +589,7 @@ class ServeEngine:
             return fn
         cfg = self.cfg
         count_key = ("decode_window_pallas", batch_b, window,
-                     sampling.key())
+                     sampling.key(), *self._shard_suffix)
         interpret = self._pallas_interpret
 
         def window_fn(params, fused, h_cache, c_cache, slots, tokens,
@@ -549,6 +629,12 @@ class ServeEngine:
     def _pallas_window_ok(self, batch_b: int, window: int,
                           sampling: SamplingParams) -> bool:
         cfg = self.cfg
+        if self.mesh_shards > 1:
+            # the fused kernel is a single-device program: on a sharded
+            # engine every pallas pick falls back to the scan window —
+            # counted per dispatch (in _window_fn_for), announced once
+            # at boot (__init__'s log line)
+            return False
         return (pallas_decode.sampling_supported(
                     sampling.temperature, sampling.top_k, sampling.top_p,
                     sampling.greedy)
@@ -885,6 +971,7 @@ class ServeEngine:
             fallbacks = self.decode_window_scan_fallbacks
         return {
             "decode_kernel": self.decode_kernel,
+            "mesh_shards": self.mesh_shards,
             "decode_window_scan_fallbacks": fallbacks,
             "cache": self.cache.stats(),
             "prefix_cache": None if self.prefix is None else self.prefix.stats(),

@@ -1,0 +1,499 @@
+"""Remote-replica RPC transport: a peer serve PROCESS behind the router.
+
+PR 7's replicas are threads in one process; this module generalises the
+replica to a separate host. A :class:`RemoteReplica` satisfies the exact
+Router-facing surface a local :class:`~.router.Replica` does —
+``submit``/``queued``/``load``/``drain_queue``/``fail_inflight``/
+``fail_request`` plus the scheduler heartbeat — over the stdlib
+HTTP/JSON endpoint the peer already serves (serve/server.py): generate
+RPCs ride ``POST /v1/generate`` verbatim, liveness and load ride the
+lightweight ``GET /replica/heartbeat``, and session affinity probes ride
+``GET /replica/has_session``. No new wire protocol, no new dependency —
+the serve plane's public endpoint IS the replica transport.
+
+Liveness is structural, not bolted on: the shim's heartbeat poller
+thread is started by ``ServeServer.start()`` exactly like a local
+scheduler thread (``RemoteBatcher.run(stop_event)``), and it EXITS when
+``DEAD_AFTER`` consecutive heartbeats fail — so the router's existing
+death sweep (thread-not-alive → retire exactly once) fires unchanged,
+and replica-death handling generalises to HOST death for free:
+
+- nothing is queued front-side (submits dispatch an RPC thread
+  immediately), so ``drain_queue`` is empty by construction;
+- in-flight RPCs ``fail_inflight`` honestly — the remote's decode
+  position is indeterminate, the same verdict as a dead local scheduler;
+- the dead host's KEPT sessions are NOT lost when the fleet shares a
+  ``--session-dir``: the peer write-behind checkpointed every kept
+  session at its request boundaries (PR 8), so a continuation re-routes
+  to any live tiered replica and fills from the shared disk tier
+  token-identically (tests/test_serve_mesh.py's 2-process kill drill;
+  tools/chaos_serve.py ``host_die`` phase).
+
+Affinity: the router probes ``sid in replica.engine.cache`` under its
+lock; for a remote replica that is one bounded HTTP probe against the
+peer's cache AND tiers (``ServeEngine.has_session``), so continuations
+keep landing where their carries live. A dead/unreachable peer probes
+False and the (shared-disk) fallback applies.
+
+Error mapping keeps the client contract: a remote 429 settles the
+request with the shed message, a remote ``deadline_exceeded`` settles it
+as an honest timeout WITH the partial tokens, an unreachable host
+mid-request settles it "state lost" — never a silent re-decode.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from .batcher import CLASSES, QueueFullError, Request
+from .router import Replica
+
+#: consecutive failed heartbeats before the poller declares the host
+#: dead and exits (the router's sweep then retires the replica).
+DEAD_AFTER = 4
+
+#: batcher-stat counter keys mirrored from the remote heartbeat so
+#: ServeServer.stats() can aggregate a mixed local/remote fleet.
+_STAT_KEYS = (
+    "submitted", "completed", "rejected", "failed", "timed_out",
+    "queued", "active", "prefilling", "windows_pipelined",
+    "tokens_generated", "prefill_chunks_dispatched", "prefix_resumed",
+    "prefix_tokens_saved",
+)
+
+
+class _RemoteCache:
+    """Affinity-probe view of the peer's session residency: membership
+    is one bounded HTTP probe (device slots AND tiers — the peer can
+    serve the session either way). Unreachable peer → False, and the
+    router's shared-disk fallback takes over."""
+
+    def __init__(self, shim: "RemoteBatcher"):
+        self._shim = shim
+
+    def __contains__(self, sid: str) -> bool:
+        return self._shim.has_session(sid)
+
+    def session_ids(self) -> list[str]:
+        # retirement migration: a DEAD host's device state is gone by
+        # definition — nothing to detach. Kept sessions survive through
+        # the shared --session-dir disk tier instead.
+        return []
+
+    def stats(self) -> dict:
+        return {"slots": 0, "live_sessions": 0, "pinned": 0, "free": 0,
+                "evictions": 0, "generation": 0}
+
+
+class _RemoteEngine:
+    """The engine-shaped face of a remote replica: enough surface for
+    the router (cache membership, tiers=None, metrics) and the server's
+    stats/gauge collection — never a device owner."""
+
+    def __init__(self, shim: "RemoteBatcher", registry):
+        self.cache = _RemoteCache(shim)
+        self.tiers = None
+        self.prefix = None
+        self.metrics = registry
+        self._shim = shim
+
+    def has_session(self, sid: str) -> bool:
+        return self._shim.has_session(sid)
+
+    def detach_session(self, sid: str):
+        raise KeyError(f"session {sid!r} lives on a remote host — "
+                       "detach is not part of the RPC surface")
+
+    def restore_session(self, sid: str, state) -> int:
+        raise RuntimeError("cannot restore a session into a remote "
+                           "replica — route the continuation instead")
+
+    def stats(self) -> dict:
+        return {
+            "remote_url": self._shim.url,
+            "decode_kernel": None,
+            "mesh_shards": None,
+            "decode_window_scan_fallbacks": 0,
+            "cache": self.cache.stats(),
+            "prefix_cache": None,
+            "tiers": None,
+            "compiles": {},
+            "heartbeat_age_s": self._shim.heartbeat_age(),
+        }
+
+
+class RemoteBatcher:
+    """Batcher-shaped RPC shim for one remote serve host.
+
+    ``run(stop_event)`` is the scheduler closure ServeServer drives on a
+    thread (graftlint host-sync covers it like every scheduler loop —
+    it never touches the device): poll ``/replica/heartbeat`` every
+    ``poll_interval`` seconds, mirror the peer's queue/active counters,
+    and EXIT after :data:`DEAD_AFTER` consecutive failures so the
+    router's thread-liveness sweep retires the replica through the
+    normal path. ``submit`` never blocks the router lock on the network:
+    it dispatches a daemon RPC thread per request."""
+
+    def __init__(self, url: str, *, replica: int = 0, queue_size: int = 64,
+                 poll_interval: float = 0.5, rpc_timeout: float = 5.0,
+                 registry=None):
+        self.url = url.rstrip("/")
+        self.replica = int(replica)
+        self.queue_size = int(queue_size)
+        self.poll_interval = float(poll_interval)
+        self.rpc_timeout = float(rpc_timeout)
+        self.last_heartbeat: float | None = None
+        self._lock = threading.Lock()
+        self._inflight: set[Request] = set()
+        self._remote: dict = {}  # last heartbeat's batcher aggregate
+        self._last_ok: float | None = None
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self._m_rpc = None
+        if registry is not None:
+            fam = registry.counter(
+                "serve_remote_rpc_total",
+                "remote-replica RPC outcomes (generate calls by result)",
+                labelnames=("outcome", "replica"))
+            rl = str(self.replica)
+            self._m_rpc = {o: fam.labels(outcome=o, replica=rl)
+                           for o in ("ok", "error", "unreachable")}
+
+    # ---- HTTP plumbing -------------------------------------------------
+
+    def _get(self, path: str, timeout: float | None = None) -> dict:
+        with urllib.request.urlopen(
+                self.url + path,
+                timeout=self.rpc_timeout if timeout is None else timeout
+        ) as r:
+            return json.loads(r.read())
+
+    def _post(self, path: str, body: dict, timeout: float) -> dict:
+        req = urllib.request.Request(
+            self.url + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    # ---- liveness ------------------------------------------------------
+
+    def heartbeat_age(self) -> float | None:
+        hb = self.last_heartbeat
+        return None if hb is None else round(time.monotonic() - hb, 3)
+
+    def run(self, stop_event: threading.Event,
+            idle_wait: float = 0.05) -> None:
+        """Heartbeat poller — THE liveness proxy: this thread's exit is
+        how the router learns the host died (sweep: thread-not-alive →
+        retire). One initial probe runs immediately so a host that was
+        already down is retired within ``DEAD_AFTER`` polls of start."""
+        failures = 0
+        while not stop_event.is_set():
+            try:
+                hb = self._get("/replica/heartbeat")
+            except (urllib.error.URLError, OSError, ValueError):
+                failures += 1
+                if failures >= DEAD_AFTER:
+                    return  # host dead: the sweep takes it from here
+            else:
+                failures = 0
+                with self._lock:
+                    self._remote = hb.get("batcher") or {}
+                    self._last_ok = time.monotonic()
+                if hb.get("status") != "down":
+                    # a peer whose own schedulers are wedged reports
+                    # "down": its thread lives but nothing serves — keep
+                    # OUR heartbeat stale so the router stops routing
+                    # fresh sessions there (the wedge semantics local
+                    # replicas already have)
+                    self.last_heartbeat = time.monotonic()
+            stop_event.wait(self.poll_interval)
+
+    def has_session(self, sid: str) -> bool:
+        # the router calls this under its GLOBAL lock (affinity probe):
+        # the probe is one bounded HTTP GET for a peer whose heartbeat
+        # is FRESH, and a lock-free False for one that is not — a
+        # silent/dying peer must not stall the whole admission plane
+        # for a network timeout per continuation while the poller
+        # counts down to declaring it dead. Routing the continuation
+        # elsewhere is exactly right for an unhealthy peer: with a
+        # shared --session-dir the survivor fills the last checkpointed
+        # boundary from disk, and without one the honest "unknown
+        # session" beats a submit plane frozen behind a corpse.
+        with self._lock:
+            last_ok = self._last_ok
+        if (last_ok is None
+                or time.monotonic() - last_ok > 3 * self.poll_interval):
+            return False
+        try:
+            return bool(self._get(
+                "/replica/has_session?sid="
+                + urllib.parse.quote(sid, safe=""),
+                timeout=min(self.rpc_timeout, 2.0)).get("has"))
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    # ---- router-facing surface -----------------------------------------
+
+    def queued(self) -> int:
+        # remote-reported queue depth PLUS the local in-flight RPCs:
+        # the router's GLOBAL admission bound sums queued() across
+        # replicas, and a burst routed here inside one heartbeat window
+        # is invisible to the peer's last-reported number — counting it
+        # locally makes the router's bound (with its shed accounting
+        # and measured Retry-After) trip BEFORE the shim's own backstop
+        # below. Slightly conservative in steady state (an in-flight
+        # RPC the peer already admitted counts once here and once in
+        # the peer's active set at the next poll) — early shedding
+        # beats an unaccounted one.
+        with self._lock:
+            return (int(self._remote.get("queued", 0) or 0)
+                    + len(self._inflight))
+
+    def load(self) -> int:
+        with self._lock:
+            # in-flight RPCs cover the heartbeat staleness window (a
+            # burst routed between polls must weigh on the next pick);
+            # counted ONCE — queued() above uses the same accounting
+            return (sum(int(self._remote.get(k, 0) or 0)
+                        for k in ("queued", "active", "prefilling"))
+                    + len(self._inflight))
+
+    def submit(self, req: Request) -> None:
+        """Dispatch the request to the peer on an RPC thread; returns
+        immediately (the router holds its lock here — the network must
+        never run under it). Backpressure: the local in-flight count is
+        bounded at ``queue_size`` — the remote's own admission (and the
+        router's global bound over ``queued()``) does the rest."""
+        with self._lock:
+            # 2x backstop only: the router's global bound (which counts
+            # our in-flight RPCs via queued()) sheds with full
+            # accounting first — this guards direct submit() callers
+            # and pathological races, not normal overload
+            if len(self._inflight) >= 2 * self.queue_size:
+                raise QueueFullError(
+                    f"remote replica {self.replica} has "
+                    f"{2 * self.queue_size} RPCs in flight; "
+                    "retry after 0.25s", retry_after_s=0.25)
+            self._inflight.add(req)
+            self.submitted += 1
+        if req.t_submit is None:
+            req.t_submit = time.perf_counter()
+            if req.deadline_s is not None:
+                req.deadline = req.t_submit + req.deadline_s
+        threading.Thread(target=self._rpc_generate, args=(req,),
+                         name=f"serve-remote-rpc-{self.replica}",
+                         daemon=True).start()
+
+    def _rpc_generate(self, req: Request) -> None:
+        body = {
+            "prompt": [int(t) for t in req.prompt],
+            "max_new_tokens": req.max_new_tokens,
+            "temperature": req.sampling.temperature,
+            "top_k": req.sampling.top_k,
+            "top_p": req.sampling.top_p,
+            "greedy": req.sampling.greedy,
+            "session_id": req.session_id,
+            "keep_session": req.keep_session,
+            "eos_id": req.eos_id,
+            "use_prefix": req.use_prefix,
+            "class": req.klass,
+        }
+        timeout = 120.0
+        if req.deadline is not None:
+            remaining = req.deadline - time.perf_counter()
+            if remaining <= 0:
+                self._settle(req, timeout_stage=True)
+                return
+            body["deadline_s"] = round(remaining, 3)
+            timeout = remaining + self.rpc_timeout
+        body["timeout"] = timeout
+        try:
+            reply = self._post("/v1/generate", body,
+                               timeout=timeout + self.rpc_timeout)
+        except urllib.error.HTTPError as e:
+            try:
+                err = json.loads(e.read())
+            except Exception:
+                err = {"error": f"HTTP {e.code}", "code": "internal"}
+            if err.get("code") == "deadline_exceeded":
+                # honest remote expiry WITH the partial tokens
+                self._settle(req, tokens=err.get("tokens") or [],
+                             timeout_stage=True)
+            elif err.get("code") == "queue_full":
+                # the peer SHED the request: it must reach the front's
+                # client as a retryable 429 carrying the peer's measured
+                # Retry-After — settling it as a plain error would turn
+                # transient backpressure into a non-retryable 500 and
+                # discard the honest drain estimate
+                self._settle(req, error=(
+                    f"remote replica {self.replica} shed the request: "
+                    f"{err.get('error', 'queue full')}"),
+                    shed_retry_after=float(
+                        err.get("retry_after_s") or 0.25))
+            else:
+                self._settle(req, error=(
+                    f"remote replica {self.replica} ({self.url}) "
+                    f"rejected the request: "
+                    f"{err.get('error', f'HTTP {e.code}')}"))
+            return
+        except (urllib.error.URLError, OSError, ValueError,
+                TimeoutError) as e:
+            # host unreachable mid-request: its decode position is
+            # indeterminate — "state lost" is the truthful verdict,
+            # exactly like a dead local scheduler's in-flight work
+            self._settle(req, error=(
+                f"remote replica {self.replica} ({self.url}) became "
+                f"unreachable mid-request ({type(e).__name__}); its "
+                "decode position is indeterminate (state lost — resend "
+                "the request)"), unreachable=True)
+            return
+        self._settle(req, tokens=reply.get("tokens") or [],
+                     session_id=reply.get("session_id"))
+
+    def _settle(self, req: Request, *, tokens=None, session_id=None,
+                error: str | None = None, timeout_stage: bool = False,
+                unreachable: bool = False,
+                shed_retry_after: float | None = None) -> None:
+        # the whole settle — done-check, field writes, done.set() —
+        # commits under the shim lock: an RPC thread finishing a
+        # long-connected generate can race fail_inflight (host declared
+        # dead on heartbeats while the socket still lives), and a
+        # half-locked settle could hand the client a completed
+        # request's tokens with a "state lost" error (or double-count
+        # the outcome). Unlike the local Batcher, whose fail_inflight
+        # only runs once its single scheduler thread is provably dead,
+        # these RPC threads are independent and may still be live.
+        now = time.perf_counter()
+        with self._lock:
+            self._inflight.discard(req)
+            if req.done.is_set():
+                return  # the racing settler won; this outcome is moot
+            if error is None and not timeout_stage:
+                self.completed += 1
+            else:
+                self.failed += 1
+            if tokens:
+                req.tokens.extend(int(t) for t in tokens)
+                if req.t_first_token is None:
+                    req.t_first_token = now
+                req.t_tokens.extend([now] * len(tokens))
+            if session_id is not None:
+                req.session_id = session_id
+            req.error = error
+            req.timed_out = timeout_stage
+            if shed_retry_after is not None:
+                # marker ServeServer.generate re-raises as QueueFullError
+                # (→ HTTP 429 + Retry-After), keeping the backpressure
+                # contract across the RPC hop
+                req.remote_shed_retry_after = shed_retry_after
+            req.t_done = now
+            req.done.set()
+        if self._m_rpc is not None:
+            self._m_rpc["unreachable" if unreachable else
+                        "error" if (error or timeout_stage)
+                        else "ok"].inc()
+
+    # ---- retirement (router-driven, after run() exited) ----------------
+
+    def drain_queue(self) -> list[Request]:
+        return []  # nothing queues front-side: submits dispatch at once
+
+    def fail_inflight(self, reason: str) -> int:
+        # same locked-settle discipline as _settle: a still-live RPC
+        # thread may be completing one of these requests concurrently,
+        # and exactly one settler must win per request
+        now = time.perf_counter()
+        with self._lock:
+            inflight = list(self._inflight)
+            self._inflight.clear()
+            n = 0
+            for req in inflight:
+                if req.done.is_set():
+                    continue
+                req.error = reason
+                req.t_done = now
+                req.done.set()
+                n += 1
+            self.failed += n
+        return n
+
+    def fail_request(self, req: Request, reason: str) -> None:
+        with self._lock:
+            if not req.done.is_set():
+                req.error = reason
+                req.t_done = time.perf_counter()
+                req.done.set()
+
+    # ---- views / warmup -------------------------------------------------
+
+    def warmup(self, sampling=None, prompt_lens: tuple[int, ...] = (1,)):
+        """Ask the peer to (re)warm its compile lattice for these prompt
+        lengths. Best-effort: the peer already warmed at boot (cli
+        _serve_http), so an unreachable peer costs a log line, not a
+        failed start."""
+        body = {"prompt_lens": [int(t) for t in prompt_lens]}
+        if sampling is not None:
+            body.update(temperature=sampling.temperature,
+                        top_k=sampling.top_k, top_p=sampling.top_p,
+                        greedy=sampling.greedy)
+        try:
+            return int(self._post("/replica/warmup", body,
+                                  timeout=600.0).get("programs", 0))
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"serve: remote replica {self.replica} warmup RPC "
+                  f"failed ({type(e).__name__}) — relying on its own "
+                  "boot-time warmup", flush=True)
+            return 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            remote = dict(self._remote)
+            submitted, completed = self.submitted, self.completed
+            failed, inflight = self.failed, len(self._inflight)
+        out = {k: int(remote.get(k, 0) or 0) for k in _STAT_KEYS}
+        out.update({
+            "replica": self.replica,
+            "remote_url": self.url,
+            "rpc_submitted": submitted,
+            "rpc_completed": completed,
+            "rpc_failed": failed,
+            "rpc_inflight": inflight,
+            # JSON stringified the K keys in flight; re-int them so the
+            # server's cross-replica aggregation merges onto the local
+            # batchers' integer rungs instead of duplicating "4" vs 4
+            "windows_dispatched": {
+                (int(k) if str(k).isdigit() else k): v
+                for k, v in (remote.get("windows_dispatched")
+                             or {}).items()},
+            "queued_by_class": dict(remote.get("queued_by_class")
+                                    or {c: 0 for c in CLASSES}),
+            "class_weights": list(remote.get("class_weights") or []),
+            "max_active": remote.get("max_active"),
+            "queue_size": self.queue_size,
+            "window_ladder": list(remote.get("window_ladder") or []),
+            "prefill_chunk": remote.get("prefill_chunk"),
+        })
+        return out
+
+
+class RemoteReplica(Replica):
+    """A :class:`~.router.Replica` whose engine+scheduler live in
+    another process. Plugs into ``ServeServer``/``Router`` unchanged:
+    the heartbeat poller is the scheduler thread, the RPC shim is the
+    batcher, and the engine view answers affinity probes."""
+
+    def __init__(self, index: int, url: str, *, registry=None,
+                 queue_size: int = 64, poll_interval: float = 0.5,
+                 rpc_timeout: float = 5.0):
+        shim = RemoteBatcher(url, replica=index, queue_size=queue_size,
+                             poll_interval=poll_interval,
+                             rpc_timeout=rpc_timeout, registry=registry)
+        super().__init__(index, _RemoteEngine(shim, registry), shim)
+        self.url = shim.url

@@ -51,6 +51,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .batcher import (
@@ -95,11 +96,19 @@ class ServeServer:
                  health_stale_after: float = 60.0,
                  best_effort_queue_frac: float = 0.5,
                  deadline_defaults: dict | None = None,
-                 sweep_interval: float | None = None, **batcher_kw):
+                 sweep_interval: float | None = None,
+                 remote_replicas: tuple[str, ...] = (), **batcher_kw):
         engines = (list(engine) if isinstance(engine, (list, tuple))
                    else [engine])
         if not engines:
-            raise ValueError("ServeServer needs at least one engine")
+            # remote-only fleets are deliberately unsupported: replica 0
+            # anchors the registry, the back-compat engine/batcher views,
+            # and the shared-session-dir failover target host death
+            # depends on — a front with zero local capacity would also
+            # lose every kept session with its last remote host
+            raise ValueError(
+                "ServeServer needs at least one LOCAL engine (remote "
+                "replicas ride behind it via remote_replicas=)")
         if sweep_interval is not None and sweep_interval <= 0:
             raise ValueError(
                 f"sweep_interval must be > 0 or None, got {sweep_interval}")
@@ -135,6 +144,18 @@ class ServeServer:
                 # without an explicit replica index
                 eng.tiers.set_replica(i)
             self.replicas.append(Replica(i, eng, b))
+        # remote replicas (serve/remote.py): peer serve PROCESSES behind
+        # this router — the RPC shim satisfies the same replica surface,
+        # its heartbeat poller is the scheduler thread start() drives,
+        # and host death retires through the exact replica-death path.
+        # Indexed after the locals, so replica 0 (the engine/batcher
+        # back-compat views, the registry anchor) stays in-process.
+        for url in remote_replicas:
+            from .remote import RemoteReplica
+
+            self.replicas.append(RemoteReplica(
+                len(self.replicas), url, registry=engines[0].metrics,
+                queue_size=self.replicas[0].batcher.queue_size))
         # the global admission bound == the per-replica queue bound, so
         # the router's check is the only one that ever fires
         self.router = Router(
@@ -302,8 +323,74 @@ class ServeServer:
             # exception — the HTTP layer returns it, never a wedged client
             raise DeadlineExceededError(req)
         if req.error is not None:
+            retry = getattr(req, "remote_shed_retry_after", None)
+            if retry is not None:
+                # a REMOTE replica shed this request after routing
+                # (serve/remote.py): re-raise as the same retryable 429
+                # a local shed produces, with the peer's measured
+                # Retry-After — not a hard RuntimeError/500
+                raise QueueFullError(req.error, retry_after_s=retry)
             raise RuntimeError(req.error)
         return req
+
+    def has_session(self, session_id: str) -> bool:
+        """Fleet-wide session residency (device slots OR tiers on any
+        replica) — the ``/replica/has_session`` affinity probe a FRONT
+        router's RPC shim asks before routing a continuation here."""
+        return any(r.engine.has_session(session_id)
+                   for r in self.replicas
+                   if hasattr(r.engine, "has_session"))
+
+    @staticmethod
+    def _aggregate_batcher(snapshots: list[dict]) -> dict:
+        """THE cross-replica batcher aggregation — one implementation
+        for ``stats()`` and ``replica_heartbeat()``, so a counter added
+        to ``_SUMMED_BATCHER_KEYS`` (or a new merged dict) can never
+        diverge between the two views. Seeds from the first snapshot
+        (config fields ride along; merged dicts deep-copied so summing
+        never mutates replica 0's reported view), sums the counter
+        keys, and merges the per-K / per-class dicts."""
+        agg: dict = {}
+        for b in snapshots:
+            if not agg:
+                agg = dict(b)
+                agg["windows_dispatched"] = dict(
+                    b.get("windows_dispatched") or {})
+                agg["queued_by_class"] = dict(
+                    b.get("queued_by_class") or {})
+                continue
+            for k in _SUMMED_BATCHER_KEYS:
+                agg[k] += b.get(k, 0)
+            for k, v in (b.get("windows_dispatched") or {}).items():
+                agg["windows_dispatched"][k] = (
+                    agg["windows_dispatched"].get(k, 0) + v)
+            for k, v in (b.get("queued_by_class") or {}).items():
+                agg["queued_by_class"][k] = (
+                    agg["queued_by_class"].get(k, 0) + v)
+        agg.pop("replica", None)  # the aggregate is not one replica's view
+        return agg
+
+    def replica_heartbeat(self) -> dict:
+        """Lightweight liveness + load payload for a front-of-fleet
+        router's RPC shim (``GET /replica/heartbeat``): the health
+        verdict plus the summed batcher counters — deliberately WITHOUT
+        the metrics summaries /stats carries, because the shim polls
+        this every ~0.5 s."""
+        health = self.health()
+        agg = self._aggregate_batcher(
+            [r.batcher.stats() for r in self.replicas])
+        return {
+            "ok": health["ok"],
+            "status": health["status"],
+            "queued": health["queued"],
+            "active": health["active"],
+            "replicas_healthy": health["replicas_healthy"],
+            "replicas_total": health["replicas_total"],
+            "sessions": sum(len(r.engine.cache)
+                            for r in self.replicas
+                            if hasattr(r.engine.cache, "__len__")),
+            "batcher": agg,
+        }
 
     def stats(self) -> dict:
         """Aggregate view + per-replica detail. Top-level ``batcher`` sums
@@ -312,29 +399,13 @@ class ServeServer:
         for back-compat; ``replicas`` carries each replica's full
         batcher/engine stats and ``router`` the routing/requeue/migration
         counters."""
-        agg: dict = {}
         per = []
         for r in self.replicas:
-            b = r.batcher.stats()
-            per.append({"replica": r.index, "batcher": b, **r.engine.stats()})
-            if not agg:
-                # seed from THIS snapshot (not a second stats() call) so
-                # the aggregate and replicas[0]'s detail in one reply
-                # describe the same instant; deep-copy the merged dicts so
-                # summing never mutates replica 0's reported view
-                agg = dict(b)
-                agg["windows_dispatched"] = dict(b["windows_dispatched"])
-                agg["queued_by_class"] = dict(b["queued_by_class"])
-                continue
-            for k in _SUMMED_BATCHER_KEYS:
-                agg[k] += b[k]
-            for k, v in b["windows_dispatched"].items():
-                agg["windows_dispatched"][k] = (
-                    agg["windows_dispatched"].get(k, 0) + v)
-            for k, v in b["queued_by_class"].items():
-                agg["queued_by_class"][k] = (
-                    agg["queued_by_class"].get(k, 0) + v)
-        agg.pop("replica", None)  # the aggregate is not one replica's view
+            # ONE stats() call per replica: the aggregate and this
+            # replica's detail in one reply describe the same instant
+            per.append({"replica": r.index, "batcher": r.batcher.stats(),
+                        **r.engine.stats()})
+        agg = self._aggregate_batcher([p["batcher"] for p in per])
         rt = self.router.stats()
         # router-level 429s are THE backpressure count of the replicated
         # stack (per-replica bounds never fire; see Router docstring)
@@ -558,11 +629,48 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
+        elif self.path == "/replica/heartbeat":
+            # the remote-replica transport's liveness+load poll
+            # (serve/remote.py RemoteBatcher.run): health verdict +
+            # summed batcher counters, no metrics summaries — cheap
+            # enough for a sub-second poll cadence
+            self._reply(200, self._serve.replica_heartbeat())
+        elif self.path.startswith("/replica/has_session"):
+            # affinity probe from a front-of-fleet router: is this
+            # session device- or tier-resident on ANY local replica?
+            q = urllib.parse.parse_qs(
+                urllib.parse.urlparse(self.path).query)
+            sid = (q.get("sid") or [None])[0]
+            if not sid:
+                self._error(400, "bad_request",
+                            "has_session needs ?sid=", retryable=False)
+            else:
+                self._reply(200, {"has": self._serve.has_session(sid)})
         else:
             self._error(404, "not_found", f"no route {self.path}",
                         retryable=False)
 
     def do_POST(self) -> None:
+        if self.path == "/replica/warmup":
+            # front-of-fleet warmup pass-through: compile the lattice
+            # for the front's prompt lengths/sampling before traffic
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                lens = tuple(int(t) for t in body.get("prompt_lens", (1,)))
+                sampling = _sampling_from_body(body)
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._error(400, "bad_request", f"bad request: {e}",
+                            retryable=False)
+                return
+            try:
+                n = self._serve.warmup(sampling, prompt_lens=lens)
+            except (ValueError, RuntimeError) as e:
+                self._error(500, "internal",
+                            f"{type(e).__name__}: {e}", retryable=False)
+                return
+            self._reply(200, {"programs": n})
+            return
         if self.path != "/v1/generate":
             self._error(404, "not_found", f"no route {self.path}",
                         retryable=False)

@@ -102,9 +102,11 @@ class DetachedState(NamedTuple):
 
 class StateCache:
     def __init__(self, num_layers: int, num_slots: int, hidden_size: int,
-                 registry=None, device=None):
+                 registry=None, device=None, sharding=None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if device is not None and sharding is not None:
+            raise ValueError("pass device OR sharding, not both")
         self.num_layers = num_layers
         self.num_slots = num_slots
         self.hidden_size = hidden_size
@@ -117,6 +119,14 @@ class StateCache:
             # runs on this replica's device
             self.h = jax.device_put(self.h, device)
             self.c = jax.device_put(self.c, device)
+        elif sharding is not None:
+            # mesh-per-replica serving (ServeEngine mesh_shards > 1): the
+            # cache slots shard over the hidden axis like the params —
+            # every gather/scatter/step program then runs sharded with
+            # XLA deriving the collectives, and detach/device_get
+            # assemble the full rows host-side
+            self.h = jax.device_put(self.h, sharding)
+            self.c = jax.device_put(self.c, sharding)
         self._lock = threading.RLock()
         self._slots: OrderedDict[str, int] = OrderedDict()  # LRU: oldest first
         self._free: list[int] = list(range(num_slots))
@@ -669,6 +679,18 @@ class _SpillJob:
         self.in_queue = False
 
 
+def session_file_path(directory: str, sid: str) -> str:
+    """THE disk-tier session-file naming scheme, in one place: session
+    ids are client-controlled strings, so the name is a digest
+    (filesystem-safe, length-bounded) and the sid itself lives in the
+    file's JSON header. Exposed module-level because the chaos drill
+    and the host-kill tests probe checkpoint freshness by path — a
+    private copy of the scheme would silently stop matching if it ever
+    changed here."""
+    digest = hashlib.sha256(sid.encode()).hexdigest()[:24]
+    return os.path.join(directory, f"sess-{digest}{_DiskTier.SUFFIX}")
+
+
 class _DiskTier:
     """Durable session files under one directory — the serve twin of the
     training checkpoint story (train/checkpoint.py): every file is
@@ -721,8 +743,7 @@ class _DiskTier:
                 self._index[sid] = path
 
     def _path(self, sid: str) -> str:
-        digest = hashlib.sha256(sid.encode()).hexdigest()[:24]
-        return os.path.join(self.directory, f"sess-{digest}{self.SUFFIX}")
+        return session_file_path(self.directory, sid)
 
     def _quarantine(self, sid: str | None, path: str) -> None:
         for p in (path, path + ".sha256"):

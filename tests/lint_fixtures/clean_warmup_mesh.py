@@ -1,0 +1,26 @@
+"""graftlint fixture: clean twin of viol_warmup_mesh — ONE defining
+method builds the compile key for both the single-device and the
+sharded family (the shard axis rides as a suffix, exactly the
+serve/engine.py pattern), so the one warmup() reaches every family a
+mesh engine can dispatch."""
+
+
+class MiniMeshEngine:
+    def __init__(self, mesh_shards=1):
+        self.mesh_shards = mesh_shards
+        self._shard_suffix = (mesh_shards,) if mesh_shards > 1 else ()
+        self.compile_counts = {}
+        self._fns = {}
+
+    def _get_window_fn(self, bucket, k):
+        count_key = ("decode_window", bucket, k, *self._shard_suffix)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def decode_window(self, tokens, k):
+        return self._get_window_fn(len(tokens), k)(tokens)
+
+    def warmup(self):
+        # the ONE family-defining method: covered for every shard count
+        return self._get_window_fn(1, 4)([0])

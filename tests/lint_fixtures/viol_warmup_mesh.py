@@ -1,0 +1,35 @@
+"""graftlint fixture: warmup-coverage true positive for the SHARDED
+compile-key family — the mesh engine's window program family grows a
+trailing shard axis (("decode_window", bucket, K, sampling, shards)) in
+its own defining method, but warmup() only reaches the single-device
+family's method: the first request a sharded engine serves pays the
+XLA compile mid-traffic."""
+
+
+class MiniMeshEngine:
+    def __init__(self, mesh_shards=1):
+        self.mesh_shards = mesh_shards
+        self.compile_counts = {}
+        self._fns = {}
+
+    def _get_window_fn(self, bucket, k):
+        count_key = ("decode_window", bucket, k)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def _get_window_sharded_fn(self, bucket, k):
+        count_key = ("decode_window", bucket, k, self.mesh_shards)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def decode_window(self, tokens, k):
+        if self.mesh_shards > 1:
+            return self._get_window_sharded_fn(len(tokens), k)(tokens)
+        return self._get_window_fn(len(tokens), k)(tokens)
+
+    def warmup(self):
+        # only the single-device family: a sharded engine compiles its
+        # window program in the middle of serving traffic
+        return self._get_window_fn(1, 4)([0])

@@ -40,6 +40,7 @@ VIOLATIONS = {
     "viol_tier_sync": "host-sync",
     "viol_decode_sync": "host-sync",
     "viol_warmup_pallas": "warmup-coverage",
+    "viol_warmup_mesh": "warmup-coverage",
     "viol_warmup_train": "warmup-coverage",
     "viol_lock_abba": "lock-order",
     "viol_lock_listener": "lock-order",
@@ -63,6 +64,7 @@ CLEAN_TWINS = {
     "clean_tier_sync": "host-sync",
     "clean_decode_sync": "host-sync",
     "clean_warmup_pallas": "warmup-coverage",
+    "clean_warmup_mesh": "warmup-coverage",
     "clean_warmup_train": "warmup-coverage",
     "clean_lock_order": "lock-order",
     "clean_lock_shared_rlock": "lock-order",

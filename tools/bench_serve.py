@@ -66,6 +66,15 @@ _REPO = os.path.dirname(_HERE)
 sys.path.insert(0, _REPO)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the mesh probe (--mesh-shards) needs virtual devices to shard over
+# (must be set BEFORE jax imports); the other probes keep the host's
+# default so their numbers stay comparable with earlier trajectory runs
+if (any(a.startswith("--mesh-shards") for a in sys.argv)
+        and "xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")):
+    os.environ.update(XLA_FLAGS=(
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip())
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
@@ -75,6 +84,7 @@ from lstm_tensorspark_tpu.obs import MetricsRegistry  # noqa: E402
 from lstm_tensorspark_tpu.serve import ServeEngine, ServeServer  # noqa: E402
 from lstm_tensorspark_tpu.serve.loadgen import (  # noqa: E402
     kernel_sweep,
+    mesh_sweep,
     replica_sweep,
     run_loadgen,
     run_longtail,
@@ -564,6 +574,78 @@ def run_decode_kernel_bench(kernels: tuple[str, ...], out_path: str) -> int:
     return 0 if (sweep.get("parity_ok", True) and gate) else 1
 
 
+# ---- tensor-parallel mesh probe (--mesh-shards; BENCH_serve_r06) --------
+#
+# The mesh-serving trendline's SEED datapoint (ISSUE-14): the same
+# closed-loop decode workload through a 1-shard engine and an N-shard
+# GSPMD engine on virtual CPU devices. On CPU the shards are threads of
+# one host, so the ratio prices partition/collective overhead WITHOUT
+# the memory-capacity win sharding exists for — recorded honestly, no
+# >= gate (the capacity/speed claims belong to real multi-chip hosts).
+# What IS gated: greedy token parity across shard counts, and the
+# warmup-asserted zero-mid-traffic-compile invariant on the sharded
+# ("decode_window", bucket, K, sampling, shards) family.
+
+M_CFG = dict(vocab_size=89, hidden_size=128, num_layers=2)
+M_SESSIONS = 8
+M_PROMPT_LEN = 8
+M_MAX_NEW = 64
+M_REQS = 3
+
+
+def _mesh_server(shards: int) -> ServeServer:
+    cfg = LMConfig(**M_CFG)
+    params = init_lm(jax.random.PRNGKey(0), cfg)
+    engine = ServeEngine(
+        params, cfg, num_slots=32,
+        prefill_buckets=(8, 16), batch_buckets=(1, 2, 4, 8),
+        prefix_cache=False, mesh_shards=shards,
+        registry=MetricsRegistry(),
+    )
+    return ServeServer(engine, max_active=M_SESSIONS, queue_size=64,
+                       window_ladder=(1, 4, 8))
+
+
+def run_mesh_bench(levels: tuple[int, ...], out_path: str) -> int:
+    print(f"bench_serve: tensor-parallel mesh probe (shards {levels})...",
+          flush=True)
+    sweep = mesh_sweep(
+        _mesh_server, vocab_size=M_CFG["vocab_size"], levels=levels,
+        sessions=M_SESSIONS, requests_per_session=M_REQS,
+        prompt_len=M_PROMPT_LEN, max_new_tokens=M_MAX_NEW, seed=5)
+    sc = sweep["scaling"]
+    out = {
+        "note": "serve_bench_r06 tensor-parallel mesh serving "
+                "(tools/bench_serve.py --mesh-shards)",
+        "config": {
+            **M_CFG, "sessions": M_SESSIONS, "prompt_len": M_PROMPT_LEN,
+            "max_new_tokens": M_MAX_NEW, "requests_per_session": M_REQS,
+            "levels": list(levels),
+            "platform": jax.devices()[0].platform,
+            "devices": jax.device_count(),
+        },
+        "mesh_scaling": sweep,
+        # honesty marker: CPU virtual-device shards share one host's
+        # cores — the ratio prices GSPMD overhead, not the capacity win
+        "cpu_virtual_devices": jax.devices()[0].platform != "tpu",
+        "pass_parity": bool(sweep.get("parity_ok", False)),
+        "pass_warmup_covered": bool(sweep.get("warmup_covered", False)),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "tokens_per_sec": sc["tokens_per_sec"],
+        "shard_ratio_top_vs_base": sc["shard_ratio_top_vs_base"],
+        "p50_ttft_ms": sc["p50_ttft_ms"],
+        "p99_itl_ms": sc["p99_itl_ms"],
+        "mid_traffic_compiles": sweep["mid_traffic_compiles"],
+        "parity_ok": sweep.get("parity_ok"),
+    }))
+    print(f"bench_serve: report written to {out_path}")
+    return 0 if (out["pass_parity"] and out["pass_warmup_covered"]) else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
@@ -580,6 +662,13 @@ def main(argv=None) -> int:
                          "('on' runs the paired all-on-device-vs-tiered "
                          "gate; 'off' adds the re-prefill contrast; "
                          "writes BENCH_serve_r03.json)")
+    ap.add_argument("--mesh-shards", default=None,
+                    help="comma list of shard counts (e.g. 1,2): run the "
+                         "tensor-parallel mesh probe on virtual devices "
+                         "— aggregate tokens/s + TTFT/ITL per shard "
+                         "count, honest CPU ratio, greedy cross-config "
+                         "parity + warmup-asserted zero mid-traffic "
+                         "compiles; writes BENCH_serve_r06.json")
     ap.add_argument("--decode-kernel", default=None,
                     help="comma list of kernels (e.g. pallas,scan): run "
                          "the decode-kernel comparison (tokens/s + ITL "
@@ -601,6 +690,14 @@ def main(argv=None) -> int:
             ap.error(f"--tiered-cache modes must be on/off, got {bad}")
         out_path = args.out or os.path.join(_REPO, "BENCH_serve_r03.json")
         return run_tiered_bench(modes, out_path)
+    if args.mesh_shards:
+        try:
+            levels = tuple(int(x) for x in args.mesh_shards.split(",")
+                           if x.strip())
+        except ValueError:
+            ap.error(f"--mesh-shards must be ints, got {args.mesh_shards!r}")
+        out_path = args.out or os.path.join(_REPO, "BENCH_serve_r06.json")
+        return run_mesh_bench(levels, out_path)
     if args.decode_kernel:
         kernels = tuple(k.strip() for k in args.decode_kernel.split(",")
                         if k.strip())

@@ -24,6 +24,12 @@ Four phases, each building a fresh in-process stack from one fixed seed:
    best-effort sheds with honest ``Retry-After`` 429s; the same burst is
    replayed with the old indiscriminate-FIFO settings for contrast, and
    both land in BENCH_serve_r04.json (``--json``).
+5. **host death** (``host_die`` fault kind) — a REMOTE replica (a real
+   ``cli serve --http`` subprocess behind the front router via the RPC
+   transport, serve/remote.py) is SIGKILLed mid-conversation; the
+   shared ``--session-dir`` disk tier must hand every kept session to
+   the surviving local replica, token-identical to an uninterrupted
+   run — PR 7's replica-death invariant generalized to a dead HOST.
 
 Wired into tools/verify.sh after the serve smoke (sequenced, never
 concurrent with the timed suite). Exit 0 on PASS, 1 on any violated
@@ -64,6 +70,10 @@ from lstm_tensorspark_tpu.serve import (  # noqa: E402
     ServeServer,
     run_loadgen,
 )
+from lstm_tensorspark_tpu.serve.state_cache import (  # noqa: E402
+    session_file_path as _session_file,
+)
+from tools.serve_proc import boot_serve_http_or_raise  # noqa: E402
 
 _CFG = LMConfig(vocab_size=41, hidden_size=16, num_layers=1)
 _SEED = 3  # params seed — every stack (chaos + reference) shares params
@@ -309,6 +319,144 @@ def _phase_latency_faults(params, seed, failures):
     return res
 
 
+# ---- phase 5: host death (remote replica killed mid-conversation) -------
+
+
+_HOST_ARGS = [
+    "serve", "--http", "--port", "0", "--vocab-size", str(_CFG.vocab_size),
+    "--hidden-units", str(_CFG.hidden_size),
+    "--num-layers", str(_CFG.num_layers), "--seed", str(_SEED),
+    "--prefill-buckets", "4,8", "--batch-buckets", "1,2",
+    "--decode-window", "1", "--prefix-cache", "off",
+    "--num-slots", "8", "--max-active", "4",
+]
+
+
+def _boot_remote_host(session_dir: str, timeout: float = 180.0):
+    """Boot a replica-host subprocess (same params as the in-process
+    reference: the CLI re-derives them from --seed/--vocab-size/...)
+    and wait for its address line (tools/serve_proc.py)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "lstm_tensorspark_tpu.cli",
+           *_HOST_ARGS, "--session-dir", session_dir]
+    return boot_serve_http_or_raise(cmd, env, timeout)
+
+
+
+
+def _phase_host_death(params, seed, failures):
+    work = tempfile.mkdtemp(prefix="chaos_serve_hostdie_")
+    n_sessions = 4
+    res = {"sessions": n_sessions, "fault_spec": "host_die@remote"}
+    proc = None
+    try:
+        proc, base = _boot_remote_host(work)
+        res["remote_url"] = base
+        from lstm_tensorspark_tpu.serve import ServeServer
+
+        reg = MetricsRegistry()
+        eng = ServeEngine(params, _CFG, num_slots=8,
+                          prefill_buckets=(4, 8), batch_buckets=(1, 2),
+                          rng_seed=0, registry=reg, session_dir=work,
+                          replica=0)
+        srv = ServeServer(eng, max_active=4, queue_size=16,
+                          window_ladder=(1,), remote_replicas=(base,))
+        with srv:
+            sids, toks, homes = [], [], []
+            for i in range(n_sessions):
+                sid, t, home = _create_kept(srv, i)
+                sids.append(sid)
+                toks.append(t)
+                homes.append(home)
+            res["remote_sessions"] = sum(1 for h in homes if h == 1)
+            if res["remote_sessions"] < 1:
+                failures.append(
+                    "host_death: no kept session landed on the remote "
+                    f"replica (homes {homes}) — the kill would test "
+                    "nothing")
+                return res
+            t_turn = time.monotonic()
+            # wall clock on purpose: compared against file MTIMES below
+            # (the checkpoint-flushed probe) — monotonic has no epoch
+            t_turn_wall = time.time()  # graftlint: disable=wallclock-timing
+            for i, sid in enumerate(sids):  # one pre-death turn
+                toks[i].extend(_continue_kept(srv, sid, toks[i][-1]))
+            # durability boundary: the drill tests host DEATH, not an
+            # unflushed write-behind — await every session's checkpoint
+            # (file mtime at/after the turn) before pulling the trigger
+            deadline = time.monotonic() + 30
+
+            def flushed():
+                # every file strictly after the turn started (a file
+                # from a PREVIOUS boundary would resume the
+                # conversation without tokens the client already saw)
+                # AND quiescent for 1 s: the write-behind worker merges
+                # a superseded capture and rewrites within ~100 ms, so
+                # a lagging creation-boundary write landing after
+                # t_turn_wall cannot masquerade as the turn's
+                # checkpoint past the quiet window
+                mtimes = []
+                for sid in sids:
+                    p = _session_file(work, sid)
+                    if not os.path.exists(p):
+                        return False
+                    mtimes.append(os.path.getmtime(p))
+                return (min(mtimes) >= t_turn_wall
+                        and time.time()  # graftlint: disable=wallclock-timing
+                        - max(mtimes) > 1.0)
+
+            while not flushed() and time.monotonic() < deadline:
+                time.sleep(0.1)
+            res["checkpoints_flushed"] = flushed()
+            if not flushed():
+                failures.append(
+                    "host_death: write-behind session checkpoints never "
+                    "landed on the shared --session-dir")
+                return res
+            proc.kill()  # SIGKILL mid-conversation: host death
+            proc.wait()
+            res["kill_after_s"] = round(time.monotonic() - t_turn, 2)
+            lost = 0
+            for i, sid in enumerate(sids):  # post-death continuations
+                try:
+                    toks[i].extend(_continue_kept(srv, sid, toks[i][-1]))
+                except Exception as e:
+                    lost += 1
+                    failures.append(
+                        f"host_death: kept session {sid!r} lost after "
+                        f"the host kill: {type(e).__name__}: {e}")
+            res["lost_sessions"] = lost
+            # the heartbeat poller exits → the sweep retires the host
+            deadline = time.monotonic() + 15
+            while (1 not in srv.router.stats()["retired"]
+                   and time.monotonic() < deadline):
+                srv.router.sweep()
+                time.sleep(0.2)
+            rt = srv.router.stats()
+            res["retired"] = rt["retired"]
+            res["router"] = {k: rt[k] for k in
+                             ("retired", "failed_on_death", "requeued")}
+            if 1 not in rt["retired"]:
+                failures.append(
+                    "host_death: the dead host was never retired (the "
+                    "heartbeat poller must exit and the sweep must "
+                    "claim it)")
+        ref = _reference_tokens(params, n_sessions, turns=2)
+        res["token_identical"] = toks == ref
+        if toks != ref:
+            failures.append(
+                "host_death: continuations diverged from the "
+                "uninterrupted run (host_die@remote)")
+    except Exception as e:
+        failures.append(f"host_death: drill error: {type(e).__name__}: {e}")
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        shutil.rmtree(work, ignore_errors=True)
+    return res
+
+
 # ---- phase 4: burst shed (SLO-aware vs indiscriminate FIFO) -------------
 
 
@@ -404,6 +552,7 @@ def main(argv=None) -> int:
                                                       failures)
     summary["burst_shed"] = _phase_burst_shed(params, args.seed,
                                               args.slo_ms, failures)
+    summary["host_death"] = _phase_host_death(params, args.seed, failures)
     summary["wall_s"] = round(time.monotonic() - t_start, 1)
     summary["result"] = "PASS" if not failures else "FAIL"
     summary["failures"] = failures

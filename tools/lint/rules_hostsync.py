@@ -36,9 +36,11 @@ from .core import Finding, Rule, register
 from .model import ModuleInfo, Project, self_call_closure
 
 #: classes whose run/step/drain closure is the serving hot loop (the
-#: batcher's scheduler iteration, and the tiered cache's spill worker —
-#: its whole point is owning the spill plane's one designated sync)
-SCHEDULER_CLASSES = {"Batcher", "SessionTiers"}
+#: batcher's scheduler iteration, the tiered cache's spill worker — its
+#: whole point is owning the spill plane's one designated sync — and
+#: the remote-replica RPC shim's heartbeat poller, serve/remote.py:
+#: a scheduler thread by contract that must never touch the device)
+SCHEDULER_CLASSES = {"Batcher", "SessionTiers", "RemoteBatcher"}
 _SCHEDULER_ENTRIES = {"run", "step", "drain"}
 #: attribute-call names that ARE the designated sync points — a direct
 #: np.asarray around them is the blessed fetch, not a stray sync

@@ -1,0 +1,66 @@
+"""Shared serve-subprocess boot protocol for the drills.
+
+THE one copy of the boot-and-wait-for-address dance (spawn
+`cli serve --http`, pump its output on a thread, match the
+"serving on http://..." line): tools/serve_smoke.py,
+tools/chaos_serve.py's host_die phase, and the 2-process kill drill in
+tests/test_serve_mesh.py all boot real serve processes, and three
+private copies of the same regex/pump/ready-event logic would drift
+apart the first time the CLI's address line changes — the same reason
+state_cache.session_file_path is module-level instead of re-derived."""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import threading
+
+#: the CLI's address announcement (cli._serve_http) — the boot barrier
+_ADDR_RE = re.compile(r"serving on (http://[\w.]+:\d+)")
+
+#: children run `-m lstm_tensorspark_tpu.cli`, which resolves from the
+#: repo root regardless of where the drill itself was invoked
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def boot_serve_http(cmd, env, timeout: float):
+    """Spawn a serve subprocess and wait for its address line.
+
+    Returns ``(proc, lines, url-or-None)`` — ``lines`` accumulates the
+    child's combined output (keeps filling on the pump thread; the
+    smoke replays it on failure), ``url`` is None when the child died
+    or never announced within ``timeout`` (callers fail/raise with the
+    captured output)."""
+    proc = subprocess.Popen(cmd, cwd=_REPO, env=env, text=True,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    lines: list[str] = []
+    url: list[str] = []
+    ready = threading.Event()
+
+    def pump():
+        for line in proc.stdout:
+            lines.append(line)
+            m = _ADDR_RE.search(line)
+            if m:
+                url.append(m.group(1))
+                ready.set()
+        ready.set()  # EOF: unblock the waiter to report the death
+
+    threading.Thread(target=pump, daemon=True).start()
+    if not ready.wait(timeout) or not url:
+        return proc, lines, None
+    return proc, lines, url[0]
+
+
+def boot_serve_http_or_raise(cmd, env, timeout: float = 180.0):
+    """:func:`boot_serve_http` that kills the child and raises (with
+    its output) when the address never appears — the drill/test form."""
+    proc, lines, url = boot_serve_http(cmd, env, timeout)
+    if url is None:
+        proc.kill()
+        raise RuntimeError(
+            "serve subprocess never reported its address:\n"
+            + "".join(lines))
+    return proc, url

@@ -13,6 +13,14 @@ graceful flush), a fresh server is booted on the same session dir, and
 the pre-restart session's continuation must succeed from the disk tier
 (without it, the continuation fails "unknown session").
 
+Then two single-replica kernel/topology boots, each required to serve
+the SAME greedy tokens as the main boot: `--decode-kernel pallas`
+(interpreter-mode fused window, PR 11) and `--mesh-shards 2` (the
+tensor-parallel mesh engine on 2 VIRTUAL cpu devices via
+XLA_FLAGS=--xla_force_host_platform_device_count — sharding must not
+change a single token, and /metrics keeps its replica-labelled
+families).
+
 Run by tools/verify.sh after the tier-1 gate. CPU, tiny model, pinned
 --decode-window 1 and two prefill buckets to keep the warmup lattice
 (compiled once PER replica) to a few seconds. Exit 0 on PASS, 1 on any
@@ -29,11 +37,9 @@ import argparse
 import glob
 import json
 import os
-import re
 import subprocess
 import sys
 import tempfile
-import threading
 import time
 import urllib.error
 import urllib.request
@@ -43,6 +49,7 @@ _REPO = os.path.dirname(_HERE)
 sys.path.insert(0, _REPO)
 
 from lstm_tensorspark_tpu.obs import parse_exposition  # noqa: E402
+from tools.serve_proc import boot_serve_http  # noqa: E402
 
 _REPLICAS = 2
 _SERVE_ARGS = [
@@ -64,6 +71,19 @@ _PALLAS_ARGS = [
     "--tiered-cache", "off", "--decode-kernel", "pallas",
     "--replicas", "1",
 ]
+# the mesh (tensor-parallel) boot: one replica whose engine shards H
+# over 2 VIRTUAL cpu devices (XLA_FLAGS in _boot's env below) — the
+# sharded engine must serve routed traffic token-identically to the
+# single-device boots and export the same replica-labelled families
+_MESH_SHARDS = 2
+_MESH_ARGS = [
+    "serve", "--http", "--port", "0", "--vocab-size", "31",
+    "--hidden-units", "12", "--num-layers", "1",
+    "--prefill-buckets", "4,8", "--batch-buckets", "1,2",
+    "--decode-window", "4", "--prefix-cache", "off",
+    "--tiered-cache", "off", "--mesh-shards", str(_MESH_SHARDS),
+    "--replicas", "1",
+]
 
 
 def _fail(proc: subprocess.Popen, lines: list[str], why: str) -> int:
@@ -75,27 +95,10 @@ def _fail(proc: subprocess.Popen, lines: list[str], why: str) -> int:
 
 
 def _boot(cmd, env, timeout):
-    """Start a serve subprocess and wait for its address line. Returns
+    """Start a serve subprocess and wait for its address line
+    (tools/serve_proc.py — the shared boot protocol). Returns
     (proc, lines, base-url-or-None)."""
-    proc = subprocess.Popen(cmd, cwd=_REPO, env=env, text=True,
-                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-    lines: list[str] = []
-    url: list[str] = []
-    ready = threading.Event()
-
-    def pump():
-        for line in proc.stdout:
-            lines.append(line)
-            m = re.search(r"serving on (http://[\w.]+:\d+)", line)
-            if m:
-                url.append(m.group(1))
-                ready.set()
-        ready.set()  # EOF: unblock the waiter to report the death
-
-    threading.Thread(target=pump, daemon=True).start()
-    if not ready.wait(timeout) or not url:
-        return proc, lines, None
-    return proc, lines, url[0]
+    return boot_serve_http(cmd, env, timeout)
 
 
 def _generate(base, body: dict, timeout=60):
@@ -242,12 +245,63 @@ def main(argv=None) -> int:
                          "pallas decode-window tokens diverge from the "
                          f"scan window: {preply.get('tokens')} != "
                          f"{reply.get('tokens')}")
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+        # ---- mesh (tensor-parallel) boot on 2 virtual devices ---------
+        # the sharded engine behind the router: routed generate must be
+        # token-identical to the single-device boots, and /metrics must
+        # keep the replica-labelled serve families
+        mesh_env = dict(env)
+        mesh_env["XLA_FLAGS"] = (
+            mesh_env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={_MESH_SHARDS}"
+        ).strip()
+        mesh_cmd = [sys.executable, "-m", "lstm_tensorspark_tpu.cli",
+                    *_MESH_ARGS]
+        proc, lines, base = _boot(mesh_cmd, mesh_env, args.timeout)
+        if base is None:
+            return _fail(proc, lines,
+                         "--mesh-shards server never reported its address")
+        mreply = _generate(base, {"prompt": [1, 2, 3], "max_new_tokens": 4,
+                                  "greedy": True})
+        if mreply.get("tokens") != reply.get("tokens"):
+            return _fail(proc, lines,
+                         f"{_MESH_SHARDS}-shard mesh engine tokens "
+                         f"diverge from the single-device engine: "
+                         f"{mreply.get('tokens')} != {reply.get('tokens')}")
+        if mreply.get("replica") != 0:
+            return _fail(proc, lines,
+                         f"mesh generate reply missing routed replica: "
+                         f"{mreply}")
+        with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+            mstats = json.loads(r.read())
+        if mstats.get("mesh_shards") != _MESH_SHARDS:
+            return _fail(proc, lines,
+                         f"/stats mesh_shards wrong: "
+                         f"{mstats.get('mesh_shards')}")
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            mtext = r.read().decode()
+        try:
+            mfams = parse_exposition(mtext)
+        except ValueError as e:
+            return _fail(proc, lines, f"mesh exposition invalid: {e}")
+        mseen = {labels.get("replica")
+                 for _, labels, _ in mfams["serve_queue_depth"]["samples"]}
+        if "0" not in mseen:
+            return _fail(proc, lines,
+                         f"mesh /metrics replica labels wrong: {mseen}")
 
         print(f"serve_smoke: PASS ({scan_base}: healthz fan-in "
               f"({len(reps)} replicas) + routed generate + stats + "
               f"{len(fams)} metric families validated; kill -9 → restart "
-              f"→ session {sid!r} continued from the disk tier; {base}: "
-              "--decode-kernel pallas boot token-identical)")
+              f"→ session {sid!r} continued from the disk tier; "
+              "--decode-kernel pallas boot token-identical; "
+              f"{base}: {_MESH_SHARDS}-shard mesh boot token-identical "
+              "with replica-labelled metrics)")
         proc.terminate()
         try:
             proc.wait(timeout=10)

@@ -71,20 +71,24 @@ if [ "$gate" -ne 0 ]; then
   exit "$gate"
 fi
 
-# 660 s > the smoke's own worst-case internal budget (2x 180 s boot
-# waits — the restart drill boots twice — + 3x60 s generates + 3x30 s
-# GETs + 30 s checkpoint wait) so its failure diagnostics always print
-# before the outer kill fires
-JAX_PLATFORMS=cpu timeout -k 10 660 python tools/serve_smoke.py
+# 900 s > the smoke's own worst-case internal budget (4x 180 s boot
+# waits — main + restart + pallas + mesh boots — + generates + GETs +
+# 30 s checkpoint wait) so its failure diagnostics always print before
+# the outer kill fires
+JAX_PLATFORMS=cpu timeout -k 10 900 python tools/serve_smoke.py
 smoke=$?
 if [ "$smoke" -ne 0 ]; then
   exit "$smoke"
 fi
 
 # serve chaos drill (sequenced after the smoke — never concurrent with
-# the timed suite): ~30 s measured; 300 s cap covers a loaded CI box.
-# Rewrites BENCH_serve_r04.json in place (the checked-in burst-shedding
-# trajectory datapoint).
-JAX_PLATFORMS=cpu timeout -k 10 300 python tools/chaos_serve.py \
+# the timed suite): ~30 s measured. The 600 s cap covers the host_die
+# phase's worst-case internal budget on a loaded box (180 s replica-host
+# subprocess boot + 30 s checkpoint wait + 15 s retirement wait on top
+# of the ~30 s fault phases) so the drill's failure diagnostics always
+# print before the outer kill fires. Rewrites BENCH_serve_r04.json in
+# place (the checked-in burst-shedding + host-death trajectory
+# datapoint).
+JAX_PLATFORMS=cpu timeout -k 10 600 python tools/chaos_serve.py \
   --json BENCH_serve_r04.json
 exit $?
