@@ -1402,6 +1402,58 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "can stall running sessions' decode (and lifts "
                         "the prompt-length cap). 0 = off (monolithic "
                         "bucketed prefill)")
+    # --- online autotuner (serve/autotune.py) ---
+    p.add_argument("--autotune", type=str, default="off",
+                   choices=["on", "off"],
+                   help="online serve autotuner: a controller thread "
+                        "watches WINDOWED deltas of the live TTFT/ITL/"
+                        "queue-wait histograms + tier occupancy/spill-"
+                        "thrash counters and moves the decode-window "
+                        "cap, the prefill-chunk size, the host-tier "
+                        "bound and the best-effort admission fraction — "
+                        "each within PRE-WARMED bounds, so it can never "
+                        "trigger a mid-traffic compile. Decisions land "
+                        "in /stats 'autotune' + serve_autotune_moves_"
+                        "total{knob,direction}. Needs --telemetry on. "
+                        "'off' (default) = today's static operating "
+                        "point, byte-identical")
+    p.add_argument("--autotune-interval", type=float, default=0.25,
+                   help="seconds between autotuner control windows "
+                        "(each window reads one histogram delta)")
+    p.add_argument("--slo-ms", type=float, default=250.0,
+                   help="the TTFT p99 SLO (ms) the autotuner protects: "
+                        "pressure/headroom thresholds are fractions of "
+                        "it (smaller K / larger chunks as the p99 "
+                        "approaches it; larger K only well below it)")
+    p.add_argument("--autotune-chunks", type=str, default=None,
+                   help="warmed prefill-chunk choice set the autotuner "
+                        "moves --prefill-chunk among (comma list; each "
+                        "entry must satisfy the same bucket/stride "
+                        "constraints as --prefill-chunk). Default: "
+                        "half/base/double of --prefill-chunk, invalid "
+                        "entries dropped. Ignored without "
+                        "--prefill-chunk")
+    p.add_argument("--autotune-host-tier-max", type=int, default=0,
+                   help="ceiling the autoscaler leg may grow "
+                        "--host-tier-entries to under spill thrash "
+                        "(0 = 4x the configured entries)")
+    p.add_argument("--autotune-be-floor", type=float, default=0.1,
+                   help="lowest best-effort admission fraction the "
+                        "autotuner may tighten --best-effort-queue-frac "
+                        "to when the state plane thrashes at its "
+                        "capacity ceiling")
+    # --- per-tenant rate limiting (serve/router.py) ---
+    p.add_argument("--tenant-rate", type=float, default=0,
+                   help="per-tenant token-bucket rate limit (requests/s "
+                        "per distinct 'tenant' request field) on top of "
+                        "the class policy; over-rate requests 429 with "
+                        "an honest Retry-After (time to the next token, "
+                        "floored by the shared queue-drain policy). "
+                        "0 = off; untenanted requests are never limited")
+    p.add_argument("--tenant-burst", type=float, default=5.0,
+                   help="token-bucket burst allowance per tenant "
+                        "(requests that may arrive back-to-back before "
+                        "the rate limit engages)")
     # --- sampling defaults (selftest is always greedy) ---
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=None)
@@ -1416,6 +1468,28 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    choices=["closed", "open"])
     p.add_argument("--rate", type=float, default=None,
                    help="open-loop arrival rate (req/s)")
+    p.add_argument("--arrival", type=str, default="fixed",
+                   choices=["fixed", "burst", "sine"],
+                   help="open-loop arrival shape: 'fixed' = constant "
+                        "--rate; 'burst' = --burst-n simultaneous "
+                        "arrivals every --burst-gap seconds; 'sine' = "
+                        "diurnal-shaped rate --rate*(1+amp*sin(2pi*t/"
+                        "period)) — the phase-shifting workloads the "
+                        "autotuner bench drives")
+    p.add_argument("--arrival-trace", type=str, default=None,
+                   help="open-loop trace replay: a file of sorted "
+                        "seconds-from-start arrival offsets, one per "
+                        "line ('#' comments ignored); a trace shorter "
+                        "than the workload loops, shifted by its span. "
+                        "Overrides --arrival/--rate")
+    p.add_argument("--burst-n", type=int, default=8,
+                   help="--arrival burst: requests per burst")
+    p.add_argument("--burst-gap", type=float, default=0.5,
+                   help="--arrival burst: seconds between burst starts")
+    p.add_argument("--sine-period", type=float, default=2.0,
+                   help="--arrival sine: modulation period (seconds)")
+    p.add_argument("--sine-amp", type=float, default=0.8,
+                   help="--arrival sine: modulation amplitude in [0, 1)")
     p.add_argument("--compare", type=str, default=None,
                    help="closed-loop concurrency sweep levels (default "
                         "1,8; empty string: single run at --sessions)")
@@ -1505,6 +1579,47 @@ def _parse_window_ladder(spec: str) -> tuple[int, ...]:
     return tuple(sorted(
         {1, n} | {k for k in Batcher.DEFAULT_WINDOW_LADDER if k < n}
     ))
+
+
+def _autotune_chunk_choices(args, chunk: int | None) -> tuple[int, ...] | None:
+    """The warmed prefill-chunk choice set the autotuner moves among.
+    Explicit ``--autotune-chunks`` entries must each satisfy the same
+    bucket/stride constraints as ``--prefill-chunk`` (fail fast with the
+    flag's own message); the derived default is half/base/double of the
+    configured chunk with invalid candidates silently dropped. None when
+    chunking is off — the chunk knob stays pinned."""
+    if chunk is None:
+        if args.autotune_chunks:
+            raise SystemExit(
+                "--autotune-chunks needs --prefill-chunk (the knob moves "
+                "among chunk sizes, it cannot turn chunking on)")
+        return None
+    max_bucket = max(_parse_buckets(args.prefill_buckets,
+                                    "--prefill-buckets"))
+
+    def ok(c: int) -> bool:
+        if c < 1 or c > max_bucket:
+            return False
+        return (args.prefix_cache != "on" or c % args.prefix_stride == 0
+                or args.prefix_stride % c == 0)
+
+    if args.autotune_chunks:
+        try:
+            choices = tuple(int(x) for x in args.autotune_chunks.split(",")
+                            if x.strip())
+        except ValueError:
+            raise SystemExit(
+                f"--autotune-chunks: expected comma-separated ints, got "
+                f"{args.autotune_chunks!r}")
+        bad = [c for c in choices if not ok(c)]
+        if not choices or bad:
+            raise SystemExit(
+                f"--autotune-chunks: entries must be in [1, {max_bucket}] "
+                f"and stride-compatible with --prefix-stride "
+                f"{args.prefix_stride}; bad: {bad or 'empty'}")
+        return tuple(sorted(set(choices) | {chunk}))
+    derived = {c for c in (chunk // 2, chunk, chunk * 2) if ok(c)}
+    return tuple(sorted(derived | {chunk}))
 
 
 def _parse_buckets(spec: str, flag: str) -> tuple[int, ...]:
@@ -1684,11 +1799,39 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
         raise SystemExit(
             f"--class-weights: weights must be >= 1, got "
             f"{args.class_weights!r}")
+    autotune_cfg = None
+    chunk_choices = None
+    if getattr(args, "autotune", "off") == "on":
+        if getattr(args, "telemetry", "on") == "off":
+            # the controller steers on the live histograms — a blind
+            # controller would simply never move, which reads like a bug
+            raise SystemExit(
+                "--autotune on needs --telemetry on (the controller "
+                "watches the live serve histograms)")
+        from .serve import AutoTuneConfig
+
+        if args.autotune_interval <= 0:
+            raise SystemExit(
+                f"--autotune-interval must be > 0, got "
+                f"{args.autotune_interval}")
+        if args.slo_ms <= 0:
+            raise SystemExit(f"--slo-ms must be > 0, got {args.slo_ms}")
+        chunk_choices = _autotune_chunk_choices(args, chunk)
+        autotune_cfg = AutoTuneConfig(
+            interval_s=args.autotune_interval,
+            slo_s=args.slo_ms / 1e3,
+            host_tier_max=args.autotune_host_tier_max or None,
+            best_effort_floor=args.autotune_be_floor,
+        )
     server = ServeServer(engines if n_replicas > 1 else engines[0],
                          max_active=args.max_active,
                          queue_size=args.queue_size,
                          window_ladder=_parse_window_ladder(args.decode_window),
                          prefill_chunk=args.prefill_chunk or None,
+                         prefill_chunk_choices=chunk_choices,
+                         autotune=autotune_cfg,
+                         tenant_rate=getattr(args, "tenant_rate", 0) or None,
+                         tenant_burst=getattr(args, "tenant_burst", 5.0),
                          class_weights=(wp, wb),
                          health_stale_after=args.replica_stale_s,
                          best_effort_queue_frac=args.best_effort_queue_frac,
@@ -1784,6 +1927,10 @@ def _serve_loadgen(args) -> int:
               f"< --prompt-len {args.prompt_len} (each prompt needs >= 1 "
               "unshared token)", file=sys.stderr)
         return 2
+    if (args.arrival != "fixed" or args.arrival_trace) and args.mode != "open":
+        print("error: --arrival burst/sine and --arrival-trace shape "
+              "OPEN-loop arrivals; add --mode open", file=sys.stderr)
+        return 2
     kernels = _parse_decode_kernels(args.decode_kernel)
     replica_levels = _parse_replicas(args.replicas)
     if len(kernels) > 1:
@@ -1848,6 +1995,10 @@ def _serve_loadgen(args) -> int:
                 priority_frac=args.priority_frac,
                 deadline_s=args.deadline_s or None,
                 retry_max=args.retry_max,
+                arrival=args.arrival,
+                arrival_times=_read_arrival_trace(args.arrival_trace),
+                burst_n=args.burst_n, burst_gap_s=args.burst_gap,
+                sine_period_s=args.sine_period, sine_amp=args.sine_amp,
             )
     # aggregate across replicas — a --replicas N run spreads traffic, and
     # replica-0-only counters would silently halve every number vs /stats
@@ -1912,6 +2063,32 @@ def _serve_loadgen(args) -> int:
             json.dump(out, f, indent=1, sort_keys=True)
         print(f"loadgen: report written to {args.json}", file=sys.stderr)
     return 0
+
+
+def _read_arrival_trace(path: str | None) -> list[float] | None:
+    """``--arrival-trace``: sorted seconds-from-start offsets, one float
+    per line, blank lines and '#' comments ignored (loadgen validates
+    ordering/sign so a bad trace fails with its own message)."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        raise SystemExit(f"--arrival-trace: cannot read {path!r}: {e}")
+    out: list[float] = []
+    for ln in lines:
+        ln = ln.split("#", 1)[0].strip()
+        if not ln:
+            continue
+        try:
+            out.append(float(ln))
+        except ValueError:
+            raise SystemExit(
+                f"--arrival-trace: bad offset {ln!r} in {path!r}")
+    if not out:
+        raise SystemExit(f"--arrival-trace: {path!r} has no offsets")
+    return out
 
 
 def _serve_loadgen_longtail(args, n_replicas: int) -> int:

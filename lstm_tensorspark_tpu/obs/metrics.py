@@ -273,6 +273,62 @@ class _Family:
         return {_labelstr(residual, rkey): self._merge_instances(insts)
                 for rkey, insts in groups.items()}
 
+    def snapshot_delta(self, cursor: dict | None = None):
+        """Windowed (delta-since-``cursor``) view of this family, merged
+        across every labelled child. Returns ``(view, new_cursor)`` —
+        pass the returned cursor back to the next call to advance the
+        window; ``None`` means "since registration".
+
+        The registry's instruments are CUMULATIVE over the process life,
+        so any consumer reacting to ``summary()`` reacts to boot-time
+        history: a controller watching lifetime p99s would still see
+        yesterday's burst. The delta view subtracts the cursor's bucket
+        counts per child before merging, so the estimated quantiles
+        describe ONLY the samples recorded inside the window — the
+        recent-biased signal the serve autotuner steers on.
+
+        View shapes: histograms → ``{count, sum[, p50, p99]}`` over the
+        delta distribution; counters → the float increment over the
+        window; gauges → the current summed level (a gauge is a level,
+        not a flow — there is no meaningful delta). Each consumer holds
+        its own cursor, so independent readers never reset each other
+        (unlike a read-and-clear API)."""
+        children = self.children()
+        prev = cursor or {}
+        new_cursor: dict = {}
+        if self.kind == "histogram":
+            bounds = tuple(float(b) for b in
+                           (self._buckets or DEFAULT_LATENCY_BUCKETS))
+            merged = [0] * (len(bounds) + 1)
+            msum, mtotal = 0.0, 0
+            for key, inst in children:
+                counts, s, total = inst.snapshot()
+                new_cursor[key] = (list(counts), s, total)
+                pc = prev.get(key)
+                if pc is not None:
+                    counts = [a - b for a, b in zip(counts, pc[0])]
+                    s -= pc[1]
+                    total -= pc[2]
+                merged = [a + b for a, b in zip(merged, counts)]
+                msum += s
+                mtotal += total
+            out: dict = {"count": mtotal, "sum": round(msum, 6)}
+            if mtotal:
+                out["p50"] = round(
+                    _estimate_quantile(bounds, merged, mtotal, 0.5), 6)
+                out["p99"] = round(
+                    _estimate_quantile(bounds, merged, mtotal, 0.99), 6)
+            return out, new_cursor
+        total = 0.0
+        for key, inst in children:
+            v = inst.value
+            new_cursor[key] = v
+            if self.kind == "counter":
+                total += v - prev.get(key, 0.0)
+            else:  # gauge: a level, reported as-is
+                total += v
+        return total, new_cursor
+
     # -- label-less convenience (delegates to the anonymous child) -------
 
     def inc(self, amount: float = 1.0) -> None:
@@ -472,6 +528,12 @@ class _NullInstrument:
         # mirrors _Family.aggregate_over for disabled telemetry: the
         # router reads the queue-wait p99 through this to size Retry-After
         return {}
+
+    def snapshot_delta(self, cursor: dict | None = None):
+        # mirrors _Family.snapshot_delta: a histogram-shaped empty window
+        # — a controller on a --telemetry off stack sees zero traffic and
+        # never moves a knob (the CLI refuses the combination anyway)
+        return {"count": 0, "sum": 0.0}, {}
 
     @property
     def value(self) -> float:

@@ -46,6 +46,13 @@ into a serving engine:
   ``/replica/has_session``), so the admission router becomes a
   front-of-fleet tier and replica death generalises to host death
   (kept sessions fail over through the shared ``--session-dir`` tier);
+- ``autotune``: the online serve autotuner (``--autotune on``) — a
+  controller thread over windowed telemetry deltas that moves the
+  decode-window cap, the prefill-chunk size, the host-tier bound and
+  the best-effort admission fraction within pre-warmed bounds (it can
+  never trigger a mid-traffic compile), with hysteresis so flat
+  workloads never oscillate; decisions exported via ``/stats``
+  ``autotune`` + ``serve_autotune_moves_total{knob,direction}``;
 - ``server``: stdlib ThreadingHTTPServer JSON endpoint + in-process
   client over the replica set, with ``GET /metrics`` Prometheus
   exposition of the stack's telemetry registry (obs/, ``replica``-
@@ -67,6 +74,7 @@ CLI: ``python -m lstm_tensorspark_tpu.cli serve --selftest`` (see cli.py).
 """
 
 from .state_cache import CacheFullError, PrefixCache, SessionTiers, StateCache
+from .autotune import AutoTuneConfig, AutoTuner
 from .engine import PAD_TOKEN, DecodeWindow, SamplingParams, ServeEngine
 from .batcher import (
     CLASSES,
@@ -81,6 +89,8 @@ from .server import InprocessClient, ServeServer
 from .loadgen import mesh_sweep, replica_sweep, run_loadgen, run_longtail
 
 __all__ = [
+    "AutoTuneConfig",
+    "AutoTuner",
     "Batcher",
     "CLASSES",
     "CacheFullError",

@@ -127,21 +127,28 @@ def register_shed_instruments(reg):
     """Resolve the shed instruments both admission layers record into —
     one registration site, so the name/labels/help can never drift
     between the router and the batcher (metrics-consistency). Returns
-    ``(shed_by_class, retry_after_histogram)``."""
+    ``(shed_by_class, tenant_shed_by_class, retry_after_histogram)`` —
+    ``tenant_limited="yes"`` children count the router's per-tenant
+    token-bucket 429s, ``"no"`` the capacity sheds."""
     fam = reg.counter(
         "serve_shed_total",
         "429 sheds by admission class (best_effort sheds at its "
-        "smaller queue bound while priority keeps the headroom)",
-        labelnames=("class",))
+        "smaller queue bound while priority keeps the headroom); "
+        "tenant_limited=yes marks per-tenant token-bucket rejections",
+        labelnames=("class", "tenant_limited"))
     # "class" is a Python keyword, so the kwarg must go through ** —
     # which the analyzer cannot resolve against the registration
     # graftlint: disable=metrics-consistency
-    shed = {c: fam.labels(**{"class": c}) for c in CLASSES}
+    shed = {c: fam.labels(**{"class": c, "tenant_limited": "no"})
+            for c in CLASSES}
+    # graftlint: disable=metrics-consistency
+    tenant_shed = {c: fam.labels(**{"class": c, "tenant_limited": "yes"})
+                   for c in CLASSES}
     retry_hist = reg.histogram(
         "serve_retry_after_seconds",
         "Retry-After hints attached to 429 sheds, computed from the "
         "live queue-wait p99 (drain estimate, not a fixed constant)")
-    return shed, retry_hist
+    return shed, tenant_shed, retry_hist
 
 
 class QueueFullError(RuntimeError):
@@ -186,6 +193,7 @@ class Request:
         use_prefix: bool = True,
         klass: str = "priority",
         deadline_s: float | None = None,
+        tenant: str | None = None,
     ):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
@@ -214,6 +222,14 @@ class Request:
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         self.deadline_s = deadline_s
+        # per-tenant rate limiting (serve/router.py): the token-bucket
+        # identity. None = untenanted traffic, never rate-limited.
+        if tenant is not None:
+            tenant = str(tenant)
+            if not tenant or len(tenant) > 256:
+                raise ValueError(
+                    "tenant must be a non-empty string of <= 256 chars")
+        self.tenant = tenant
         # absolute perf_counter deadline, stamped at FIRST submission so
         # the budget covers queue wait; a requeued request (replica
         # death) keeps its original deadline — the client's budget does
@@ -349,6 +365,7 @@ class Batcher:
         queue_size: int = 64,
         window_ladder: tuple[int, ...] = DEFAULT_WINDOW_LADDER,
         prefill_chunk: int | None = None,
+        prefill_chunk_choices: tuple[int, ...] | None = None,
         class_weights: tuple[int, int] = DEFAULT_CLASS_WEIGHTS,
     ):
         if max_active < 1:
@@ -365,25 +382,19 @@ class Batcher:
             raise ValueError(
                 f"window_ladder needs positive window sizes, got "
                 f"{window_ladder!r}")
-        if prefill_chunk is not None and prefill_chunk < 1:
-            raise ValueError(
-                f"prefill_chunk must be >= 1 or None, got {prefill_chunk}")
-        if prefill_chunk is not None and prefill_chunk > engine.max_prompt_len:
-            raise ValueError(
-                f"prefill_chunk {prefill_chunk} exceeds the largest prefill "
-                f"bucket {engine.max_prompt_len} — each chunk is one bucketed "
-                "program")
-        if (prefill_chunk is not None and engine.prefix is not None
-                and prefill_chunk % engine.prefix.stride != 0
-                and engine.prefix.stride % prefill_chunk != 0):
-            # _stop_from stride-aligns every pre-boundary stop, so an
-            # incompatible chunk is silently truncated each dispatch — the
-            # operator gets a smaller effective chunk than configured
-            raise ValueError(
-                f"prefill_chunk {prefill_chunk} is not a multiple or divisor "
-                f"of prefix stride {engine.prefix.stride} — chunks would be "
-                "truncated to stride alignment; pick a compatible chunk or "
-                "disable the prefix cache")
+        self._validate_chunk(prefill_chunk, engine)
+        if prefill_chunk_choices:
+            if prefill_chunk is None:
+                # the choice set is the autotuner's movement range for an
+                # ALREADY-chunked scheduler; flipping None↔int at runtime
+                # would also flip submit()'s prompt-length admission rule
+                # under a client's feet
+                raise ValueError(
+                    "prefill_chunk_choices needs prefill_chunk set (the "
+                    "knob moves among chunk sizes, it cannot turn "
+                    "chunking on or off)")
+            for c in prefill_chunk_choices:
+                self._validate_chunk(int(c), engine)
         if (len(class_weights) != len(CLASSES)
                 or any(int(w) < 1 for w in class_weights)):
             raise ValueError(
@@ -401,7 +412,19 @@ class Batcher:
         self.max_active = max_active
         self.queue_size = queue_size
         self.window_ladder = ladder
+        # live ceiling on the adaptive window pick — the serve
+        # autotuner's K knob. Always a ladder rung (set_window_cap
+        # validates), so every reachable window size is warmup-covered;
+        # the default (the top rung) is exactly the pre-knob behavior.
+        self.window_cap = ladder[-1]
         self.prefill_chunk = prefill_chunk
+        # warmed chunk sizes the autotuner may move prefill_chunk among
+        # (set_prefill_chunk refuses anything else; warmup() replays the
+        # stop sequence for EVERY choice so no pick compiles mid-traffic)
+        self.prefill_chunk_choices = (
+            tuple(sorted({int(c) for c in prefill_chunk_choices}
+                         | {prefill_chunk}))
+            if prefill_chunk_choices else ())
         # admitted sessions still consuming their prompt (FIFO; owned by
         # the scheduler thread — the lock only covers reads from stats())
         self._prefilling: list[_Prefilling] = []
@@ -519,25 +542,31 @@ class Batcher:
         # replica's own queue filling on the affinity path while the
         # router's non-stale sum stays low) — those 429s must carry the
         # same Retry-After + shed accounting as the router's (one shared
-        # registration + one shared policy, so the layers cannot drift)
-        self._m_shed, self._m_retry_after = register_shed_instruments(reg)
+        # registration + one shared policy, so the layers cannot drift).
+        # The tenant-limited children are the router's (rate limiting
+        # lives above routing); the batcher only sheds on capacity.
+        self._m_shed, _, self._m_retry_after = register_shed_instruments(reg)
 
     # ---- client side ---------------------------------------------------
 
     def submit(self, req: Request) -> None:
         """Enqueue a request, or raise :class:`QueueFullError` (bounded
         queue — the backpressure boundary)."""
-        if (self.prefill_chunk is None
-                and req.prompt.size > self.engine.max_prompt_len):
-            # chunked prefill lifts this cap: any prompt length is consumed
-            # prefill_chunk tokens per dispatch, so no single program ever
-            # exceeds the bucket lattice
-            raise ValueError(
-                f"prompt length {req.prompt.size} exceeds the engine's "
-                f"largest prefill bucket {self.engine.max_prompt_len} "
-                "(enable prefill_chunk to serve longer prompts)"
-            )
         with self._lock:
+            # under the lock: prefill_chunk is a live knob
+            # (set_prefill_chunk) — though only its None-ness matters
+            # here, and the autotuner can never flip that
+            if (self.prefill_chunk is None
+                    and req.prompt.size > self.engine.max_prompt_len):
+                # chunked prefill lifts this cap: any prompt length is
+                # consumed prefill_chunk tokens per dispatch, so no
+                # single program ever exceeds the bucket lattice
+                raise ValueError(
+                    f"prompt length {req.prompt.size} exceeds the "
+                    f"engine's largest prefill bucket "
+                    f"{self.engine.max_prompt_len} "
+                    "(enable prefill_chunk to serve longer prompts)"
+                )
             if self._qlen_locked() >= self.queue_size:
                 # same honest-429 contract as the router's shed path:
                 # Retry-After from the measured queue wait, counted under
@@ -598,6 +627,58 @@ class Batcher:
         with self._lock:
             return (self._qlen_locked() + len(self._active)
                     + len(self._prefilling))
+
+    # ---- live knobs (serve/autotune.py; bounded by the warmed lattice) -
+
+    @staticmethod
+    def _validate_chunk(chunk: int | None, engine: ServeEngine) -> None:
+        if chunk is None:
+            return
+        if chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 or None, got {chunk}")
+        if chunk > engine.max_prompt_len:
+            raise ValueError(
+                f"prefill_chunk {chunk} exceeds the largest prefill "
+                f"bucket {engine.max_prompt_len} — each chunk is one "
+                "bucketed program")
+        if (engine.prefix is not None
+                and chunk % engine.prefix.stride != 0
+                and engine.prefix.stride % chunk != 0):
+            # _stop_from stride-aligns every pre-boundary stop, so an
+            # incompatible chunk is silently truncated each dispatch —
+            # the operator gets a smaller effective chunk than configured
+            raise ValueError(
+                f"prefill_chunk {chunk} is not a multiple or divisor "
+                f"of prefix stride {engine.prefix.stride} — chunks would "
+                "be truncated to stride alignment; pick a compatible "
+                "chunk or disable the prefix cache")
+
+    def set_window_cap(self, k: int) -> None:
+        """Move the decode-window ceiling to ladder rung ``k`` (the
+        autotuner's K knob). Only warmed rungs are accepted — the
+        controller can NEVER select a window size that would compile
+        mid-traffic. Takes effect at the next ``_pick_window``."""
+        if k not in self.window_ladder:
+            raise ValueError(
+                f"window cap {k} is not a warmed ladder rung "
+                f"{self.window_ladder} — an off-ladder window would "
+                "compile mid-traffic")
+        with self._lock:
+            self.window_cap = int(k)
+
+    def set_prefill_chunk(self, chunk: int) -> None:
+        """Move the prefill chunk size to ``chunk`` (the autotuner's
+        chunk knob). Only members of the warmed ``prefill_chunk_choices``
+        set are accepted — warmup() replayed the stop sequence for every
+        choice, so no pick dispatches an uncompiled program."""
+        if chunk not in self.prefill_chunk_choices:
+            raise ValueError(
+                f"prefill_chunk {chunk} is not in the warmed choice set "
+                f"{self.prefill_chunk_choices} — an unwarmed chunk would "
+                "compile mid-traffic")
+        with self._lock:
+            self.prefill_chunk = int(chunk)
 
     # ---- replica retirement (router-driven; see serve/router.py) -------
     #
@@ -841,26 +922,37 @@ class Batcher:
 
     # ---- prefill scheduling (chunked + prefix-resumed; see module doc) --
 
-    def _next_stop(self, p: _Prefilling) -> int:
+    def _next_stop(self, p: _Prefilling,
+                   chunk: int | None = None) -> int:
         """Prompt position the next dispatch advances ``p`` to: the prompt
         end, capped by the chunk size. With the prefix cache on, stops are
         stride-ALIGNED: every stop is a potential (deduped) insert point,
         so chunked prefill caches a shared prefix at block granularity —
         and without chunking, the single split lands at the largest stride
         boundary (the state after ``prompt[:k]`` must exist in the
-        session's own slot for the one-copy insert)."""
+        session's own slot for the one-copy insert). ``chunk`` pins the
+        chunk size for one scheduler iteration — a live knob move
+        (set_prefill_chunk) must land BETWEEN iterations, never between
+        a batch's dispatch and its ``pos`` bookkeeping."""
         # opt-out requests never insert, so never pay the insert-boundary
         # split either — their prefill is the plain monolithic/chunked one
         return self._stop_from(p.pos, p.sess.req.prompt.size,
-                               p.was_fresh and p.sess.req.use_prefix)
+                               p.was_fresh and p.sess.req.use_prefix,
+                               chunk=(self.prefill_chunk if chunk is None
+                                      else chunk))
 
-    def _stop_from(self, pos: int, total: int, fresh: bool) -> int:
+    def _stop_from(self, pos: int, total: int, fresh: bool,
+                   chunk: int | None = None) -> int:
         """Pure arithmetic core of :meth:`_next_stop` — also replayed by
         :meth:`warmup` to enumerate the exact program lengths this
-        scheduler will dispatch for a prompt length."""
+        scheduler will dispatch for a prompt length. ``chunk`` overrides
+        the live ``prefill_chunk`` (warmup replays the stop sequence for
+        every entry of the autotuner's choice set)."""
+        if chunk is None:
+            chunk = self.prefill_chunk
         stop = total
-        if self.prefill_chunk is not None:
-            stop = min(stop, pos + self.prefill_chunk)
+        if chunk is not None:
+            stop = min(stop, pos + chunk)
         if self.engine.prefix is not None and fresh:
             k = self.engine.prefix.boundary(total)
             if pos < k:
@@ -868,7 +960,7 @@ class Batcher:
                 # dispatch, and keep chunk stops stride-aligned — every
                 # stop is then an insert point
                 stop = min(stop, k)
-                if self.prefill_chunk is not None:
+                if chunk is not None:
                     aligned = (stop // self.engine.prefix.stride
                                ) * self.engine.prefix.stride
                     if aligned > pos:
@@ -890,47 +982,67 @@ class Batcher:
         finals: set[int] = set()
         chunks: set[int] = set()
         prefix = self.engine.prefix
+        # every chunk size the scheduler can EVER run with: the live one
+        # (read under the lock — it is a knob now) plus the autotuner's
+        # whole choice set. The walk is a CLOSURE over choice MIXES, not
+        # a per-choice replay: a knob move lands between scheduler
+        # iterations, so one prompt's chunks may use different sizes —
+        # e.g. chunk 16 then 32 on a 48-token prompt dispatches a
+        # 32-length FINAL that neither pure-16 nor pure-32 replay ever
+        # produces. Every position reachable under ANY mix is expanded
+        # with EVERY choice, or the first mid-prompt knob move compiles
+        # mid-traffic (caught by the bench's zero-compile assert).
+        with self._lock:
+            live_chunk = self.prefill_chunk
+        chunk_values = sorted({live_chunk} | set(self.prefill_chunk_choices),
+                              key=lambda c: (c is None, c))
         for t in prompt_lens:
             t = max(1, int(t))
             # (start position, was_fresh) dispatch sequences to replay —
-            # longest-match lookup can resume from ANY stride multiple up
-            # to boundary(t), not just the full boundary, so every such
-            # start must be replayed or a partial hit's remainder length
-            # dispatches an unwarmed program
-            starts = {(0, True), (0, False)}
+            # longest-match lookup can resume from ANY stride multiple
+            # up to boundary(t), not just the full boundary, so every
+            # such start must be replayed or a partial hit's remainder
+            # length dispatches an unwarmed program
+            stack = [(0, True), (0, False)]
             if prefix is not None:
                 for k in range(prefix.stride, prefix.boundary(t) + 1,
                                prefix.stride):
-                    starts.add((k, True))
-            # _stop_from is pure in (pos, fresh) for a given t, so every
-            # start's chain merges onto positions already walked — stop at
-            # the first visited one or replay is O(t^2/(stride*chunk))
+                    stack.append((k, True))
+            # _stop_from is pure in (pos, fresh, chunk) for a given t,
+            # so the BFS visits each (pos, fresh) once — bounded by
+            # t/min_chunk * |choices| expansions
             seen: set[tuple[int, bool]] = set()
-            for pos, fresh in starts:
-                while pos < t and (pos, fresh) not in seen:
-                    seen.add((pos, fresh))
-                    stop = self._stop_from(pos, t, fresh)
+            while stack:
+                pos, fresh = stack.pop()
+                if pos >= t or (pos, fresh) in seen:
+                    continue
+                seen.add((pos, fresh))
+                for chunk in chunk_values:
+                    stop = self._stop_from(pos, t, fresh, chunk=chunk)
                     (finals if stop >= t else chunks).add(stop - pos)
-                    pos = stop
+                    if stop < t:
+                        stack.append((stop, fresh))
         return self.engine.warmup(
             sampling, prompt_lens=tuple(sorted(finals)),
             windows=self.window_ladder,
             chunk_lens=tuple(sorted(chunks)))
 
-    def _select_prefill_batch(self) -> tuple[list[_Prefilling], bool]:
+    def _select_prefill_batch(
+            self, chunk: int | None) -> tuple[list[_Prefilling], bool]:
         """FIFO-fair batch selection: the HEAD of the prefilling list
         always progresses (a stream of short prompts cannot starve a long
         prompt's chunks); compatible rows ride along — same phase
         (final/intermediate), and for finals the same sampling config
         (intermediate chunks are sampling-free programs)."""
         head = self._prefilling[0]
-        final = self._next_stop(head) >= head.sess.req.prompt.size
+        final = self._next_stop(head, chunk) >= head.sess.req.prompt.size
         skey = head.sess.req.sampling.key()
         batch = []
         for p in self._prefilling:
             if len(batch) >= self.engine.max_batch:
                 break
-            if (self._next_stop(p) >= p.sess.req.prompt.size) != final:
+            if (self._next_stop(p, chunk)
+                    >= p.sess.req.prompt.size) != final:
                 continue
             if final and p.sess.req.sampling.key() != skey:
                 continue
@@ -945,6 +1057,11 @@ class Batcher:
         running sessions by one chunk's latency per token."""
         if not self._prefilling:
             return False
+        # ONE chunk-size read per scheduler iteration: selection, the
+        # dispatched slice, and the pos bookkeeping below must all agree
+        # even while the autotuner moves the knob from its own thread —
+        # a move lands between iterations, never inside one
+        chunk = self.prefill_chunk
         now = time.perf_counter()
         for p in list(self._prefilling):
             if p.sess.req.cancelled:
@@ -954,17 +1071,18 @@ class Batcher:
                 # stop burning chunk dispatches on a dead deadline
                 self._abort_prefilling(p, None, timeout=True)
         while self._prefilling:
-            batch, final = self._select_prefill_batch()
-            self._dispatch_prefill(batch, final)
-            if self.prefill_chunk is not None:
+            batch, final = self._select_prefill_batch(chunk)
+            self._dispatch_prefill(batch, final, chunk)
+            if chunk is not None:
                 break  # one bounded dispatch per scheduler iteration
         return True
 
-    def _dispatch_prefill(self, batch: list[_Prefilling], final: bool) -> None:
+    def _dispatch_prefill(self, batch: list[_Prefilling], final: bool,
+                          chunk: int | None = None) -> None:
         prefix = self.engine.prefix
         items = []
         for p in batch:
-            stop = self._next_stop(p)
+            stop = self._next_stop(p, chunk)
             # stride-aligned insert point: the state after prompt[:pos]
             # sits in the session's own slot — one O(1) device copy caches
             # it for every future sharer (insert() dedups existing keys
@@ -1007,7 +1125,7 @@ class Batcher:
                 prefix.release(p.entry)
                 p.entry = None
             if not final:
-                p.pos = self._next_stop(p)
+                p.pos = self._next_stop(p, chunk)
                 continue
             with self._lock:
                 self._prefilling.remove(p)
@@ -1119,10 +1237,13 @@ class Batcher:
         """Largest ladder rung no session would overshoot (a session
         within K tokens of its budget forces a smaller K — the on-device
         budget latch makes overshoot SAFE, this just keeps windows from
-        decoding padding and delaying completion)."""
+        decoding padding and delaying completion), additionally capped
+        by ``window_cap`` — the autotuner's live K ceiling (default: the
+        top rung, i.e. exactly the uncapped pick)."""
         k = 1
+        cap = self.window_cap
         for w in self.window_ladder:
-            if w <= min_remaining:
+            if w <= min_remaining and w <= cap:
                 k = max(k, w)
         return k
 
@@ -1385,6 +1506,7 @@ class Batcher:
             queued_by_class = {c: len(q) for c, q in self._queues.items()}
             prefilling = len(self._prefilling)
             submitted, rejected = self.submitted, self.rejected
+            window_cap, prefill_chunk = self.window_cap, self.prefill_chunk
         return {
             "replica": self.replica,
             "submitted": submitted,
@@ -1401,9 +1523,11 @@ class Batcher:
             "max_active": self.max_active,
             "queue_size": self.queue_size,
             "window_ladder": list(self.window_ladder),
+            "window_cap": window_cap,
             "windows_dispatched": dict(self.windows_dispatched),
             "windows_pipelined": self.windows_pipelined,
-            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunk": prefill_chunk,
+            "prefill_chunk_choices": list(self.prefill_chunk_choices),
             "prefill_chunks_dispatched": self.prefill_chunks_dispatched,
             "prefix_resumed": self.prefix_resumed,
             "prefix_tokens_saved": self.prefix_tokens_saved,

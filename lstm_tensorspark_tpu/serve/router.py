@@ -116,9 +116,16 @@ class Replica:
 class Router:
     """Admission front for a set of replicas (module docstring)."""
 
+    #: tenant token-bucket table cap: beyond this, fully-refilled buckets
+    #: (indistinguishable from absent ones) are pruned — an adversarial
+    #: stream of fresh tenant names cannot grow router memory unboundedly
+    MAX_TENANT_BUCKETS = 4096
+
     def __init__(self, replicas: list[Replica], *, queue_size: int = 64,
                  stale_after: float = 60.0,
-                 best_effort_frac: float = 0.5, registry=None):
+                 best_effort_frac: float = 0.5, registry=None,
+                 tenant_rate: float | None = None,
+                 tenant_burst: float = 5.0):
         if not replicas:
             raise ValueError("router needs at least one replica")
         if queue_size < 1:
@@ -126,8 +133,22 @@ class Router:
         if not 0.0 < best_effort_frac <= 1.0:
             raise ValueError(
                 f"best_effort_frac must be in (0, 1], got {best_effort_frac}")
+        if tenant_rate is not None and tenant_rate <= 0:
+            raise ValueError(
+                f"tenant_rate must be > 0 req/s or None, got {tenant_rate}")
+        if tenant_burst < 1:
+            raise ValueError(
+                f"tenant_burst must be >= 1, got {tenant_burst}")
         self.replicas = list(replicas)
         self.queue_size = queue_size
+        # per-tenant token buckets (requests/s with a burst allowance) on
+        # TOP of the class policy: one tenant flooding the fleet is
+        # rate-limited before it can consume the shared queue bound the
+        # other tenants' traffic lives under. None = no per-tenant
+        # limiting (requests without a tenant field are never limited).
+        self.tenant_rate = tenant_rate
+        self.tenant_burst = float(tenant_burst)
+        self._tenant_buckets: dict[str, list] = {}  # tenant -> [tokens, t]
         # SLO-aware shedding: best-effort requests are 429'd once the
         # live queue reaches this smaller bound, so a best-effort burst
         # sheds while the priority class keeps the remaining headroom —
@@ -151,6 +172,7 @@ class Router:
         self._stopping = True
         self.rejected = 0            # global-bound 429s
         self.shed = {c: 0 for c in CLASSES}  # 429s by admission class
+        self.tenant_limited = {c: 0 for c in CLASSES}  # token-bucket 429s
         self.requeued = 0            # dead-replica queue → live replica
         self.failed_on_death = 0     # in-flight requests failed honestly
         self.migrated_sessions = 0   # idle kept sessions detach/restored
@@ -185,8 +207,11 @@ class Router:
             "idle kept sessions moved off dead replicas via detach/restore")
         # shared with the batcher's own queue bound: one registration
         # site + one policy function, so the two layers can never hint
-        # different Retry-After curves for the same queue state
-        self._m_shed, self._m_retry_after = register_shed_instruments(reg)
+        # different Retry-After curves for the same queue state; the
+        # tenant_limited="yes" children count this router's per-tenant
+        # token-bucket 429s
+        (self._m_shed, self._m_tenant_shed,
+         self._m_retry_after) = register_shed_instruments(reg)
         # the live queue-wait histogram family (registered by the
         # batchers, same name/labels/buckets — idempotent): its p99 IS
         # the drain-time evidence Retry-After is computed from
@@ -214,6 +239,19 @@ class Router:
             if not live:
                 raise RuntimeError(
                     "no live replica schedulers (all replicas dead)")
+            # per-tenant token bucket FIRST: a rate-limited tenant is
+            # rejected before it can consume the shared queue bound the
+            # other tenants' traffic lives under
+            if self.tenant_rate is not None and req.tenant is not None:
+                retry = self._tenant_take_locked(req.tenant)
+                if retry is not None:
+                    self.tenant_limited[req.klass] += 1
+                    self._m_tenant_shed[req.klass].inc()
+                    self._m_retry_after.observe(retry)
+                    raise QueueFullError(
+                        f"tenant {req.tenant!r} exceeded its "
+                        f"{self.tenant_rate:g} req/s rate limit; retry "
+                        f"after {retry:.2f}s", retry_after_s=retry)
             # the bound covers NON-STALE queues only: a wedged replica
             # never drains (its admission loop is stuck), so counting its
             # stranded entries would permanently shrink the fleet's
@@ -236,6 +274,64 @@ class Router:
                     f"({queued} pending >= bound {bound}); retry after "
                     f"{retry:.2f}s", retry_after_s=retry)
             self._dispatch_locked(req, live)
+
+    def _tenant_take_locked(self, tenant: str) -> float | None:
+        """Take one token from ``tenant``'s bucket. Returns None when a
+        token was available (request admitted to the normal policy), or
+        the honest Retry-After: the time until the bucket accrues a
+        token, floored by the shared queue-drain policy
+        (:func:`~.batcher.retry_after_from_p99`) so a rate-limited
+        client never retries into a congested queue faster than a shed
+        one would."""
+        now = time.monotonic()
+        bucket = self._tenant_buckets.get(tenant)
+        if bucket is None:
+            if len(self._tenant_buckets) >= self.MAX_TENANT_BUCKETS:
+                # prune fully-refilled buckets — indistinguishable from
+                # absent ones, so dropping them changes no verdict
+                full = [t for t, (tok, ts) in self._tenant_buckets.items()
+                        if tok + (now - ts) * self.tenant_rate
+                        >= self.tenant_burst]
+                for t in full:
+                    del self._tenant_buckets[t]
+                while len(self._tenant_buckets) >= self.MAX_TENANT_BUCKETS:
+                    # nothing prunable (a flood of FRESH tenant names
+                    # faster than the refill rate): evict the fullest
+                    # bucket — the closest to indistinguishable-from-
+                    # absent, so dropping it perturbs verdicts least.
+                    # The cap is a hard bound, not a hint: without this
+                    # the table grows with attacker send rate.
+                    victim = max(
+                        self._tenant_buckets,
+                        key=lambda t: self._tenant_buckets[t][0]
+                        + (now - self._tenant_buckets[t][1])
+                        * self.tenant_rate)
+                    del self._tenant_buckets[victim]
+            bucket = self._tenant_buckets[tenant] = [self.tenant_burst, now]
+        tokens = min(self.tenant_burst,
+                     bucket[0] + (now - bucket[1]) * self.tenant_rate)
+        bucket[1] = now
+        if tokens >= 1.0:
+            bucket[0] = tokens - 1.0
+            return None
+        bucket[0] = tokens
+        deficit = (1.0 - tokens) / self.tenant_rate
+        agg = self._qwait.aggregate_over("replica")
+        s = agg.get("") or {}
+        return max(deficit, retry_after_from_p99(s.get("p99"), 0.0))
+
+    def set_best_effort_frac(self, frac: float) -> None:
+        """Move the best-effort shed bound at runtime — the autotuner's
+        admission knob (tightened when the state plane thrashes at its
+        capacity ceiling, relaxed back toward the configured policy when
+        the pressure clears). Same validation as construction."""
+        if not 0.0 < frac <= 1.0:
+            raise ValueError(
+                f"best_effort_frac must be in (0, 1], got {frac}")
+        with self._lock:
+            self.best_effort_frac = float(frac)
+            self._best_effort_bound = max(
+                1, int(round(self.queue_size * frac)))
 
     def _retry_after_locked(self, queued: int) -> float:
         """Honest Retry-After (seconds) for a shed: the fleet's queue-wait
@@ -491,7 +587,10 @@ class Router:
                            for k, v in sorted(self.routed.items())},
                 "rejected": self.rejected,
                 "shed_by_class": dict(self.shed),
+                "tenant_limited": dict(self.tenant_limited),
+                "tenant_rate": self.tenant_rate,
                 "best_effort_bound": self._best_effort_bound,
+                "best_effort_frac": self.best_effort_frac,
                 "requeued": self.requeued,
                 "failed_on_death": self.failed_on_death,
                 "migrated_sessions": self.migrated_sessions,

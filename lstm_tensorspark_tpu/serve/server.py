@@ -97,7 +97,10 @@ class ServeServer:
                  best_effort_queue_frac: float = 0.5,
                  deadline_defaults: dict | None = None,
                  sweep_interval: float | None = None,
-                 remote_replicas: tuple[str, ...] = (), **batcher_kw):
+                 remote_replicas: tuple[str, ...] = (),
+                 autotune=None,
+                 tenant_rate: float | None = None,
+                 tenant_burst: float = 5.0, **batcher_kw):
         engines = (list(engine) if isinstance(engine, (list, tuple))
                    else [engine])
         if not engines:
@@ -162,8 +165,20 @@ class ServeServer:
             self.replicas, queue_size=self.replicas[0].batcher.queue_size,
             stale_after=health_stale_after,
             best_effort_frac=best_effort_queue_frac,
-            registry=engines[0].metrics)
+            registry=engines[0].metrics,
+            tenant_rate=tenant_rate, tenant_burst=tenant_burst)
         self.health_stale_after = health_stale_after
+        # online autotuner (serve/autotune.py): built over the finished
+        # stack so it sees every replica/tier/router surface; its
+        # controller thread is started by start() and JOINED by stop()
+        # (the thread-lifecycle contract lives inside AutoTuner itself).
+        # None (the default) is byte-identical pre-autotuner behavior —
+        # no thread, no knob ever moves.
+        self.autotuner = None
+        if autotune is not None:
+            from .autotune import AutoTuner
+
+            self.autotuner = AutoTuner(self, autotune)
         # optional periodic death sweep: the sweep normally piggybacks on
         # submits and health probes, so a dead replica on a QUIET server
         # is only retired when the next probe lands — an interval makes
@@ -220,6 +235,8 @@ class ServeServer:
                                  name="serve-death-sweeper", daemon=True)
             self._sweep_thread = t
             t.start()
+        if self.autotuner is not None:
+            self.autotuner.start()
         return self
 
     def _sweep_loop(self) -> None:
@@ -229,6 +246,11 @@ class ServeServer:
             self.router.sweep()
 
     def stop(self) -> None:
+        # the controller parks FIRST: knobs must not move while the
+        # schedulers are being joined (its thread is joined here — the
+        # thread-lifecycle contract)
+        if self.autotuner is not None:
+            self.autotuner.stop()
         # mark the stop BEFORE joining: the router's death sweep must not
         # mistake deliberately-joined scheduler threads for crashes and
         # start requeueing a shutting-down server's work
@@ -284,6 +306,7 @@ class ServeServer:
         timeout: float = 120.0,
         klass: str = "priority",
         deadline_s: float | None = None,
+        tenant: str | None = None,
     ) -> Request:
         """Submit and block until the request completes; returns the filled
         :class:`Request` (``.tokens``, ``.session_id``, ``.replica``,
@@ -308,6 +331,7 @@ class ServeServer:
             prompt, max_new_tokens, sampling=sampling,
             session_id=session_id, keep_session=keep_session, eos_id=eos_id,
             use_prefix=use_prefix, klass=klass, deadline_s=deadline_s,
+            tenant=tenant,
         )
         self.router.submit(req)
         if not req.done.wait(timeout):
@@ -411,7 +435,11 @@ class ServeServer:
         # stack (per-replica bounds never fire; see Router docstring)
         agg["rejected"] += rt["rejected"]
         return {"batcher": agg, **self.engine.stats(), "router": rt,
-                "replicas": per, "metrics": self.metrics_summary()}
+                "replicas": per, "metrics": self.metrics_summary(),
+                # controller decisions + the last windowed (recent-
+                # biased) signal deltas; None = autotuning off
+                "autotune": (None if self.autotuner is None
+                             else self.autotuner.stats())}
 
     def _collect_gauges(self) -> None:
         """Refresh poll-style gauges at scrape time — an idle server's
@@ -691,6 +719,10 @@ class _Handler(BaseHTTPRequestHandler):
                 deadline_s = None if hdr is None else float(hdr)
             deadline_s = None if deadline_s is None else float(deadline_s)
             klass = str(body.get("class", "priority"))
+            # per-tenant rate limiting (serve/router.py): the token-
+            # bucket identity; absent = untenanted, never rate-limited
+            tenant = body.get("tenant")
+            tenant = None if tenant is None else str(tenant)
         except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
             # TypeError included: {"max_new_tokens": null} etc. must be a
             # 400, not a handler crash that resets the connection
@@ -706,6 +738,7 @@ class _Handler(BaseHTTPRequestHandler):
                 eos_id=body.get("eos_id"),
                 use_prefix=bool(body.get("use_prefix", True)),
                 timeout=timeout, klass=klass, deadline_s=deadline_s,
+                tenant=tenant,
             )
         except QueueFullError as e:
             # the shed path: retryable by definition, with the router's

@@ -1188,6 +1188,24 @@ class SessionTiers:
         for job in stored:
             self._m_spill_lat.observe(end - job.t0)
 
+    def set_host_entries(self, n: int) -> None:
+        """Resize the host-tier bound at runtime — the serve autotuner's
+        capacity (autoscaler) knob. Growing is free; shrinking cascades
+        overflow victims through the exact spill-time overflow path
+        (disk-bound victims park in ``_evacuating`` until their write
+        lands, the rest are dropped honestly and counted). The disk
+        writes themselves run OUTSIDE the shared lock, like every other
+        flush."""
+        if n < 1:
+            raise ValueError(f"host_entries must be >= 1, got {n}")
+        disk_writes: list = []
+        with self._lock:
+            self.host_entries = int(n)
+            dropped = self._cascade_overflow_locked(disk_writes)
+        if dropped:
+            self._m_lost["overflow"].inc(dropped)
+        self._flush_disk_writes(disk_writes)
+
     def _cascade_overflow_locked(self, disk_writes: list) -> int:
         """Pop host-tier overflow victims. Disk-bound victims PARK in
         ``_evacuating`` (still fillable) until their write lands; the

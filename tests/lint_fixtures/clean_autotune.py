@@ -1,0 +1,33 @@
+"""graftlint fixture: clean twin of viol_autotune — the controller
+thread parks on a stop Event its loop waits on, and stop() both sets the
+flag and joins the stored handle (the serve/autotune.py lifecycle
+contract: ServeServer.stop() drives AutoTuner.stop())."""
+
+import threading
+
+
+class MiniTuner:
+    def __init__(self, server):
+        self.server = server
+        self._stop = threading.Event()
+        self._thread = None
+        self.ticks = 0
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mini-autotuner", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.25):
+            self.tick()
+
+    def tick(self):
+        self.ticks += 1
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

@@ -1,0 +1,28 @@
+"""graftlint fixture: clean twin of viol_autotune_warmup — warmup()
+dispatches the window program for EVERY ladder rung the controller's
+knob may cap to, so no knob move can ever charge a live request a
+mid-traffic compile (the serve/autotune.py no-compile invariant:
+set_window_cap / set_prefill_chunk only accept warmed values)."""
+
+
+class MiniKnobEngine:
+    def __init__(self, ladder=(1, 4, 8)):
+        self.ladder = ladder
+        self.window_cap = ladder[-1]
+        self.compile_counts = {}
+        self._fns = {}
+
+    def window_fn(self, k):
+        count_key = ("knob_window", k)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda toks: toks[:k])
+
+    def decode(self, toks):
+        return self.window_fn(self.window_cap)(toks)
+
+    def warmup(self, toks=(0,)):
+        out = None
+        for k in self.ladder:
+            out = self.window_fn(k)(toks)
+        return out

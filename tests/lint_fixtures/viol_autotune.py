@@ -1,0 +1,27 @@
+"""graftlint fixture: thread-lifecycle true positive for the AUTOTUNER
+shape — a serve controller whose daemon control-loop thread is stored on
+the tuner and started, but with NO stop()/close() path that joins it or
+signals a flag its loop reads. A controller nobody can park keeps moving
+knobs while the server it steers is being torn down (the PR 15 contract:
+the thread is stored on the tuner and joined in ``stop()``)."""
+
+import threading
+
+
+class MiniTuner:
+    def __init__(self, server):
+        self.server = server
+        self._thread = None
+        self.ticks = 0
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="mini-autotuner", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self.tick()
+
+    def tick(self):
+        self.ticks += 1

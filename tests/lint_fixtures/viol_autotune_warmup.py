@@ -1,0 +1,28 @@
+"""graftlint fixture: warmup-coverage true positive for the AUTOTUNER
+knob shape — a window dispatcher whose ``("knob_window", k)`` compile-key
+family is only reachable through the controller's knob pick, never from
+``warmup()``: the first knob move after boot charges a live request the
+mid-traffic XLA compile the autotuner exists to avoid (the PR 15
+contract: every value a knob can select must be warmup-covered)."""
+
+
+class MiniKnobEngine:
+    def __init__(self, ladder=(1, 4, 8)):
+        self.ladder = ladder
+        self.window_cap = ladder[-1]
+        self.compile_counts = {}
+        self._fns = {}
+
+    def window_fn(self, k):
+        count_key = ("knob_window", k)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda toks: toks[:k])
+
+    def decode(self, toks):
+        return self.window_fn(self.window_cap)(toks)
+
+    def warmup(self):
+        # never dispatches window_fn: every rung the controller can cap
+        # to compiles mid-traffic on its first pick
+        return None

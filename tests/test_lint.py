@@ -52,6 +52,8 @@ VIOLATIONS = {
     "viol_midfile_import": "mid-file-import",
     "viol_resource_pair": "resource-pairing",
     "viol_thread_lifecycle": "thread-lifecycle",
+    "viol_autotune": "thread-lifecycle",
+    "viol_autotune_warmup": "warmup-coverage",
     "viol_io_lock": "io-under-lock",
     "viol_toctou": "toctou-fs",
     "viol_swallowed": "swallowed-exception",
@@ -76,6 +78,8 @@ CLEAN_TWINS = {
     "clean_midfile_import": "mid-file-import",
     "clean_resource_pair": "resource-pairing",
     "clean_thread_lifecycle": "thread-lifecycle",
+    "clean_autotune": "thread-lifecycle",
+    "clean_autotune_warmup": "warmup-coverage",
     "clean_io_lock": "io-under-lock",
     "clean_toctou": "toctou-fs",
     "clean_swallowed": "swallowed-exception",

@@ -160,6 +160,68 @@ def test_exposition_validator_rejects(bad):
         parse_exposition(bad)
 
 
+def test_snapshot_delta_histogram_windows():
+    """snapshot_delta: the delta view describes ONLY the samples recorded
+    since the cursor — the recent-biased quantiles a controller steers on
+    (the cumulative summary() would keep reporting boot-time history)."""
+    reg = MetricsRegistry()
+    fam = reg.histogram("lat_seconds", labelnames=("replica",))
+    fam.labels(replica="0").observe(0.001)
+    view, cur = fam.snapshot_delta(None)  # None = since registration
+    assert view["count"] == 1
+    assert view["p99"] <= 0.001
+    # new window: only the fresh (much slower) samples show up, merged
+    # across children — including a child born mid-window
+    fam.labels(replica="0").observe(0.1)
+    fam.labels(replica="1").observe(0.1)
+    view, cur = fam.snapshot_delta(cur)
+    assert view["count"] == 2
+    assert 0.05 <= view["p50"] <= 0.1  # the old 1 ms sample is gone
+    assert abs(view["sum"] - 0.2) < 1e-9
+    # an empty window reports zero, not the lifetime distribution
+    view, cur = fam.snapshot_delta(cur)
+    assert view == {"count": 0, "sum": 0.0}
+    # the lifetime summary still covers everything (cursors are
+    # per-consumer: reading a delta resets nothing)
+    assert fam.labels(replica="0").summary()["count"] == 2
+
+
+def test_snapshot_delta_counter_and_gauge():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total", labelnames=("k",))
+    c.labels(k="a").inc(3)
+    v, cur = c.snapshot_delta()
+    assert v == 3.0
+    c.labels(k="a").inc(2)
+    c.labels(k="b").inc(1)
+    v, cur = c.snapshot_delta(cur)
+    assert v == 3.0  # 2 on the old child + 1 on the new one
+    v, cur = c.snapshot_delta(cur)
+    assert v == 0.0
+    # gauges are levels, not flows: the view is the current summed value
+    g = reg.gauge("depth", labelnames=("k",))
+    g.labels(k="a").set(7)
+    v, gcur = g.snapshot_delta()
+    assert v == 7.0
+    v, gcur = g.snapshot_delta(gcur)
+    assert v == 7.0
+
+
+def test_snapshot_delta_independent_consumers():
+    """Two consumers with their own cursors see the same windows — a
+    delta read must never reset another reader (unlike read-and-clear)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds")
+    h.observe(0.01)
+    va, ca = h.snapshot_delta()
+    vb, cb = h.snapshot_delta()
+    assert va["count"] == vb["count"] == 1
+    h.observe(0.02)
+    va, ca = h.snapshot_delta(ca)
+    vb, cb = h.snapshot_delta(cb)
+    assert va["count"] == vb["count"] == 1
+
+
 def test_null_registry_noops():
     c = NULL_REGISTRY.counter("x", "whatever")
     c.inc()
@@ -168,6 +230,8 @@ def test_null_registry_noops():
     h = NULL_REGISTRY.histogram("h")
     h.observe(1.0)
     assert h.summary() == {}
+    view, cur = h.snapshot_delta()
+    assert view["count"] == 0 and cur == {}
     assert NULL_REGISTRY.summaries() == {}
     assert "disabled" in NULL_REGISTRY.render_prometheus()
 

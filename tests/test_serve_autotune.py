@@ -1,0 +1,475 @@
+"""Online serve autotuner (serve/autotune.py) + its satellites: knob
+setters bounded by the warmed lattice, controller decisions from seeded
+windowed deltas (hysteresis: no oscillation on flat workloads), zero
+mid-traffic compiles with the controller live, --autotune-off parity,
+the PR 10 burst gate with the controller on, per-tenant token-bucket
+rate limiting, and the loadgen arrival modes (burst/sine/trace).
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from lstm_tensorspark_tpu.models import LMConfig, init_lm
+from lstm_tensorspark_tpu.obs import MetricsRegistry
+from lstm_tensorspark_tpu.serve import (
+    AutoTuneConfig,
+    AutoTuner,
+    QueueFullError,
+    ServeEngine,
+    ServeServer,
+    run_loadgen,
+)
+from lstm_tensorspark_tpu.serve.loadgen import arrival_offsets
+
+_CFG = LMConfig(vocab_size=29, hidden_size=16, num_layers=1)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_lm(jax.random.PRNGKey(3), _CFG)
+
+
+def _server(params, registry=None, *, session_dir=None, num_slots=8,
+            host_tier_entries=4, tiered=False, **kw):
+    reg = registry if registry is not None else MetricsRegistry()
+    engine = ServeEngine(
+        params, _CFG, num_slots=num_slots, prefill_buckets=(4, 8, 16),
+        batch_buckets=(1, 2, 4), registry=reg,
+        tiered_cache=tiered, host_tier_entries=host_tier_entries,
+        session_dir=session_dir)
+    kw.setdefault("max_active", 4)
+    kw.setdefault("queue_size", 8)
+    kw.setdefault("window_ladder", (1, 2, 4))
+    return ServeServer(engine, **kw)
+
+
+def _tuner(server, **cfg_kw):
+    cfg_kw.setdefault("slo_s", 0.2)
+    cfg_kw.setdefault("min_events", 4)
+    cfg_kw.setdefault("patience_up", 2)
+    cfg_kw.setdefault("patience_down", 1)
+    cfg_kw.setdefault("cooldown", 0)
+    return AutoTuner(server, AutoTuneConfig(**cfg_kw))
+
+
+def _sig(*, itl=(0, None), qwait=(0, None), ttft=(0, None), queued=0,
+         queue_size=8, chunks=0.0, tiers=None):
+    def h(pair):
+        count, p99 = pair
+        out = {"count": count, "sum": 0.0}
+        if p99 is not None:
+            out["p50"] = p99 / 2
+            out["p99"] = p99
+        return out
+
+    return {"ttft": h(ttft), "itl": h(itl), "queue_wait": h(qwait),
+            "queued": queued, "queue_size": queue_size,
+            "prefill_chunks": chunks, "tiers": tiers}
+
+
+# the two canonical windows: ITL-bound steady decode (grow) and
+# queue-wait-bound pressure (shrink) — p99s relative to slo_s = 0.2
+_HEADROOM = dict(itl=(20, 0.002), qwait=(6, 0.001), ttft=(6, 0.005))
+_PRESSURE = dict(itl=(20, 0.002), qwait=(8, 0.15), ttft=(8, 0.18))
+
+
+# ---- knob setters: bounded by the warmed lattice -----------------------
+
+
+def test_knob_setters_validate_and_stats_reflect(params):
+    server = _server(params, prefill_chunk=4,
+                     prefill_chunk_choices=(2, 4, 8))
+    b = server.batcher
+    assert b.window_cap == 4  # default: the top rung (pre-knob behavior)
+    b.set_window_cap(2)
+    assert b.stats()["window_cap"] == 2
+    with pytest.raises(ValueError):
+        b.set_window_cap(3)  # not a warmed ladder rung
+    b.set_prefill_chunk(8)
+    assert b.stats()["prefill_chunk"] == 8
+    assert b.stats()["prefill_chunk_choices"] == [2, 4, 8]
+    with pytest.raises(ValueError):
+        b.set_prefill_chunk(6)  # not in the warmed choice set
+    with pytest.raises(ValueError):
+        # choices without chunking: the knob cannot turn chunking on
+        _server(params, prefill_chunk_choices=(2, 4))
+
+
+def test_warmup_covers_every_chunk_choice(params):
+    """A knob move must never compile: warmup replays the chunk-stop
+    sequence for EVERY choice, so traffic after any set_prefill_chunk
+    finds its programs compiled."""
+    server = _server(params, prefill_chunk=4,
+                     prefill_chunk_choices=(2, 4, 8))
+    with server:
+        server.warmup(prompt_lens=(4, 8, 16))
+        n0 = server.engine.num_compiles()
+        for chunk in (2, 8, 4):
+            server.batcher.set_prefill_chunk(chunk)
+            server.generate(list(range(1, 11)), max_new_tokens=2)
+        for cap in (1, 4, 2):
+            server.batcher.set_window_cap(cap)
+            server.generate([1, 2, 3], max_new_tokens=6)
+        assert server.engine.num_compiles() == n0
+
+
+# ---- controller decisions (seeded windows; tick() driven directly) -----
+
+
+def test_warmup_covers_mid_prefill_chunk_mixes(params):
+    """A knob move can land BETWEEN a long prompt's chunk dispatches, so
+    one prompt may mix chunk sizes — segment lengths neither pure-choice
+    replay produces (chunk 4 then 8 on a 16-token prompt ends with an
+    8-length final from position 4+8=12... and a 4+8 intermediate walk).
+    The warmup closure must cover every mix."""
+    from lstm_tensorspark_tpu.serve import Request
+
+    server = _server(params, prefill_chunk=4,
+                     prefill_chunk_choices=(2, 4, 8))
+    b = server.batcher
+    b.warmup(prompt_lens=(4, 8, 16))
+    n0 = server.engine.num_compiles()
+    req = Request(list(range(1, 17)), 2)
+    b.submit(req)
+    b.step()  # dispatches the first chunk at size 4
+    b.set_prefill_chunk(8)  # the controller moves mid-prompt
+    b.drain()
+    assert req.error is None and len(req.tokens) == 2
+    assert server.engine.num_compiles() == n0
+
+
+def test_tuner_moves_k_up_on_itl_bound_windows(params):
+    server = _server(params)
+    server.batcher.set_window_cap(2)  # mid-ladder operating point
+    tuner = _tuner(server)
+    assert tuner.tick(_sig(**_HEADROOM)) == []  # patience_up = 2
+    moves = tuner.tick(_sig(**_HEADROOM))
+    assert [(m["knob"], m["direction"]) for m in moves] == [
+        ("window_k", "up")]
+    assert server.batcher.window_cap == 4
+    # at the top rung: further headroom windows cannot overshoot
+    for _ in range(4):
+        tuner.tick(_sig(**_HEADROOM))
+    assert server.batcher.window_cap == 4
+
+
+def test_tuner_moves_k_down_on_queue_pressure(params):
+    server = _server(params)
+    tuner = _tuner(server)
+    moves = tuner.tick(_sig(**_PRESSURE))  # patience_down = 1
+    assert moves and {k: moves[0][k] for k in
+                      ("knob", "direction", "from", "to")} == {
+        "knob": "window_k", "direction": "down", "from": 4, "to": 2}
+    tuner.tick(_sig(**_PRESSURE))
+    assert server.batcher.window_cap == 1
+    for _ in range(3):  # floor: never below rung 1
+        tuner.tick(_sig(**_PRESSURE))
+    assert server.batcher.window_cap == 1
+    s = tuner.stats()
+    assert s["moves"]["window_k"]["down"] == 2
+    assert s["window"]["pressure"] is True
+
+
+def test_tuner_hysteresis_no_moves_on_flat_or_sparse_windows(params):
+    """A quiet server (no samples), a sparse window (below min_events),
+    and alternating one-window signals must never move a knob — the
+    no-oscillation contract."""
+    server = _server(params)
+    server.batcher.set_window_cap(2)
+    tuner = _tuner(server, patience_up=2, patience_down=2)
+    for _ in range(6):
+        assert tuner.tick(_sig()) == []  # flat: no traffic at all
+    sparse = dict(_HEADROOM)
+    sparse["itl"] = (2, 0.002)  # below min_events: casts no vote
+    for _ in range(6):
+        assert tuner.tick(_sig(**sparse)) == []
+    for _ in range(4):  # alternating: the streak resets every window
+        assert tuner.tick(_sig(**_HEADROOM)) == []
+        assert tuner.tick(_sig(**_PRESSURE)) == []
+    assert server.batcher.window_cap == 2
+    assert tuner.stats()["moves"]["window_k"] == {"up": 0, "down": 0}
+
+
+def test_tuner_cooldown_rests_after_a_move(params):
+    server = _server(params)
+    tuner = _tuner(server, cooldown=2, patience_down=1)
+    assert tuner.tick(_sig(**_PRESSURE))  # 4 -> 2
+    assert tuner.tick(_sig(**_PRESSURE)) == []  # cooling
+    assert tuner.tick(_sig(**_PRESSURE)) == []  # cooling
+    assert tuner.tick(_sig(**_PRESSURE))  # 2 -> 1
+    assert server.batcher.window_cap == 1
+
+
+def test_tuner_moves_chunk_opposite_to_k(params):
+    """Pressure grows the chunk (finish prompts in fewer dispatches);
+    ITL-bound headroom shrinks it (bound the stall) — and the knob only
+    moves while prefill chunks are actually dispatching."""
+    server = _server(params, prefill_chunk=4,
+                     prefill_chunk_choices=(2, 4, 8))
+    tuner = _tuner(server)
+    # no prefill activity in the window: the chunk knob stays pinned
+    tuner.tick(_sig(**_PRESSURE))
+    assert server.batcher.prefill_chunk == 4
+    moves = tuner.tick(_sig(**_PRESSURE, chunks=3.0))
+    assert ("prefill_chunk", "up") in {(m["knob"], m["direction"])
+                                       for m in moves}
+    assert server.batcher.prefill_chunk == 8  # pressure: larger chunks
+    tuner2 = _tuner(server)
+    tuner2.tick(_sig(**_HEADROOM, chunks=3.0))
+    moves = tuner2.tick(_sig(**_HEADROOM, chunks=3.0))
+    assert ("prefill_chunk", "down") in {(m["knob"], m["direction"])
+                                         for m in moves}
+    assert server.batcher.prefill_chunk == 4  # headroom: bound the stall
+
+
+def test_tuner_grows_host_tier_on_spill_thrash_and_shrinks_back(params,
+                                                                tmp_path):
+    server = _server(params, tiered=True, host_tier_entries=4,
+                     session_dir=str(tmp_path))
+    tuner = _tuner(server, host_tier_max=16, patience_down=1,
+                   patience_up=2)
+    thrash = {"host": 4, "host_max": 4, "disk_spills": 3.0,
+              "disk_fills": 2.0, "lost": 0.0}
+    moves = tuner.tick(_sig(tiers=thrash))
+    assert moves and moves[0]["knob"] == "host_tier"
+    assert moves[0]["direction"] == "up"
+    assert server.engine.tiers.host_entries == 8
+    # grow caps at host_tier_max
+    tuner.tick(_sig(tiers={**thrash, "host": 8, "host_max": 8}))
+    assert server.engine.tiers.host_entries == 16
+    for _ in range(3):
+        tuner.tick(_sig(tiers={**thrash, "host": 16, "host_max": 16}))
+    assert server.engine.tiers.host_entries == 16
+    # occupancy collapses: shrink back toward the configured size only
+    idle = {"host": 1, "host_max": 16, "disk_spills": 0.0,
+            "disk_fills": 0.0, "lost": 0.0}
+    for _ in range(8):
+        tuner.tick(_sig(tiers=idle))
+    assert server.engine.tiers.host_entries == 4  # never below initial
+
+
+def test_tuner_tightens_best_effort_at_capacity_ceiling(params, tmp_path):
+    server = _server(params, tiered=True, host_tier_entries=4,
+                     session_dir=str(tmp_path))
+    tuner = _tuner(server, host_tier_max=4, patience_down=1,
+                   patience_up=2, best_effort_floor=0.1)
+    thrash = {"host": 4, "host_max": 4, "disk_spills": 3.0,
+              "disk_fills": 2.0, "lost": 1.0}
+    # tier already at max (host_tier_max == initial): tighten admission
+    moves = tuner.tick(_sig(tiers=thrash))
+    assert ("best_effort", "down") in {(m["knob"], m["direction"])
+                                       for m in moves}
+    assert server.router.best_effort_frac == 0.25
+    for _ in range(4):
+        tuner.tick(_sig(tiers=thrash))
+    assert server.router.best_effort_frac >= 0.1  # floor respected
+    # thrash clears: relax back toward the configured policy
+    idle = {"host": 0, "host_max": 4, "disk_spills": 0.0,
+            "disk_fills": 0.0, "lost": 0.0}
+    for _ in range(8):
+        tuner.tick(_sig(tiers=idle))
+    assert server.router.best_effort_frac == 0.5  # never above initial
+
+
+# ---- live-stack integration -------------------------------------------
+
+
+def test_controller_live_zero_mid_traffic_compiles(params):
+    """Real traffic with the controller thread live and knobs forced
+    through their whole range: serve_compiles_total must not move after
+    warmup — the controller can NEVER trigger a mid-traffic compile."""
+    reg = MetricsRegistry()
+    server = _server(params, registry=reg, prefill_chunk=4,
+                     prefill_chunk_choices=(2, 4, 8),
+                     autotune=AutoTuneConfig(interval_s=0.02, slo_s=0.05,
+                                             min_events=2, patience_up=1,
+                                             patience_down=1, cooldown=0))
+    with server:
+        server.warmup(prompt_lens=(4, 8, 16))
+        n0 = server.engine.num_compiles()
+        assert server.autotuner._thread is not None  # controller live
+        for i in range(12):
+            server.generate(list(range(1, 4 + (i % 12))),
+                            max_new_tokens=5)
+        assert server.engine.num_compiles() == n0
+        st = server.stats()["autotune"]
+        assert st["ticks"] > 0 and st["errors"] == 0
+        # whatever the controller chose, it stayed inside the lattice
+        assert server.batcher.window_cap in server.batcher.window_ladder
+        assert (server.batcher.prefill_chunk
+                in server.batcher.prefill_chunk_choices)
+    assert server.autotuner._thread is None  # joined by stop()
+
+
+def test_autotune_off_is_todays_behavior(params):
+    """No config = no controller thread, no knob ever moves, and greedy
+    tokens are identical to an autotuned stack's (the knobs change
+    latency shape, never output)."""
+    server_off = _server(params)
+    server_on = _server(params,
+                        autotune=AutoTuneConfig(interval_s=0.02))
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+    outs = {}
+    for name, server in (("off", server_off), ("on", server_on)):
+        with server:
+            server.warmup(prompt_lens=(4,))
+            outs[name] = [list(server.generate(
+                p, max_new_tokens=6).tokens) for p in prompts]
+    assert outs["off"] == outs["on"]
+    assert server_off.autotuner is None
+    assert server_off.stats()["autotune"] is None
+    assert server_off.batcher.window_cap == 4  # untouched top rung
+
+
+def test_burst_gate_holds_with_controller_on(params):
+    """The PR 10 SLO-aware shedding contract survives a live controller:
+    under an over-capacity open-loop burst, zero PRIORITY sheds while
+    best-effort sheds with Retry-After."""
+    # bounds sized so PRIORITY structurally cannot shed (12 priority
+    # requests + the best-effort bound < queue_size) while best-effort
+    # must: the test gates the POLICY with the controller live, not CPU
+    # scheduling luck
+    server = _server(params, queue_size=24, best_effort_queue_frac=0.2,
+                     autotune=AutoTuneConfig(interval_s=0.02, slo_s=0.25,
+                                             min_events=4))
+    with server:
+        server.warmup(prompt_lens=(4,))
+        report = run_loadgen(
+            server, vocab_size=_CFG.vocab_size, sessions=4,
+            requests_per_session=12, prompt_len=4, max_new_tokens=8,
+            mode="open", rate=500.0, priority_frac=0.25, seed=7,
+            retry_max=1, retry_base_s=0.02, retry_cap_s=0.2)
+    assert report["classes"]["priority"]["shed"] == 0
+    assert report["classes"]["best_effort"]["shed"] >= 1
+    assert report["classes"]["priority"]["completed"] >= 1
+
+
+def test_moves_metric_exported(params):
+    reg = MetricsRegistry()
+    server = _server(params, registry=reg)
+    tuner = _tuner(server, patience_down=1)
+    tuner.tick(_sig(**_PRESSURE))
+    s = reg.summaries()
+    key = 'serve_autotune_moves_total{knob="window_k",direction="down"}'
+    assert s[key] == 1
+    st = tuner.stats()
+    assert st["history"][-1]["knob"] == "window_k"
+    assert st["knobs"]["window_k"]["value"] == 2
+
+
+# ---- per-tenant rate limiting ------------------------------------------
+
+
+def test_tenant_token_bucket_sheds_with_retry_after(params):
+    reg = MetricsRegistry()
+    server = _server(params, tenant_rate=1.0, tenant_burst=2.0,
+                     registry=reg)
+    with server:
+        server.warmup(prompt_lens=(4,))
+        for _ in range(2):  # the burst allowance admits these
+            server.generate([1, 2, 3], max_new_tokens=2, tenant="acme")
+        with pytest.raises(QueueFullError) as ei:
+            server.generate([1, 2, 3], max_new_tokens=2, tenant="acme")
+        assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+        # a DIFFERENT tenant and untenanted traffic are unaffected
+        server.generate([1, 2, 3], max_new_tokens=2, tenant="other")
+        server.generate([1, 2, 3], max_new_tokens=2)
+    st = server.router.stats()
+    assert st["tenant_limited"] == {"priority": 1, "best_effort": 0}
+    assert st["tenant_rate"] == 1.0
+    s = reg.summaries()
+    assert s['serve_shed_total{class="priority",tenant_limited="yes"}'] == 1
+    assert s["serve_retry_after_seconds"]["count"] == 1
+
+
+def test_tenant_bucket_refills_over_time(params):
+    server = _server(params, tenant_rate=50.0, tenant_burst=1.0)
+    with server:
+        server.warmup(prompt_lens=(4,))
+        server.generate([1, 2], max_new_tokens=2, tenant="t")
+        with pytest.raises(QueueFullError):
+            server.generate([1, 2], max_new_tokens=2, tenant="t")
+        time.sleep(0.05)  # > 1/rate: one token accrued
+        server.generate([1, 2], max_new_tokens=2, tenant="t")
+
+
+def test_tenant_bucket_table_hard_bounded(params):
+    """A flood of FRESH tenant names faster than the refill rate must not
+    grow the bucket table past MAX_TENANT_BUCKETS: with nothing fully
+    refilled to prune, the fullest bucket is evicted instead — the cap
+    is a memory bound, not a hint."""
+    server = _server(params, tenant_rate=0.001, tenant_burst=2.0)
+    router = server.router
+    cap = 8
+    router.MAX_TENANT_BUCKETS = cap
+    with router._lock:
+        for i in range(3 * cap):  # refill needs ~1000 s: never prunable
+            router._tenant_take_locked(f"flood-{i}")
+            assert len(router._tenant_buckets) <= cap
+    assert len(router._tenant_buckets) == cap
+
+
+def test_tenant_rate_off_by_default(params):
+    server = _server(params)
+    with server:
+        server.warmup(prompt_lens=(4,))
+        for _ in range(3):
+            server.generate([1, 2], max_new_tokens=2, tenant="acme")
+    assert server.router.stats()["tenant_limited"] == {
+        "priority": 0, "best_effort": 0}
+
+
+# ---- loadgen arrival modes ---------------------------------------------
+
+
+def test_arrival_offsets_shapes():
+    # burst: groups of burst_n at each gap, simultaneous within a burst
+    off = arrival_offsets(6, arrival="burst", burst_n=3, burst_gap_s=0.5)
+    assert off == [0.0, 0.0, 0.0, 0.5, 0.5, 0.5]
+    # fixed: the classic constant rate
+    assert arrival_offsets(3, rate=10.0) == [0.0, 0.1, 0.2]
+    # sine: non-decreasing, rate modulated around the mean — the gap at
+    # peak rate is shorter than at trough rate
+    off = arrival_offsets(40, rate=20.0, arrival="sine",
+                          sine_period_s=1.0, sine_amp=0.5)
+    gaps = [b - a for a, b in zip(off, off[1:])]
+    assert all(g > 0 for g in gaps)
+    assert min(gaps) < 1 / 20.0 < max(gaps)
+    # trace replay: explicit offsets; a short trace LOOPS shifted by its
+    # span (the recorded shape repeats instead of truncating)
+    off = arrival_offsets(5, arrival_times=[0.0, 0.1])
+    assert off[:2] == [0.0, 0.1]
+    assert off[2] > off[1] and off[4] > off[3]
+    with pytest.raises(ValueError):
+        arrival_offsets(2, arrival_times=[0.2, 0.1])  # unsorted
+    with pytest.raises(ValueError):
+        # a burst spanning past the next burst's start would silently
+        # degenerate into a continuous stream — refused, not misreported
+        arrival_offsets(16, rate=20.0, arrival="burst", burst_n=8,
+                        burst_gap_s=0.2)
+    with pytest.raises(ValueError):
+        arrival_offsets(2, arrival="fixed")  # fixed needs a rate
+    with pytest.raises(ValueError):
+        arrival_offsets(2, arrival="warp")
+
+
+def test_loadgen_trace_replay_drives_requests(params):
+    server = _server(params)
+    trace = [0.0, 0.01, 0.02, 0.25]
+    with server:
+        server.warmup(prompt_lens=(4,))
+        report = run_loadgen(
+            server, vocab_size=_CFG.vocab_size, sessions=2,
+            requests_per_session=2, prompt_len=4, max_new_tokens=2,
+            mode="open", arrival_times=trace, seed=11)
+    assert report["arrival"] == "trace"
+    assert report["arrival_trace_len"] == 4
+    assert report["completed"] == 4
+    # arrival shaping is an open-loop feature
+    with pytest.raises(ValueError):
+        run_loadgen(server, vocab_size=_CFG.vocab_size,
+                    mode="closed", arrival="burst")

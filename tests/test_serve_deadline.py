@@ -231,8 +231,8 @@ def test_router_sheds_best_effort_first_with_retry_after(params):
     assert st["shed_by_class"] == {"priority": 1, "best_effort": 1}
     assert st["best_effort_bound"] == 4
     s = reg.summaries()
-    assert s['serve_shed_total{class="best_effort"}'] == 1
-    assert s['serve_shed_total{class="priority"}'] == 1
+    assert s['serve_shed_total{class="best_effort",tenant_limited="no"}'] == 1
+    assert s['serve_shed_total{class="priority",tenant_limited="no"}'] == 1
     assert s["serve_retry_after_seconds"]["count"] == 2
 
 
@@ -249,7 +249,7 @@ def test_batcher_level_429_also_carries_retry_after(params):
         b.submit(Request([1, 2], 2, klass="best_effort"))
     assert ei.value.retry_after_s and ei.value.retry_after_s > 0
     s = reg.summaries()
-    assert s['serve_shed_total{class="best_effort"}'] == 1
+    assert s['serve_shed_total{class="best_effort",tenant_limited="no"}'] == 1
     assert s["serve_retry_after_seconds"]["count"] == 1
 
 

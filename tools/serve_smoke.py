@@ -15,7 +15,10 @@ the pre-restart session's continuation must succeed from the disk tier
 
 Then two single-replica kernel/topology boots, each required to serve
 the SAME greedy tokens as the main boot: `--decode-kernel pallas`
-(interpreter-mode fused window, PR 11) and `--mesh-shards 2` (the
+(interpreter-mode fused window, PR 11) — which also runs with
+`--autotune on` (PR 15: the controller thread must boot, tick without
+errors, export its `/stats` section, and hold every knob still on a
+quiet workload) — and `--mesh-shards 2` (the
 tensor-parallel mesh engine on 2 VIRTUAL cpu devices via
 XLA_FLAGS=--xla_force_host_platform_device_count — sharding must not
 change a single token, and /metrics keeps its replica-labelled
@@ -62,14 +65,19 @@ _SERVE_ARGS = [
 ]
 # the pallas fallback boot: one replica, windowed ladder (so decode
 # actually dispatches the fused window kernel), interpreter mode on CPU;
-# tiers off to keep the extra boot to a couple of seconds
+# tiers off to keep the extra boot to a couple of seconds. This boot
+# also carries --autotune on (ISSUE-15): the controller thread must
+# boot, tick, export its /stats section, and change NOTHING about a
+# quiet workload — the token-parity assertion below doubles as the
+# controller-live no-op guarantee (hysteresis: min_events gates every
+# vote, so a smoke-sized trickle never moves a knob)
 _PALLAS_ARGS = [
     "serve", "--http", "--port", "0", "--vocab-size", "31",
     "--hidden-units", "12", "--num-layers", "1",
     "--prefill-buckets", "4,8", "--batch-buckets", "1,2",
     "--decode-window", "4", "--prefix-cache", "off",
     "--tiered-cache", "off", "--decode-kernel", "pallas",
-    "--replicas", "1",
+    "--replicas", "1", "--autotune", "on", "--slo-ms", "250",
 ]
 # the mesh (tensor-parallel) boot: one replica whose engine shards H
 # over 2 VIRTUAL cpu devices (XLA_FLAGS in _boot's env below) — the
@@ -245,6 +253,27 @@ def main(argv=None) -> int:
                          "pallas decode-window tokens diverge from the "
                          f"scan window: {preply.get('tokens')} != "
                          f"{reply.get('tokens')}")
+        # the controller is LIVE on this boot: its thread must be
+        # running and error-free, its /stats section exported, and the
+        # knobs still at their boot positions (a quiet smoke workload
+        # must never trip the hysteresis — the parity check above
+        # already proved it changed no tokens)
+        with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+            pstats = json.loads(r.read())
+        at = pstats.get("autotune")
+        if not at or not at.get("running"):
+            return _fail(proc, lines,
+                         f"--autotune on but /stats autotune section "
+                         f"missing or controller not running: {at}")
+        if at.get("errors"):
+            return _fail(proc, lines,
+                         f"autotuner ticked with errors: "
+                         f"{at.get('last_error')}")
+        at_moves = sum(d for v in at["moves"].values() for d in v.values())
+        if at_moves:
+            return _fail(proc, lines,
+                         f"autotuner moved knobs on a quiet smoke "
+                         f"workload (hysteresis broken): {at['moves']}")
         proc.terminate()
         try:
             proc.wait(timeout=10)
@@ -299,7 +328,8 @@ def main(argv=None) -> int:
               f"({len(reps)} replicas) + routed generate + stats + "
               f"{len(fams)} metric families validated; kill -9 → restart "
               f"→ session {sid!r} continued from the disk tier; "
-              "--decode-kernel pallas boot token-identical; "
+              "--decode-kernel pallas + --autotune on boot "
+              "token-identical with a quiet error-free controller; "
               f"{base}: {_MESH_SHARDS}-shard mesh boot token-identical "
               "with replica-labelled metrics)")
         proc.terminate()
