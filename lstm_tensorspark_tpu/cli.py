@@ -1442,6 +1442,35 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "autotuner may tighten --best-effort-queue-frac "
                         "to when the state plane thrashes at its "
                         "capacity ceiling")
+    # --- model registry + rolling rollout (serve/registry.py, rollout.py) ---
+    p.add_argument("--registry-dir", type=str, default=None,
+                   help="model registry directory (serve/registry.py): "
+                        "attaches a rollout controller so POST /rollout "
+                        "(or a supervising trainer's publication) can "
+                        "roll a new model version across the replicas "
+                        "WITHOUT a restart — drain one replica (kept "
+                        "sessions migrate, queued work requeues), swap "
+                        "params, re-warm the compile-key lattice "
+                        "off-path, rejoin; one replica at a time, so "
+                        "capacity never drops below N-1. Also unlocks "
+                        "the autotuner's device-slot capacity leg")
+    p.add_argument("--model-id", type=str, default="default",
+                   help="model id this fleet boots as (the registry/"
+                        "routing namespace for the checkpoint loaded at "
+                        "startup; requests with no 'model' field route "
+                        "here)")
+    p.add_argument("--canary-every", type=int, default=0,
+                   help="canary routing during a rollout: shadow every "
+                        "Nth stateless request onto the first upgraded "
+                        "replica and token-diff its output against the "
+                        "primary before rolling the rest (report in "
+                        "/rollout 'last_canary' + serve_canary_diff_"
+                        "total{verdict}). 0 = no canary phase")
+    p.add_argument("--require-canary-match", action="store_true",
+                   help="abort the rollout (outcome 'canary_regression') "
+                        "when any canary pair token-diffs; without it "
+                        "the diff report is informational (sampled "
+                        "traffic diffs legitimately)")
     # --- per-tenant rate limiting (serve/router.py) ---
     p.add_argument("--tenant-rate", type=float, default=0,
                    help="per-tenant token-bucket rate limit (requests/s "
@@ -1772,6 +1801,9 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
             tiered_cache=args.tiered_cache == "on",
             host_tier_entries=args.host_tier_entries,
             session_dir=args.session_dir,
+            # the registry/routing namespace the boot checkpoint serves
+            # under (requests with no 'model' field route here)
+            model_id=getattr(args, "model_id", "default"),
             replica=i,
             decode_kernel=_single_decode_kernel(args),
             # one registry argument scopes the whole serve stack's
@@ -1842,7 +1874,16 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
                                  args.deadline_best_effort_s or None,
                          },
                          remote_replicas=tuple(
-                             getattr(args, "remote_replica", []) or ()))
+                             getattr(args, "remote_replica", []) or ()),
+                         model_registry=getattr(args, "registry_dir",
+                                                None) or None,
+                         rollout_kw={
+                             "canary_every":
+                                 getattr(args, "canary_every", 0),
+                             "require_canary_match":
+                                 getattr(args, "require_canary_match",
+                                         False),
+                         })
     return params, cfg, server
 
 

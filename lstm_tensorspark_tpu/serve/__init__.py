@@ -53,6 +53,18 @@ into a serving engine:
   never trigger a mid-traffic compile), with hysteresis so flat
   workloads never oscillate; decisions exported via ``/stats``
   ``autotune`` + ``serve_autotune_moves_total{knob,direction}``;
+- ``registry``: sha256-verified model artifact store (the training→
+  serving hand-off: ``supervise --registry-dir`` publishes each new
+  best checkpoint; corrupt artifacts are quarantined, never served);
+- ``rollout``: the zero-downtime rollout controller (``--registry-dir``
+  / ``POST /rollout``) — rolls a registry version across the replicas
+  one at a time (drain → swap → off-path warmup → rejoin; kept sessions
+  migrate, queued work requeues, capacity stays >= N-1), with optional
+  canary shadowing + token-diff before promotion, and the drain/rejoin
+  machinery doubles as the device-slot RESIZE move the autotuner's
+  capacity leg requests; the engine itself multiplexes N resident
+  models (per-model compile-key namespaces and slot accounting,
+  requests routed by their ``model`` field);
 - ``server``: stdlib ThreadingHTTPServer JSON endpoint + in-process
   client over the replica set, with ``GET /metrics`` Prometheus
   exposition of the stack's telemetry registry (obs/, ``replica``-
@@ -75,7 +87,13 @@ CLI: ``python -m lstm_tensorspark_tpu.cli serve --selftest`` (see cli.py).
 
 from .state_cache import CacheFullError, PrefixCache, SessionTiers, StateCache
 from .autotune import AutoTuneConfig, AutoTuner
-from .engine import PAD_TOKEN, DecodeWindow, SamplingParams, ServeEngine
+from .engine import (
+    PAD_TOKEN,
+    DecodeWindow,
+    SamplingParams,
+    ServeEngine,
+    UnknownModelError,
+)
 from .batcher import (
     CLASSES,
     Batcher,
@@ -83,6 +101,8 @@ from .batcher import (
     QueueFullError,
     Request,
 )
+from .registry import ModelRegistry, RegistryError, config_fingerprint
+from .rollout import RolloutController, RolloutError
 from .router import Replica, Router
 from .remote import RemoteBatcher, RemoteReplica
 from .server import InprocessClient, ServeServer
@@ -97,9 +117,13 @@ __all__ = [
     "DeadlineExceededError",
     "DecodeWindow",
     "InprocessClient",
+    "ModelRegistry",
     "PAD_TOKEN",
     "PrefixCache",
     "QueueFullError",
+    "RegistryError",
+    "RolloutController",
+    "RolloutError",
     "RemoteBatcher",
     "RemoteReplica",
     "Replica",
@@ -110,6 +134,8 @@ __all__ = [
     "ServeServer",
     "SessionTiers",
     "StateCache",
+    "UnknownModelError",
+    "config_fingerprint",
     "mesh_sweep",
     "replica_sweep",
     "run_loadgen",

@@ -108,6 +108,18 @@ class AutoTuneConfig:
     best_effort_floor: float = 0.1
     #: decision records kept for the /stats autotune section
     history: int = 32
+    #: device-slot growth ceiling for the rollout-controller resize leg
+    #: (None = 4x the boot slot count); the leg is inert without a
+    #: rollout controller on the server
+    slots_max: int | None = None
+    #: consecutive thrash-at-every-ceiling windows before a slot resize
+    #: is requested — a resize drains the whole fleet replica-by-replica
+    #: and recompiles, so it demands far more sustained evidence than
+    #: the cheap knobs
+    slots_patience: int = 8
+    #: quiet windows after a resize request (the roll itself takes many
+    #: windows; re-requesting mid-roll would just queue churn)
+    slots_cooldown: int = 40
 
     def validate(self) -> "AutoTuneConfig":
         if self.interval_s <= 0:
@@ -127,6 +139,15 @@ class AutoTuneConfig:
         if self.host_tier_max is not None and self.host_tier_max < 1:
             raise ValueError(
                 f"host_tier_max must be >= 1, got {self.host_tier_max}")
+        if self.slots_max is not None and self.slots_max < 1:
+            raise ValueError(
+                f"slots_max must be >= 1 or None, got {self.slots_max}")
+        if self.slots_patience < 1:
+            raise ValueError(
+                f"slots_patience must be >= 1, got {self.slots_patience}")
+        if self.slots_cooldown < 0:
+            raise ValueError(
+                f"slots_cooldown must be >= 0, got {self.slots_cooldown}")
         return self
 
 
@@ -160,7 +181,8 @@ class AutoTuner:
             "controller is oscillating; pin the knob and diagnose)",
             labelnames=("knob", "direction"))
         self._m_moves = {(k, d): fam.labels(knob=k, direction=d)
-                         for k in KNOBS for d in ("up", "down")}
+                         for k in KNOBS + ("slots",)
+                         for d in ("up", "down")}
         # per-consumer delta cursors (only the tick thread touches them)
         self._cur_ttft: dict | None = None
         self._cur_itl: dict | None = None
@@ -187,9 +209,15 @@ class AutoTuner:
         # hysteresis state + history (guarded by _lock: tick() writes,
         # stats() reads from HTTP threads)
         self._lock = threading.Lock()
-        self._streak = {k: 0 for k in KNOBS}
-        self._cooldown = {k: 0 for k in KNOBS}
-        self.moves = {k: {"up": 0, "down": 0} for k in KNOBS}
+        self._streak = {k: 0 for k in KNOBS + ("slots",)}
+        self._cooldown = {k: 0 for k in KNOBS + ("slots",)}
+        self.moves = {k: {"up": 0, "down": 0} for k in KNOBS + ("slots",)}
+        # the rollout-controller resize leg (the PR 14 residual: slot
+        # count is no longer a frozen boot shape)
+        self._initial_slots = server.engine.cache.num_slots
+        self._slots_max = (self.cfg.slots_max
+                           if self.cfg.slots_max is not None
+                           else 4 * self._initial_slots)
         self._history: deque = deque(maxlen=self.cfg.history)
         self._last_window: dict = {}
         self.ticks = 0
@@ -373,6 +401,13 @@ class AutoTuner:
             move = self._consider(knob, desires[knob])
             if move is not None:
                 applied.append(move)
+        # the capacity leg: only when EVERY cheap knob is exhausted —
+        # host tier at ceiling, admission at its shed floor, and the
+        # state plane still thrashing
+        move = self._consider_slots(
+            thrash and self._tier_at_max() and self._be_at_floor())
+        if move is not None:
+            applied.append(move)
         with self._lock:
             self.ticks += 1
             self._last_window = {
@@ -407,6 +442,45 @@ class AutoTuner:
     def _be_relaxable(self) -> bool:
         return (self.server.router.best_effort_frac
                 < self._initial_be_frac - 1e-9)
+
+    def _be_at_floor(self) -> bool:
+        return (self.server.router.best_effort_frac
+                <= self.cfg.best_effort_floor + 1e-9)
+
+    def _consider_slots(self, desired: bool) -> dict | None:
+        """The device-capacity leg (PR 14 residual closed): when the
+        state plane still thrashes AFTER the host tier hit its ceiling
+        and best-effort shedding hit its floor, every cheap knob is
+        exhausted — ask the rollout controller for more device slots
+        (a drain-and-rejoin resize move; serve/rollout.py). GROW-ONLY:
+        shrinking slots forcibly migrates kept sessions off every
+        replica, which is an operator decision (``POST /rollout``), not
+        a control loop's. Inert without a controller on the server —
+        the pre-registry fleet keeps its frozen boot shape."""
+        ctl = getattr(self.server, "rollout", None)
+        if ctl is None:
+            return None
+        with self._lock:
+            if self._cooldown["slots"] > 0:
+                self._cooldown["slots"] -= 1
+                self._streak["slots"] = 0
+                return None
+            if not desired:
+                self._streak["slots"] = 0
+                return None
+            self._streak["slots"] -= 1
+            if -self._streak["slots"] < self.cfg.slots_patience:
+                return None
+            self._streak["slots"] = 0
+        cur = self.server.engine.cache.num_slots
+        new = min(self._slots_max, cur * 2)
+        if new <= cur:
+            return None
+        ctl.request_resize(new)  # async: the controller thread rolls it
+        with self._lock:
+            self._cooldown["slots"] = self.cfg.slots_cooldown
+        return {"knob": "slots", "direction": "up",
+                "from": cur, "to": new, "via": "rollout"}
 
     def _consider(self, knob: str, desired: int) -> dict | None:
         """Hysteresis gate: ``desired`` (+1 grow / -1 shrink / 0 hold)
@@ -521,6 +595,10 @@ class AutoTuner:
                 "value": round(self.server.router.best_effort_frac, 4),
                 "initial": round(self._initial_be_frac, 4),
                 "floor": self.cfg.best_effort_floor},
+            "slots": {"value": self.server.engine.cache.num_slots,
+                      "initial": self._initial_slots,
+                      "max": self._slots_max,
+                      "via": "rollout"},
         }
         with self._lock:
             return {

@@ -99,7 +99,8 @@ import numpy as np
 
 from ..resilience import faults as _faults
 from ..utils import tracing
-from .engine import GREEDY, PAD_TOKEN, DecodeWindow, SamplingParams, ServeEngine
+from .engine import (GREEDY, PAD_TOKEN, DecodeWindow, SamplingParams,
+                     ServeEngine, UnknownModelError)
 from .state_cache import PREFIX_SID_NAMESPACE
 
 #: admission classes, in dequeue-priority order. "priority" is the
@@ -194,6 +195,7 @@ class Request:
         klass: str = "priority",
         deadline_s: float | None = None,
         tenant: str | None = None,
+        model: str | None = None,
     ):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
@@ -230,6 +232,17 @@ class Request:
                 raise ValueError(
                     "tenant must be a non-empty string of <= 256 chars")
         self.tenant = tenant
+        # multi-model multiplexing (serve/engine.py residents): which
+        # resident model serves this request. None = the replica's
+        # default model — the single-model fleet's behavior, unchanged.
+        # One dispatched batch is one model (like sampling configs), so
+        # the scheduler groups by it everywhere it groups by sampling.
+        if model is not None:
+            model = str(model)
+            if not model or len(model) > 256:
+                raise ValueError(
+                    "model must be a non-empty string of <= 256 chars")
+        self.model = model
         # absolute perf_counter deadline, stamped at FIRST submission so
         # the budget covers queue wait; a requeued request (replica
         # death) keeps its original deadline — the client's budget does
@@ -567,6 +580,14 @@ class Batcher:
                     f"{self.engine.max_prompt_len} "
                     "(enable prefill_chunk to serve longer prompts)"
                 )
+            if not self.engine.has_model(req.model):
+                # reject at the admission boundary, not at dispatch time:
+                # a request naming a non-resident model would otherwise
+                # consume a slot, reach _dispatch_prefill, and fail a
+                # whole co-batched dispatch with it
+                raise UnknownModelError(
+                    f"model {req.model!r} is not resident on replica "
+                    f"{self.replica}")
             if self._qlen_locked() >= self.queue_size:
                 # same honest-429 contract as the router's shed path:
                 # Retry-After from the measured queue wait, counted under
@@ -666,6 +687,22 @@ class Batcher:
                 "compile mid-traffic")
         with self._lock:
             self.window_cap = int(k)
+
+    def set_max_active(self, n: int) -> None:
+        """Move the active-set bound (the rollout controller's
+        slot-resize move resizes the device cache first, then raises or
+        lowers this to match). Bounded by the CURRENT slot count — the
+        same invariant __init__ enforces: admission must always be able
+        to pin a slot."""
+        if n < 1:
+            raise ValueError(f"max_active must be >= 1, got {n}")
+        if n > self.engine.cache.num_slots:
+            raise ValueError(
+                f"max_active {n} exceeds the cache's "
+                f"{self.engine.cache.num_slots} slots — resize the slot "
+                "pool first (rollout controller resize move)")
+        with self._lock:
+            self.max_active = int(n)
 
     def set_prefill_chunk(self, chunk: int) -> None:
         """Move the prefill chunk size to ``chunk`` (the autotuner's
@@ -814,9 +851,11 @@ class Batcher:
                     self._queues[cls].popleft()
                     dropped.append(head)
                     continue
-                # one prefill batch = one sampling config (compile key);
-                # FIFO at the picked head keeps admission starvation-free
-                if admit and head.sampling.key() != admit[0].sampling.key():
+                # one prefill batch = one sampling config AND one model
+                # (both are compile/dispatch keys); FIFO at the picked
+                # head keeps admission starvation-free
+                if admit and (head.sampling.key(), head.model) != (
+                        admit[0].sampling.key(), admit[0].model):
                     break
                 self._queues[cls].popleft()
                 self._wrr_idx = (jpos + 1) % nwrr
@@ -1037,6 +1076,7 @@ class Batcher:
         head = self._prefilling[0]
         final = self._next_stop(head, chunk) >= head.sess.req.prompt.size
         skey = head.sess.req.sampling.key()
+        mdl = head.sess.req.model
         batch = []
         for p in self._prefilling:
             if len(batch) >= self.engine.max_batch:
@@ -1045,6 +1085,11 @@ class Batcher:
                     >= p.sess.req.prompt.size) != final:
                 continue
             if final and p.sess.req.sampling.key() != skey:
+                continue
+            # one dispatch is one model's params — intermediate chunks
+            # included (the chunk program is sampling-free but not
+            # model-free)
+            if p.sess.req.model != mdl:
                 continue
             batch.append(p)
         return batch, final
@@ -1099,9 +1144,11 @@ class Batcher:
         t0 = time.perf_counter()
         try:
             if final:
-                first = self.engine.prefill(items, batch[0].sess.req.sampling)
+                first = self.engine.prefill(items, batch[0].sess.req.sampling,
+                                            model=batch[0].sess.req.model)
             else:
-                self.engine.prefill_chunk(items)
+                self.engine.prefill_chunk(items,
+                                          model=batch[0].sess.req.model)
                 self.prefill_chunks_dispatched += 1
                 self._m_chunks.inc()
         except Exception as e:
@@ -1188,12 +1235,14 @@ class Batcher:
         active = [s for s in active if not s.req.done.is_set()]
         if not active:
             return True
-        # pack by sampling config, chunk to the engine's largest batch
-        # bucket; iteration order == admission order (fairness: every
-        # active session advances exactly one token per step)
+        # pack by (sampling config, model) — both are dispatch keys;
+        # chunk to the engine's largest batch bucket; iteration order ==
+        # admission order (fairness: every active session advances
+        # exactly one token per step)
         groups: dict[tuple, list[_Session]] = {}
         for s in active:
-            groups.setdefault(s.req.sampling.key(), []).append(s)
+            groups.setdefault((s.req.sampling.key(), s.req.model),
+                              []).append(s)
         # steady-state fast path: the whole active set is one sampling
         # group in one batch bucket and nobody is waiting to be admitted —
         # advance K tokens in one program and let the NEXT iteration fetch
@@ -1217,7 +1266,9 @@ class Batcher:
                 toks = [s.last_token for s in chunk]
                 t0 = time.perf_counter()
                 try:
-                    nxt = self.engine.decode(slots, toks, chunk[0].req.sampling)
+                    nxt = self.engine.decode(slots, toks,
+                                             chunk[0].req.sampling,
+                                             model=chunk[0].req.model)
                 except Exception as e:
                     self._fail_chunk(
                         chunk, f"decode failed: {type(e).__name__}: {e}")
@@ -1258,6 +1309,7 @@ class Batcher:
                 [-1 if s.req.eos_id is None else s.req.eos_id
                  for s in sessions],
                 sessions[0].req.sampling, window=k,
+                model=sessions[0].req.model,
             )
         except Exception as e:
             self._fail_chunk(sessions, f"decode failed: {type(e).__name__}: {e}")
@@ -1507,6 +1559,7 @@ class Batcher:
             prefilling = len(self._prefilling)
             submitted, rejected = self.submitted, self.rejected
             window_cap, prefill_chunk = self.window_cap, self.prefill_chunk
+            max_active = self.max_active
         return {
             "replica": self.replica,
             "submitted": submitted,
@@ -1520,7 +1573,7 @@ class Batcher:
             "queued": queued,
             "active": active,
             "prefilling": prefilling,
-            "max_active": self.max_active,
+            "max_active": max_active,
             "queue_size": self.queue_size,
             "window_ladder": list(self.window_ladder),
             "window_cap": window_cap,

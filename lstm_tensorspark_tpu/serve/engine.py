@@ -109,6 +109,13 @@ assert pallas_decode.PAD_TOKEN == PAD_TOKEN  # one wire contract, two files
 DECODE_KERNELS = ("auto", "pallas", "scan")
 
 
+class UnknownModelError(Exception):
+    """A request named a model that is not resident on this engine (or,
+    at the router, on any live replica). Maps to HTTP 404 — the client
+    asked for something the fleet does not currently serve, which is
+    neither a bad request shape nor a capacity problem."""
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Per-request sampling config — static at trace time (one compiled
@@ -151,6 +158,9 @@ class DecodeWindow:
     # derives dispatch→fetch readback latency and the request timeline's
     # decode_window span from it (telemetry only — never device-ordered)
     t_dispatch: float = 0.0
+    # which resident model produced this window — decode_window_next
+    # dispatches the follow-up against the same model's params
+    model: str | None = None
 
 
 def _bucket_for(value: int, buckets: tuple[int, ...], what: str) -> int:
@@ -188,6 +198,8 @@ class ServeEngine:
         decode_kernel: str = "auto",
         mesh_shards: int = 1,
         mesh_devices=None,
+        model_id: str = "default",
+        model_version: int = 0,
     ):
         # serving never rematerialises (same override as generate())
         if cfg.remat_chunk is not None:
@@ -238,6 +250,21 @@ class ServeEngine:
             raise ValueError("mesh_devices needs mesh_shards > 1")
         self.params = params
         self.fused_layers = fuse_layers(params, cfg)  # once, at init
+        # ---- resident models -----------------------------------------
+        # N models (same LMConfig — the cache slots and bucket programs
+        # are shape-compatible across residents) live side by side; each
+        # dispatch resolves its (params, fused) pair by model id, and the
+        # batcher groups batches so one dispatch is one model. The
+        # DEFAULT model (``model_id``) keeps the legacy compile-key arity
+        # — a single-model fleet's keys, stats, and tests are unchanged;
+        # extra residents append their id to program/count keys (family
+        # string stays FIRST: graftlint warmup-coverage reads elts[0]).
+        self.model_id = str(model_id)
+        self._residents: dict[str, dict] = {
+            self.model_id: {"params": self.params,
+                            "fused": self.fused_layers,
+                            "version": model_version},
+        }
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         self.batch_buckets = tuple(sorted(batch_buckets))
         # the telemetry registry every serve-side component records into
@@ -364,6 +391,118 @@ class ServeEngine:
     def max_batch(self) -> int:
         return self.batch_buckets[-1]
 
+    # ---- resident models ----------------------------------------------
+
+    # The ``self._residents`` reads below are DELIBERATELY lock-free:
+    # updates REPLACE the dict wholesale (add/remove/swap never mutate it
+    # in place), so a reader sees either the whole old table or the whole
+    # new one — and stats/health/routing probes can never block behind an
+    # in-flight (possibly wedged) dispatch holding _lock.
+
+    def _resolve_model(self, model: str | None):
+        """``(model_id, params, fused, key_suffix)`` for one dispatch.
+        ``None`` means the default model; the default's suffix is empty so
+        its compile keys keep the legacy arity."""
+        mid = self.model_id if model is None else model
+        res = self._residents.get(mid)  # graftlint: disable=cross-thread-state
+        if res is None:
+            raise UnknownModelError(
+                f"model {mid!r} is not resident on this engine "
+                f"(resident: {sorted(self._residents)})")  # graftlint: disable=cross-thread-state
+        suffix = () if mid == self.model_id else (mid,)
+        return mid, res["params"], res["fused"], suffix
+
+    def has_model(self, model_id: str | None) -> bool:
+        return (model_id is None
+                or model_id in self._residents)  # graftlint: disable=cross-thread-state
+
+    @property
+    def model_version(self) -> int | str:
+        """The DEFAULT model's resident version (what a versionless
+        request is served by) — rollout observability's convergence
+        check."""
+        return self._residents[self.model_id]["version"]  # graftlint: disable=cross-thread-state
+
+    def resident_models(self) -> dict[str, int | str]:
+        """{model_id: version} of every resident (default included),
+        read via the wholesale-replace protocol above."""
+        residents = self._residents  # graftlint: disable=cross-thread-state
+        return {mid: res["version"] for mid, res in residents.items()}
+
+    def add_model(self, model_id: str, params, *, version: int | str = 0):
+        """Make a model resident (or replace one): mesh-place its params
+        like __init__ did for the boot model, fuse once, and install
+        under the dispatch lock — in-flight dispatches finish on the old
+        pair, the next dispatch reads the new one. Same-shape params
+        reuse the already-compiled programs (params are traced arguments,
+        not constants), so a same-model weight swap costs ZERO compiles;
+        a NEW model id gets its own compile-key namespace and must be
+        warmed before taking traffic (rollout controller's warmup
+        phase)."""
+        model_id = str(model_id)
+        if self.mesh is not None:
+            from ..parallel.tensor_parallel import place_lm_params
+            params = place_lm_params(params, self.mesh)
+        fused = fuse_layers(params, self.cfg)
+        with self._lock:
+            residents = dict(self._residents)
+            residents[model_id] = {
+                "params": params, "fused": fused, "version": version}
+            # REPLACE the table (resident_models reads it lock-free)
+            self._residents = residents
+            if model_id == self.model_id:
+                self.params = params
+                self.fused_layers = fused
+
+    def swap_model(self, params, *, model_id: str | None = None,
+                   version: int | str | None = None) -> None:
+        """Replace an ALREADY-resident model's params (the rolling-reload
+        swap step). Unlike :meth:`add_model` this refuses unknown ids —
+        a typoed rollout must fail loudly, not silently grow a second
+        resident nobody routes to."""
+        mid = self.model_id if model_id is None else str(model_id)
+        with self._lock:
+            if mid not in self._residents:
+                raise UnknownModelError(
+                    f"cannot swap model {mid!r}: not resident "
+                    f"(resident: {sorted(self._residents)})")
+            if version is None:
+                version = self._residents[mid]["version"]
+            self.add_model(mid, params, version=version)
+
+    def remove_model(self, model_id: str) -> None:
+        """Evict a non-default resident and its compiled programs. The
+        caller (rollout controller / server) is responsible for having
+        drained the model's sessions first — the engine only owns
+        params and programs."""
+        with self._lock:
+            if model_id == self.model_id:
+                raise ValueError(
+                    f"cannot remove the default model {model_id!r}")
+            if model_id not in self._residents:
+                raise UnknownModelError(
+                    f"model {model_id!r} is not resident")
+            residents = dict(self._residents)
+            residents.pop(model_id)
+            self._residents = residents
+            for cache in (self._prefill_fns, self._prefill_chunk_fns,
+                          self._decode_fns, self._decode_window_fns,
+                          self._decode_window_pallas_fns):
+                for key in [k for k in cache if k and k[-1] == model_id]:
+                    cache.pop(key)
+
+    def resize_slots(self, num_slots: int) -> None:
+        """Reallocate the state cache at a new device-slot count — the
+        rollout controller's drain-and-rejoin resize move (the PR 14
+        autotuner residual: slot count is no longer a frozen boot
+        shape). Only legal with no resident sessions; prefix entries are
+        dropped first (they are derived state, re-insertable)."""
+        prefix = self.prefix  # outside _lock: stats() reads it lock-free
+        if prefix is not None:
+            prefix.clear()  # takes the prefix cache's own lock
+        with self._lock:
+            self.cache.resize(num_slots)
+
     # ---- compiled programs --------------------------------------------
 
     def _admit_sampling(self, sampling: SamplingParams) -> None:
@@ -413,14 +552,15 @@ class ServeEngine:
         c_cache = c_cache.at[:, dst_slots, :].set(new_c.astype(jnp.float32))
         return h_cache, c_cache, ys
 
-    def _get_prefill_fn(self, batch_b: int, len_b: int, sampling: SamplingParams):
-        key = (batch_b, len_b, sampling.key())
+    def _get_prefill_fn(self, batch_b: int, len_b: int,
+                        sampling: SamplingParams, mkey: tuple = ()):
+        key = (batch_b, len_b, sampling.key(), *mkey)
         fn = self._prefill_fns.get(key)
         if fn is not None:
             return fn
         cfg = self.cfg
         count_key = ("prefill", batch_b, len_b, sampling.key(),
-                     *self._shard_suffix)
+                     *self._shard_suffix, *mkey)
 
         def prefill_fn(params, h_cache, c_cache, src_slots, dst_slots,
                        fresh, prompts, lengths, rng):
@@ -453,17 +593,19 @@ class ServeEngine:
         self._prefill_fns[key] = fn
         return fn
 
-    def _get_prefill_chunk_fn(self, batch_b: int, len_b: int):
+    def _get_prefill_chunk_fn(self, batch_b: int, len_b: int,
+                              mkey: tuple = ()):
         """An intermediate prefill chunk: consume up to ``len_b`` prompt
         tokens from a gathered state and scatter the advanced state — no
         head, no sampling (the final chunk's program does those), so one
         compile per ("prefill_chunk", batch-bucket, length-bucket) covers
         EVERY sampling config."""
-        key = (batch_b, len_b)
+        key = (batch_b, len_b, *mkey)
         fn = self._prefill_chunk_fns.get(key)
         if fn is not None:
             return fn
-        count_key = ("prefill_chunk", batch_b, len_b, *self._shard_suffix)
+        count_key = ("prefill_chunk", batch_b, len_b, *self._shard_suffix,
+                     *mkey)
 
         def chunk_fn(params, h_cache, c_cache, src_slots, dst_slots, fresh,
                      prompts, lengths):
@@ -479,13 +621,15 @@ class ServeEngine:
         self._prefill_chunk_fns[key] = fn
         return fn
 
-    def _get_decode_fn(self, batch_b: int, sampling: SamplingParams):
-        key = (batch_b, sampling.key())
+    def _get_decode_fn(self, batch_b: int, sampling: SamplingParams,
+                       mkey: tuple = ()):
+        key = (batch_b, sampling.key(), *mkey)
         fn = self._decode_fns.get(key)
         if fn is not None:
             return fn
         cfg = self.cfg
-        count_key = ("decode", batch_b, sampling.key(), *self._shard_suffix)
+        count_key = ("decode", batch_b, sampling.key(), *self._shard_suffix,
+                     *mkey)
 
         def decode_fn(params, fused, h_cache, c_cache, slots, tokens, rng):
             with self._counts_lock:
@@ -512,14 +656,14 @@ class ServeEngine:
         return fn
 
     def _get_decode_window_fn(self, batch_b: int, window: int,
-                              sampling: SamplingParams):
-        key = (batch_b, window, sampling.key())
+                              sampling: SamplingParams, mkey: tuple = ()):
+        key = (batch_b, window, sampling.key(), *mkey)
         fn = self._decode_window_fns.get(key)
         if fn is not None:
             return fn
         cfg = self.cfg
         count_key = ("decode_window", batch_b, window, sampling.key(),
-                     *self._shard_suffix)
+                     *self._shard_suffix, *mkey)
 
         def window_fn(params, fused, h_cache, c_cache, slots, tokens,
                       alive, remaining, eos_ids, rng):
@@ -575,7 +719,8 @@ class ServeEngine:
         return fn
 
     def _get_decode_window_pallas_fn(self, batch_b: int, window: int,
-                                     sampling: SamplingParams):
+                                     sampling: SamplingParams,
+                                     mkey: tuple = ()):
         """The fused Pallas decode window (ops/pallas_decode.py): same
         host-facing signature and handle shapes as the scan window fn,
         so `decode_window`/`decode_window_next` can dispatch either per
@@ -583,13 +728,13 @@ class ServeEngine:
         produced a `DecodeWindow`. Compile-key family
         ``("decode_window_pallas", bucket, K, sampling)`` — covered by
         `warmup` through the same `decode_window` calls."""
-        key = (batch_b, window, sampling.key())
+        key = (batch_b, window, sampling.key(), *mkey)
         fn = self._decode_window_pallas_fns.get(key)
         if fn is not None:
             return fn
         cfg = self.cfg
         count_key = ("decode_window_pallas", batch_b, window,
-                     sampling.key(), *self._shard_suffix)
+                     sampling.key(), *self._shard_suffix, *mkey)
         interpret = self._pallas_interpret
 
         def window_fn(params, fused, h_cache, c_cache, slots, tokens,
@@ -644,7 +789,7 @@ class ServeEngine:
                     sampled=not sampling.greedy))
 
     def _window_fn_for(self, batch_b: int, window: int,
-                       sampling: SamplingParams):
+                       sampling: SamplingParams, mkey: tuple = ()):
         """Pick the window program for this compile key: the fused
         Pallas kernel when selected AND it covers this (shape, sampling)
         — otherwise the scan window, with the fallback counted (a
@@ -652,10 +797,10 @@ class ServeEngine:
         if self.decode_kernel == "pallas":
             if self._pallas_window_ok(batch_b, window, sampling):
                 return self._get_decode_window_pallas_fn(
-                    batch_b, window, sampling)
+                    batch_b, window, sampling, mkey)
             with self._counts_lock:
                 self.decode_window_scan_fallbacks += 1
-        return self._get_decode_window_fn(batch_b, window, sampling)
+        return self._get_decode_window_fn(batch_b, window, sampling, mkey)
 
     # ---- host-facing steps --------------------------------------------
 
@@ -702,7 +847,8 @@ class ServeEngine:
             lens[i] = p.size
         return src, dst, fresh, prompts, lens, n, batch_b, len_b
 
-    def prefill(self, items, sampling: SamplingParams = GREEDY) -> np.ndarray:
+    def prefill(self, items, sampling: SamplingParams = GREEDY, *,
+                model: str | None = None) -> np.ndarray:
         """Run one bucketed prefill batch (the FINAL — or only — chunk of
         each row's prompt: ends with the head + sampler).
 
@@ -720,16 +866,17 @@ class ServeEngine:
         src, dst, fresh, prompts, lens, n, batch_b, len_b = (
             self._pack_prefill(self._norm_prefill_items(items)))
         with self._lock:
-            fn = self._get_prefill_fn(batch_b, len_b, sampling)
+            _, params, _, mkey = self._resolve_model(model)
+            fn = self._get_prefill_fn(batch_b, len_b, sampling, mkey)
             rng = self._next_rng(sampling)
-            h, c, tok = fn(self.params, self.cache.h, self.cache.c,
+            h, c, tok = fn(params, self.cache.h, self.cache.c,
                            jnp.asarray(src), jnp.asarray(dst),
                            jnp.asarray(fresh), jnp.asarray(prompts),
                            jnp.asarray(lens), rng)
             self.cache.swap(h, c)
         return np.asarray(tok)[:n]
 
-    def prefill_chunk(self, items) -> None:
+    def prefill_chunk(self, items, *, model: str | None = None) -> None:
         """Dispatch one INTERMEDIATE prefill chunk batch: advance each
         row's state over its chunk tokens and scatter it — no head, no
         sampling, nothing returned (async dispatch; the final chunk via
@@ -740,13 +887,15 @@ class ServeEngine:
         src, dst, fresh, prompts, lens, _, batch_b, len_b = (
             self._pack_prefill(self._norm_prefill_items(items)))
         with self._lock:
-            fn = self._get_prefill_chunk_fn(batch_b, len_b)
-            h, c = fn(self.params, self.cache.h, self.cache.c,
+            _, params, _, mkey = self._resolve_model(model)
+            fn = self._get_prefill_chunk_fn(batch_b, len_b, mkey)
+            h, c = fn(params, self.cache.h, self.cache.c,
                       jnp.asarray(src), jnp.asarray(dst), jnp.asarray(fresh),
                       jnp.asarray(prompts), jnp.asarray(lens))
             self.cache.swap(h, c)
 
-    def decode(self, slots, tokens, sampling: SamplingParams = GREEDY) -> np.ndarray:
+    def decode(self, slots, tokens, sampling: SamplingParams = GREEDY, *,
+               model: str | None = None) -> np.ndarray:
         """Advance each session one token: gather carries by ``slots`` [B],
         feed ``tokens`` [B], return the next token per row ``[B]`` int32.
         Pads to the batch bucket (dead rows at the scratch slot)."""
@@ -769,9 +918,10 @@ class ServeEngine:
         tokens_p[:n] = np.asarray(tokens, np.int32)
 
         with self._lock:
-            fn = self._get_decode_fn(batch_b, sampling)
+            _, params, fused, mkey = self._resolve_model(model)
+            fn = self._get_decode_fn(batch_b, sampling, mkey)
             rng = self._next_rng(sampling)
-            h, c, tok = fn(self.params, self.fused_layers, self.cache.h,
+            h, c, tok = fn(params, fused, self.cache.h,
                            self.cache.c, jnp.asarray(slots_p),
                            jnp.asarray(tokens_p), rng)
             self.cache.swap(h, c)
@@ -779,7 +929,7 @@ class ServeEngine:
 
     def decode_window(self, slots, tokens, remaining, eos_ids=None,
                       sampling: SamplingParams = GREEDY, *,
-                      window: int) -> DecodeWindow:
+                      window: int, model: str | None = None) -> DecodeWindow:
         """Dispatch one K-token decode window and return device HANDLES
         (no sync — pair with :meth:`fetch_window`).
 
@@ -811,12 +961,13 @@ class ServeEngine:
         alive_p[:n] = rem_p[:n] > 0
 
         with self._lock:
-            fn = self._window_fn_for(batch_b, window, sampling)
+            mid, params, fused, mkey = self._resolve_model(model)
+            fn = self._window_fn_for(batch_b, window, sampling, mkey)
             rng = self._next_rng(sampling)
             slots_d = jnp.asarray(slots_p)
             eos_d = jnp.asarray(eos_p)
             h, c, toks, next_tok, alive, rem = fn(
-                self.params, self.fused_layers, self.cache.h, self.cache.c,
+                params, fused, self.cache.h, self.cache.c,
                 slots_d, jnp.asarray(tokens_p), jnp.asarray(alive_p),
                 jnp.asarray(rem_p), eos_d, rng,
             )
@@ -825,6 +976,7 @@ class ServeEngine:
             tokens=toks, next_tokens=next_tok, alive=alive, remaining=rem,
             slots=slots_d, eos_ids=eos_d, batch_b=batch_b, window=window,
             n=n, sampling=sampling, t_dispatch=time.perf_counter(),
+            model=mid,
         )
 
     def decode_window_next(self, prev: DecodeWindow, *,
@@ -841,10 +993,12 @@ class ServeEngine:
         if not self._warming:
             _faults.serve_decode_hook()
         with self._lock:
-            fn = self._window_fn_for(prev.batch_b, window, prev.sampling)
+            _, params, fused, mkey = self._resolve_model(prev.model)
+            fn = self._window_fn_for(prev.batch_b, window, prev.sampling,
+                                     mkey)
             rng = self._next_rng(prev.sampling)
             h, c, toks, next_tok, alive, rem = fn(
-                self.params, self.fused_layers, self.cache.h, self.cache.c,
+                params, fused, self.cache.h, self.cache.c,
                 prev.slots, prev.next_tokens, prev.alive, prev.remaining,
                 prev.eos_ids, rng,
             )
@@ -881,7 +1035,8 @@ class ServeEngine:
                prompt_lens: tuple[int, ...] = (1,),
                batch_sizes: tuple[int, ...] | None = None,
                windows: tuple[int, ...] = (),
-               chunk_lens: tuple[int, ...] = ()) -> int:
+               chunk_lens: tuple[int, ...] = (),
+               models: tuple[str, ...] | None = None) -> int:
         """Pre-compile the bucket lattice a workload will touch (every
         batch bucket x the length buckets covering ``prompt_lens``, both
         phases, plus a ``decode_window`` program per batch bucket x each
@@ -903,29 +1058,38 @@ class ServeEngine:
             _bucket_for(t, self.prefill_buckets, "chunk length")
             for t in chunk_lens
         })
+        # every RESIDENT model warms its own program namespace (extra
+        # residents are separate traces — the rollout/canary path must
+        # never charge the first routed request a compile)
+        model_ids = (tuple(models) if models is not None
+                     else tuple(self._residents))  # graftlint: disable=cross-thread-state
         scratch = self.cache.scratch_slot
         self._warming = True
         try:
-            for b in batch_sizes:
-                bb = _bucket_for(b, self.batch_buckets, "batch")
-                for t in len_buckets:
-                    items = [(scratch, True, np.zeros((t,), np.int32))] * bb
-                    self.prefill(items, sampling)
-                for t in chunk_buckets:
-                    items = [(scratch, True, np.zeros((t,), np.int32))] * bb
-                    self.prefill_chunk(items)
-                self.decode([scratch] * bb, [0] * bb, sampling)
-                # every rung compiles as a window program — INCLUDING k=1:
-                # the batcher's sync path uses the fused decode fn for
-                # K=1, but the pipelined window tail dispatches K=1 as a
-                # decode_window, and an unwarmed one would compile in the
-                # middle of serving traffic
-                for k in sorted(set(windows)):
-                    win = self.decode_window(
-                        [scratch] * bb, [0] * bb, [k] * bb,
-                        sampling=sampling, window=k,
-                    )
-                    self.fetch_window(win)
+            for mid in model_ids:
+                for b in batch_sizes:
+                    bb = _bucket_for(b, self.batch_buckets, "batch")
+                    for t in len_buckets:
+                        items = [(scratch, True,
+                                  np.zeros((t,), np.int32))] * bb
+                        self.prefill(items, sampling, model=mid)
+                    for t in chunk_buckets:
+                        items = [(scratch, True,
+                                  np.zeros((t,), np.int32))] * bb
+                        self.prefill_chunk(items, model=mid)
+                    self.decode([scratch] * bb, [0] * bb, sampling,
+                                model=mid)
+                    # every rung compiles as a window program — INCLUDING
+                    # k=1: the batcher's sync path uses the fused decode
+                    # fn for K=1, but the pipelined window tail dispatches
+                    # K=1 as a decode_window, and an unwarmed one would
+                    # compile in the middle of serving traffic
+                    for k in sorted(set(windows)):
+                        win = self.decode_window(
+                            [scratch] * bb, [0] * bb, [k] * bb,
+                            sampling=sampling, window=k, model=mid,
+                        )
+                        self.fetch_window(win)
             if self.tiers is not None:
                 # the tier-fill scatter lattice is warmup-covered like
                 # every other program family: a continuation burst must
@@ -972,6 +1136,8 @@ class ServeEngine:
         return {
             "decode_kernel": self.decode_kernel,
             "mesh_shards": self.mesh_shards,
+            "model_id": self.model_id,
+            "models": self.resident_models(),
             "decode_window_scan_fallbacks": fallbacks,
             "cache": self.cache.stats(),
             "prefix_cache": None if self.prefix is None else self.prefix.stats(),

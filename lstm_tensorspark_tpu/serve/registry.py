@@ -1,0 +1,297 @@
+"""Model registry: sha256-verified artifacts behind the serve fleet.
+
+The registry is the hand-off point between training and serving
+(ROADMAP item 3): ``supervise`` publishes each new best checkpoint
+here, and the rollout controller (serve/rollout.py) pulls versions out
+to roll across replicas without a restart. It deliberately reuses the
+PR 2/8 durability core (train/checkpoint.py ``atomic_write`` /
+``read_verified``) instead of inventing a second torn-write story:
+every artifact is fsync'd, renamed into place, and carries a
+``.sha256`` sidecar that is checked on every read.
+
+Layout — one flat directory, three files per artifact:
+
+- ``<model>__v<version>.msgpack``            payload bytes
+- ``<model>__v<version>.msgpack.sha256``     integrity sidecar
+- ``<model>__v<version>.json``               metadata record
+
+The metadata record holds {model, version, kind, config_hash, parent,
+sha256, payload_bytes}: enough for an operator (or the version-skew
+runbook row) to answer "what is v7 and where did it come from" without
+deserializing the payload. ``parent`` names the checkpoint artifact the
+weights were promoted from (e.g. ``best.msgpack @ step 1200``).
+
+The in-memory manifest is an INDEX, not a source of truth: it is
+rebuilt from the directory on every :meth:`scan`, so a registry shared
+by a publishing supervisor and a serving fleet (or two fleets) needs no
+coordination beyond the filesystem's atomic rename. A payload that
+fails its checksum — truncation, bit rot, a torn copy — is QUARANTINED
+(all three files renamed ``*.quarantined``, kept for forensics) and
+drops out of the manifest: a corrupt artifact can be diagnosed but
+never served.
+
+Payload kinds:
+
+- ``"params"``     ``flax.serialization.to_bytes(params)`` — decoded
+  against the engine's params template via ``from_bytes``.
+- ``"best_state"`` the raw ``best.msgpack`` artifact a train run's
+  Checkpointer wrote (msgpack dict with ``step``/``value``/``state``) —
+  ``supervise`` publishes these bytes VERBATIM, so promotion never
+  deserializes multi-MB weights in the supervisor process; the serve
+  side extracts ``state["params"]`` against its template on load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import threading
+
+from flax import serialization
+
+from ..train.checkpoint import (
+    CorruptCheckpointError,
+    atomic_write,
+    read_verified,
+)
+
+
+class RegistryError(RuntimeError):
+    """Lookup failure: unknown model id / version, or an artifact that
+    was quarantined out from under the request."""
+
+
+# one naming authority for artifact files; version is zero-padded so a
+# plain directory listing sorts in version order for operators
+_ARTIFACT_PAT = re.compile(r"^(?P<model>[A-Za-z0-9._\-]+)__v(?P<ver>\d+)"
+                           r"\.msgpack$")
+
+
+def artifact_name(model_id: str, version: int) -> str:
+    return f"{model_id}__v{version:06d}.msgpack"
+
+
+def config_fingerprint(cfg) -> str:
+    """Stable short hash of a model config (dataclass or mapping) —
+    stored with every artifact so a rollout can refuse weights whose
+    architecture does not match the engine's resident config (the
+    "version skew" runbook row's third signature)."""
+    if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+        payload = dataclasses.asdict(cfg)
+    elif isinstance(cfg, dict):
+        payload = cfg
+    else:
+        payload = {"repr": repr(cfg)}
+    blob = json.dumps(payload, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class ModelRegistry:
+    """sha256-verified model artifact store (module docstring).
+
+    Thread-safe: ``publish``/``scan``/``load`` may be called from the
+    supervisor loop, the rollout controller's thread and HTTP handlers
+    concurrently — the lock only guards the manifest index; payload IO
+    runs outside it (the filesystem rename is the real arbiter)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._manifest: dict[str, dict[int, dict]] = {}
+        self.quarantined = 0  # artifacts set aside across this process
+        self.scan()
+
+    # ---- publishing -----------------------------------------------------
+
+    def publish(self, model_id: str, payload: bytes, *,
+                version: int | None = None, kind: str = "params",
+                config_hash: str | None = None,
+                parent: str | None = None) -> dict:
+        """Write one artifact atomically and index it. ``version=None``
+        allocates the next version for the model (max + 1, starting at
+        1). Returns the metadata record. The payload lands with its
+        sidecar BEFORE the metadata record: a crash between the two
+        leaves an unindexed-but-valid payload the next scan adopts
+        (metadata reconstructed minimally), never a record pointing at
+        missing bytes."""
+        if not model_id or "__v" in model_id or "/" in model_id:
+            raise ValueError(
+                f"invalid model id {model_id!r} (must be non-empty, no "
+                "'__v' or '/')")
+        if kind not in ("params", "best_state"):
+            raise ValueError(f"unknown artifact kind {kind!r}")
+        with self._lock:
+            if version is None:
+                have = self._manifest.get(model_id, {})
+                version = max(have, default=0) + 1
+            version = int(version)
+            if version < 1:
+                raise ValueError(f"version must be >= 1, got {version}")
+            if version in self._manifest.get(model_id, {}):
+                raise ValueError(
+                    f"{model_id} v{version} already published — versions "
+                    "are immutable, publish a new one")
+        name = artifact_name(model_id, version)
+        path = os.path.join(self.directory, name)
+        atomic_write(path, payload, checksum=True)
+        meta = {
+            "model": model_id,
+            "version": version,
+            "kind": kind,
+            "config_hash": config_hash,
+            "parent": parent,
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "payload_bytes": len(payload),
+        }
+        atomic_write(self._meta_path(path),
+                     json.dumps(meta, sort_keys=True).encode())
+        with self._lock:
+            self._manifest.setdefault(model_id, {})[version] = meta
+        return dict(meta)
+
+    # ---- index ----------------------------------------------------------
+
+    def scan(self) -> dict[str, list[int]]:
+        """Rebuild the manifest from the directory (the only source of
+        truth — a peer process may have published or quarantined since
+        the last scan). Verifies every payload against its sidecar and
+        quarantines failures HERE, at index time, so a corrupt artifact
+        is out of the manifest before anything can pick it. Returns
+        {model_id: sorted versions}."""
+        manifest: dict[str, dict[int, dict]] = {}
+        quarantined = 0
+        for fname in sorted(os.listdir(self.directory)):
+            m = _ARTIFACT_PAT.match(fname)
+            if m is None:
+                continue
+            path = os.path.join(self.directory, fname)
+            try:
+                payload = read_verified(path)
+            except (CorruptCheckpointError, OSError) as e:
+                print(f"registry: QUARANTINING {fname}: {e}", flush=True)
+                self._quarantine(path)
+                quarantined += 1
+                continue
+            meta = self._read_meta(path, m, payload)
+            manifest.setdefault(meta["model"], {})[meta["version"]] = meta
+        with self._lock:
+            self._manifest = manifest
+            self.quarantined += quarantined
+            return {mid: sorted(v) for mid, v in manifest.items()}
+
+    def _read_meta(self, path: str, m: re.Match, payload: bytes) -> dict:
+        try:
+            with open(self._meta_path(path)) as f:
+                return json.loads(f.read())
+        except (OSError, ValueError):
+            # publish crashed between payload and record (or the record
+            # was lost): the payload is verified-good, so adopt it with
+            # a reconstructed minimal record instead of stranding it
+            return {
+                "model": m.group("model"),
+                "version": int(m.group("ver")),
+                "kind": "params",
+                "config_hash": None,
+                "parent": None,
+                "sha256": hashlib.sha256(payload).hexdigest(),
+                "payload_bytes": len(payload),
+            }
+
+    def _meta_path(self, payload_path: str) -> str:
+        return payload_path[:-len(".msgpack")] + ".json"
+
+    def _quarantine(self, path: str) -> None:
+        for p in (path, path + ".sha256", self._meta_path(path)):
+            try:
+                os.replace(p, p + ".quarantined")
+            except OSError:
+                pass  # best effort; the next scan retries what remains
+
+    def models(self) -> dict[str, list[int]]:
+        with self._lock:
+            return {mid: sorted(vers)
+                    for mid, vers in self._manifest.items()}
+
+    def latest(self, model_id: str) -> dict | None:
+        """Newest version's metadata record, or None."""
+        with self._lock:
+            vers = self._manifest.get(model_id)
+            if not vers:
+                return None
+            return dict(vers[max(vers)])
+
+    def meta(self, model_id: str, version: int | None = None) -> dict:
+        with self._lock:
+            vers = self._manifest.get(model_id)
+            if not vers:
+                raise RegistryError(
+                    f"unknown model {model_id!r} (registry has "
+                    f"{sorted(self._manifest) or 'no models'})")
+            if version is None:
+                version = max(vers)
+            if version not in vers:
+                raise RegistryError(
+                    f"{model_id} has no version {version} "
+                    f"(have {sorted(vers)})")
+            return dict(vers[version])
+
+    # ---- loading --------------------------------------------------------
+
+    def load_bytes(self, model_id: str,
+                   version: int | None = None) -> tuple[dict, bytes]:
+        """Verified payload bytes + metadata. A checksum failure at THIS
+        point (corruption after the indexing scan) quarantines the
+        artifact, drops it from the manifest and raises
+        :class:`RegistryError` — a corrupt artifact is never served."""
+        meta = self.meta(model_id, version)
+        path = os.path.join(self.directory,
+                            artifact_name(meta["model"], meta["version"]))
+        try:
+            payload = read_verified(path)
+        except (CorruptCheckpointError, OSError) as e:
+            print(f"registry: QUARANTINING {os.path.basename(path)} at "
+                  f"load: {e}", flush=True)
+            self._quarantine(path)
+            with self._lock:
+                vers = self._manifest.get(meta["model"], {})
+                vers.pop(meta["version"], None)
+                if not vers:  # no versions left — drop the model entirely
+                    self._manifest.pop(meta["model"], None)
+                self.quarantined += 1
+            raise RegistryError(
+                f"{meta['model']} v{meta['version']} failed verification "
+                f"and was quarantined: {e}") from e
+        return meta, payload
+
+    def load_params(self, model_id: str, template,
+                    version: int | None = None) -> tuple[dict, object]:
+        """Decode an artifact into a params pytree shaped like
+        ``template`` (the serving engine's resident params — host copies
+        are fine; the engine re-places on device at swap). Dispatch on
+        the record's ``kind``; see the module docstring."""
+        meta, payload = self.load_bytes(model_id, version)
+        if meta.get("kind") == "best_state":
+            best = serialization.msgpack_restore(payload)
+            state_sd = serialization.msgpack_restore(best["state"])
+            params = serialization.from_state_dict(
+                template, state_sd["params"])
+        else:
+            params = serialization.from_bytes(template, payload)
+        return meta, params
+
+    # ---- views ----------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "directory": self.directory,
+                "models": {mid: sorted(vers)
+                           for mid, vers in self._manifest.items()},
+                "artifacts": sum(len(v)
+                                 for v in self._manifest.values()),
+                "quarantined": self.quarantined,
+            }

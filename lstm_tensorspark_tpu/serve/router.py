@@ -80,7 +80,7 @@ from .batcher import (
     register_shed_instruments,
     retry_after_from_p99,
 )
-from .engine import ServeEngine
+from .engine import ServeEngine, UnknownModelError
 from .state_cache import PREFIX_SID_NAMESPACE
 
 
@@ -89,7 +89,8 @@ class Replica:
     router and server agree on liveness; ``retired`` marks a dead
     replica whose cleanup (requeue/fail/migrate) already ran."""
 
-    __slots__ = ("index", "engine", "batcher", "thread", "retired")
+    __slots__ = ("index", "engine", "batcher", "thread", "retired",
+                 "draining")
 
     def __init__(self, index: int, engine: ServeEngine, batcher: Batcher):
         self.index = index
@@ -97,12 +98,21 @@ class Replica:
         self.batcher = batcher
         self.thread: threading.Thread | None = None
         self.retired = False  # claimed under the router lock, exactly once
+        # held out of rotation by the rollout controller: fresh routing,
+        # the admission bound and the death sweep all skip it (its
+        # scheduler thread is about to be stopped DELIBERATELY)
+        self.draining = False
 
     def alive(self) -> bool:
-        """Routable: never started (requests queue until ``start()``) or
-        the thread is running. Started-and-exited is dead."""
+        """Live: never started (requests queue until ``start()``) or the
+        thread is running. Started-and-exited is dead — except during a
+        drain, when the controller stops the thread on purpose."""
         return not self.retired and (
             self.thread is None or self.thread.is_alive())
+
+    def routable(self) -> bool:
+        """Eligible for routing: live AND not mid-drain."""
+        return self.alive() and not self.draining
 
     def stale(self, stale_after: float) -> bool:
         """Running but heartbeat-silent past ``stale_after`` — the wedge
@@ -164,6 +174,11 @@ class Router:
         self.stale_after = stale_after
         self._lock = threading.Lock()
         self._rr = itertools.count()  # round-robin tie-break cursor
+        # rollout canary hook: called with every successfully admitted
+        # request OUTSIDE the lock (the hook submits shadow work back
+        # through replica batchers — calling it under ``_lock`` would
+        # deadlock on re-entry through submit's own acquisition)
+        self._canary = None
         # the death sweep starts DISARMED: ServeServer.start() arms it
         # (set_stopping(False)) only once every scheduler thread is
         # running — otherwise a submit/probe racing the first start()
@@ -235,10 +250,11 @@ class Router:
         (the measured drain time), not a made-up constant."""
         self.sweep()
         with self._lock:
-            live = [r for r in self.replicas if r.alive()]
+            live = [r for r in self.replicas if r.routable()]
             if not live:
                 raise RuntimeError(
-                    "no live replica schedulers (all replicas dead)")
+                    "no routable replica schedulers (replicas dead or "
+                    "draining)")
             # per-tenant token bucket FIRST: a rate-limited tenant is
             # rejected before it can consume the shared queue bound the
             # other tenants' traffic lives under
@@ -274,6 +290,12 @@ class Router:
                     f"({queued} pending >= bound {bound}); retry after "
                     f"{retry:.2f}s", retry_after_s=retry)
             self._dispatch_locked(req, live)
+        canary = self._canary
+        if canary is not None:
+            try:
+                canary(req)
+            except Exception:
+                pass  # a shadow must never fail the admitted primary
 
     def _tenant_take_locked(self, tenant: str) -> float | None:
         """Take one token from ``tenant``'s bucket. Returns None when a
@@ -357,6 +379,16 @@ class Router:
         self._m_routed[target.index].inc()
 
     def _pick_locked(self, req: Request, live: list[Replica]) -> Replica:
+        if req.model is not None:
+            # multi-model routing: only replicas with the model resident
+            # are candidates — a miss everywhere is the client's error
+            # (HTTP 404), not a capacity condition
+            hosts = [r for r in live if r.engine.has_model(req.model)]
+            if not hosts:
+                raise UnknownModelError(
+                    f"model {req.model!r} is not resident on any "
+                    "routable replica")
+            live = hosts
         sid = req.session_id
         if sid is not None:
             # affinity: the replica holding the session's carries owns the
@@ -369,6 +401,13 @@ class Router:
             for r in live:
                 if sid in r.engine.cache:
                     return r
+            # drain affinity: a DRAINING replica's kept sessions migrate
+            # off as they go idle — a continuation racing that migration
+            # is migrated HERE, just in time, so it never fails "unknown
+            # session" mid-rollout
+            target = self._drain_affinity_locked(sid, live)
+            if target is not None:
+                return target
             # tier residency (SessionTiers): the session was spilled off
             # its device slot. MEMORY tiers first — a replica holding the
             # session in its pending/host/evacuating tiers is the OWNER
@@ -423,6 +462,103 @@ class Router:
         cands = [r for load, r in loads if load == lo]
         return cands[next(self._rr) % len(cands)]
 
+    def _drain_affinity_locked(self, sid: str,
+                               live: list[Replica]) -> Replica | None:
+        """Resolve a continuation whose session still lives on a
+        DRAINING replica. Idle sessions are detach/restored onto a live
+        peer right now — O(1) LSTM state, KBs, same cost bound as the
+        fill-ahead this lock already tolerates — and the continuation
+        follows, token-identical. A pinned (in-flight) session routes to
+        the drainee itself while its scheduler still runs: it finishes
+        the active work, and the session migrates once idle. Overlapping
+        same-session submits can still race the detach window and fail
+        once — the documented transient, unchanged by rollouts."""
+        for d in self.replicas:
+            if not d.draining or d.retired:
+                continue
+            cache = d.engine.cache
+            if sid in cache:
+                if cache.is_pinned(sid):
+                    if d.alive():
+                        return d
+                    continue  # stopped mid-flight: unreachable in a
+                    # controller-sequenced drain (load hits 0 first)
+                try:
+                    state = d.engine.detach_session(sid)
+                except KeyError:
+                    continue  # went idle and migrated under our probe
+                healthy = [r for r in live
+                           if not r.stale(self.stale_after)]
+                for target in sorted(healthy or live,
+                                     key=lambda r: r.batcher.load()):
+                    try:
+                        target.engine.restore_session(sid, state)
+                    except Exception:
+                        continue  # every slot pinned: try the next
+                    self.migrated_sessions += 1
+                    self._m_migrated.inc()
+                    return target
+                # nowhere to put it: undo — serve where the state is
+                d.engine.restore_session(sid, state)
+                return d if d.alive() else None
+            tiers = d.engine.tiers
+            if (tiers is not None and tiers.has_memory(sid)
+                    and d.alive()):
+                # the drainee owns the freshest boundary and its
+                # scheduler still runs — admission fills from the tier.
+                # Once the controller stops the thread it evacuates the
+                # tiers immediately, so the post-stop window falls
+                # through to the shared-disk probe instead of hanging.
+                return d
+        return None
+
+    # ---- rollout drain (controller-driven) ------------------------------
+
+    def begin_drain(self, index: int) -> Replica:
+        """Take replica ``index`` out of rotation for a rolling swap or
+        resize. One replica at a time, and never the last routable one,
+        so serving capacity stays >= N-1 for the whole rollout. The
+        death sweep skips a draining replica — its scheduler thread is
+        stopped deliberately, not dead."""
+        with self._lock:
+            rep = self._replica_locked(index)
+            if rep.retired:
+                raise ValueError(f"replica {index} is retired")
+            for r in self.replicas:
+                if r.draining and r is not rep:
+                    raise RuntimeError(
+                        f"replica {r.index} is already draining; "
+                        "rollouts move one replica at a time")
+            if not any(r.routable() and r is not rep
+                       for r in self.replicas):
+                raise RuntimeError(
+                    "cannot drain the last routable replica")
+            rep.draining = True
+            return rep
+
+    def end_drain(self, index: int) -> None:
+        """Return a drained replica to rotation (rollout rejoin)."""
+        with self._lock:
+            self._replica_locked(index).draining = False
+
+    def _replica_locked(self, index: int) -> Replica:
+        for r in self.replicas:
+            if r.index == index:
+                return r
+        raise ValueError(f"no replica with index {index}")
+
+    # ---- canary shadowing ----------------------------------------------
+
+    def set_canary(self, hook) -> None:
+        """Install the rollout controller's shadow hook: called with
+        every successfully admitted request, OUTSIDE the router lock.
+        Exceptions are swallowed at the call site — a shadow must never
+        fail the primary it mirrors."""
+        self._canary = hook
+
+    def clear_canary(self) -> None:
+        self._canary = None
+
     # ---- replica-death handling ----------------------------------------
 
     def set_stopping(self, stopping: bool) -> None:
@@ -441,7 +577,10 @@ class Router:
             if self._stopping:
                 return
             for r in self.replicas:
-                if (not r.retired and r.thread is not None
+                # a draining replica's thread is stopped DELIBERATELY by
+                # the rollout controller — not a death
+                if (not r.retired and not r.draining
+                        and r.thread is not None
                         and not r.thread.is_alive()):
                     r.retired = True  # claim under the lock, clean outside
                     claimed.append(r)
@@ -459,18 +598,37 @@ class Router:
             "(state lost — resend the request)")
         # migrate idle kept sessions FIRST so a drained continuation is
         # requeued to wherever its state now lives
+        self.migrate_from(dead)
+        self.requeue(drained, dead)
+        with self._lock:
+            self.failed_on_death += failed
+        if failed:
+            self._m_failed_death.inc(failed)
+
+    def migrate_from(self, rep: Replica) -> tuple[int, int]:
+        """Move every kept session off ``rep``: device-resident idle
+        sessions via detach/restore onto a live healthy peer, tier-held
+        sessions via :meth:`SessionTiers.evacuate` (shared disk when one
+        exists, else adopted into a peer's host tier). Shared by
+        replica-death retirement and the rollout controller's drain —
+        which is why targets exclude ``rep`` explicitly and skip
+        draining peers rather than relying on ``alive()`` alone.
+        Returns ``(migrated, lost)`` and folds both into the router's
+        aggregate counters. Runs OUTSIDE the router lock (takes it
+        briefly per session)."""
         migrated = lost = 0
-        for sid in dead.engine.cache.session_ids():
+        for sid in rep.engine.cache.session_ids():
             if sid.startswith(PREFIX_SID_NAMESPACE):
                 continue  # prefix entries are an optimisation — they die
                 # with their replica and re-seed from live traffic
             try:
-                state = dead.engine.detach_session(sid)
+                state = rep.engine.detach_session(sid)
             except KeyError:
                 continue  # raced an eviction; nothing to move
             placed = False
             with self._lock:
-                targets = [r for r in self.replicas if r.alive()]
+                targets = [r for r in self.replicas
+                           if r.routable() and r is not rep]
             # healthy targets ONLY — no wedged fallback: a wedged
             # replica's engine lock may be held across a dispatch that
             # never returns, so restore_session could block this thread
@@ -503,19 +661,20 @@ class Router:
             else:
                 lost += 1
         # tier-held sessions (spilled to host RAM / pending spills) are
-        # still reachable — the replica's THREAD died, not the process.
-        # Persist them to the shared disk tier when one exists (any live
-        # replica then fills from it on demand), else adopt them into a
-        # live healthy replica's host tier.
-        if dead.engine.tiers is not None:
-            persisted, homeless = dead.engine.tiers.evacuate()
+        # still reachable — the replica's THREAD died (or was stopped),
+        # not the process. Persist them to the shared disk tier when one
+        # exists (any live replica then fills from it on demand), else
+        # adopt them into a live healthy replica's host tier.
+        if rep.engine.tiers is not None:
+            persisted, homeless = rep.engine.tiers.evacuate()
             migrated += persisted
             if persisted:
                 self._m_migrated.inc(persisted)
             for sid, state in homeless:
                 with self._lock:
                     targets = [r for r in self.replicas
-                               if r.alive() and r.engine.tiers is not None
+                               if r.routable() and r is not rep
+                               and r.engine.tiers is not None
                                and not r.stale(self.stale_after)]
                 target = min(targets, key=lambda r: r.batcher.load(),
                              default=None)
@@ -525,22 +684,34 @@ class Router:
                     self._m_migrated.inc()
                 else:
                     lost += 1
+        with self._lock:
+            self.migrated_sessions += migrated
+            self.lost_sessions += lost
+        return migrated, lost
+
+    def requeue(self, reqs: list[Request], source: Replica) -> int:
+        """Resubmit drained, not-yet-admitted requests through the
+        normal routing path. Deadlines survive: ``Batcher.submit`` only
+        stamps ``t_submit``/``deadline`` when unset, so a requeued
+        request keeps its original clock. No global-bound recheck —
+        these requests already held queue slots before the drain.
+        Concurrent submits can still steal that headroom (the drain
+        released it before this loop re-enqueues), so capacity is
+        checked under the router lock (every client submit serialises
+        through it) and a full affinity pick falls back to any live
+        replica with room — no exception-driven retry, so the
+        per-replica rejected counters never see these internal probes.
+        Returns the number requeued; the rest fail honestly on
+        ``source``'s batcher. Shared by replica-death retirement and
+        the rollout controller's drain."""
         requeued = 0
-        for req in drained:
+        for req in reqs:
             try:
                 with self._lock:
-                    live = [r for r in self.replicas if r.alive()]
+                    live = [r for r in self.replicas
+                            if r.routable() and r is not source]
                     if not live:
                         raise RuntimeError("no live replica schedulers")
-                    # no global-bound recheck: these requests already held
-                    # queue slots before the death. Concurrent submits can
-                    # still steal that headroom (the drain released it
-                    # before this loop re-enqueues), so capacity is
-                    # checked under the router lock (every client submit
-                    # serialises through it) and a full affinity pick
-                    # falls back to any live replica with room — no
-                    # exception-driven retry, so the per-replica
-                    # rejected counters never see these internal probes.
                     target = self._pick_locked(req, live)
                     if target.batcher.queued() >= self.queue_size:
                         if req.session_id is not None:
@@ -563,16 +734,12 @@ class Router:
                 requeued += 1
                 self._m_requeued.inc()
             except Exception as e:
-                dead.batcher.fail_request(
-                    req, f"replica {dead.index} scheduler died and the "
-                         f"request could not be requeued: {e}")
+                source.batcher.fail_request(
+                    req, f"replica {source.index} went out of rotation "
+                         f"and the request could not be requeued: {e}")
         with self._lock:
             self.requeued += requeued
-            self.failed_on_death += failed
-            self.migrated_sessions += migrated
-            self.lost_sessions += lost
-        if failed:
-            self._m_failed_death.inc(failed)
+        return requeued
 
     # ---- views ---------------------------------------------------------
 
@@ -582,6 +749,8 @@ class Router:
                 "replicas": len(self.replicas),
                 "live": sum(1 for r in self.replicas if r.alive()),
                 "retired": [r.index for r in self.replicas if r.retired],
+                "draining": [r.index for r in self.replicas
+                             if r.draining],
                 "queue_size": self.queue_size,
                 "routed": {str(k): v
                            for k, v in sorted(self.routed.items())},

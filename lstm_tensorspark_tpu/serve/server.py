@@ -61,8 +61,24 @@ from .batcher import (
     QueueFullError,
     Request,
 )
-from .engine import GREEDY, SamplingParams, ServeEngine
+from .engine import GREEDY, SamplingParams, ServeEngine, UnknownModelError
 from .router import Replica, Router
+
+
+class _ReplicaStop:
+    """Per-replica stop signal layered over the server-wide one: the
+    rollout controller stops ONE scheduler (drain → swap → rejoin)
+    without touching its peers. ``Batcher.run`` only calls
+    ``is_set()``, so this tiny OR-view is the whole contract."""
+
+    __slots__ = ("server_stop", "local")
+
+    def __init__(self, server_stop: threading.Event):
+        self.server_stop = server_stop
+        self.local = threading.Event()
+
+    def is_set(self) -> bool:
+        return self.server_stop.is_set() or self.local.is_set()
 
 #: aggregated batcher counters summed across replicas in stats(); config
 #: fields (window ladder etc.) are taken from replica 0 instead
@@ -100,7 +116,9 @@ class ServeServer:
                  remote_replicas: tuple[str, ...] = (),
                  autotune=None,
                  tenant_rate: float | None = None,
-                 tenant_burst: float = 5.0, **batcher_kw):
+                 tenant_burst: float = 5.0,
+                 model_registry=None,
+                 rollout_kw: dict | None = None, **batcher_kw):
         engines = (list(engine) if isinstance(engine, (list, tuple))
                    else [engine])
         if not engines:
@@ -179,6 +197,22 @@ class ServeServer:
             from .autotune import AutoTuner
 
             self.autotuner = AutoTuner(self, autotune)
+        # rollout controller (serve/rollout.py): registry-backed rolling
+        # weight swaps and slot resizes over this stack. None (the
+        # default) = no registry, no controller thread, no new behavior.
+        # ``model_registry`` is a ModelRegistry or a directory path.
+        self.rollout = None
+        if model_registry is not None:
+            from .rollout import RolloutController
+
+            self.rollout = RolloutController(
+                self, model_registry, **(rollout_kw or {}))
+        # the last warmup spec, remembered so the rollout controller can
+        # replay the full compile-key lattice off-path before a swapped/
+        # resized replica rejoins (None until warmup() runs)
+        self._warmup_spec: tuple | None = None
+        self._replica_stops: dict[int, _ReplicaStop] = {}
+        self._model_info_seen: set[tuple[str, str]] = set()
         # optional periodic death sweep: the sweep normally piggybacks on
         # submits and health probes, so a dead replica on a QUIET server
         # is only retired when the next probe lands — an interval makes
@@ -217,14 +251,7 @@ class ServeServer:
             # flag set would make the router refuse them forever while
             # health reports the new thread alive
             r.retired = False
-            # target resolved at start time so tests can monkeypatch
-            # replica batchers' run/step before (or between) starts
-            t = threading.Thread(
-                target=r.batcher.run, args=(self._stop,),
-                name=f"serve-scheduler-{r.index}", daemon=True,
-            )
-            r.thread = t
-            t.start()
+            self._start_replica(r)
         # re-arm the death sweep only once every thread is RUNNING: a
         # concurrent probe/submit sweeping between `r.thread = t` and
         # `t.start()` would see a not-yet-alive thread and retire a
@@ -237,7 +264,33 @@ class ServeServer:
             t.start()
         if self.autotuner is not None:
             self.autotuner.start()
+        if self.rollout is not None:
+            self.rollout.start()
         return self
+
+    def _start_replica(self, r: Replica) -> None:
+        """Start (or restart, after a rollout drain) one replica's
+        scheduler thread under a fresh per-replica stop signal. Target
+        resolved at start time so tests can monkeypatch replica
+        batchers' run/step before (or between) starts."""
+        stop = _ReplicaStop(self._stop)
+        self._replica_stops[r.index] = stop
+        t = threading.Thread(
+            target=r.batcher.run, args=(stop,),
+            name=f"serve-scheduler-{r.index}", daemon=True,
+        )
+        r.thread = t
+        t.start()
+
+    def _stop_replica(self, r: Replica, timeout: float = 10.0) -> None:
+        """Stop ONE replica's scheduler (the rollout controller's drain
+        step — the replica must already be out of rotation and idle;
+        the run loop's exit path would fail anything still pending)."""
+        stop = self._replica_stops.get(r.index)
+        if stop is not None:
+            stop.local.set()
+        if r.thread is not None:
+            r.thread.join(timeout=timeout)
 
     def _sweep_loop(self) -> None:
         # stop() sets self._stop, which this loop's wait reads — the
@@ -246,9 +299,11 @@ class ServeServer:
             self.router.sweep()
 
     def stop(self) -> None:
-        # the controller parks FIRST: knobs must not move while the
-        # schedulers are being joined (its thread is joined here — the
-        # thread-lifecycle contract)
+        # the controllers park FIRST: knobs must not move and no drain
+        # may start while the schedulers are being joined (both threads
+        # are joined here — the thread-lifecycle contract)
+        if self.rollout is not None:
+            self.rollout.stop()
         if self.autotuner is not None:
             self.autotuner.stop()
         # mark the stop BEFORE joining: the router's death sweep must not
@@ -281,7 +336,12 @@ class ServeServer:
         programs). Delegates to each batcher, which derives the chunk /
         prefix-insert split and window-ladder programs from its own
         policy — the one warmup entry point front-ends should use.
-        Returns the total number of cached programs across replicas."""
+        Returns the total number of cached programs across replicas.
+
+        The spec is remembered: the rollout controller replays it on a
+        swapped/resized replica before that replica rejoins rotation, so
+        a rollout never reintroduces mid-traffic compiles."""
+        self._warmup_spec = (sampling, tuple(prompt_lens))
         return sum(r.batcher.warmup(sampling, prompt_lens=prompt_lens)
                    for r in self.replicas)
 
@@ -307,6 +367,7 @@ class ServeServer:
         klass: str = "priority",
         deadline_s: float | None = None,
         tenant: str | None = None,
+        model: str | None = None,
     ) -> Request:
         """Submit and block until the request completes; returns the filled
         :class:`Request` (``.tokens``, ``.session_id``, ``.replica``,
@@ -331,7 +392,7 @@ class ServeServer:
             prompt, max_new_tokens, sampling=sampling,
             session_id=session_id, keep_session=keep_session, eos_id=eos_id,
             use_prefix=use_prefix, klass=klass, deadline_s=deadline_s,
-            tenant=tenant,
+            tenant=tenant, model=model,
         )
         self.router.submit(req)
         if not req.done.wait(timeout):
@@ -439,7 +500,27 @@ class ServeServer:
                 # controller decisions + the last windowed (recent-
                 # biased) signal deltas; None = autotuning off
                 "autotune": (None if self.autotuner is None
-                             else self.autotuner.stats())}
+                             else self.autotuner.stats()),
+                # registry/rollout state; None = no registry attached
+                "rollout": (None if self.rollout is None
+                            else self.rollout.stats()),
+                # fleet-wide model residency {model: {version: replica
+                # count}} — two versions of one model nonzero at once
+                # OUTSIDE an active rollout is the version-skew runbook
+                # signature
+                "models": self.resident_models()}
+
+    def resident_models(self) -> dict:
+        """{model: {version: replica_count}} across local replicas."""
+        models: dict = {}
+        for r in self.replicas:
+            resident = getattr(r.engine, "resident_models", None)
+            if resident is None:
+                continue
+            for mid, ver in resident().items():
+                by_ver = models.setdefault(mid, {})
+                by_ver[str(ver)] = by_ver.get(str(ver), 0) + 1
+        return models
 
     def _collect_gauges(self) -> None:
         """Refresh poll-style gauges at scrape time — an idle server's
@@ -489,6 +570,25 @@ class ServeServer:
                         labelnames=("state",))
         fam.labels(state="live").set(live)
         fam.labels(state="dead").set(dead)
+        # model residency: replicas hosting each (model, version). Pairs
+        # that vanish (a completed rollout's old version) are pinned to
+        # 0, not dropped — a flatlined-to-zero child is how the scrape
+        # side SEES the cutover complete
+        fam = reg.gauge(
+            "serve_model_info",
+            "replicas hosting each resident model version (two versions "
+            "of one model nonzero at once outside a rollout = version "
+            "skew; see docs/OPERATIONS.md)",
+            labelnames=("model", "version"))
+        current = {}
+        for mid, by_ver in self.resident_models().items():
+            for ver, count in by_ver.items():
+                current[(mid, ver)] = count
+        for key in self._model_info_seen - set(current):
+            fam.labels(model=key[0], version=key[1]).set(0)
+        for (mid, ver), count in current.items():
+            fam.labels(model=mid, version=ver).set(count)
+        self._model_info_seen |= set(current)
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the serve stack's registry
@@ -536,6 +636,9 @@ class ServeServer:
                 "alive": bool(alive),
                 "stale": bool(stale),
                 "retired": bool(r.retired),
+                # mid-rollout: out of rotation on purpose — a "degraded"
+                # verdict while this is set is the planned N-1 window
+                "draining": bool(getattr(r, "draining", False)),
                 "seconds_since_last_iteration":
                     None if age is None else round(age, 3),
                 "queued": st["queued"],
@@ -674,6 +777,16 @@ class _Handler(BaseHTTPRequestHandler):
                             "has_session needs ?sid=", retryable=False)
             else:
                 self._reply(200, {"has": self._serve.has_session(sid)})
+        elif self.path == "/rollout":
+            # rollout-controller state: active move, queue, history,
+            # last canary report, registry manifest
+            if self._serve.rollout is None:
+                self._error(404, "not_found",
+                            "no model registry attached (start the "
+                            "server with --registry-dir)",
+                            retryable=False)
+            else:
+                self._reply(200, self._serve.rollout.stats())
         else:
             self._error(404, "not_found", f"no route {self.path}",
                         retryable=False)
@@ -699,6 +812,34 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._reply(200, {"programs": n})
             return
+        if self.path == "/rollout":
+            # enqueue a rolling swap ({"model": ..., "version": N?}) or
+            # a slot resize ({"slots": N}) for the controller thread;
+            # 202 — the roll happens replica-by-replica off this request
+            if self._serve.rollout is None:
+                self._error(404, "not_found",
+                            "no model registry attached (start the "
+                            "server with --registry-dir)",
+                            retryable=False)
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                slots = body.get("slots")
+                if slots is not None:
+                    move = self._serve.rollout.request_resize(int(slots))
+                else:
+                    version = body.get("version")
+                    move = self._serve.rollout.request_rollout(
+                        str(body["model"]),
+                        None if version is None else int(version))
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._error(400, "bad_request", f"bad request: {e}",
+                            retryable=False)
+                return
+            self._reply(202, {"accepted": True, **move})
+            return
         if self.path != "/v1/generate":
             self._error(404, "not_found", f"no route {self.path}",
                         retryable=False)
@@ -723,6 +864,10 @@ class _Handler(BaseHTTPRequestHandler):
             # bucket identity; absent = untenanted, never rate-limited
             tenant = body.get("tenant")
             tenant = None if tenant is None else str(tenant)
+            # multi-model multiplexing: absent = the default model —
+            # the single-model fleet's behavior, unchanged
+            model = body.get("model")
+            model = None if model is None else str(model)
         except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
             # TypeError included: {"max_new_tokens": null} etc. must be a
             # 400, not a handler crash that resets the connection
@@ -738,8 +883,14 @@ class _Handler(BaseHTTPRequestHandler):
                 eos_id=body.get("eos_id"),
                 use_prefix=bool(body.get("use_prefix", True)),
                 timeout=timeout, klass=klass, deadline_s=deadline_s,
-                tenant=tenant,
+                tenant=tenant, model=model,
             )
+        except UnknownModelError as e:
+            # the model is not resident anywhere in the fleet: the
+            # client named a thing that does not exist — 404, like an
+            # unknown route, not a capacity condition
+            self._error(404, "unknown_model", str(e), retryable=False)
+            return
         except QueueFullError as e:
             # the shed path: retryable by definition, with the router's
             # live drain estimate as the honest Retry-After

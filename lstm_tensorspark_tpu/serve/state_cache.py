@@ -110,6 +110,11 @@ class StateCache:
         self.num_layers = num_layers
         self.num_slots = num_slots
         self.hidden_size = hidden_size
+        # remembered for resize(): a reallocated array pair must land
+        # exactly where the originals did (committed device / mesh
+        # sharding), or the engine's programs would recompile against a
+        # different placement
+        self._placement = device if device is not None else sharding
         # +1: the scratch slot for padded batch rows (index == num_slots)
         self.h = jnp.zeros((num_layers, num_slots + 1, hidden_size), jnp.float32)
         self.c = jnp.zeros((num_layers, num_slots + 1, hidden_size), jnp.float32)
@@ -154,7 +159,10 @@ class StateCache:
 
     @property
     def scratch_slot(self) -> int:
-        return self.num_slots
+        # lock-free on the hot dispatch path: resize() only rebinds
+        # num_slots with the cache drained (no sessions, no dispatches),
+        # and a plain int rebind cannot tear
+        return self.num_slots  # graftlint: disable=cross-thread-state
 
     # ---- session table -------------------------------------------------
 
@@ -227,6 +235,13 @@ class StateCache:
     def unpin(self, session_id: str) -> None:
         with self._lock:
             self._pinned.discard(session_id)
+
+    def is_pinned(self, session_id: str) -> bool:
+        """True while the session's slot is held by active work — the
+        router's drain path must not detach a pinned session (its
+        in-flight decode still writes the slot)."""
+        with self._lock:
+            return session_id in self._pinned
 
     def __contains__(self, session_id: str) -> bool:
         with self._lock:
@@ -367,6 +382,37 @@ class StateCache:
                 jnp.asarray(state.c)[:, None, :],
             )
             return slot
+
+    def resize(self, num_slots: int) -> None:
+        """Reallocate the slot arrays at a new slot count (the rollout
+        controller's drained-replica resize move). Only legal while NO
+        sessions are resident — live carries would not survive the
+        reallocation, so the caller drains/migrates first. The new
+        arrays keep the original placement (committed device or mesh
+        sharding); the bucket programs themselves are slot-count
+        agnostic (slots are a gather index, the array's slot axis is a
+        shape), so a resize invalidates compiled programs exactly like
+        any other shape change — warm up before rejoining traffic."""
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        with self._lock:
+            if self._slots:
+                raise RuntimeError(
+                    f"cannot resize with {len(self._slots)} resident "
+                    "sessions — drain and migrate them first")
+            self.num_slots = num_slots
+            h = jnp.zeros((self.num_layers, num_slots + 1,
+                           self.hidden_size), jnp.float32)
+            c = jnp.zeros((self.num_layers, num_slots + 1,
+                           self.hidden_size), jnp.float32)
+            if self._placement is not None:
+                h = jax.device_put(h, self._placement)
+                c = jax.device_put(c, self._placement)
+            self.h, self.c = h, c
+            self._free = list(range(num_slots))
+            self._pinned.clear()
+            self.generation += 1
+        self._m_swaps.inc()
 
     def stats(self) -> dict:
         with self._lock:
@@ -601,6 +647,17 @@ class PrefixCache:
             self.tiers.discard_memory(entry.sid)
         self.evictions += 1
         self._m_evict.inc()
+
+    def clear(self) -> None:
+        """Evict every entry that is not mid-use (refs == 0), releasing
+        its backing slot. The rollout controller calls this on a DRAINED
+        replica before a slot-count resize — prefix entries are derived
+        state (re-insertable from traffic), so dropping them is the
+        cheap half of emptying the cache."""
+        with self._lock:
+            for entry in list(self._entries.values()):
+                if entry.refs == 0:
+                    self._evict_entry_locked(entry)
 
     def _on_slot_evicted_locked(self, sid: str, slot: int) -> None:
         # state-cache LRU took a backing slot. Untiered: the dependent

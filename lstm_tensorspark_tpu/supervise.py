@@ -96,6 +96,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "be > 0; NOTE: the watchdog merges the child's "
                         "stderr into stdout so one stream carries the "
                         "liveness signal)")
+    p.add_argument("--registry-dir", default=None,
+                   help="model registry directory (serve/registry.py): "
+                        "after every child exit, promote the run's best "
+                        "checkpoint (best.msgpack, versioned by its step) "
+                        "into the registry so a serving fleet can roll it "
+                        "without a restart; requires --checkpoint-dir in "
+                        "the child's flags")
+    p.add_argument("--registry-model", default="default",
+                   help="model id to publish under (default: 'default' — "
+                        "the serve engine's boot model id, so rollouts "
+                        "reach existing sessions)")
+    p.add_argument("--rollout-url", default=None,
+                   help="serve fleet base URL (e.g. http://host:8000): "
+                        "POST /rollout after each NEW publication so the "
+                        "fleet rolls the fresh best automatically; best "
+                        "effort — an unreachable fleet only loses the "
+                        "trigger, not the artifact")
     p.add_argument("cli_args", nargs=argparse.REMAINDER,
                    help="-- followed by the training CLI flags")
     return p
@@ -143,6 +160,76 @@ def _checkpoint_dir_of(cli_args: list[str]) -> str | None:
     return None
 
 
+def _publish_best(ckpt_dir: str, registry_dir: str, model_id: str, *,
+                  rollout_url: str | None = None) -> dict | None:
+    """Promote the run's best checkpoint into a model registry
+    (serve/registry.py) so the serving side can roll it out without a
+    restart. The raw ``best.msgpack`` bytes are published VERBATIM as a
+    ``best_state`` artifact versioned by its step — the supervisor never
+    deserializes multi-MB weights, and re-publication of an already-
+    promoted step is a no-op (registry versions are immutable). Returns
+    the published metadata record, or None when there was nothing new
+    (or nothing valid) to promote. Sharded bests (``best.complete``
+    marker sets) are skipped: promotion needs the single-artifact form a
+    1-process training run writes."""
+    import json
+
+    meta_path = os.path.join(ckpt_dir, "best.json")
+    try:
+        with open(meta_path) as f:
+            best = json.load(f)
+        step = int(best["step"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None  # no best yet — nothing to promote
+    # heavy imports stay OUT of module scope: the supervisor is
+    # import-light by contract (no jax/backend init) unless publication
+    # is armed and a best checkpoint actually exists
+    from .serve.registry import ModelRegistry
+    from .train.checkpoint import CorruptCheckpointError, read_verified
+
+    path = os.path.join(ckpt_dir, "best.msgpack")
+    try:
+        payload = read_verified(path)
+    except (CorruptCheckpointError, OSError) as e:
+        print(f"supervise: best checkpoint not publishable ({e})",
+              file=sys.stderr)
+        return None
+    reg = ModelRegistry(registry_dir)
+    try:
+        meta = reg.publish(model_id, payload, kind="best_state",
+                           version=step,
+                           parent=f"best.msgpack @ step {step}")
+    except ValueError:
+        return None  # this step is already in the registry
+    print(f"supervise: published {model_id} v{step} "
+          f"({len(payload)} bytes) to {registry_dir}", file=sys.stderr)
+    if rollout_url:
+        _trigger_rollout(rollout_url, model_id, step)
+    return meta
+
+
+def _trigger_rollout(url: str, model_id: str, version: int) -> None:
+    """Ask a serve fleet (``POST /rollout``) to roll the version that was
+    just published. Best effort: an unreachable fleet only loses the
+    TRIGGER — the artifact is in the registry, and an operator (or the
+    next publication) can roll it later."""
+    import json
+    import urllib.request
+
+    body = json.dumps({"model": model_id, "version": version}).encode()
+    req = urllib.request.Request(
+        url.rstrip("/") + "/rollout", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            print(f"supervise: rollout of {model_id} v{version} accepted "
+                  f"({resp.status})", file=sys.stderr)
+    except OSError as e:
+        print(f"supervise: rollout trigger failed ({e}) — artifact is "
+              "published; roll it manually via POST /rollout",
+              file=sys.stderr)
+
+
 def run_with_stall_watch(cmd: list[str], stall_timeout: float) -> int:
     """Run ``cmd``, relaying its output line-by-line; if NO line arrives for
     ``stall_timeout`` seconds, terminate (then kill) it. Returns the exit
@@ -183,6 +270,9 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
               restart_delay: float = 1.0, max_delay: float = 30.0,
               no_progress_limit: int = 2,
               stall_timeout: float | None = None,
+              registry_dir: str | None = None,
+              registry_model: str = "default",
+              rollout_url: str | None = None,
               runner=None, rand=None) -> int:
     """Run the CLI (as a subprocess by default); relaunch with --resume on
     failure. ``runner(argv) -> int`` is injectable for tests; ``rand``
@@ -253,6 +343,18 @@ def supervise(cli_args: list[str], *, max_restarts: int = 3,
         lifetime = time.monotonic() - start
         if rc is not None and rc < 0:
             rc = 128 - rc  # signal death -> conventional 128+signum status
+        if registry_dir is not None and ckpt_dir is not None:
+            # promotion runs on EVERY exit, not just success: a crashed
+            # attempt may still have improved the best checkpoint, and
+            # serving the newest best should not wait out the restart
+            # budget. Already-published steps no-op inside.
+            try:
+                _publish_best(ckpt_dir, registry_dir, registry_model,
+                              rollout_url=rollout_url)
+            except Exception as e:  # registry trouble must not eat the
+                # supervisor's retry loop — the child's lifecycle wins
+                print(f"supervise: registry publication failed: {e}",
+                      file=sys.stderr)
         if rc == 0:
             if attempt > 0:
                 print(f"supervise: succeeded after {attempt} restart(s)",
@@ -323,6 +425,9 @@ def main(argv=None) -> int:
         max_delay=args.max_delay,
         no_progress_limit=args.no_progress_limit,
         stall_timeout=args.stall_timeout,
+        registry_dir=args.registry_dir,
+        registry_model=args.registry_model,
+        rollout_url=args.rollout_url,
     )
 
 

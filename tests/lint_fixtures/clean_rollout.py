@@ -1,0 +1,36 @@
+"""graftlint fixture: clean twin of viol_rollout — the rollout
+controller's worker thread parks on a stop Event its loop waits on, and
+stop() both sets the flag and joins the stored handle (the
+serve/rollout.py lifecycle contract: ServeServer.stop() drives
+RolloutController.stop() BEFORE stopping the replicas the controller
+might be mid-drain on)."""
+
+import threading
+
+
+class MiniRollout:
+    def __init__(self, server):
+        self.server = server
+        self._queue = []
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mini-rollout", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.25):
+            if self._queue:
+                self.roll(self._queue.pop(0))
+
+    def roll(self, move):
+        return move
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

@@ -1,0 +1,28 @@
+"""graftlint fixture: clean twin of viol_rollout_warmup — warmup()
+replays the decode program for EVERY resident model, so a request routed
+to any resident (including one a rollout just added) can never be
+charged a mid-traffic compile (the serve/engine.py multi-model
+invariant: the rollout controller's warmup phase covers the full
+per-model compile-key lattice before rejoin)."""
+
+
+class MiniModelEngine:
+    def __init__(self):
+        self.residents = {"default": 0}
+        self.compile_counts = {}
+        self._fns = {}
+
+    def model_fn(self, mid):
+        count_key = ("model_decode", mid)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda toks: list(toks))
+
+    def decode(self, toks, mid="default"):
+        return self.model_fn(mid)(toks)
+
+    def warmup(self, toks=(0,)):
+        out = None
+        for mid in self.residents:
+            out = self.model_fn(mid)(toks)
+        return out

@@ -1,0 +1,30 @@
+"""graftlint fixture: thread-lifecycle true positive for the ROLLOUT
+CONTROLLER shape — a serve-side controller whose daemon worker thread
+(draining replicas and swapping weights) is stored and started, but with
+no stop()/close() path that joins the handle or sets a flag its loop
+reads. A rollout loop nobody can park keeps draining replicas while the
+server it upgrades is being torn down (the PR 16 contract: the
+controller thread is stored on the controller and joined in
+``stop()``)."""
+
+import threading
+
+
+class MiniRollout:
+    def __init__(self, server):
+        self.server = server
+        self._queue = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="mini-rollout", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            if self._queue:
+                self.roll(self._queue.pop(0))
+
+    def roll(self, move):
+        return move

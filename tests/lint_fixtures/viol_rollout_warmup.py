@@ -1,0 +1,29 @@
+"""graftlint fixture: warmup-coverage true positive for the PER-MODEL
+namespace shape — a multi-model engine whose ``("model_decode", mid)``
+compile-key family is only reachable through live dispatch, never from
+``warmup()``: the first request routed to a freshly-added resident
+charges a live request the mid-traffic XLA compile the rollout
+controller's warmup phase exists to absorb (the PR 16 contract: every
+RESIDENT model's program lattice is replayed off-path before the
+replica rejoins rotation)."""
+
+
+class MiniModelEngine:
+    def __init__(self):
+        self.residents = {"default": 0}
+        self.compile_counts = {}
+        self._fns = {}
+
+    def model_fn(self, mid):
+        count_key = ("model_decode", mid)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda toks: list(toks))
+
+    def decode(self, toks, mid="default"):
+        return self.model_fn(mid)(toks)
+
+    def warmup(self):
+        # never dispatches model_fn: every resident a request can route
+        # to compiles mid-traffic on first touch
+        return None

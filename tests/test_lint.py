@@ -54,6 +54,8 @@ VIOLATIONS = {
     "viol_thread_lifecycle": "thread-lifecycle",
     "viol_autotune": "thread-lifecycle",
     "viol_autotune_warmup": "warmup-coverage",
+    "viol_rollout": "thread-lifecycle",
+    "viol_rollout_warmup": "warmup-coverage",
     "viol_io_lock": "io-under-lock",
     "viol_toctou": "toctou-fs",
     "viol_swallowed": "swallowed-exception",
@@ -80,6 +82,8 @@ CLEAN_TWINS = {
     "clean_thread_lifecycle": "thread-lifecycle",
     "clean_autotune": "thread-lifecycle",
     "clean_autotune_warmup": "warmup-coverage",
+    "clean_rollout": "thread-lifecycle",
+    "clean_rollout_warmup": "warmup-coverage",
     "clean_io_lock": "io-under-lock",
     "clean_toctou": "toctou-fs",
     "clean_swallowed": "swallowed-exception",

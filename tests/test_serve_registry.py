@@ -1,0 +1,189 @@
+"""Model registry (serve/registry.py) + supervise publication: verified
+publish/load roundtrips, version immutability, peer adoption via rescan,
+quarantine of truncated AND bit-flipped artifacts (a corrupt artifact is
+never served), config-fingerprint version-skew material, and the
+``supervise --registry-dir`` best-checkpoint promotion hook."""
+
+import json
+import os
+
+import jax
+import pytest
+from flax import serialization
+
+from lstm_tensorspark_tpu.models import LMConfig, init_lm
+from lstm_tensorspark_tpu.serve import (
+    ModelRegistry,
+    RegistryError,
+    config_fingerprint,
+)
+from lstm_tensorspark_tpu.supervise import _publish_best
+from lstm_tensorspark_tpu.train.checkpoint import atomic_write
+
+_CFG = LMConfig(vocab_size=29, hidden_size=16, num_layers=1)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_lm(jax.random.PRNGKey(3), _CFG)
+
+
+# ---- publish / load ---------------------------------------------------
+
+
+def test_publish_load_roundtrip(tmp_path, params):
+    """Params published as bytes come back decoded against the engine's
+    template, with the metadata record intact."""
+    reg = ModelRegistry(str(tmp_path))
+    meta = reg.publish("m", serialization.to_bytes(params),
+                       config_hash=config_fingerprint(_CFG),
+                       parent="best.msgpack @ step 7")
+    assert meta["version"] == 1 and meta["kind"] == "params"
+    got_meta, got = reg.load_params("m", params)
+    assert got_meta["parent"] == "best.msgpack @ step 7"
+    flat_a = jax.tree_util.tree_leaves(params)
+    flat_b = jax.tree_util.tree_leaves(got)
+    assert all((a == b).all() for a, b in zip(flat_a, flat_b))
+
+
+def test_auto_versioning_and_immutability(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    assert reg.publish("m", b"one")["version"] == 1
+    assert reg.publish("m", b"two")["version"] == 2
+    with pytest.raises(ValueError, match="immutable"):
+        reg.publish("m", b"redo", version=2)
+    assert reg.latest("m")["version"] == 2
+    _, payload = reg.load_bytes("m", 1)
+    assert payload == b"one"
+
+
+def test_bad_ids_and_unknown_lookups(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    for bad in ("", "a/b", "x__v1"):
+        with pytest.raises(ValueError):
+            reg.publish(bad, b"p")
+    with pytest.raises(RegistryError, match="unknown model"):
+        reg.meta("ghost")
+    reg.publish("m", b"p")
+    with pytest.raises(RegistryError, match="no version 9"):
+        reg.load_bytes("m", 9)
+
+
+def test_peer_adoption_via_scan(tmp_path):
+    """A second registry instance over the same directory (the serving
+    fleet next to the publishing supervisor) indexes everything the peer
+    published — the filesystem is the only coordination."""
+    a = ModelRegistry(str(tmp_path))
+    a.publish("m", b"v1-bytes")
+    b = ModelRegistry(str(tmp_path))
+    assert b.models() == {"m": [1]}
+    a.publish("m", b"v2-bytes")
+    assert b.models() == {"m": [1]}  # stale until rescan, by design
+    b.scan()
+    assert b.models() == {"m": [1, 2]}
+
+
+def test_orphan_payload_adopted_with_reconstructed_meta(tmp_path):
+    """publish crashing between payload and metadata record leaves a
+    verified payload with no .json — the next scan adopts it instead of
+    stranding it."""
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish("m", b"payload")
+    os.remove(tmp_path / "m__v000001.json")
+    reg.scan()
+    meta = reg.meta("m", 1)
+    assert meta["kind"] == "params" and meta["payload_bytes"] == 7
+
+
+# ---- quarantine -------------------------------------------------------
+
+
+def test_truncated_artifact_quarantined_on_scan(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish("m", b"x" * 64)
+    path = tmp_path / "m__v000001.msgpack"
+    path.write_bytes(b"x" * 10)  # truncation: sha sidecar now mismatches
+    fresh = ModelRegistry(str(tmp_path))
+    assert fresh.models() == {}
+    assert fresh.quarantined == 1
+    assert (tmp_path / "m__v000001.msgpack.quarantined").exists()
+    with pytest.raises(RegistryError):
+        fresh.load_bytes("m", 1)
+
+
+def test_bit_flip_after_index_quarantined_at_load(tmp_path):
+    """Corruption landing AFTER the indexing scan is caught by the
+    per-load verification: the artifact is quarantined, drops out of the
+    manifest, and the load raises — it is never served."""
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish("m", b"A" * 64)
+    path = tmp_path / "m__v000001.msgpack"
+    blob = bytearray(path.read_bytes())
+    blob[13] ^= 0x40
+    path.write_bytes(bytes(blob))
+    with pytest.raises(RegistryError, match="quarantined"):
+        reg.load_bytes("m")
+    assert reg.models() == {}
+    assert reg.quarantined == 1
+    assert (tmp_path / "m__v000001.msgpack.quarantined").exists()
+    # the good sibling-model path still works after the quarantine
+    reg.publish("other", b"fine")
+    assert reg.load_bytes("other")[1] == b"fine"
+
+
+def test_config_fingerprint_stability():
+    assert config_fingerprint(_CFG) == config_fingerprint(
+        LMConfig(vocab_size=29, hidden_size=16, num_layers=1))
+    assert config_fingerprint(_CFG) != config_fingerprint(
+        LMConfig(vocab_size=29, hidden_size=32, num_layers=1))
+
+
+# ---- supervise publication -------------------------------------------
+
+
+def _write_best(ckpt_dir, params, step=5, value=1.25):
+    """The single-process best artifact exactly as train/checkpoint.py
+    writes it: msgpack {step, value, state=to_bytes(state)} + sidecar +
+    best.json."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    payload = serialization.msgpack_serialize({
+        "step": step, "value": value,
+        "state": serialization.to_bytes({"params": params}),
+    })
+    atomic_write(os.path.join(ckpt_dir, "best.msgpack"), payload,
+                 checksum=True)
+    with open(os.path.join(ckpt_dir, "best.json"), "w") as f:
+        json.dump({"step": step, "value": value}, f)
+
+
+def test_supervise_publishes_best_state(tmp_path, params):
+    ckpt = tmp_path / "ckpt"
+    regdir = tmp_path / "registry"
+    _write_best(str(ckpt), params, step=5)
+    meta = _publish_best(str(ckpt), str(regdir), "default")
+    assert meta["version"] == 5 and meta["kind"] == "best_state"
+    assert meta["parent"] == "best.msgpack @ step 5"
+    # re-publication of the same step is a no-op (versions are immutable)
+    assert _publish_best(str(ckpt), str(regdir), "default") is None
+    # a NEW best step publishes the next version
+    _write_best(str(ckpt), params, step=9)
+    assert _publish_best(str(ckpt), str(regdir), "default")["version"] == 9
+    # the serve side decodes best_state against its params template
+    reg = ModelRegistry(str(regdir))
+    got_meta, got = reg.load_params("default", params, 5)
+    assert got_meta["kind"] == "best_state"
+    flat_a = jax.tree_util.tree_leaves(params)
+    flat_b = jax.tree_util.tree_leaves(got)
+    assert all((a == b).all() for a, b in zip(flat_a, flat_b))
+
+
+def test_supervise_skips_missing_or_corrupt_best(tmp_path, params):
+    assert _publish_best(str(tmp_path / "none"), str(tmp_path / "r"),
+                         "m") is None
+    ckpt = tmp_path / "ckpt"
+    _write_best(str(ckpt), params, step=3)
+    best = ckpt / "best.msgpack"
+    best.write_bytes(best.read_bytes()[:-7])  # truncated: fails sha
+    assert _publish_best(str(ckpt), str(tmp_path / "r"), "m") is None
+    assert not os.path.isdir(tmp_path / "r") or ModelRegistry(
+        str(tmp_path / "r")).models() == {}

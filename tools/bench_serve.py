@@ -59,6 +59,8 @@ import argparse
 import json
 import os
 import sys
+import tempfile
+import threading
 import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -79,9 +81,15 @@ if (any(a.startswith("--mesh-shards") for a in sys.argv)
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
+from flax import serialization  # noqa: E402
+
 from lstm_tensorspark_tpu.models import LMConfig, init_lm  # noqa: E402
 from lstm_tensorspark_tpu.obs import MetricsRegistry  # noqa: E402
-from lstm_tensorspark_tpu.serve import ServeEngine, ServeServer  # noqa: E402
+from lstm_tensorspark_tpu.serve import (  # noqa: E402
+    ModelRegistry,
+    ServeEngine,
+    ServeServer,
+)
 from lstm_tensorspark_tpu.serve.loadgen import (  # noqa: E402
     kernel_sweep,
     mesh_sweep,
@@ -934,6 +942,181 @@ def run_autotune_bench(out_path: str) -> int:
     return 0 if all(gates.values()) else 1
 
 
+# ---- rolling-reload gate (--rollout; BENCH_serve_r08) -------------------
+#
+# The zero-downtime rollout drill (ISSUE-16 acceptance): a 2-replica
+# fleet boots on v1 with a registry holding v1, v2 (genuinely different
+# weights) and v3 (the SAME bytes as v2 — the deterministic
+# canary-match arm). Under continuous closed-loop traffic the
+# controller rolls v1 -> v2 and then v2 -> v3 with the canary shadow
+# compare live. Gates: ZERO failed requests across both rolling swaps
+# (drain requeues, migration preserves kept sessions, capacity stays
+# >= N-1), ZERO mid-traffic compiles (params are traced ARGUMENTS —
+# same-shape swaps reuse every compiled program), a kept session
+# started on v1 continuing TOKEN-IDENTICALLY to a single-replica
+# in-place-swap reference, fresh post-rollout requests matching the new
+# version's reference tokens, and the canary report comparing >= the
+# configured pair floor with 0 diffs on identical weights.
+
+R_CFG = dict(vocab_size=89, hidden_size=128, num_layers=2)
+R_REPLICAS = 2
+R_PUMPS = 3
+R_MAX_NEW = 4
+R_CANARY_PAIRS = 4
+
+
+def _rollout_server(params, cfg, n):
+    engines = [
+        ServeEngine(params, cfg, num_slots=8,
+                    prefill_buckets=(8, 16), batch_buckets=(1, 2, 4),
+                    rng_seed=i, replica=i)
+        for i in range(n)
+    ]
+    return ServeServer(engines if n > 1 else engines[0],
+                       max_active=4, queue_size=64)
+
+
+def run_rollout_bench(out_path: str) -> int:
+    print(f"bench_serve: rolling-reload gate ({R_REPLICAS} replicas, "
+          "v1 -> v2 under load, then the v3 canary-match arm)...",
+          flush=True)
+    cfg = LMConfig(**R_CFG)
+    params = init_lm(jax.random.PRNGKey(0), cfg)
+    params_v2 = init_lm(jax.random.PRNGKey(7), cfg)
+    reg = ModelRegistry(tempfile.mkdtemp(prefix="bench_rollout_reg_"))
+    v2_bytes = serialization.to_bytes(jax.device_get(params_v2))
+    reg.publish("default", serialization.to_bytes(jax.device_get(params)))
+    reg.publish("default", v2_bytes)  # v2: the new weights
+    reg.publish("default", v2_bytes)  # v3 == v2 bytes: canary must match
+
+    engines = [
+        ServeEngine(params, cfg, num_slots=8,
+                    prefill_buckets=(8, 16), batch_buckets=(1, 2, 4),
+                    rng_seed=i, replica=i)
+        for i in range(R_REPLICAS)
+    ]
+    server = ServeServer(
+        engines, max_active=4, queue_size=64, model_registry=reg,
+        rollout_kw={"drain_timeout_s": 60.0,
+                    "canary_min_pairs": R_CANARY_PAIRS,
+                    "canary_timeout_s": 120.0,
+                    "require_canary_match": True})
+    failures: list = []
+    done = threading.Event()
+    pumped = [0] * R_PUMPS
+
+    def pump(worker):
+        while not done.is_set():
+            try:
+                r = server.generate([1 + worker, 2, 3],
+                                    max_new_tokens=R_MAX_NEW)
+                if r.error is not None:
+                    failures.append((worker, r.error))
+            except Exception as e:  # queue-full is a failure too:
+                # capacity must stay >= N-1 replicas throughout
+                failures.append((worker, repr(e)))
+            pumped[worker] += 1
+
+    with server:
+        server.warmup()
+        r1 = server.generate([1, 2, 3], max_new_tokens=R_MAX_NEW,
+                             keep_session=True)
+        sid, v1_toks = r1.session_id, list(r1.tokens)
+        compiles_before = sum(sum(r.engine.compile_counts.values())
+                              for r in server.replicas)
+        pumps = [threading.Thread(target=pump, args=(w,), daemon=True)
+                 for w in range(R_PUMPS)]
+        t0 = time.monotonic()
+        for t in pumps:
+            t.start()
+        try:
+            record = server.rollout.run_rollout("default", 2)
+            canary_record = server.rollout.run_rollout("default", 3,
+                                                       canary_every=1)
+        finally:
+            done.set()
+            for t in pumps:
+                t.join(timeout=60)
+        traffic_wall_s = round(time.monotonic() - t0, 3)
+        compiles_after = sum(sum(r.engine.compile_counts.values())
+                             for r in server.replicas)
+        cont = server.generate([v1_toks[-1]], max_new_tokens=R_MAX_NEW,
+                               session_id=sid, keep_session=True)
+        post = server.generate([1, 2, 3], max_new_tokens=R_MAX_NEW)
+        versions = [r.engine.model_version for r in server.replicas]
+
+    # the reference: the same conversation on ONE replica with an
+    # in-place weight swap (no drain, no migration, no rollout) — the
+    # rolling path must be indistinguishable token-for-token
+    ref = _rollout_server(params, cfg, 1)
+    with ref:
+        ref.warmup()
+        a = ref.generate([1, 2, 3], max_new_tokens=R_MAX_NEW,
+                         keep_session=True)
+        ref.engine.swap_model(jax.device_get(params_v2), version=2)
+        b = ref.generate([a.tokens[-1]], max_new_tokens=R_MAX_NEW,
+                         session_id=a.session_id, keep_session=True)
+        c = ref.generate([1, 2, 3], max_new_tokens=R_MAX_NEW)
+
+    canary = canary_record["canary"] or {}
+    counts = canary.get("counts", {})
+    phases_ok = all(
+        p["outcome"] == "ok"
+        for rec in (record, canary_record)
+        for e in rec["replicas"] for p in e["phases"])
+    gates = {
+        "pass_zero_failed_requests": not failures,
+        "pass_zero_mid_traffic_compiles":
+            compiles_after == compiles_before,
+        "pass_all_phases_ok": bool(
+            phases_ok and record["outcome"] == "ok"
+            and canary_record["outcome"] == "ok"),
+        "pass_kept_session_token_identical":
+            list(a.tokens) == v1_toks
+            and list(cont.tokens) == list(b.tokens),
+        "pass_post_rollout_new_version_tokens":
+            list(post.tokens) == list(c.tokens),
+        "pass_fleet_converged": all(v == 3 for v in versions),
+        "pass_canary_match": bool(
+            counts.get("compared", 0) >= R_CANARY_PAIRS
+            and counts.get("diff", 1) == 0),
+    }
+    out = {
+        "note": "serve_bench_r08 zero-downtime rolling reload gate "
+                "(tools/bench_serve.py --rollout)",
+        "config": {
+            **R_CFG, "replicas": R_REPLICAS, "pump_threads": R_PUMPS,
+            "max_new_tokens": R_MAX_NEW,
+            "canary_min_pairs": R_CANARY_PAIRS,
+            "platform": jax.devices()[0].platform,
+        },
+        "traffic": {
+            "requests": sum(pumped), "failed": len(failures),
+            "failures_sample": failures[:5],
+            "wall_s": traffic_wall_s,
+        },
+        "mid_traffic_compiles": compiles_after - compiles_before,
+        "rollout_v2": record,
+        "rollout_v3_canary": canary_record,
+        "canary_report": canary,
+        "fleet_versions": versions,
+        **gates,
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "requests_during_rollouts": sum(pumped),
+        "failed": len(failures),
+        "mid_traffic_compiles": compiles_after - compiles_before,
+        "canary_counts": counts,
+        "fleet_versions": versions,
+        **gates,
+    }))
+    print(f"bench_serve: report written to {out_path}")
+    return 0 if all(gates.values()) else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
@@ -966,6 +1149,16 @@ def main(argv=None) -> int:
                          "TTFT p99 >= 5% better, zero mid-traffic "
                          "compiles, and the PR 10 4x-burst gate with "
                          "the controller on; writes BENCH_serve_r07.json")
+    ap.add_argument("--rollout", action="store_true",
+                    help="run the zero-downtime rolling-reload gate: a "
+                         "2-replica fleet rolls registry v1 -> v2 under "
+                         "continuous load, then v2 -> v3 (identical "
+                         "bytes) with the canary shadow compare live — "
+                         "zero failed requests, zero mid-traffic "
+                         "compiles, kept-session continuations token-"
+                         "identical to an in-place-swap reference, "
+                         "canary reports 0 diffs; writes "
+                         "BENCH_serve_r08.json")
     ap.add_argument("--decode-kernel", default=None,
                     help="comma list of kernels (e.g. pallas,scan): run "
                          "the decode-kernel comparison (tokens/s + ITL "
@@ -998,6 +1191,9 @@ def main(argv=None) -> int:
     if args.autotune:
         out_path = args.out or os.path.join(_REPO, "BENCH_serve_r07.json")
         return run_autotune_bench(out_path)
+    if args.rollout:
+        out_path = args.out or os.path.join(_REPO, "BENCH_serve_r08.json")
+        return run_rollout_bench(out_path)
     if args.decode_kernel:
         kernels = tuple(k.strip() for k in args.decode_kernel.split(",")
                         if k.strip())

@@ -13,6 +13,16 @@ graceful flush), a fresh server is booted on the same session dir, and
 the pre-restart session's continuation must succeed from the disk tier
 (without it, the continuation fails "unknown session").
 
+Then the ROLLING-RELOAD drill (model registry + rollout controller,
+PR 16): fresh weights are published into the restarted server's
+--registry-dir from this process (exactly what `supervise
+--registry-dir` does — a different process than the server), twice with
+identical bytes (v1 and v2). The live 2-replica fleet is rolled v0 → v1
+→ v2 over POST /rollout: both rollouts must converge with every phase
+"ok", the identical-bytes versions must serve identical greedy tokens
+(the parity oracle), /stats must report the fleet converged on v2, and
+the disk-restored kept session must survive BOTH rolling swaps.
+
 Then two single-replica kernel/topology boots, each required to serve
 the SAME greedy tokens as the main boot: `--decode-kernel pallas`
 (interpreter-mode fused window, PR 11) — which also runs with
@@ -134,8 +144,9 @@ def main(argv=None) -> int:
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     session_dir = tempfile.mkdtemp(prefix="serve_smoke_sessions_")
+    registry_dir = tempfile.mkdtemp(prefix="serve_smoke_registry_")
     cmd = [sys.executable, "-m", "lstm_tensorspark_tpu.cli", *_SERVE_ARGS,
-           "--session-dir", session_dir]
+           "--session-dir", session_dir, "--registry-dir", registry_dir]
     proc, lines, base = _boot(cmd, env, args.timeout)
     try:
         if base is None:
@@ -226,6 +237,93 @@ def main(argv=None) -> int:
             return _fail(proc, lines,
                          f"post-restart continuation of {sid!r} failed "
                          f"(disk tier restore): {cont}")
+
+        # ---- rolling-reload drill (registry + rollout controller) -----
+        # publish fresh weights into the live server's --registry-dir
+        # from THIS process (the supervise publication path), as v1 and
+        # again with IDENTICAL bytes as v2, then roll the fleet over
+        # HTTP: v0 -> v1 proves convergence, v1 -> v2 proves token
+        # parity (same bytes must serve the same tokens), and the
+        # disk-restored kept session must survive both rolling swaps
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax  # noqa: E402 — lazy: only this drill inits weights
+        from flax import serialization  # noqa: E402
+
+        from lstm_tensorspark_tpu.models import (  # noqa: E402
+            LMConfig,
+            init_lm,
+        )
+        from lstm_tensorspark_tpu.serve.registry import (  # noqa: E402
+            ModelRegistry,
+        )
+
+        blob = serialization.to_bytes(jax.device_get(init_lm(
+            jax.random.PRNGKey(9),
+            LMConfig(vocab_size=31, hidden_size=12, num_layers=1))))
+        reg = ModelRegistry(registry_dir)
+        reg.publish("default", blob)  # v1: the new weights
+        reg.publish("default", blob)  # v2: same bytes — parity oracle
+
+        def _roll_to(version: int) -> dict | None:
+            req = urllib.request.Request(
+                base + "/rollout",
+                data=json.dumps({"model": "default",
+                                 "version": version}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                if r.status != 202:
+                    return None
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(base + "/rollout",
+                                            timeout=30) as r:
+                    rs = json.loads(r.read())
+                hist = [h for h in rs.get("history", [])
+                        if h.get("kind") == "rollout"
+                        and h.get("version") == version]
+                if hist:
+                    return hist[-1]
+                if rs.get("last_error"):
+                    # a move that died before its record was opened
+                    # (e.g. the registry refused the version) never
+                    # reaches history — fail fast instead of timing out
+                    return {"outcome": f"error: {rs['last_error']}"}
+                time.sleep(0.25)
+            return None
+
+        rec1 = _roll_to(1)
+        if not rec1 or rec1.get("outcome") != "ok":
+            return _fail(proc, lines,
+                         f"rolling reload v0 -> v1 did not converge: "
+                         f"{rec1}")
+        v1_reply = _generate(base, {"prompt": [1, 2, 3],
+                                    "max_new_tokens": 4, "greedy": True})
+        rec2 = _roll_to(2)
+        if not rec2 or rec2.get("outcome") != "ok":
+            return _fail(proc, lines,
+                         f"rolling reload v1 -> v2 did not converge: "
+                         f"{rec2}")
+        v2_reply = _generate(base, {"prompt": [1, 2, 3],
+                                    "max_new_tokens": 4, "greedy": True})
+        if (len(v2_reply.get("tokens", [])) != 4
+                or v2_reply.get("tokens") != v1_reply.get("tokens")):
+            return _fail(proc, lines,
+                         "identical-bytes registry versions served "
+                         f"different tokens: {v1_reply.get('tokens')} "
+                         f"!= {v2_reply.get('tokens')}")
+        with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+            rstats = json.loads(r.read())
+        if rstats.get("models", {}).get("default") != {"2": _REPLICAS}:
+            return _fail(proc, lines,
+                         "/stats models not converged on v2: "
+                         f"{rstats.get('models')}")
+        cont2 = _generate(base, {"prompt": [cont["tokens"][-1]],
+                                 "max_new_tokens": 4, "greedy": True,
+                                 "session_id": sid})
+        if "error" in cont2 or len(cont2.get("tokens", [])) != 4:
+            return _fail(proc, lines,
+                         f"kept session {sid!r} lost across the rolling "
+                         f"reload: {cont2}")
         proc.terminate()
         try:
             proc.wait(timeout=10)
@@ -328,6 +426,8 @@ def main(argv=None) -> int:
               f"({len(reps)} replicas) + routed generate + stats + "
               f"{len(fams)} metric families validated; kill -9 → restart "
               f"→ session {sid!r} continued from the disk tier; "
+              "registry publish → v0→v1→v2 rolling reload converged "
+              "token-identically with the kept session intact; "
               "--decode-kernel pallas + --autotune on boot "
               "token-identical with a quiet error-free controller; "
               f"{base}: {_MESH_SHARDS}-shard mesh boot token-identical "
