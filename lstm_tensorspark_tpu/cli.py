@@ -1337,6 +1337,13 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "host loses no kept session (continuations fill "
                         "from the shared disk tier on survivors; "
                         "docs/OPERATIONS.md 'Mesh serving')")
+    p.add_argument("--remote-timeout-s", type=float, default=120.0,
+                   help="client-side wait bound (seconds) for one remote "
+                        "generate RPC (--remote-replica): past it the "
+                        "front settles the request honestly instead of "
+                        "holding the slot forever. 0 = no bound; a "
+                        "request deadline always tightens it. Negative "
+                        "rejected at construction")
     p.add_argument("--decode-window", type=str, default="auto",
                    help="multi-token decode window: 'auto' (adaptive "
                         "ladder 1/4/8 — large windows in steady-state "
@@ -1875,6 +1882,8 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
                          },
                          remote_replicas=tuple(
                              getattr(args, "remote_replica", []) or ()),
+                         remote_timeout_s=getattr(
+                             args, "remote_timeout_s", 120.0),
                          model_registry=getattr(args, "registry_dir",
                                                 None) or None,
                          rollout_kw={

@@ -50,6 +50,23 @@ Serve-plane faults (chaos drills for the replicated/tiered serve stack —
 - ``slow_readback@N[xMS]`` the Nth decode-window readback sleeps MS
   milliseconds (default 250) — slow device→host fetch.
 
+Network faults (injected inside ``serve/transport.py`` ``PeerTransport``
+so heartbeat, residency, and generate RPCs all see the same wire; peer
+numbers are the transport's ``peer`` index, windows run from arming on
+the monotonic clock):
+
+- ``net_latency@N[xMS]``  the Nth generate RPC attempt after arming is
+  delayed MS milliseconds (default 100) before the wire;
+- ``net_drop@N``          the Nth generate RPC attempt executes on the
+  wire but the client drops the response — an indeterminate failure
+  (``executed=None``) that must resolve via request_id replay, never a
+  double decode;
+- ``net_blackhole@R[xS]`` peer R is blackholed (connects time out,
+  nothing delivered) for S seconds — omit ``xS`` for "until disarm",
+  the partition drill's heal switch;
+- ``net_flap@R[xS]``      peer R's link alternates ok/fail per RPC
+  attempt for S seconds (default 10) — the flap-damping drill.
+
 Step numbers are the 1-based global optimizer step about to be computed —
 resume-stable, so a restarted child reasons in the same coordinates.
 
@@ -82,11 +99,12 @@ ENV_VAR = "LSTM_TSP_FAULTS"
 _KINDS = ("crash", "nan_grads", "ckpt_corrupt", "data_error", "serve_error",
           "seed", "replica_die", "replica_wedge", "wedge_secs",
           "disk_write_err", "disk_read_err", "session_corrupt",
-          "spill_stall", "slow_readback")
+          "spill_stall", "slow_readback",
+          "net_latency", "net_drop", "net_blackhole", "net_flap")
 
 #: kinds whose ``xK`` suffix is meaningful (everything else rejects it)
 _XK_KINDS = ("nan_grads", "replica_die", "replica_wedge", "spill_stall",
-             "slow_readback")
+             "slow_readback", "net_latency", "net_blackhole", "net_flap")
 
 
 class InjectedFault(RuntimeError):
@@ -129,6 +147,15 @@ class FaultPlane:
         self.session_corrupt_writes: set[int] = set()
         self.spill_stall_batches: dict[int, int] = {}   # batch N -> seconds
         self.slow_readback_calls: dict[int, int] = {}   # call N -> millis
+        # network faults (PeerTransport): windows run from arming time
+        self.net_latency_calls: dict[int, int] = {}     # gen call N -> ms
+        self.net_drop_calls: set[int] = set()           # gen call N
+        self.net_blackhole: dict[int, int | None] = {}  # peer -> secs|None
+        self.net_flap: dict[int, int] = {}              # peer -> secs
+        self._armed_at = time.monotonic()
+        self._net_generate_calls = 0
+        self._net_flap_calls: dict[int, int] = {}
+        self._net_announced: set[str] = set()
         # serve hooks fire from several threads (scheduler threads, the
         # spill worker, HTTP threads) — count under one small lock so
         # "fires exactly once at the Nth call" stays true under races
@@ -187,6 +214,14 @@ class FaultPlane:
                 self.spill_stall_batches[n] = int(k or 1)
             elif kind == "slow_readback":
                 self.slow_readback_calls[n] = int(k or 250)
+            elif kind == "net_latency":
+                self.net_latency_calls[n] = int(k or 100)
+            elif kind == "net_drop":
+                self.net_drop_calls.add(n)
+            elif kind == "net_blackhole":
+                self.net_blackhole[n] = None if k is None else int(k)
+            elif kind == "net_flap":
+                self.net_flap[n] = int(k or 10)
         self.nan_grad_steps = tuple(sorted(set(nan)))
 
     # ---- one-shot bookkeeping -----------------------------------------
@@ -409,6 +444,47 @@ class FaultPlane:
             self._announce(f"readback delayed {ms}ms on fetch {n}")
             time.sleep(ms / 1000.0)
 
+    def serve_net_hook(self, peer: int, method: str):
+        """Consulted by ``PeerTransport._attempt`` before every wire
+        attempt.  Returns ``None`` (no fault) or an action tuple the
+        transport enacts: ``("blackhole",)`` — connect times out, nothing
+        delivered; ``("fail",)`` — connection reset (flap); ``("latency",
+        ms)`` — delay then proceed; ``("drop",)`` — execute for real,
+        then lose the response client-side (indeterminate)."""
+        if not (self.net_blackhole or self.net_flap
+                or self.net_latency_calls or self.net_drop_calls):
+            return None
+        elapsed = time.monotonic() - self._armed_at
+        window = self.net_blackhole.get(peer, False)
+        if window is not False and (window is None or elapsed <= window):
+            if f"bh{peer}" not in self._net_announced:
+                self._net_announced.add(f"bh{peer}")
+                self._announce(
+                    f"peer {peer} blackholed "
+                    + ("until disarm" if window is None
+                       else f"for {window}s"))
+            return ("blackhole",)
+        secs = self.net_flap.get(peer)
+        if secs is not None and elapsed <= secs:
+            with self._serve_lock:
+                n = self._net_flap_calls.get(peer, 0) + 1
+                self._net_flap_calls[peer] = n
+            if n % 2 == 1:
+                return ("fail",)
+        if method == "generate" and \
+                (self.net_latency_calls or self.net_drop_calls):
+            with self._serve_lock:
+                self._net_generate_calls += 1
+                n = self._net_generate_calls
+            ms = self.net_latency_calls.get(n)
+            if ms:
+                self._announce(f"generate RPC {n} delayed {ms}ms")
+                return ("latency", ms)
+            if n in self.net_drop_calls:
+                self._announce(f"generate RPC {n} response dropped")
+                return ("drop",)
+        return None
+
 
 # ---- module singleton ---------------------------------------------------
 
@@ -505,6 +581,14 @@ def serve_readback_hook() -> None:
     plane = _active
     if plane is not None:
         plane.serve_readback_hook()
+
+
+def serve_net_hook(peer: int, method: str):
+    """Unarmed-safe transport wire hook (serve/transport.py)."""
+    plane = _active
+    if plane is None:
+        return None
+    return plane.serve_net_hook(peer, method)
 
 
 def maybe_corrupt_checkpoint(path: str, step: int) -> None:

@@ -257,6 +257,13 @@ class Request:
         # None until routed (or forever, for a direct Batcher.submit).
         # Surfaced in the HTTP reply and loadgen's per-replica counts.
         self.replica: int | None = None
+        # network-resilience bookkeeping (serve/remote.py): the client-
+        # minted idempotency key the remote transport replays under
+        # (minted once, at first remote submit), and how many times a
+        # provably-undelivered RPC re-entered routing (Router.reroute
+        # bounds this by fleet size)
+        self.rpc_request_id: str | None = None
+        self.reroutes = 0
         self.tokens: list[int] = []
         self.error: str | None = None
         self.cancelled = False  # set by an abandoning client (timeout)
@@ -794,6 +801,14 @@ class Batcher:
             # does a WORKING iteration hold the scheduler", not "how often
             # does the idle loop spin"
             self._m_iteration.observe(time.perf_counter() - t0)
+        # beat AGAIN on completion: a step that spends its whole budget
+        # inside one long dispatch (first-shape compile, big window)
+        # must not leave the heartbeat aged by that dispatch — a fresh
+        # pick racing it would misread this replica as wedged and fall
+        # back onto genuinely stale ones. A step that truly never
+        # returns (the wedge) never reaches this line, so staleness
+        # still means stuck, not slow.
+        self.last_heartbeat = time.monotonic()
         return did
 
     def _admit(self) -> bool:

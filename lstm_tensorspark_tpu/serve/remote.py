@@ -1,4 +1,4 @@
-"""Remote-replica RPC transport: a peer serve PROCESS behind the router.
+"""Remote-replica RPC shim: a peer serve PROCESS behind the router.
 
 PR 7's replicas are threads in one process; this module generalises the
 replica to a separate host. A :class:`RemoteReplica` satisfies the exact
@@ -6,56 +6,75 @@ Router-facing surface a local :class:`~.router.Replica` does —
 ``submit``/``queued``/``load``/``drain_queue``/``fail_inflight``/
 ``fail_request`` plus the scheduler heartbeat — over the stdlib
 HTTP/JSON endpoint the peer already serves (serve/server.py): generate
-RPCs ride ``POST /v1/generate`` verbatim, liveness and load ride the
-lightweight ``GET /replica/heartbeat``, and session affinity probes ride
-``GET /replica/has_session``. No new wire protocol, no new dependency —
-the serve plane's public endpoint IS the replica transport.
+RPCs ride ``POST /v1/generate``, liveness, load AND session residency
+ride the lightweight ``GET /replica/heartbeat``. No new wire protocol,
+no new dependency — the serve plane's public endpoint IS the replica
+transport, and all wire traffic flows through the shared
+:class:`~.transport.PeerTransport` (ISSUE 17): pooled connections,
+bounded ``backoff_delay`` retries, per-peer circuit breaker, and
+deterministic network-fault injection.
 
-Liveness is structural, not bolted on: the shim's heartbeat poller
-thread is started by ``ServeServer.start()`` exactly like a local
-scheduler thread (``RemoteBatcher.run(stop_event)``), and it EXITS when
-``DEAD_AFTER`` consecutive heartbeats fail — so the router's existing
-death sweep (thread-not-alive → retire exactly once) fires unchanged,
-and replica-death handling generalises to HOST death for free:
+Liveness distinguishes DEAD from PARTITIONED (circuit-open ≠ dead):
 
-- nothing is queued front-side (submits dispatch an RPC thread
-  immediately), so ``drain_queue`` is empty by construction;
-- in-flight RPCs ``fail_inflight`` honestly — the remote's decode
-  position is indeterminate, the same verdict as a dead local scheduler;
-- the dead host's KEPT sessions are NOT lost when the fleet shares a
-  ``--session-dir``: the peer write-behind checkpointed every kept
-  session at its request boundaries (PR 8), so a continuation re-routes
-  to any live tiered replica and fills from the shared disk tier
-  token-identically (tests/test_serve_mesh.py's 2-process kill drill;
-  tools/chaos_serve.py ``host_die`` phase).
+- a **refused** connection means no listener — the process provably
+  exited. :data:`DEAD_AFTER` consecutive refused heartbeats make the
+  poller thread exit, and the router's existing death sweep
+  (thread-not-alive → retire exactly once) fires unchanged. Kept
+  sessions survive through the shared ``--session-dir`` disk tier
+  (tests/test_serve_mesh.py's 2-process kill drill).
+- **timeouts/resets** (partition-shaped failures) never retire: they
+  feed the per-peer :class:`~.transport.CircuitBreaker`. After
+  ``circuit_open_after`` consecutive failures the circuit opens and the
+  router routes around instantly (no request waits out ``rpc_timeout``
+  against a blackhole); the heartbeat poller keeps probing as the
+  half-open path, and ``circuit_rejoin_after`` consecutive successes
+  close it — the peer REJOINS without a process restart.
+- **flap damping**: in the closed regime one success resets the failure
+  streak, so an alternating lossy link below the threshold never opens
+  the circuit (and never retires — flap failures aren't refusals); once
+  suspect, only consecutive successes rejoin (hysteresis).
 
-Affinity: the router probes ``sid in replica.engine.cache`` under its
-lock; for a remote replica that is one bounded HTTP probe against the
-peer's cache AND tiers (``ServeEngine.has_session``), so continuations
-keep landing where their carries live. A dead/unreachable peer probes
-False and the (shared-disk) fallback applies.
+Session residency (the affinity probe) is served from an async cache:
+the heartbeat payload carries the peer's resident session ids, and
+``has_session`` answers from that snapshot plus a front-side overlay of
+recently settled kept sessions — ZERO network under the router's lock
+(the old blocking GET per continuation is the exact bug the graftlint
+``io-under-lock`` fixture pair ``viol/clean_remote_sync`` pins).
 
-Error mapping keeps the client contract: a remote 429 settles the
-request with the shed message, a remote ``deadline_exceeded`` settles it
-as an honest timeout WITH the partial tokens, an unreachable host
-mid-request settles it "state lost" — never a silent re-decode.
+Generate RPCs are exactly-once over at-least-once delivery: the shim
+mints a ``request_id`` per request, the peer deduplicates replays via
+its settled cache, and the transport only retries indeterminate
+failures under that replay guarantee. A failure that provably never
+reached the peer (``executed is False``) re-enters routing through
+``Router.reroute`` — with a shared session dir the survivor fills the
+last checkpointed boundary token-identically; truly indeterminate
+exhausted failures settle honestly ("state lost"), never a silent
+re-decode.
 """
 
 from __future__ import annotations
 
-import json
 import threading
 import time
-import urllib.error
-import urllib.parse
-import urllib.request
+import uuid
 
 from .batcher import CLASSES, QueueFullError, Request
 from .router import Replica
+from .transport import CircuitBreaker, PeerHTTPError, PeerTransport, \
+    TransportError
 
-#: consecutive failed heartbeats before the poller declares the host
-#: dead and exits (the router's sweep then retires the replica).
+#: consecutive REFUSED heartbeats (no listener — the process provably
+#: exited) before the poller declares the host dead and exits (the
+#: router's sweep then retires the replica). Partition-shaped failures
+#: (timeouts, resets) never count here — they open the circuit instead.
 DEAD_AFTER = 4
+
+#: default circuit thresholds: N consecutive transport failures open,
+#: H consecutive heartbeat-probe successes close (rejoin hysteresis),
+#: M consecutive failures mark cached residency suspect (M <= N).
+CIRCUIT_OPEN_AFTER = 3
+CIRCUIT_REJOIN_AFTER = 2
+DAMP_AFTER = 2
 
 #: batcher-stat counter keys mirrored from the remote heartbeat so
 #: ServeServer.stats() can aggregate a mixed local/remote fleet.
@@ -69,9 +88,10 @@ _STAT_KEYS = (
 
 class _RemoteCache:
     """Affinity-probe view of the peer's session residency: membership
-    is one bounded HTTP probe (device slots AND tiers — the peer can
-    serve the session either way). Unreachable peer → False, and the
-    router's shared-disk fallback takes over."""
+    reads the heartbeat-refreshed residency cache — in-memory only,
+    never the network (the router probes under its global lock).
+    Suspect/partitioned peer → False, and the router's shared-disk
+    fallback takes over."""
 
     def __init__(self, shim: "RemoteBatcher"):
         self._shim = shim
@@ -124,6 +144,7 @@ class _RemoteEngine:
             "tiers": None,
             "compiles": {},
             "heartbeat_age_s": self._shim.heartbeat_age(),
+            "circuit": self._shim.circuit.state(),
         }
 
 
@@ -133,53 +154,75 @@ class RemoteBatcher:
     ``run(stop_event)`` is the scheduler closure ServeServer drives on a
     thread (graftlint host-sync covers it like every scheduler loop —
     it never touches the device): poll ``/replica/heartbeat`` every
-    ``poll_interval`` seconds, mirror the peer's queue/active counters,
-    and EXIT after :data:`DEAD_AFTER` consecutive failures so the
-    router's thread-liveness sweep retires the replica through the
-    normal path. ``submit`` never blocks the router lock on the network:
-    it dispatches a daemon RPC thread per request."""
+    ``poll_interval`` seconds through the retrying transport, mirror
+    the peer's queue/active counters and session residency, feed the
+    circuit breaker (the poller IS the half-open prober), and EXIT only
+    after :data:`DEAD_AFTER` consecutive REFUSED connections so the
+    router's thread-liveness sweep retires provably-dead hosts through
+    the normal path while partitioned ones merely open the circuit.
+    ``submit`` never blocks the router lock on the network: it
+    dispatches a daemon RPC thread per request."""
 
     def __init__(self, url: str, *, replica: int = 0, queue_size: int = 64,
                  poll_interval: float = 0.5, rpc_timeout: float = 5.0,
-                 registry=None):
+                 generate_timeout_s: float | None = 120.0,
+                 registry=None, circuit_open_after: int = CIRCUIT_OPEN_AFTER,
+                 circuit_rejoin_after: int = CIRCUIT_REJOIN_AFTER,
+                 damp_after: int = DAMP_AFTER, max_retries: int = 2,
+                 retry_base_s: float = 0.05, transport=None):
         self.url = url.rstrip("/")
         self.replica = int(replica)
         self.queue_size = int(queue_size)
         self.poll_interval = float(poll_interval)
         self.rpc_timeout = float(rpc_timeout)
+        if generate_timeout_s is not None:
+            generate_timeout_s = float(generate_timeout_s)
+            if generate_timeout_s < 0:
+                raise ValueError(
+                    f"generate_timeout_s must be >= 0 "
+                    f"(0 = no client-side bound), got {generate_timeout_s}")
+            if generate_timeout_s == 0:       # CLI convention: 0 = none
+                generate_timeout_s = None
+        self.generate_timeout_s = generate_timeout_s
+        self.damp_after = int(damp_after)
         self.last_heartbeat: float | None = None
         self._lock = threading.Lock()
         self._inflight: set[Request] = set()
         self._remote: dict = {}  # last heartbeat's batcher aggregate
         self._last_ok: float | None = None
+        # residency cache: the last heartbeat's resident session ids
+        # (None = peer didn't report / truncated list) plus an overlay
+        # of kept sessions this front settled recently — covers the
+        # window before the next heartbeat reflects them.
+        self._residency: frozenset[str] | None = None
+        self._recent: dict[str, float] = {}
         self.submitted = 0
         self.completed = 0
         self.failed = 0
-        self._m_rpc = None
+        self.rerouted = 0
+        self._reroute = None          # ServeServer wires Router.reroute
+        gauge_child = None
         if registry is not None:
-            fam = registry.counter(
-                "serve_remote_rpc_total",
-                "remote-replica RPC outcomes (generate calls by result)",
-                labelnames=("outcome", "replica"))
-            rl = str(self.replica)
-            self._m_rpc = {o: fam.labels(outcome=o, replica=rl)
-                           for o in ("ok", "error", "unreachable")}
+            gauge_child = registry.gauge(
+                "serve_circuit_state",
+                "per-peer circuit state (0=closed, 1=open, 2=half_open)",
+                labelnames=("peer",)).labels(peer=str(self.replica))
+        self.circuit = CircuitBreaker(open_after=circuit_open_after,
+                                      rejoin_after=circuit_rejoin_after,
+                                      gauge=gauge_child)
+        if transport is None:
+            transport = PeerTransport(
+                self.url, peer=self.replica,
+                connect_timeout=min(self.rpc_timeout, 1.0),
+                max_retries=max_retries, retry_base_s=retry_base_s,
+                circuit=self.circuit, registry=registry)
+        self._transport = transport
 
-    # ---- HTTP plumbing -------------------------------------------------
-
-    def _get(self, path: str, timeout: float | None = None) -> dict:
-        with urllib.request.urlopen(
-                self.url + path,
-                timeout=self.rpc_timeout if timeout is None else timeout
-        ) as r:
-            return json.loads(r.read())
-
-    def _post(self, path: str, body: dict, timeout: float) -> dict:
-        req = urllib.request.Request(
-            self.url + path, data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return json.loads(r.read())
+    def set_reroute(self, fn) -> None:
+        """Wire the router's reroute path (called by ServeServer after
+        Router construction): ``fn(req) -> bool`` re-picks a replica
+        for a request whose RPC provably never reached this peer."""
+        self._reroute = fn
 
     # ---- liveness ------------------------------------------------------
 
@@ -187,57 +230,90 @@ class RemoteBatcher:
         hb = self.last_heartbeat
         return None if hb is None else round(time.monotonic() - hb, 3)
 
+    def suspect(self) -> bool:
+        """True while the peer's link is not trustworthy: circuit open,
+        or ``damp_after`` consecutive transport failures accrued (the
+        flap-damping threshold below full circuit-open)."""
+        return self.circuit.suspect(self.damp_after)
+
     def run(self, stop_event: threading.Event,
             idle_wait: float = 0.05) -> None:
-        """Heartbeat poller — THE liveness proxy: this thread's exit is
-        how the router learns the host died (sweep: thread-not-alive →
-        retire). One initial probe runs immediately so a host that was
-        already down is retired within ``DEAD_AFTER`` polls of start."""
-        failures = 0
+        """Heartbeat poller — THE liveness proxy AND the circuit's
+        half-open prober: this thread's exit is how the router learns
+        the host provably DIED (sweep: thread-not-alive → retire), and
+        its probes are how a partitioned-then-healed peer REJOINS (the
+        transport records every outcome into the breaker; probes bypass
+        the open-circuit fail-fast). One initial probe runs immediately
+        so a host that was already down is retired within
+        ``DEAD_AFTER`` polls of start."""
+        refused = 0
         while not stop_event.is_set():
             try:
-                hb = self._get("/replica/heartbeat")
-            except (urllib.error.URLError, OSError, ValueError):
-                failures += 1
-                if failures >= DEAD_AFTER:
-                    return  # host dead: the sweep takes it from here
+                hb = self._transport.rpc_get(
+                    "/replica/heartbeat", method="heartbeat",
+                    timeout=self.rpc_timeout, retries=0, probe=True)
+            except TransportError as e:
+                if e.kind == "refused":
+                    refused += 1
+                    if refused >= DEAD_AFTER:
+                        return  # no listener: the sweep takes it
+                else:
+                    # partition-shaped (timeout/reset/blackhole): the
+                    # breaker absorbed it — never a retirement signal
+                    refused = 0
+            except PeerHTTPError:
+                refused = 0   # a listener answered: alive but unwell
             else:
-                failures = 0
+                refused = 0
+                now = time.monotonic()
+                ids = hb.get("session_ids")
                 with self._lock:
                     self._remote = hb.get("batcher") or {}
-                    self._last_ok = time.monotonic()
+                    self._last_ok = now
+                    if ids is None:
+                        self._residency = None
+                    else:
+                        self._residency = frozenset(ids)
+                        # overlay entries the snapshot now covers (or
+                        # the peer evicted) are done shielding the gap
+                        self._recent = {
+                            s: t for s, t in self._recent.items()
+                            if s not in self._residency
+                            and now - t <= 3 * self.poll_interval}
                 if hb.get("status") != "down":
                     # a peer whose own schedulers are wedged reports
                     # "down": its thread lives but nothing serves — keep
                     # OUR heartbeat stale so the router stops routing
                     # fresh sessions there (the wedge semantics local
                     # replicas already have)
-                    self.last_heartbeat = time.monotonic()
-            stop_event.wait(self.poll_interval)
+                    self.last_heartbeat = now
+            # the stop contract is is_set() only (server._ReplicaStop is
+            # an OR-view, not an Event) — sleep in idle_wait slices so
+            # stop/drain stays responsive at any poll_interval
+            deadline = time.monotonic() + self.poll_interval
+            while (not stop_event.is_set()
+                   and time.monotonic() < deadline):
+                time.sleep(min(idle_wait, self.poll_interval))
 
     def has_session(self, sid: str) -> bool:
         # the router calls this under its GLOBAL lock (affinity probe):
-        # the probe is one bounded HTTP GET for a peer whose heartbeat
-        # is FRESH, and a lock-free False for one that is not — a
-        # silent/dying peer must not stall the whole admission plane
-        # for a network timeout per continuation while the poller
-        # counts down to declaring it dead. Routing the continuation
-        # elsewhere is exactly right for an unhealthy peer: with a
-        # shared --session-dir the survivor fills the last checkpointed
-        # boundary from disk, and without one the honest "unknown
-        # session" beats a submit plane frozen behind a corpse.
+        # the answer comes from the heartbeat-refreshed residency cache
+        # and the recent-settle overlay — NEVER the network (the old
+        # blocking GET here stalled the whole admission plane for a
+        # network timeout per continuation; graftlint io-under-lock now
+        # pins the pattern). A suspect/stale peer probes False and the
+        # shared-disk fallback routes the continuation to a survivor.
+        if self.suspect():
+            return False
+        now = time.monotonic()
         with self._lock:
-            last_ok = self._last_ok
-        if (last_ok is None
-                or time.monotonic() - last_ok > 3 * self.poll_interval):
-            return False
-        try:
-            return bool(self._get(
-                "/replica/has_session?sid="
-                + urllib.parse.quote(sid, safe=""),
-                timeout=min(self.rpc_timeout, 2.0)).get("has"))
-        except (urllib.error.URLError, OSError, ValueError):
-            return False
+            if (self._last_ok is None
+                    or now - self._last_ok > 3 * self.poll_interval):
+                return False
+            if self._residency is not None and sid in self._residency:
+                return True
+            t = self._recent.get(sid)
+            return t is not None and now - t <= 3 * self.poll_interval
 
     # ---- router-facing surface -----------------------------------------
 
@@ -283,6 +359,11 @@ class RemoteBatcher:
                     "retry after 0.25s", retry_after_s=0.25)
             self._inflight.add(req)
             self.submitted += 1
+        if req.rpc_request_id is None:
+            # the idempotency key the peer deduplicates replays on —
+            # minted ONCE per request so retries AND reroute-then-retry
+            # chains can never double-decode on the same peer
+            req.rpc_request_id = uuid.uuid4().hex
         if req.t_submit is None:
             req.t_submit = time.perf_counter()
             if req.deadline_s is not None:
@@ -304,24 +385,28 @@ class RemoteBatcher:
             "eos_id": req.eos_id,
             "use_prefix": req.use_prefix,
             "class": req.klass,
+            "request_id": req.rpc_request_id,
         }
-        timeout = 120.0
+        timeout = self.generate_timeout_s      # None = no client bound
         if req.deadline is not None:
             remaining = req.deadline - time.perf_counter()
             if remaining <= 0:
                 self._settle(req, timeout_stage=True)
                 return
             body["deadline_s"] = round(remaining, 3)
-            timeout = remaining + self.rpc_timeout
-        body["timeout"] = timeout
+            timeout = remaining
+        # the peer bounds its own wait on this; a day stands in for
+        # "unbounded" because 0 means "expire immediately" server-side
+        body["timeout"] = timeout if timeout is not None else 86400.0
         try:
-            reply = self._post("/v1/generate", body,
-                               timeout=timeout + self.rpc_timeout)
-        except urllib.error.HTTPError as e:
-            try:
-                err = json.loads(e.read())
-            except Exception:
-                err = {"error": f"HTTP {e.code}", "code": "internal"}
+            reply = self._transport.rpc_post(
+                "/v1/generate", body, method="generate",
+                timeout=None if timeout is None
+                else timeout + self.rpc_timeout,
+                replay_safe=True, deadline=req.deadline)
+        except PeerHTTPError as e:
+            err = e.body or {"error": f"HTTP {e.status}",
+                             "code": "internal"}
             if err.get("code") == "deadline_exceeded":
                 # honest remote expiry WITH the partial tokens
                 self._settle(req, tokens=err.get("tokens") or [],
@@ -341,21 +426,69 @@ class RemoteBatcher:
                 self._settle(req, error=(
                     f"remote replica {self.replica} ({self.url}) "
                     f"rejected the request: "
-                    f"{err.get('error', f'HTTP {e.code}')}"))
+                    f"{err.get('error', f'HTTP {e.status}')}"))
             return
-        except (urllib.error.URLError, OSError, ValueError,
-                TimeoutError) as e:
-            # host unreachable mid-request: its decode position is
-            # indeterminate — "state lost" is the truthful verdict,
-            # exactly like a dead local scheduler's in-flight work
+        except TransportError as e:
+            if e.executed is False:
+                # provably never delivered (connect-phase failure or
+                # circuit fail-fast): re-routing is safe even for a
+                # kept continuation — the shared disk tier fills the
+                # last checkpointed boundary on the survivor
+                if self._try_reroute(req):
+                    return
+                self._settle(req, error=(
+                    f"remote replica {self.replica} ({self.url}) is "
+                    f"unreachable ({e.kind}); the request was never "
+                    "delivered (safe to resend)"), unreachable=True)
+            else:
+                # indeterminate after replay-safe retries exhausted:
+                # the peer may have decoded — "state lost" is the
+                # truthful verdict, exactly like a dead local
+                # scheduler's in-flight work
+                self._settle(req, error=(
+                    f"remote replica {self.replica} ({self.url}) became "
+                    f"unreachable mid-request ({e.kind}); its decode "
+                    "position is indeterminate (state lost — resend "
+                    "the request)"), unreachable=True)
+            return
+        except (ValueError, TypeError) as e:
             self._settle(req, error=(
-                f"remote replica {self.replica} ({self.url}) became "
-                f"unreachable mid-request ({type(e).__name__}); its "
-                "decode position is indeterminate (state lost — resend "
-                "the request)"), unreachable=True)
+                f"remote replica {self.replica} ({self.url}) sent an "
+                f"unusable reply ({type(e).__name__}: {e})"))
             return
+        sid = reply.get("session_id")
         self._settle(req, tokens=reply.get("tokens") or [],
-                     session_id=reply.get("session_id"))
+                     session_id=sid)
+        if req.keep_session and sid:
+            # overlay: the next continuation's affinity probe must see
+            # this session before the next heartbeat reflects it
+            with self._lock:
+                self._recent[sid] = time.monotonic()
+
+    def _try_reroute(self, req: Request) -> bool:
+        """Re-enter routing for a provably-undelivered request. The
+        request leaves our in-flight set first (a racing fail_inflight
+        must not settle what another replica now owns); exactly-one-
+        settler stays true via the done-event check discipline."""
+        reroute = self._reroute
+        if reroute is None or req.done.is_set():
+            return False
+        with self._lock:
+            self._inflight.discard(req)
+        try:
+            ok = bool(reroute(req))
+        except Exception:
+            ok = False
+        if ok:
+            with self._lock:
+                self.rerouted += 1
+            return True
+        # nobody took it — restore accounting so the settle below and
+        # fail_inflight keep seeing a consistent in-flight set
+        with self._lock:
+            if not req.done.is_set():
+                self._inflight.add(req)
+        return False
 
     def _settle(self, req: Request, *, tokens=None, session_id=None,
                 error: str | None = None, timeout_stage: bool = False,
@@ -395,10 +528,6 @@ class RemoteBatcher:
                 req.remote_shed_retry_after = shed_retry_after
             req.t_done = now
             req.done.set()
-        if self._m_rpc is not None:
-            self._m_rpc["unreachable" if unreachable else
-                        "error" if (error or timeout_stage)
-                        else "ok"].inc()
 
     # ---- retirement (router-driven, after run() exited) ----------------
 
@@ -444,9 +573,11 @@ class RemoteBatcher:
                         top_k=sampling.top_k, top_p=sampling.top_p,
                         greedy=sampling.greedy)
         try:
-            return int(self._post("/replica/warmup", body,
-                                  timeout=600.0).get("programs", 0))
-        except (urllib.error.URLError, OSError, ValueError) as e:
+            return int(self._transport.rpc_post(
+                "/replica/warmup", body, method="warmup",
+                timeout=600.0, replay_safe=True).get("programs", 0))
+        except (TransportError, PeerHTTPError, ValueError,
+                TypeError) as e:
             print(f"serve: remote replica {self.replica} warmup RPC "
                   f"failed ({type(e).__name__}) — relying on its own "
                   "boot-time warmup", flush=True)
@@ -457,6 +588,7 @@ class RemoteBatcher:
             remote = dict(self._remote)
             submitted, completed = self.submitted, self.completed
             failed, inflight = self.failed, len(self._inflight)
+            rerouted = self.rerouted
         out = {k: int(remote.get(k, 0) or 0) for k in _STAT_KEYS}
         out.update({
             "replica": self.replica,
@@ -465,6 +597,11 @@ class RemoteBatcher:
             "rpc_completed": completed,
             "rpc_failed": failed,
             "rpc_inflight": inflight,
+            "rpc_retries": self._transport.retries_total,
+            "rpc_rerouted": rerouted,
+            "circuit": self.circuit.state(),
+            "circuit_opened": self.circuit.opened_total,
+            "circuit_closed": self.circuit.closed_total,
             # JSON stringified the K keys in flight; re-int them so the
             # server's cross-replica aggregation merges onto the local
             # batchers' integer rungs instead of duplicating "4" vs 4
@@ -487,13 +624,27 @@ class RemoteReplica(Replica):
     """A :class:`~.router.Replica` whose engine+scheduler live in
     another process. Plugs into ``ServeServer``/``Router`` unchanged:
     the heartbeat poller is the scheduler thread, the RPC shim is the
-    batcher, and the engine view answers affinity probes."""
+    batcher, and the engine view answers affinity probes. Overrides
+    ``circuit_open`` so the router treats a partitioned peer like a
+    stale one (route around, don't retire) while it heals."""
 
     def __init__(self, index: int, url: str, *, registry=None,
                  queue_size: int = 64, poll_interval: float = 0.5,
-                 rpc_timeout: float = 5.0):
+                 rpc_timeout: float = 5.0,
+                 generate_timeout_s: float | None = 120.0,
+                 circuit_open_after: int = CIRCUIT_OPEN_AFTER,
+                 circuit_rejoin_after: int = CIRCUIT_REJOIN_AFTER,
+                 damp_after: int = DAMP_AFTER):
         shim = RemoteBatcher(url, replica=index, queue_size=queue_size,
                              poll_interval=poll_interval,
-                             rpc_timeout=rpc_timeout, registry=registry)
+                             rpc_timeout=rpc_timeout,
+                             generate_timeout_s=generate_timeout_s,
+                             registry=registry,
+                             circuit_open_after=circuit_open_after,
+                             circuit_rejoin_after=circuit_rejoin_after,
+                             damp_after=damp_after)
         super().__init__(index, _RemoteEngine(shim, registry), shim)
         self.url = shim.url
+
+    def circuit_open(self) -> bool:
+        return self.batcher.suspect()

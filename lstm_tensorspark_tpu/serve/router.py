@@ -122,6 +122,13 @@ class Replica:
         return (self.thread is not None and hb is not None
                 and time.monotonic() - hb > stale_after)
 
+    def circuit_open(self) -> bool:
+        """True while the replica's transport circuit is open or suspect
+        (remote replicas only — a partitioned peer must be routed
+        around instantly, like a wedge, while its heartbeat prober
+        works toward rejoin). Local replicas have no circuit."""
+        return False
+
 
 class Router:
     """Admission front for a set of replicas (module docstring)."""
@@ -189,6 +196,7 @@ class Router:
         self.shed = {c: 0 for c in CLASSES}  # 429s by admission class
         self.tenant_limited = {c: 0 for c in CLASSES}  # token-bucket 429s
         self.requeued = 0            # dead-replica queue → live replica
+        self.rerouted = 0            # undelivered RPCs re-picked elsewhere
         self.failed_on_death = 0     # in-flight requests failed honestly
         self.migrated_sessions = 0   # idle kept sessions detach/restored
         self.lost_sessions = 0       # could not be restored anywhere
@@ -220,6 +228,9 @@ class Router:
         self._m_migrated = reg.counter(
             "serve_router_migrated_sessions_total",
             "idle kept sessions moved off dead replicas via detach/restore")
+        self._m_rerouted = reg.counter(
+            "serve_router_rerouted_total",
+            "provably-undelivered remote RPCs re-routed to another replica")
         # shared with the batcher's own queue bound: one registration
         # site + one policy function, so the two layers can never hint
         # different Retry-After curves for the same queue state; the
@@ -233,6 +244,13 @@ class Router:
         self._qwait = reg.histogram(
             "serve_queue_wait_seconds", "submit → admission wait",
             labelnames=("replica",))
+
+    def _suspect(self, r: Replica) -> bool:
+        """Unfit for fresh work: heartbeat-stale (the wedge) OR
+        transport circuit open/suspect (the partition). Both are
+        route-around states, not deaths — the replica stays in the
+        fleet and rejoins when its heartbeat/probes recover."""
+        return r.stale(self.stale_after) or r.circuit_open()
 
     # ---- client side ---------------------------------------------------
 
@@ -268,13 +286,15 @@ class Router:
                         f"tenant {req.tenant!r} exceeded its "
                         f"{self.tenant_rate:g} req/s rate limit; retry "
                         f"after {retry:.2f}s", retry_after_s=retry)
-            # the bound covers NON-STALE queues only: a wedged replica
-            # never drains (its admission loop is stuck), so counting its
-            # stranded entries would permanently shrink the fleet's
-            # effective admission capacity until restart. If the wedge
-            # recovers, a transient overshoot of the bound drains normally.
+            # the bound covers NON-SUSPECT queues only: a wedged replica
+            # never drains (its admission loop is stuck) and a
+            # partitioned one drains only after it heals, so counting
+            # their stranded entries would shrink the fleet's effective
+            # admission capacity until recovery. If the wedge/partition
+            # recovers, a transient overshoot of the bound drains
+            # normally.
             queued = sum(r.batcher.queued() for r in live
-                         if not r.stale(self.stale_after))
+                         if not self._suspect(r))
             bound = (self._best_effort_bound
                      if req.klass == "best_effort" else self.queue_size)
             if queued >= bound:
@@ -423,7 +443,7 @@ class Router:
             for r in live:
                 tiers = r.engine.tiers
                 if tiers is not None and tiers.has_memory(sid):
-                    if not r.stale(self.stale_after):
+                    if not self._suspect(r):
                         tiers.fill_ahead(sid)
                     return r
             # disk tier only: no live replica holds a fresher memory
@@ -447,15 +467,15 @@ class Router:
                     hit = by_dir[d] = tiers.has(sid)
                 if hit:
                     cands.append(r)
-            healthy = [r for r in cands
-                       if not r.stale(self.stale_after)]
+            healthy = [r for r in cands if not self._suspect(r)]
             if cands:
                 return min(healthy or cands,
                            key=lambda r: r.batcher.load())
-        # fresh sessions avoid wedged (stale) replicas while any healthy
-        # one exists — a stale replica admits nothing, so work routed
-        # there hangs to client timeout while holding queue capacity
-        fresh = [r for r in live if not r.stale(self.stale_after)]
+        # fresh sessions avoid wedged (stale) and circuit-open
+        # (partitioned) replicas while any healthy one exists — work
+        # routed there hangs to client timeout (or fails fast against
+        # an open circuit) while holding queue capacity
+        fresh = [r for r in live if not self._suspect(r)]
         pool = fresh or live
         loads = [(r.batcher.load(), r) for r in pool]
         lo = min(load for load, _ in loads)
@@ -487,8 +507,7 @@ class Router:
                     state = d.engine.detach_session(sid)
                 except KeyError:
                     continue  # went idle and migrated under our probe
-                healthy = [r for r in live
-                           if not r.stale(self.stale_after)]
+                healthy = [r for r in live if not self._suspect(r)]
                 for target in sorted(healthy or live,
                                      key=lambda r: r.batcher.load()):
                     try:
@@ -635,8 +654,7 @@ class Router:
             # (a health probe!) forever, and even a successful restore
             # parks the session where continuations hang to client
             # timeout. No healthy target → the session is lost, honestly.
-            healthy = [r for r in targets
-                       if not r.stale(self.stale_after)]
+            healthy = [r for r in targets if not self._suspect(r)]
             for target in sorted(healthy,
                                  key=lambda r: r.batcher.load()):
                 try:
@@ -675,7 +693,7 @@ class Router:
                     targets = [r for r in self.replicas
                                if r.routable() and r is not rep
                                and r.engine.tiers is not None
-                               and not r.stale(self.stale_after)]
+                               and not self._suspect(r)]
                 target = min(targets, key=lambda r: r.batcher.load(),
                              default=None)
                 if target is not None:
@@ -741,6 +759,32 @@ class Router:
             self.requeued += requeued
         return requeued
 
+    def reroute(self, req: Request, source: Replica) -> bool:
+        """Re-pick a replica for a request whose remote RPC provably
+        NEVER reached ``source`` (``TransportError.executed is False``:
+        connect refused/timed out, or circuit fail-fast). Because
+        nothing executed, resending — even a kept continuation, which
+        the shared disk tier fills on the survivor — cannot double-
+        decode. No global-bound recheck (the request already holds its
+        admission slot); bounded by the fleet size so a total outage
+        settles honestly instead of ping-ponging. Returns True when a
+        new replica accepted the request."""
+        req.reroutes += 1
+        if req.reroutes > max(len(self.replicas) - 1, 1):
+            return False
+        try:
+            with self._lock:
+                live = [r for r in self.replicas
+                        if r.routable() and r is not source]
+                if not live:
+                    return False
+                self._submit_to_locked(req, self._pick_locked(req, live))
+                self.rerouted += 1
+        except Exception:
+            return False
+        self._m_rerouted.inc()
+        return True
+
     # ---- views ---------------------------------------------------------
 
     def stats(self) -> dict:
@@ -761,6 +805,7 @@ class Router:
                 "best_effort_bound": self._best_effort_bound,
                 "best_effort_frac": self.best_effort_frac,
                 "requeued": self.requeued,
+                "rerouted": self.rerouted,
                 "failed_on_death": self.failed_on_death,
                 "migrated_sessions": self.migrated_sessions,
                 "lost_sessions": self.lost_sessions,

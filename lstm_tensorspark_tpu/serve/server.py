@@ -63,13 +63,17 @@ from .batcher import (
 )
 from .engine import GREEDY, SamplingParams, ServeEngine, UnknownModelError
 from .router import Replica, Router
+from .state_cache import PREFIX_SID_NAMESPACE
 
 
 class _ReplicaStop:
     """Per-replica stop signal layered over the server-wide one: the
     rollout controller stops ONE scheduler (drain → swap → rejoin)
-    without touching its peers. ``Batcher.run`` only calls
-    ``is_set()``, so this tiny OR-view is the whole contract."""
+    without touching its peers. ``Batcher.run`` only polls
+    ``is_set()``; ``wait()`` completes the Event-shaped surface for
+    code that parks on the stop signal (the wedged-scheduler test
+    stub) — without it such a thread dies with AttributeError and the
+    liveness sweep retires a replica that was merely stuck."""
 
     __slots__ = ("server_stop", "local")
 
@@ -79,6 +83,21 @@ class _ReplicaStop:
 
     def is_set(self) -> bool:
         return self.server_stop.is_set() or self.local.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        # OR over two Events with no shared condition to block on:
+        # park on the server-wide one in short slices, re-checking the
+        # local flag each wake (≤50 ms extra latency on a local stop)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while not self.is_set():
+            step = 0.05
+            if deadline is not None:
+                step = min(step, deadline - time.monotonic())
+                if step <= 0:
+                    return False
+            self.server_stop.wait(step)
+        return True
 
 #: aggregated batcher counters summed across replicas in stats(); config
 #: fields (window ladder etc.) are taken from replica 0 instead
@@ -114,6 +133,9 @@ class ServeServer:
                  deadline_defaults: dict | None = None,
                  sweep_interval: float | None = None,
                  remote_replicas: tuple[str, ...] = (),
+                 remote_timeout_s: float | None = 120.0,
+                 remote_rpc_timeout_s: float = 5.0,
+                 remote_poll_interval_s: float = 0.5,
                  autotune=None,
                  tenant_rate: float | None = None,
                  tenant_burst: float = 5.0,
@@ -171,12 +193,18 @@ class ServeServer:
         # and host death retires through the exact replica-death path.
         # Indexed after the locals, so replica 0 (the engine/batcher
         # back-compat views, the registry anchor) stays in-process.
+        remotes = []
         for url in remote_replicas:
             from .remote import RemoteReplica
 
-            self.replicas.append(RemoteReplica(
+            rep = RemoteReplica(
                 len(self.replicas), url, registry=engines[0].metrics,
-                queue_size=self.replicas[0].batcher.queue_size))
+                queue_size=self.replicas[0].batcher.queue_size,
+                poll_interval=remote_poll_interval_s,
+                rpc_timeout=remote_rpc_timeout_s,
+                generate_timeout_s=remote_timeout_s)
+            self.replicas.append(rep)
+            remotes.append(rep)
         # the global admission bound == the per-replica queue bound, so
         # the router's check is the only one that ever fires
         self.router = Router(
@@ -185,6 +213,19 @@ class ServeServer:
             best_effort_frac=best_effort_queue_frac,
             registry=engines[0].metrics,
             tenant_rate=tenant_rate, tenant_burst=tenant_burst)
+        # wire the provably-undelivered reroute path: a remote RPC that
+        # failed before delivery (connect refused/timed out, circuit
+        # fail-fast) re-enters routing instead of settling "state lost"
+        for rep in remotes:
+            rep.batcher.set_reroute(
+                lambda req, _r=rep: self.router.reroute(req, _r))
+        # peer-side replay dedup for the generate POST: remote fronts
+        # mint a request_id per request; a retried delivery whose first
+        # attempt executed replays the settled reply instead of
+        # double-decoding (exactly-once effect; serve/transport.py)
+        from .transport import SettledCache
+
+        self.settled = SettledCache(registry=engines[0].metrics)
         self.health_stale_after = health_stale_after
         # online autotuner (serve/autotune.py): built over the finished
         # stack so it sees every replica/tier/router surface; its
@@ -474,8 +515,32 @@ class ServeServer:
             "sessions": sum(len(r.engine.cache)
                             for r in self.replicas
                             if hasattr(r.engine.cache, "__len__")),
+            # resident session ids (device slots AND tiers): the front's
+            # RPC shim answers affinity probes from this snapshot so the
+            # admission plane never blocks on a per-continuation GET.
+            # None = truncated (a fleet past the cap falls back to the
+            # shared-disk probe front-side — correct, just less warm).
+            "session_ids": self._resident_session_ids(),
             "batcher": agg,
         }
+
+    #: heartbeat residency-list cap: past this the payload reports None
+    #: (truncated) instead of shipping an unbounded id list every poll
+    MAX_HEARTBEAT_SESSIONS = 4096
+
+    def _resident_session_ids(self) -> list[str] | None:
+        ids: set[str] = set()
+        for r in self.replicas:
+            cache = r.engine.cache
+            if hasattr(cache, "session_ids"):
+                ids.update(s for s in cache.session_ids()
+                           if not s.startswith(PREFIX_SID_NAMESPACE))
+            tiers = getattr(r.engine, "tiers", None)
+            if tiers is not None and hasattr(tiers, "session_ids"):
+                ids.update(tiers.session_ids())
+            if len(ids) > self.MAX_HEARTBEAT_SESSIONS:
+                return None
+        return sorted(ids)
 
     def stats(self) -> dict:
         """Aggregate view + per-replica detail. Top-level ``batcher`` sums
@@ -718,15 +783,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def _error(self, http_status: int, code: str, message: str, *,
-               retryable: bool, retry_after_s: float | None = None,
-               **extra) -> None:
+    @staticmethod
+    def _error_parts(code: str, message: str, *, retryable: bool,
+                     retry_after_s: float | None = None,
+                     **extra) -> tuple[dict, dict | None]:
         """ONE error shape for every non-200 reply, so clients can branch
         on a stable contract instead of parsing prose: ``error`` (the
         human message — the key every pre-existing client reads),
         ``code`` (stable machine token), ``retryable``, and
         ``retry_after_s`` where the server has an honest estimate (also
-        sent as the standard ``Retry-After`` header on 429s)."""
+        sent as the standard ``Retry-After`` header on 429s). Returns
+        ``(body, headers)`` so the generate path can settle the payload
+        into the replay cache before writing it to the wire."""
         body = {"error": message, "code": code, "retryable": bool(retryable),
                 "retry_after_s": retry_after_s, **extra}
         headers = None
@@ -734,6 +802,14 @@ class _Handler(BaseHTTPRequestHandler):
             # delta-seconds per RFC 9110 (integer, rounded up — the body
             # keeps the precise float)
             headers = {"Retry-After": str(max(1, int(-(-retry_after_s // 1))))}
+        return body, headers
+
+    def _error(self, http_status: int, code: str, message: str, *,
+               retryable: bool, retry_after_s: float | None = None,
+               **extra) -> None:
+        body, headers = self._error_parts(
+            code, message, retryable=retryable,
+            retry_after_s=retry_after_s, **extra)
         self._reply(http_status, body, headers)
 
     def do_GET(self) -> None:
@@ -874,7 +950,46 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, "bad_request", f"bad request: {e}",
                         retryable=False)
             return
+        rid = body.get("request_id")
+        rid = None if rid is None else str(rid)
+        if rid is not None:
+            # idempotent replay (serve/transport.py SettledCache): a
+            # remote front retries delivery under this client-minted id
+            # — a replay of an attempt that already executed returns
+            # the settled reply verbatim instead of double-decoding
+            state, cached = self._serve.settled.begin(
+                rid, wait_timeout=timeout)
+            if state == "hit":
+                status, payload = cached
+                self._reply(status, dict(payload, replayed=True))
+                return
+            if state == "timeout":
+                self._error(504, "client_timeout",
+                            f"request_id {rid!r} is still executing its "
+                            "first delivery", retryable=True)
+                return
+            # "mine": first delivery — every outcome below settles or
+            # abandons the id before the reply hits the wire
+        status, payload, headers = self._generate_outcome(
+            body, prompt, max_new, sampling, timeout, klass, deadline_s,
+            tenant, model)
+        if rid is not None:
+            if status == 200 or payload.get("code") == "deadline_exceeded":
+                # only outcomes that decoded tokens are worth replaying;
+                # transient errors (shed, bad request, internal) abandon
+                # so a retried delivery re-executes
+                self._serve.settled.settle(rid, status, payload)
+            else:
+                self._serve.settled.abandon(rid)
+        self._reply(status, payload, headers)
+
+    def _generate_outcome(self, body, prompt, max_new, sampling, timeout,
+                          klass, deadline_s, tenant, model):
+        """Execute one generate call and return ``(status, payload,
+        headers)`` instead of writing the wire directly — the replay
+        cache records the settled outcome before the reply is sent."""
         t0 = time.perf_counter()
+        err = self._error_parts
         try:
             req = self._serve.generate(
                 prompt, max_new_tokens=max_new, sampling=sampling,
@@ -889,42 +1004,37 @@ class _Handler(BaseHTTPRequestHandler):
             # the model is not resident anywhere in the fleet: the
             # client named a thing that does not exist — 404, like an
             # unknown route, not a capacity condition
-            self._error(404, "unknown_model", str(e), retryable=False)
-            return
+            return (404, *err("unknown_model", str(e), retryable=False))
         except QueueFullError as e:
             # the shed path: retryable by definition, with the router's
             # live drain estimate as the honest Retry-After
-            self._error(429, "queue_full", str(e), retryable=True,
-                        retry_after_s=getattr(e, "retry_after_s", None))
-            return
+            return (429, *err("queue_full", str(e), retryable=True,
+                              retry_after_s=getattr(e, "retry_after_s",
+                                                    None)))
         except DeadlineExceededError as e:
             # server-side deadline expiry: an honest timeout WITH the
             # partial output — the client keeps every token that was
             # ready, and can branch on code="deadline_exceeded"
             r = e.request
-            self._error(504, "deadline_exceeded", str(e), retryable=True,
-                        tokens=list(r.tokens),
-                        deadline_s=r.deadline_s,
-                        phases_ms=r.phase_summary_ms())
-            return
+            return (504, *err("deadline_exceeded", str(e), retryable=True,
+                              tokens=list(r.tokens),
+                              deadline_s=r.deadline_s,
+                              phases_ms=r.phase_summary_ms()))
         except (ValueError, TypeError, RuntimeError) as e:
             # TypeError: a null/wrong-typed prompt surfaces from
             # np.asarray inside Request — still the client's fault
             if isinstance(e, RuntimeError):
-                self._error(500, "internal", f"{type(e).__name__}: {e}",
-                            retryable=False)
-            else:
-                self._error(400, "bad_request",
-                            f"{type(e).__name__}: {e}", retryable=False)
-            return
+                return (500, *err("internal", f"{type(e).__name__}: {e}",
+                                  retryable=False))
+            return (400, *err("bad_request", f"{type(e).__name__}: {e}",
+                              retryable=False))
         except TimeoutError as e:
             # the client-side wait bound (distinct from the server-side
             # deadline): the request was CANCELLED, nothing useful to
             # return, but retrying re-sends the work — mark retryable
-            self._error(504, "client_timeout", str(e), retryable=True)
-            return
+            return (504, *err("client_timeout", str(e), retryable=True))
         gaps = req.itl_gaps()
-        self._reply(200, {
+        return (200, {
             "tokens": list(req.tokens),
             "session_id": req.session_id,
             "replica": req.replica,
@@ -936,7 +1046,7 @@ class _Handler(BaseHTTPRequestHandler):
             # per-request phase breakdown (queue/prefill/decode/readback
             # host time) — the trace timeline, summarised into the reply
             "phases_ms": req.phase_summary_ms(),
-        })
+        }, None)
 
 
 def make_http_server(serve: ServeServer, host: str = "127.0.0.1",

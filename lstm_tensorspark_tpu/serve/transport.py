@@ -1,0 +1,530 @@
+"""Network resilience layer for the remote-replica plane (ISSUE 17).
+
+PR 13 put the router across hosts over single-shot ``urllib`` calls:
+every RPC opened a fresh TCP connection, a slow peer burned the full
+``rpc_timeout`` per call with no retry, and one blackhole longer than
+``DEAD_AFTER * poll_interval`` permanently retired a healthy peer.
+This module is the shared transport that fixes the plane:
+
+``PeerTransport``
+    Connection-reusing HTTP client with split connect/read timeouts,
+    bounded retries driven by the shared ``resilience.backoff
+    .backoff_delay`` ladder, and per-peer failure classification.  Every
+    failure is tagged with ``executed``: ``False`` means the request
+    provably never reached the peer (connect-phase failure — safe to
+    re-route anywhere, even a kept-session continuation), ``None`` means
+    indeterminate (the request may have executed — only safe to retry
+    against the *same* peer under a ``request_id`` replay).
+
+``CircuitBreaker``
+    Per-peer state machine: N consecutive transport failures open the
+    circuit so fresh requests route away instantly instead of each
+    waiting out ``rpc_timeout``; the heartbeat poller doubles as the
+    half-open prober; H consecutive probe successes close it again
+    (hysteresis — one lucky packet does not rejoin a flapping peer).
+    Circuit-open is deliberately distinct from dead: a refused
+    connection (no listener) still retires, a partition never does.
+
+``SettledCache``
+    Peer-side idempotent-replay cache for the non-idempotent generate
+    POST: the client mints a ``request_id``, the peer remembers the
+    settled reply, and a retried POST whose first attempt actually
+    executed returns the cached settle instead of double-decoding
+    (exactly-once effect over at-least-once delivery).
+
+Fault injection (``resilience.faults`` ``net_latency`` / ``net_drop`` /
+``net_blackhole`` / ``net_flap``) hooks in at ``PeerTransport._attempt``
+so heartbeat, residency, and generate paths all see the same wire.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import threading
+import time
+from collections import OrderedDict
+from urllib.parse import urlsplit
+
+from ..resilience import faults
+from ..resilience.backoff import backoff_delay
+
+__all__ = [
+    "CircuitBreaker",
+    "PeerHTTPError",
+    "PeerTransport",
+    "SettledCache",
+    "TransportError",
+]
+
+# Circuit gauge values (docs/OPERATIONS.md "Circuit open" runbook row).
+CIRCUIT_CLOSED = 0
+CIRCUIT_OPEN = 1
+CIRCUIT_HALF_OPEN = 2
+
+
+class TransportError(OSError):
+    """A transport-level RPC failure with delivery provenance.
+
+    ``kind``
+        ``refused`` | ``connect_timeout`` | ``timeout`` | ``reset`` |
+        ``circuit_open`` | ``response_dropped`` | ``protocol``.
+    ``executed``
+        ``False`` — provably never delivered (failed before the request
+        bytes could reach a listener); re-routing is always safe.
+        ``None`` — indeterminate: the peer may have executed the call
+        (e.g. read timeout after the POST was sent, response dropped);
+        only a same-peer ``request_id`` replay is safe.
+    ``attempts``
+        How many wire attempts the failing call made (set by the retry
+        loop on the finally-raised error).
+    """
+
+    def __init__(self, kind: str, message: str, *, executed=False,
+                 attempts: int = 1):
+        super().__init__(message)
+        self.kind = kind
+        self.executed = executed
+        self.attempts = attempts
+
+
+class PeerHTTPError(Exception):
+    """The peer answered with an HTTP error status.
+
+    Reaching this far means the peer process is alive and talking — it
+    counts as a circuit *success* even though the call failed.  ``body``
+    is the peer's decoded JSON error payload (the uniform
+    ``{"error", "code", "retryable", ...}`` shape from serve/server.py)
+    when one was parseable, else ``{}``.
+    """
+
+    def __init__(self, status: int, body: dict | None = None):
+        super().__init__(f"peer returned HTTP {status}")
+        self.status = int(status)
+        self.body = body if isinstance(body, dict) else {}
+
+
+class CircuitBreaker:
+    """Per-peer circuit breaker with flap damping and rejoin hysteresis.
+
+    Closed regime: any success fully resets the failure streak, so an
+    alternating ok/fail link (flap) below ``open_after`` never opens the
+    circuit — it degrades via per-call retries instead of oscillating.
+    ``open_after`` consecutive failures open it.  Open regime: probes
+    (the heartbeat poller) keep flowing; ``rejoin_after`` *consecutive*
+    successes close it — a single lucky probe only moves it to
+    half-open.  ``suspect(after)`` exposes the milder damping threshold
+    the residency cache uses: ``after <= open_after`` consecutive
+    failures mark the peer's cached state untrusted before the circuit
+    fully opens.
+    """
+
+    def __init__(self, *, open_after: int = 3, rejoin_after: int = 2,
+                 gauge=None):
+        if open_after < 1 or rejoin_after < 1:
+            raise ValueError("circuit thresholds must be >= 1")
+        self.open_after = int(open_after)
+        self.rejoin_after = int(rejoin_after)
+        self._lock = threading.Lock()
+        self._open = False
+        self._fail_streak = 0
+        self._ok_streak = 0
+        self.opened_total = 0
+        self.closed_total = 0
+        self._gauge = gauge          # metric child: .set(state int)
+        self._set_gauge(CIRCUIT_CLOSED)
+
+    def _set_gauge(self, value: int) -> None:
+        if self._gauge is not None:
+            self._gauge.set(float(value))
+
+    def allow(self) -> bool:
+        """False while open — callers fail fast instead of waiting out
+        a timeout against a partitioned peer.  Probes bypass this."""
+        with self._lock:
+            return not self._open
+
+    @property
+    def is_open(self) -> bool:
+        with self._lock:
+            return self._open
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._ok_streak = 0
+            self._fail_streak += 1
+            if not self._open and self._fail_streak >= self.open_after:
+                self._open = True
+                self.opened_total += 1
+            value = CIRCUIT_OPEN if self._open else CIRCUIT_CLOSED
+        self._set_gauge(value)
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._open:
+                self._ok_streak += 1
+                if self._ok_streak >= self.rejoin_after:
+                    self._open = False
+                    self._fail_streak = 0
+                    self._ok_streak = 0
+                    self.closed_total += 1
+                    value = CIRCUIT_CLOSED
+                else:
+                    value = CIRCUIT_HALF_OPEN
+            else:
+                self._fail_streak = 0
+                self._ok_streak += 1
+                value = CIRCUIT_CLOSED
+        self._set_gauge(value)
+
+    def suspect(self, after: int) -> bool:
+        """True when open, or when ``after`` consecutive failures have
+        accrued — the damping threshold at which cached residency stops
+        being trusted (M in the flap-damping spec, M <= N)."""
+        with self._lock:
+            return self._open or self._fail_streak >= int(after)
+
+    def state(self) -> str:
+        with self._lock:
+            if not self._open:
+                return "closed"
+            return "half_open" if self._ok_streak > 0 else "open"
+
+
+_RETRYABLE_HTTP = ()            # HTTP statuses are never transport-retried
+
+
+class PeerTransport:
+    """Connection-reusing JSON-over-HTTP client for one remote peer.
+
+    All remote RPCs (heartbeat, has_session, stats, warmup, generate)
+    go through ``rpc_get`` / ``rpc_post`` — the names are deliberately
+    distinctive so the graftlint io-under-lock rule can flag any call
+    made while a hot lock is held.  Retries use the shared
+    ``backoff_delay`` ladder and stop early once the circuit opens
+    (burning the remaining budget against a dead link helps nobody).
+    """
+
+    MAX_POOL = 4
+
+    def __init__(self, url: str, *, peer: int = 0, connect_timeout: float = 1.0,
+                 max_retries: int = 2, retry_base_s: float = 0.05,
+                 circuit: CircuitBreaker | None = None, registry=None):
+        parts = urlsplit(url if "//" in url else "//" + url)
+        if parts.scheme not in ("", "http"):
+            raise ValueError(f"PeerTransport supports http:// urls, got {url!r}")
+        if not parts.hostname:
+            raise ValueError(f"peer url has no host: {url!r}")
+        self.url = url.rstrip("/")
+        self.host = parts.hostname
+        self.port = parts.port or 80
+        self.peer = int(peer)
+        self.connect_timeout = float(connect_timeout)
+        self.max_retries = int(max_retries)
+        self.retry_base_s = float(retry_base_s)
+        self.circuit = circuit if circuit is not None else CircuitBreaker()
+        self._pool: list[http.client.HTTPConnection] = []
+        self._pool_lock = threading.Lock()
+        self.retries_total = 0
+        self._m_rpc = None
+        self._m_retries = None
+        self._m_seconds = None
+        if registry is not None:
+            self._m_rpc = registry.counter(
+                "serve_remote_rpc_total",
+                "remote replica RPC attempts by method and outcome",
+                labelnames=("method", "outcome", "peer"))
+            self._m_retries = registry.counter(
+                "serve_remote_retries_total",
+                "remote RPC wire retries (attempts beyond the first)",
+                labelnames=("peer",))
+            self._m_seconds = registry.histogram(
+                "serve_remote_rpc_seconds",
+                "remote RPC attempt latency (per wire attempt)",
+                labelnames=("method", "peer"))
+
+    # ---- metric helpers -------------------------------------------------
+
+    def _count(self, method: str, outcome: str) -> None:
+        if self._m_rpc is not None:
+            self._m_rpc.labels(method=method, outcome=outcome,
+                               peer=str(self.peer)).inc()
+
+    def _observe(self, method: str, seconds: float) -> None:
+        if self._m_seconds is not None:
+            self._m_seconds.labels(method=method,
+                                   peer=str(self.peer)).observe(seconds)
+
+    # ---- connection pool ------------------------------------------------
+
+    def _checkout(self) -> http.client.HTTPConnection:
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.connect_timeout)
+
+    def _checkin(self, conn: http.client.HTTPConnection) -> None:
+        with self._pool_lock:
+            if len(self._pool) < self.MAX_POOL:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def close(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, []
+        for conn in pool:
+            conn.close()
+
+    # ---- RPC ------------------------------------------------------------
+
+    def rpc_get(self, path: str, *, method: str, timeout: float | None = None,
+                retries: int | None = None, probe: bool = False) -> dict:
+        """Idempotent GET.  Retried up to ``retries`` times (default
+        ``max_retries``) regardless of delivery provenance."""
+        return self._rpc("GET", path, None, method=method, timeout=timeout,
+                         retries=retries, replay_safe=True, probe=probe)
+
+    def rpc_post(self, path: str, body: dict, *, method: str,
+                 timeout: float | None = None, retries: int | None = None,
+                 replay_safe: bool = False, probe: bool = False,
+                 deadline: float | None = None) -> dict:
+        """POST.  Only retried on indeterminate failures when
+        ``replay_safe`` (idempotent endpoint, or the body carries a
+        ``request_id`` the peer deduplicates on); provably-undelivered
+        failures (``executed is False``) are always retry-eligible."""
+        return self._rpc("POST", path, body, method=method, timeout=timeout,
+                         retries=retries, replay_safe=replay_safe,
+                         probe=probe, deadline=deadline)
+
+    def _rpc(self, verb: str, path: str, body: dict | None, *, method: str,
+             timeout: float | None, retries: int | None, replay_safe: bool,
+             probe: bool = False, deadline: float | None = None) -> dict:
+        budget = self.max_retries if retries is None else int(retries)
+        attempt = 0
+        while True:
+            attempt += 1
+            if not probe and not self.circuit.allow():
+                self._count(method, "circuit_open")
+                raise TransportError(
+                    "circuit_open",
+                    f"peer {self.peer} circuit open — routing away",
+                    executed=False, attempts=attempt - 1)
+            t0 = time.perf_counter()
+            try:
+                out = self._attempt(verb, path, body, method, timeout)
+            except PeerHTTPError:
+                # The peer answered: link is fine, the call is not.
+                self.circuit.record_success()
+                self._count(method, "error")
+                self._observe(method, time.perf_counter() - t0)
+                raise
+            except TransportError as err:
+                self.circuit.record_failure()
+                self._count(method, "unreachable")
+                self._observe(method, time.perf_counter() - t0)
+                err.attempts = attempt
+                retryable = err.executed is False or replay_safe
+                if (not retryable or attempt > budget
+                        or (not probe and self.circuit.is_open)):
+                    raise
+                delay = backoff_delay(self.retry_base_s, attempt)
+                # ``deadline`` shares the request clock (perf_counter —
+                # ``Request.deadline`` is stamped from it at submit).
+                if deadline is not None and \
+                        time.perf_counter() + delay >= deadline:
+                    raise
+                self.retries_total += 1
+                if self._m_retries is not None:
+                    self._m_retries.labels(peer=str(self.peer)).inc()
+                if delay > 0:
+                    time.sleep(delay)
+                continue
+            else:
+                self.circuit.record_success()
+                self._count(method, "ok")
+                self._observe(method, time.perf_counter() - t0)
+                return out
+
+    def _attempt(self, verb: str, path: str, body: dict | None,
+                 method: str, timeout: float | None) -> dict:
+        action = faults.serve_net_hook(self.peer, method)
+        drop_response = False
+        if action is not None:
+            kind = action[0]
+            if kind == "latency":
+                time.sleep(action[1] / 1000.0)
+            elif kind == "blackhole":
+                # SYN-drop semantics: the connect phase times out, the
+                # request bytes never reach a listener.
+                time.sleep(self.connect_timeout)
+                raise TransportError(
+                    "connect_timeout",
+                    f"peer {self.peer} blackholed (injected)",
+                    executed=False)
+            elif kind == "fail":
+                raise TransportError(
+                    "reset", f"peer {self.peer} link flap (injected)",
+                    executed=False)
+            elif kind == "drop":
+                drop_response = True
+        conn = self._checkout()
+        phase = "connect"
+        try:
+            if conn.sock is None:
+                conn.timeout = self.connect_timeout
+                conn.connect()
+            conn.sock.settimeout(timeout)
+            phase = "exchange"
+            payload = None
+            headers = {}
+            if body is not None:
+                payload = json.dumps(body).encode("utf-8")
+                headers["Content-Type"] = "application/json"
+            conn.request(verb, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            status = resp.status
+            reuse = not resp.will_close
+        except ConnectionRefusedError as err:
+            conn.close()
+            raise TransportError(
+                "refused", f"peer {self.peer} refused connection: {err}",
+                executed=False) from err
+        except (socket.timeout, TimeoutError) as err:
+            conn.close()
+            if phase == "connect":
+                raise TransportError(
+                    "connect_timeout",
+                    f"peer {self.peer} connect timed out", executed=False,
+                ) from err
+            # The request may have been sent and executed — only a
+            # request_id replay can safely retry this.
+            raise TransportError(
+                "timeout", f"peer {self.peer} RPC timed out mid-exchange",
+                executed=None) from err
+        except (OSError, http.client.HTTPException) as err:
+            conn.close()
+            executed = False if phase == "connect" else None
+            raise TransportError(
+                "reset", f"peer {self.peer} connection error: {err}",
+                executed=executed) from err
+        if reuse:
+            self._checkin(conn)
+        else:
+            conn.close()
+        if drop_response:
+            # net_drop: the call executed on the wire; the client loses
+            # the response — indeterminate, exercises the replay path.
+            raise TransportError(
+                "response_dropped",
+                f"peer {self.peer} response dropped (injected)",
+                executed=None)
+        try:
+            decoded = json.loads(data.decode("utf-8")) if data else {}
+        except ValueError as err:
+            if status < 400:
+                raise TransportError(
+                    "protocol",
+                    f"peer {self.peer} sent unparseable JSON", executed=None,
+                ) from err
+            decoded = {}
+        if status >= 400:
+            raise PeerHTTPError(status, decoded)
+        return decoded
+
+
+class SettledCache:
+    """Peer-side settled-result cache keyed by client-minted request_id.
+
+    ``begin(rid)`` returns ``("mine", None)`` for the first delivery
+    (the caller must later ``settle`` or ``abandon``), ``("hit",
+    (status, payload))`` for a replay of an already-settled request, and
+    ``("timeout", None)`` if a concurrent first delivery is still
+    executing past ``wait_timeout``.  Only terminal outcomes worth
+    replaying are settled (HTTP 200 and 504 deadline_exceeded — both
+    mean tokens were decoded); transient errors are abandoned so the
+    retry re-executes.  Bounded LRU + TTL; in-flight entries are never
+    evicted.
+    """
+
+    def __init__(self, *, max_entries: int = 1024, ttl_s: float = 600.0,
+                 registry=None):
+        self.max_entries = int(max_entries)
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._settled: OrderedDict[str, tuple[int, dict, float]] = \
+            OrderedDict()
+        self._inflight: dict[str, threading.Event] = {}
+        self.hits = 0
+        self.waits = 0
+        self.stores = 0
+        self._m_dedup = None
+        if registry is not None:
+            self._m_dedup = registry.counter(
+                "serve_replay_dedup_total",
+                "generate replay dedup events by result",
+                labelnames=("result",))
+
+    def _count(self, result: str) -> None:
+        if self._m_dedup is not None:
+            self._m_dedup.labels(result=result).inc()
+
+    def begin(self, rid: str, wait_timeout: float | None = None):
+        waited = False
+        while True:
+            with self._lock:
+                entry = self._settled.get(rid)
+                if entry is not None:
+                    self._settled.move_to_end(rid)
+                    self.hits += 1
+                    hit = (entry[0], entry[1])
+                else:
+                    event = self._inflight.get(rid)
+                    if event is None:
+                        self._inflight[rid] = threading.Event()
+                        return ("mine", None)
+                    hit = None
+            if hit is not None:
+                self._count("hit")
+                return ("hit", hit)
+            if not waited:
+                waited = True
+                self.waits += 1
+                self._count("wait")
+            if not event.wait(wait_timeout):
+                return ("timeout", None)
+            # Either settled (replay it) or abandoned (becomes ours).
+
+    def settle(self, rid: str, status: int, payload: dict) -> None:
+        now = time.monotonic()
+        with self._lock:
+            event = self._inflight.pop(rid, None)
+            self._settled[rid] = (int(status), payload, now)
+            self._settled.move_to_end(rid)
+            self.stores += 1
+            while len(self._settled) > self.max_entries:
+                self._settled.popitem(last=False)
+            cutoff = now - self.ttl_s
+            stale = [k for k, (_, _, t) in self._settled.items()
+                     if t < cutoff]
+            for k in stale:
+                del self._settled[k]
+        self._count("store")
+        if event is not None:
+            event.set()
+
+    def abandon(self, rid: str) -> None:
+        with self._lock:
+            event = self._inflight.pop(rid, None)
+        if event is not None:
+            event.set()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"settled": len(self._settled),
+                    "inflight": len(self._inflight),
+                    "hits": self.hits, "waits": self.waits,
+                    "stores": self.stores}

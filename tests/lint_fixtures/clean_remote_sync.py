@@ -1,0 +1,36 @@
+"""graftlint fixture: clean twin of viol_remote_sync — the heartbeat
+poller does the HTTP GET on its own thread OUTSIDE the lock and
+publishes an in-memory residency snapshot; the affinity probe answers
+from that snapshot under the lock with zero network."""
+
+import json
+import threading
+import urllib.request
+
+
+class PeerTransport:
+    def __init__(self, url):
+        self.url = url
+
+    def rpc_get(self, path):
+        with urllib.request.urlopen(self.url + path, timeout=5.0) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+
+class Router:
+    def __init__(self, transport: PeerTransport):
+        self.transport = transport
+        self._lock = threading.Lock()
+        self._residency = frozenset()
+
+    def poll(self):
+        # network outside any lock hold (the heartbeat poller thread)
+        hb = self.transport.rpc_get("/replica/heartbeat")
+        ids = frozenset(hb.get("session_ids", ()))
+        with self._lock:
+            self._residency = ids
+
+    def has_session(self, sid):
+        with self._lock:
+            # pure in-memory membership — never blocks on a peer
+            return sid in self._residency

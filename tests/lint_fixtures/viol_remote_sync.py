@@ -1,0 +1,30 @@
+"""graftlint fixture: io-under-lock true positive for the network
+shapes — the remote affinity probe does a bounded HTTP GET under the
+router's global admission lock (ISSUE 17: one slow peer stalled every
+admission, health probe and scheduler iteration behind the network)."""
+
+import json
+import threading
+import urllib.request
+
+
+class PeerTransport:
+    def __init__(self, url):
+        self.url = url
+
+    def rpc_get(self, path):
+        with urllib.request.urlopen(self.url + path, timeout=5.0) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+
+class Router:
+    def __init__(self, transport: PeerTransport):
+        self.transport = transport
+        self._lock = threading.Lock()
+
+    def has_session(self, sid):
+        with self._lock:
+            # blocking HTTP round-trip under the global admission lock:
+            # every submit()/drain() queues behind one peer's latency
+            hb = self.transport.rpc_get("/replica/heartbeat")
+            return sid in hb.get("session_ids", ())

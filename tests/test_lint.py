@@ -57,6 +57,7 @@ VIOLATIONS = {
     "viol_rollout": "thread-lifecycle",
     "viol_rollout_warmup": "warmup-coverage",
     "viol_io_lock": "io-under-lock",
+    "viol_remote_sync": "io-under-lock",
     "viol_toctou": "toctou-fs",
     "viol_swallowed": "swallowed-exception",
 }
@@ -85,6 +86,7 @@ CLEAN_TWINS = {
     "clean_rollout": "thread-lifecycle",
     "clean_rollout_warmup": "warmup-coverage",
     "clean_io_lock": "io-under-lock",
+    "clean_remote_sync": "io-under-lock",
     "clean_toctou": "toctou-fs",
     "clean_swallowed": "swallowed-exception",
 }

@@ -1,0 +1,369 @@
+"""Network-partition tolerance for the remote-replica plane (ISSUE 17):
+the shared retrying transport (serve/transport.py), per-peer circuit
+breaker with flap damping and rejoin hysteresis, the peer-side settled
+cache that makes the generate POST exactly-once over at-least-once
+delivery, and the four injectable network fault kinds.
+
+Pins: circuit state-machine transitions (alternating ok/fail below the
+threshold NEVER opens — the flap-damping property; rejoin needs
+consecutive probe successes); an alternating lossy heartbeat link never
+retires the poller (retirement is refused-only); a duplicate generate
+POST with the same request_id decodes exactly once (tokens identical,
+``replayed`` marked, dedup hit counted); transport retries ride
+``backoff_delay`` and a drop-then-replay round trip survives end to
+end; circuit-open fail-fast never waits out the rpc timeout;
+``generate_timeout_s`` validation (negative rejected, 0 = unbounded);
+and the ``net_*`` fault grammar + hook semantics."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from lstm_tensorspark_tpu.models import LMConfig, init_lm
+from lstm_tensorspark_tpu.obs import MetricsRegistry
+from lstm_tensorspark_tpu.resilience import faults
+from lstm_tensorspark_tpu.serve import RemoteReplica, ServeEngine, ServeServer
+from lstm_tensorspark_tpu.serve.remote import RemoteBatcher
+from lstm_tensorspark_tpu.serve.server import make_http_server
+from lstm_tensorspark_tpu.serve.transport import (
+    CircuitBreaker,
+    PeerHTTPError,
+    PeerTransport,
+    SettledCache,
+    TransportError,
+)
+
+_CFG = LMConfig(vocab_size=31, hidden_size=16, num_layers=1)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_lm(jax.random.PRNGKey(5), _CFG)
+
+
+@pytest.fixture(scope="module")
+def peer(params):
+    """One live in-process peer serve host behind its HTTP endpoint,
+    shared by the wire-level tests (each arms its own fault plane and
+    disarms in finally)."""
+    eng = ServeEngine(params, _CFG, rng_seed=0, num_slots=8,
+                      prefill_buckets=(4, 8), batch_buckets=(1, 2, 4),
+                      registry=MetricsRegistry())
+    srv = ServeServer(eng, max_active=4, queue_size=16, window_ladder=(1, 4))
+    httpd = make_http_server(srv, "127.0.0.1", 0)
+    host, port = httpd.server_address[:2]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    try:
+        with srv:
+            srv.warmup(prompt_lens=(8,))
+            thread.start()
+            yield srv, f"http://{host}:{port}"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def _post_generate(url, body, timeout=30.0):
+    req = urllib.request.Request(
+        url + "/v1/generate", data=json.dumps(body).encode("utf-8"),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode("utf-8") or "{}")
+
+
+# ---- circuit breaker state machine --------------------------------------
+
+
+def test_circuit_opens_after_consecutive_failures_only():
+    cb = CircuitBreaker(open_after=3, rejoin_after=2)
+    assert cb.state() == "closed" and cb.allow()
+    cb.record_failure()
+    cb.record_failure()
+    assert cb.state() == "closed"          # below threshold
+    cb.record_failure()
+    assert cb.state() == "open" and not cb.allow()
+    assert cb.opened_total == 1
+
+
+def test_circuit_flap_damping_alternation_never_opens():
+    """THE damping property: in the closed regime one success fully
+    resets the failure streak, so an alternating lossy link can flap
+    forever without opening the circuit."""
+    cb = CircuitBreaker(open_after=2, rejoin_after=2)
+    for _ in range(50):
+        cb.record_failure()
+        cb.record_success()
+    assert cb.state() == "closed" and cb.opened_total == 0
+
+
+def test_circuit_rejoin_needs_consecutive_successes():
+    """One lucky probe through a flapping link must NOT rejoin: open →
+    success moves to half_open; a failure resets; only rejoin_after
+    consecutive successes close."""
+    cb = CircuitBreaker(open_after=2, rejoin_after=2)
+    cb.record_failure()
+    cb.record_failure()
+    assert cb.state() == "open"
+    cb.record_success()
+    assert cb.state() == "half_open" and not cb.allow()
+    cb.record_failure()                     # flap mid-heal: back to open
+    assert cb.state() == "open"
+    cb.record_success()
+    cb.record_success()
+    assert cb.state() == "closed" and cb.allow()
+    assert cb.closed_total == 1
+
+
+def test_circuit_suspect_is_the_milder_damping_threshold():
+    cb = CircuitBreaker(open_after=3, rejoin_after=2)
+    assert not cb.suspect(2)
+    cb.record_failure()
+    cb.record_failure()
+    assert cb.suspect(2)                    # damped before fully open
+    assert cb.state() == "closed"
+    cb.record_success()
+    assert not cb.suspect(2)                # success resets the streak
+
+
+def test_circuit_threshold_validation():
+    with pytest.raises(ValueError):
+        CircuitBreaker(open_after=0)
+    with pytest.raises(ValueError):
+        CircuitBreaker(rejoin_after=0)
+
+
+# ---- settled cache (peer-side replay dedup) -----------------------------
+
+
+def test_settled_cache_replay_hit_and_abandon():
+    c = SettledCache()
+    state, _ = c.begin("r1")
+    assert state == "mine"
+    c.settle("r1", 200, {"tokens": [1, 2]})
+    state, hit = c.begin("r1")
+    assert state == "hit" and hit == (200, {"tokens": [1, 2]})
+    # abandoned ids re-execute: the next begin owns it again
+    state, _ = c.begin("r2")
+    assert state == "mine"
+    c.abandon("r2")
+    state, _ = c.begin("r2")
+    assert state == "mine"
+    assert c.stats()["hits"] == 1 and c.stats()["stores"] == 1
+
+
+def test_settled_cache_concurrent_delivery_waits_for_the_first():
+    c = SettledCache()
+    assert c.begin("dup")[0] == "mine"
+    got = {}
+
+    def second_delivery():
+        got["out"] = c.begin("dup", wait_timeout=5.0)
+
+    t = threading.Thread(target=second_delivery)
+    t.start()
+    time.sleep(0.05)
+    c.settle("dup", 200, {"tokens": [7]})
+    t.join(timeout=5.0)
+    assert got["out"] == ("hit", (200, {"tokens": [7]}))
+    assert c.stats()["waits"] == 1
+
+
+def test_settled_cache_lru_bound():
+    c = SettledCache(max_entries=2)
+    for i in range(4):
+        rid = f"r{i}"
+        c.begin(rid)
+        c.settle(rid, 200, {"i": i})
+    assert c.stats()["settled"] == 2
+    assert c.begin("r0")[0] == "mine"       # evicted → re-executes
+    assert c.begin("r3")[0] == "hit"        # newest survives
+
+
+# ---- generate_timeout_s validation (satellite: the magic 120.0) ---------
+
+
+def test_generate_timeout_validation():
+    with pytest.raises(ValueError):
+        RemoteBatcher("http://127.0.0.1:1", generate_timeout_s=-1.0)
+    with pytest.raises(ValueError):
+        RemoteReplica(1, "http://127.0.0.1:1", generate_timeout_s=-0.5)
+    # 0 is the CLI convention for "no client-side bound"
+    assert RemoteBatcher("http://127.0.0.1:1",
+                         generate_timeout_s=0).generate_timeout_s is None
+    assert RemoteBatcher("http://127.0.0.1:1",
+                         generate_timeout_s=45.0).generate_timeout_s == 45.0
+
+
+def test_transport_rejects_non_http_urls():
+    with pytest.raises(ValueError):
+        PeerTransport("https://example.com")
+
+
+# ---- net fault grammar + hook semantics ---------------------------------
+
+
+def test_net_fault_grammar():
+    p = faults.FaultPlane("net_blackhole@1")
+    assert p.net_blackhole == {1: None}     # until disarm (the heal)
+    p = faults.FaultPlane("net_blackhole@1x2;net_flap@0x5;"
+                          "net_latency@2x50;net_drop@3")
+    assert p.net_blackhole == {1: 2}
+    assert p.net_flap == {0: 5}
+    assert p.net_latency_calls == {2: 50}
+    assert p.net_drop_calls == {3}
+    with pytest.raises(ValueError):
+        faults.FaultPlane("net_drop@1x2")   # drop takes no xK window
+
+
+def test_net_hook_blackhole_is_peer_scoped():
+    p = faults.FaultPlane("net_blackhole@1")
+    assert p.serve_net_hook(1, "heartbeat") == ("blackhole",)
+    assert p.serve_net_hook(1, "generate") == ("blackhole",)
+    assert p.serve_net_hook(0, "heartbeat") is None
+
+
+def test_net_hook_flap_alternates_per_peer():
+    p = faults.FaultPlane("net_flap@2x30")
+    assert p.serve_net_hook(2, "heartbeat") == ("fail",)
+    assert p.serve_net_hook(2, "heartbeat") is None
+    assert p.serve_net_hook(2, "heartbeat") == ("fail",)
+    assert p.serve_net_hook(1, "heartbeat") is None
+
+
+def test_net_hook_latency_and_drop_count_generate_calls_only():
+    p = faults.FaultPlane("net_latency@1x50;net_drop@2")
+    # heartbeats never consume the generate-call counter
+    assert p.serve_net_hook(0, "heartbeat") is None
+    assert p.serve_net_hook(0, "generate") == ("latency", 50)
+    assert p.serve_net_hook(0, "generate") == ("drop",)
+    assert p.serve_net_hook(0, "generate") is None
+
+
+# ---- wire-level: retries, fail-fast, flap damping, replay dedup ---------
+
+
+def test_transport_retries_through_a_flapping_link(peer):
+    _, url = peer
+    transport = PeerTransport(url, peer=3, max_retries=2,
+                              retry_base_s=0.01)
+    faults.arm("net_flap@3x30")
+    try:
+        hb = transport.rpc_get("/replica/heartbeat", method="heartbeat")
+        assert hb.get("status") in ("ok", "down")
+        assert transport.retries_total == 1   # fail, backoff, ok
+        assert transport.circuit.state() == "closed"
+    finally:
+        faults.disarm()
+        transport.close()
+
+
+def test_circuit_open_fails_fast_without_waiting_out_timeouts():
+    faults.arm("net_blackhole@5")
+    transport = PeerTransport("http://127.0.0.1:1", peer=5,
+                              connect_timeout=0.2, max_retries=0,
+                              circuit=CircuitBreaker(open_after=2))
+    try:
+        for _ in range(2):
+            with pytest.raises(TransportError) as ei:
+                transport.rpc_get("/replica/heartbeat", method="heartbeat",
+                                  timeout=1.0, probe=True)
+            assert ei.value.kind == "connect_timeout"
+            assert ei.value.executed is False
+        assert transport.circuit.is_open
+        t0 = time.perf_counter()
+        with pytest.raises(TransportError) as ei:
+            transport.rpc_get("/replica/heartbeat", method="heartbeat",
+                              timeout=1.0)
+        assert ei.value.kind == "circuit_open"
+        assert ei.value.executed is False     # never delivered: reroutable
+        assert time.perf_counter() - t0 < 0.15, \
+            "circuit-open must fail fast, not wait out a timeout"
+    finally:
+        faults.disarm()
+        transport.close()
+
+
+def test_flapping_heartbeat_below_threshold_never_retires(peer):
+    """Satellite (c): an alternating ok/fail heartbeat link keeps the
+    poller alive (retirement is refused-only), never opens the circuit
+    (one success resets the streak), and heals cleanly after the flap
+    window — the peer rejoins with NO restart of anything."""
+    _, url = peer
+    shim = RemoteBatcher(url, replica=1, poll_interval=0.05,
+                         rpc_timeout=2.0)
+    stop = threading.Event()
+    poller = threading.Thread(target=shim.run, args=(stop,), daemon=True)
+    faults.arm("net_flap@1x1")
+    try:
+        poller.start()
+        time.sleep(1.2)                      # ride out the 1s flap window
+        assert poller.is_alive(), \
+            "flap failures must never retire the poller (refused-only)"
+        assert shim.circuit.opened_total == 0
+        assert shim.circuit.state() == "closed"
+        assert not shim.suspect()
+        # healed: heartbeats land again and the residency view is fresh
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            age = shim.heartbeat_age()
+            if age is not None and age <= 3 * shim.poll_interval:
+                break
+            time.sleep(0.05)
+        assert shim.heartbeat_age() is not None
+        assert shim.heartbeat_age() <= 3 * shim.poll_interval
+    finally:
+        faults.disarm()
+        stop.set()
+        poller.join(timeout=5.0)
+
+
+def test_duplicate_generate_post_decodes_exactly_once(peer):
+    """Satellite (c): two deliveries of the same request_id produce ONE
+    decode — identical tokens, the second marked ``replayed``, the
+    settled-cache hit counted, and the peer's completed counter moves by
+    exactly one."""
+    srv, url = peer
+    before = srv.stats()["batcher"]["completed"]
+    hits_before = srv.settled.stats()["hits"]
+    body = {"prompt": [1, 2, 3], "max_new_tokens": 4, "greedy": True,
+            "timeout": 30.0, "request_id": "dup-once-1"}
+    s1, r1 = _post_generate(url, body)
+    s2, r2 = _post_generate(url, body)
+    assert s1 == 200 and s2 == 200
+    assert r1["tokens"] == r2["tokens"] and len(r1["tokens"]) == 4
+    assert "replayed" not in r1 and r2["replayed"] is True
+    assert srv.stats()["batcher"]["completed"] == before + 1
+    assert srv.settled.stats()["hits"] == hits_before + 1
+
+
+def test_dropped_response_replays_instead_of_double_decoding(peer):
+    """End-to-end exactly-once over at-least-once delivery: net_drop
+    loses the first response client-side (indeterminate), the transport
+    retries under the request_id, and the peer serves the settled reply
+    — one decode, one retry, tokens delivered."""
+    srv, url = peer
+    before = srv.stats()["batcher"]["completed"]
+    transport = PeerTransport(url, peer=7, max_retries=2,
+                              retry_base_s=0.01)
+    faults.arm("net_drop@1")
+    try:
+        reply = transport.rpc_post(
+            "/v1/generate",
+            {"prompt": [2, 4], "max_new_tokens": 3, "greedy": True,
+             "timeout": 30.0, "request_id": "drop-replay-1"},
+            method="generate", timeout=30.0, replay_safe=True)
+        assert len(reply["tokens"]) == 3
+        assert reply.get("replayed") is True
+        assert transport.retries_total == 1
+        assert srv.stats()["batcher"]["completed"] == before + 1
+    finally:
+        faults.disarm()
+        transport.close()

@@ -30,6 +30,18 @@ Four phases, each building a fresh in-process stack from one fixed seed:
    shared ``--session-dir`` disk tier must hand every kept session to
    the surviving local replica, token-identical to an uninterrupted
    run — PR 7's replica-death invariant generalized to a dead HOST.
+6. **partition/heal** (``net_blackhole`` + ``net_drop``, ISSUE 17) — a
+   remote replica host is BLACKHOLED (alive, unreachable)
+   mid-conversation: the per-peer circuit must open within a few failed
+   probes, continuations must route around it fast (never waiting out
+   the generate timeout, zero kept sessions lost via the shared
+   ``--session-dir``), a burst must shed with honest ``Retry-After``;
+   on heal the peer must REJOIN without restart (probe hysteresis
+   closes the circuit, fresh traffic routes there again) and the full
+   conversation stays token-identical. A dropped-response generate then
+   proves exactly-once: the transport retries under the request_id and
+   the peer replays its settled reply — ZERO duplicate decodes
+   (``--json-partition`` → BENCH_serve_r09.json).
 
 Wired into tools/verify.sh after the serve smoke (sequenced, never
 concurrent with the timed suite). Exit 0 on PASS, 1 on any violated
@@ -39,7 +51,7 @@ it printed (see docs/OPERATIONS.md "Chaos drill failed").
 Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_serve.py [--json OUT] \
-        [--slo-ms 1000] [--seed 0]
+        [--json-partition OUT2] [--slo-ms 1000] [--seed 0]
 """
 
 from __future__ import annotations
@@ -51,7 +63,9 @@ import os
 import shutil
 import sys
 import tempfile
+import threading
 import time
+import urllib.request
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(_HERE)
@@ -457,6 +471,285 @@ def _phase_host_death(params, seed, failures):
     return res
 
 
+# ---- phase 6: partition / heal (blackholed remote host, ISSUE 17) -------
+
+
+def _peer_heartbeat(base: str) -> dict:
+    with urllib.request.urlopen(base + "/replica/heartbeat",
+                                timeout=10.0) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _peer_metric(base: str, token: str) -> float:
+    """Scrape one sample from the peer's /metrics exposition."""
+    with urllib.request.urlopen(base + "/metrics", timeout=10.0) as resp:
+        text = resp.read().decode("utf-8")
+    for line in text.splitlines():
+        if line.startswith(token):
+            return float(line.rsplit(None, 1)[-1])
+    return 0.0
+
+
+def _await_flushed(work, sids, t_turn_wall, timeout=30.0) -> bool:
+    """Every kept session's checkpoint at/after the turn AND quiescent
+    for 1 s (same durability boundary the host-death phase awaits)."""
+
+    def flushed():
+        mtimes = []
+        for sid in sids:
+            p = _session_file(work, sid)
+            if not os.path.exists(p):
+                return False
+            mtimes.append(os.path.getmtime(p))
+        return (min(mtimes) >= t_turn_wall
+                and time.time()  # graftlint: disable=wallclock-timing
+                - max(mtimes) > 1.0)
+
+    deadline = time.monotonic() + timeout
+    while not flushed() and time.monotonic() < deadline:
+        time.sleep(0.1)
+    return flushed()
+
+
+def _phase_partition(params, seed, failures):
+    """Blackhole a live remote host mid-conversation, prove the circuit
+    opens and the router routes around it (fast, honestly, losing
+    nothing), heal, prove it rejoins WITHOUT restart, then prove the
+    request_id replay path decodes a dropped-response generate exactly
+    once."""
+    work = tempfile.mkdtemp(prefix="chaos_serve_partition_")
+    n_sessions = 4
+    res = {"sessions": n_sessions,
+           "fault_spec": f"net_blackhole@1 then net_drop@1;seed@{seed}"}
+    proc = None
+    try:
+        proc, base = _boot_remote_host(work)
+        res["remote_url"] = base
+        reg = MetricsRegistry()
+        eng = ServeEngine(params, _CFG, num_slots=8,
+                          prefill_buckets=(4, 8), batch_buckets=(1, 2),
+                          rng_seed=0, registry=reg, session_dir=work,
+                          replica=0)
+        srv = ServeServer(eng, max_active=4, queue_size=16,
+                          window_ladder=(1,), remote_replicas=(base,),
+                          remote_poll_interval_s=0.1,
+                          remote_rpc_timeout_s=1.0,
+                          remote_timeout_s=30.0)
+        with srv:
+            shim = srv.replicas[1].batcher
+            sids, toks, homes = [], [], []
+            for i in range(n_sessions):
+                sid, t, home = _create_kept(srv, i)
+                sids.append(sid)
+                toks.append(t)
+                homes.append(home)
+            res["remote_sessions"] = sum(1 for h in homes if h == 1)
+            if res["remote_sessions"] < 1:
+                failures.append(
+                    "partition: no kept session landed on the remote "
+                    f"replica (homes {homes}) — the blackhole would "
+                    "test nothing")
+                return res
+            # wall clock on purpose: compared against file MTIMES (the
+            # checkpoint-flushed probe) — monotonic has no epoch
+            t_turn_wall = time.time()  # graftlint: disable=wallclock-timing
+            for i, sid in enumerate(sids):  # one pre-partition turn
+                toks[i].extend(_continue_kept(srv, sid, toks[i][-1]))
+            res["checkpoints_flushed"] = _await_flushed(
+                work, sids, t_turn_wall)
+            if not res["checkpoints_flushed"]:
+                failures.append(
+                    "partition: write-behind session checkpoints never "
+                    "landed on the shared --session-dir")
+                return res
+            routed_before = srv.router.stats()["routed"].get("1", 0)
+            # ---- partition: blackhole the peer (until the heal) -------
+            t_cut = time.monotonic()
+            faults.arm(f"net_blackhole@1;seed@{seed}")
+            deadline = time.monotonic() + 25
+            while (shim.circuit.state() != "open"
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            res["seconds_to_open"] = round(time.monotonic() - t_cut, 2)
+            res["circuit_opened"] = shim.circuit.state() == "open"
+            if not res["circuit_opened"]:
+                failures.append(
+                    f"partition: the circuit never opened within "
+                    f"{res['seconds_to_open']}s of the blackhole "
+                    f"(open_after={shim.circuit.open_after} failed "
+                    "probes expected)")
+                return res
+            # the partition is a route-around state, never a death:
+            if not srv.replicas[1].thread.is_alive():
+                failures.append(
+                    "partition: the heartbeat poller exited on "
+                    "partition-shaped failures (retirement must be "
+                    "refused-only)")
+            # continuations during the partition: every kept session —
+            # including the peer's — must complete on the local replica
+            # from the shared disk tier, fast (nobody waits out the 30s
+            # generate timeout or queues behind the blackhole)
+            lost = 0
+            slow = 0.0
+            for i, sid in enumerate(sids):
+                t0 = time.monotonic()
+                try:
+                    toks[i].extend(_continue_kept(srv, sid, toks[i][-1]))
+                except Exception as e:
+                    lost += 1
+                    failures.append(
+                        f"partition: kept session {sid!r} lost during "
+                        f"the partition: {type(e).__name__}: {e}")
+                slow = max(slow, time.monotonic() - t0)
+            res["lost_sessions"] = lost
+            res["partition_continue_max_s"] = round(slow, 2)
+            if slow >= 10.0:
+                failures.append(
+                    f"partition: a continuation took {slow:.1f}s during "
+                    "the partition — routing around an open circuit "
+                    "must not wait on the dead link")
+            routed_mid = srv.router.stats()["routed"].get("1", 0)
+            res["routed_remote_during_partition"] = (
+                routed_mid - routed_before)
+            if res["routed_remote_during_partition"] > 0:
+                failures.append(
+                    "partition: the router sent requests to the "
+                    "blackholed peer while its circuit was open")
+            # burst shed during the partition: capacity honestly halved,
+            # overload answered with 429 + measured Retry-After
+            shed_retry_after = []
+            done = []
+
+            def _burst_one(k):
+                try:
+                    srv.generate([k + 2, 5, 3], max_new_tokens=8,
+                                 klass="best_effort", timeout=30.0)
+                    done.append(k)
+                except Exception as e:
+                    ra = getattr(e, "retry_after_s", None)
+                    if ra is not None:
+                        shed_retry_after.append(float(ra))
+
+            threads = [threading.Thread(target=_burst_one, args=(k,))
+                       for k in range(32)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60.0)
+            res["burst_completed"] = len(done)
+            res["burst_shed"] = len(shed_retry_after)
+            res["burst_retry_after_s_max"] = (
+                round(max(shed_retry_after), 3) if shed_retry_after
+                else None)
+            if not shed_retry_after:
+                failures.append(
+                    "partition: a 32-request burst against the halved "
+                    "fleet shed nothing — the admission bound must "
+                    "exclude the partitioned peer's queue")
+            elif min(shed_retry_after) <= 0:
+                failures.append(
+                    "partition: a shed carried a non-positive "
+                    "Retry-After — the drain estimate must stay honest")
+            # ---- heal: probes close the circuit, the peer rejoins -----
+            t_heal = time.monotonic()
+            faults.disarm()
+            deadline = time.monotonic() + 20
+            while (shim.circuit.state() != "closed"
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            res["seconds_to_close"] = round(time.monotonic() - t_heal, 2)
+            res["circuit_closed"] = shim.circuit.state() == "closed"
+            res["circuit_opened_total"] = shim.circuit.opened_total
+            res["circuit_closed_total"] = shim.circuit.closed_total
+            res["rejoined_without_restart"] = (
+                res["circuit_closed"] and proc.poll() is None)
+            if not res["rejoined_without_restart"]:
+                failures.append(
+                    "partition: the peer never rejoined after the heal "
+                    f"(circuit {shim.circuit.state()!r}, process "
+                    f"{'alive' if proc.poll() is None else 'dead'}) — "
+                    "rejoin must need no restart")
+                return res
+            # fresh traffic routes to the healed peer again
+            res["fresh_routed_to_peer"] = False
+            for k in range(20):
+                r = srv.generate([k + 3, 7, 3], max_new_tokens=2)
+                if r.replica == 1:
+                    res["fresh_routed_to_peer"] = True
+                    break
+            if not res["fresh_routed_to_peer"]:
+                failures.append(
+                    "partition: no fresh session routed to the healed "
+                    "peer — rejoin is incomplete")
+            for i, sid in enumerate(sids):  # post-heal turn
+                toks[i].extend(_continue_kept(srv, sid, toks[i][-1]))
+            # ---- exactly-once: drop a generate response, replay it ----
+            hb0 = _peer_heartbeat(base)
+            completed0 = int(hb0["batcher"]["completed"])
+            hits0 = _peer_metric(
+                base, 'serve_replay_dedup_total{result="hit"}')
+            retries0 = shim.stats()["rpc_retries"]
+            faults.arm(f"net_drop@1;seed@{seed}")
+            try:
+                dropped = None
+                for k in range(12):
+                    r = srv.generate([k + 4, 6, 3], max_new_tokens=3)
+                    if r.replica == 1:
+                        dropped = r
+                        break
+                if dropped is None:
+                    failures.append(
+                        "partition: no generate routed to the peer for "
+                        "the drop — dedup untested")
+                    return res
+            finally:
+                faults.disarm()
+            retries = shim.stats()["rpc_retries"] - retries0
+            hb1 = _peer_heartbeat(base)
+            completed1 = int(hb1["batcher"]["completed"])
+            hits1 = _peer_metric(
+                base, 'serve_replay_dedup_total{result="hit"}')
+            res["dedup"] = {
+                "tokens_delivered": len(dropped.tokens),
+                "transport_retries": retries,
+                "peer_completed_delta": completed1 - completed0,
+                "replay_hits": hits1 - hits0,
+                "duplicate_decodes": max(0, completed1 - completed0 - 1),
+            }
+            if len(dropped.tokens) != 3:
+                failures.append(
+                    "partition: the dropped-then-replayed generate "
+                    f"delivered {len(dropped.tokens)} tokens, wanted 3")
+            if retries < 1:
+                failures.append(
+                    "partition: the transport never retried the "
+                    "dropped response — the replay path is untested")
+            if res["dedup"]["duplicate_decodes"] != 0:
+                failures.append(
+                    f"partition: the peer decoded the same request_id "
+                    f"{completed1 - completed0} times — replay dedup "
+                    "must make delivery exactly-once")
+            if hits1 - hits0 < 1:
+                failures.append(
+                    "partition: the peer's settled cache counted no "
+                    "replay hit for the retried request_id")
+        ref = _reference_tokens(params, n_sessions, turns=3)
+        res["token_identical"] = toks == ref
+        if toks != ref:
+            failures.append(
+                "partition: continuations diverged from the "
+                "uninterrupted run across partition + heal")
+    except Exception as e:
+        failures.append(f"partition: drill error: {type(e).__name__}: {e}")
+    finally:
+        faults.disarm()
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        shutil.rmtree(work, ignore_errors=True)
+    return res
+
+
 # ---- phase 4: burst shed (SLO-aware vs indiscriminate FIFO) -------------
 
 
@@ -533,6 +826,10 @@ def main(argv=None) -> int:
     ap.add_argument("--json", type=str, default=None,
                     help="write the machine-readable drill report here "
                          "(BENCH_serve_r04.json in CI)")
+    ap.add_argument("--json-partition", type=str, default=None,
+                    help="write the partition/heal phase's zero-lost / "
+                         "zero-duplicate / routed-around accounting here "
+                         "(BENCH_serve_r09.json in CI)")
     ap.add_argument("--slo-ms", type=float, default=1000.0,
                     help="priority-class p99 TTFT SLO under the 4x burst "
                          "(CPU-noise-tolerant default)")
@@ -553,6 +850,7 @@ def main(argv=None) -> int:
     summary["burst_shed"] = _phase_burst_shed(params, args.seed,
                                               args.slo_ms, failures)
     summary["host_death"] = _phase_host_death(params, args.seed, failures)
+    summary["partition"] = _phase_partition(params, args.seed, failures)
     summary["wall_s"] = round(time.monotonic() - t_start, 1)
     summary["result"] = "PASS" if not failures else "FAIL"
     summary["failures"] = failures
@@ -562,6 +860,15 @@ def main(argv=None) -> int:
             json.dump(summary, f, indent=1, sort_keys=True)
         print(f"chaos_serve: report written to {args.json}",
               file=sys.stderr)
+    if args.json_partition:
+        part = dict(summary["partition"])
+        part["note"] = "chaos_serve partition/heal (ISSUE 17)"
+        part["result"] = ("PASS" if not any(
+            f.startswith("partition:") for f in failures) else "FAIL")
+        with open(args.json_partition, "w") as f:
+            json.dump(part, f, indent=1, sort_keys=True)
+        print("chaos_serve: partition report written to "
+              f"{args.json_partition}", file=sys.stderr)
     print(f"chaos_serve: {summary['result']} in {summary['wall_s']}s"
           + (f" — {len(failures)} violated invariant(s)" if failures
              else ""),

@@ -14,8 +14,12 @@ health probe and scheduler iteration behind the filesystem.
 Blocking shapes: ``open``/``os.replace``/``os.remove``/``os.rename``/
 ``os.unlink``/``os.listdir``/``os.scandir``/``os.makedirs``/
 ``os.fsync``/``shutil.*``, the durability core ``atomic_write``/
-``read_verified``, ``time.sleep``, and the device syncs
-``jax.device_get`` / ``fetch_detached`` / ``fetch_detached_batch``.
+``read_verified``, ``time.sleep``, the device syncs
+``jax.device_get`` / ``fetch_detached`` / ``fetch_detached_batch``, and
+the network shapes ``urlopen`` / ``rpc_get`` / ``rpc_post`` (ISSUE 17:
+the remote affinity probe once held the router's global lock across a
+bounded HTTP GET per continuation — fixture pair
+``viol/clean_remote_sync``).
 Metadata probes (``os.path.exists``/``os.stat``) are deliberately NOT
 in the set: the router's disk-residency probe does one deduped stat per
 session directory under its global lock by design (PR 8 round 3), and a
@@ -41,11 +45,17 @@ from .rules_locks import _attr_chain_lock, analyze
 HOT_LOCK_CLASSES = {"StateCache", "PrefixCache", "SessionTiers",
                     "Batcher", "Router", "_DiskTier"}
 
-_BLOCKING_NAME_CALLS = {"open", "atomic_write", "read_verified"}
+_BLOCKING_NAME_CALLS = {"open", "atomic_write", "read_verified",
+                        "urlopen"}
 _BLOCKING_OS_CALLS = {"replace", "remove", "rename", "unlink", "listdir",
                       "scandir", "makedirs", "fsync"}
+# network RPCs block like file IO does (ISSUE 17: the remote affinity
+# probe once did a bounded HTTP GET under the router's global lock —
+# one slow peer stalled every admission): urlopen plus the transport
+# layer's deliberately distinctive rpc_get/rpc_post entry points
 _BLOCKING_ATTR_CALLS = {"atomic_write", "read_verified",
-                        "fetch_detached", "fetch_detached_batch"}
+                        "fetch_detached", "fetch_detached_batch",
+                        "urlopen", "rpc_get", "rpc_post"}
 
 
 def _blocking_desc(call: ast.Call) -> str | None:
@@ -119,12 +129,12 @@ class _IoIndex:
 @register
 class IoUnderLockRule(Rule):
     id = "io-under-lock"
-    doc = ("Blocking filesystem/device-sync calls (open, os.replace/"
-           "remove/listdir/fsync, atomic_write/read_verified, "
-           "jax.device_get, fetch_detached*) inside a with-region of a "
-           "designated hot lock (StateCache/PrefixCache/SessionTiers/"
-           "Batcher/Router/_DiskTier), directly or through any "
-           "resolvable callee.")
+    doc = ("Blocking filesystem/device-sync/network calls (open, "
+           "os.replace/remove/listdir/fsync, atomic_write/read_verified, "
+           "jax.device_get, fetch_detached*, urlopen, rpc_get/rpc_post) "
+           "inside a with-region of a designated hot lock (StateCache/"
+           "PrefixCache/SessionTiers/Batcher/Router/_DiskTier), directly "
+           "or through any resolvable callee.")
 
     def run(self, project: Project) -> list[Finding]:
         analysis = analyze(project)

@@ -8,7 +8,7 @@
 # summary line — with per-rule `d(rule)=±k` deltas vs the previous
 # LINT_report.json when one exists (the report is rewritten in place
 # each run, trendable next to BENCH_*.json) — and exits REGRESSION_RC
-# (3) on NEW findings — the run aborts HERE, before the ~15 min suite,
+# (3) on NEW findings — the run aborts HERE, before the ~30 min suite,
 # because a lint regression is a deterministic fail and the feedback
 # should be seconds, not minutes (phase-0 budget: 10 s; see
 # docs/OPERATIONS.md). Pure CPU/AST, sequenced BEFORE the timed suite
@@ -35,9 +35,12 @@
 # kept sessions token-identically; disk errors lose durability but
 # never correctness; corrupt session files quarantine + fail honestly;
 # priority p99 TTFT holds its SLO under a 4x burst while best-effort
-# sheds with honest Retry-After 429s) and rewrites BENCH_serve_r04.json
-# — sequenced after the smoke, never concurrent with the timed suite;
-# ~30 s budget, 300 s hard cap.
+# sheds with honest Retry-After 429s; a blackholed remote host opens
+# its circuit, is routed around losing nothing, and REJOINS on heal
+# with replay-deduped exactly-once generates) and rewrites
+# BENCH_serve_r04.json + BENCH_serve_r09.json — sequenced after the
+# smoke, never concurrent with the timed suite; ~60 s budget, 900 s
+# hard cap.
 #
 # Usage: tools/verify.sh        (from anywhere; cd's to the repo root)
 # Exit:  graftlint's code on lint regressions (3), else tier1_diff's on
@@ -58,7 +61,7 @@ if [ "$lint_rc" -ne 0 ]; then
 fi
 
 rm -f /tmp/_t1.log
-timeout -k 10 1080 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+timeout -k 10 1800 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
@@ -82,13 +85,15 @@ if [ "$smoke" -ne 0 ]; then
 fi
 
 # serve chaos drill (sequenced after the smoke — never concurrent with
-# the timed suite): ~30 s measured. The 600 s cap covers the host_die
-# phase's worst-case internal budget on a loaded box (180 s replica-host
-# subprocess boot + 30 s checkpoint wait + 15 s retirement wait on top
-# of the ~30 s fault phases) so the drill's failure diagnostics always
-# print before the outer kill fires. Rewrites BENCH_serve_r04.json in
-# place (the checked-in burst-shedding + host-death trajectory
-# datapoint).
-JAX_PLATFORMS=cpu timeout -k 10 600 python tools/chaos_serve.py \
-  --json BENCH_serve_r04.json
+# the timed suite): ~60 s measured. The 900 s cap covers the host_die
+# AND partition phases' worst-case internal budgets on a loaded box
+# (each boots a 180 s replica-host subprocess + 30 s checkpoint wait,
+# plus host_die's 15 s retirement wait and partition's 25 s circuit-
+# open + 20 s rejoin waits on top of the ~30 s fault phases) so the
+# drill's failure diagnostics always print before the outer kill
+# fires. Rewrites BENCH_serve_r04.json (burst-shedding + host-death
+# trajectory) and BENCH_serve_r09.json (partition/heal zero-lost /
+# zero-duplicate / routed-around accounting) in place.
+JAX_PLATFORMS=cpu timeout -k 10 900 python tools/chaos_serve.py \
+  --json BENCH_serve_r04.json --json-partition BENCH_serve_r09.json
 exit $?
