@@ -236,6 +236,8 @@ def main(argv=None) -> int:
         argv = sys.argv[1:]
     if argv and argv[0] == "serve":
         return _run_serve(argv[1:])
+    if argv and argv[0] == "distill":
+        return _run_distill(argv[1:])
     args = build_parser().parse_args(argv)
     if args.temperature <= 0.0:
         raise SystemExit(f"--temperature must be > 0, got {args.temperature}")
@@ -1478,6 +1480,38 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "when any canary pair token-diffs; without it "
                         "the diff report is informational (sampled "
                         "traffic diffs legitimately)")
+    # --- speculative decoding (train/distill.py, serve/engine.py) ---
+    p.add_argument("--speculative", action="store_true",
+                   help="lossless speculative decoding: a distilled "
+                        "DRAFT LM (published by `cli distill`, loaded "
+                        "from --registry-dir as a verified pair with "
+                        "the target) proposes K_draft tokens per step "
+                        "and the target verifies all of them in ONE "
+                        "teacher-forced window pass — greedy output is "
+                        "token-identical to plain decode by "
+                        "construction, rejection is an O(1) carry "
+                        "restore. Applies to greedy default-model "
+                        "traffic; sampled/named-model requests decode "
+                        "plain. Requires --registry-dir "
+                        "(docs/OPERATIONS.md 'Speculative decoding')")
+    p.add_argument("--draft-model", type=str, default=None,
+                   help="registry id of the draft artifact (default: "
+                        "'<--model-id>-draft', the id `cli distill` "
+                        "publishes under). Its config_hash/parent "
+                        "record must verify against the serving "
+                        "target or boot refuses the pair")
+    p.add_argument("--spec-ladder", type=str, default="2,4",
+                   help="warmed K_draft rungs the speculative window "
+                        "can dispatch (comma list; rung 0 = plain "
+                        "decode is always included). Each rung is one "
+                        "compile key per batch bucket, all covered by "
+                        "warmup — the autotuner's spec_k knob moves "
+                        "within this set")
+    p.add_argument("--spec-k", type=int, default=None,
+                   help="initial K_draft (must be a --spec-ladder rung "
+                        "or 0; default: the top rung). 0 starts at "
+                        "plain decode with speculation armed — the "
+                        "autotuner can still probe upward")
     # --- per-tenant rate limiting (serve/router.py) ---
     p.add_argument("--tenant-rate", type=float, default=0,
                    help="per-tenant token-bucket rate limit (requests/s "
@@ -1615,6 +1649,20 @@ def _parse_window_ladder(spec: str) -> tuple[int, ...]:
     return tuple(sorted(
         {1, n} | {k for k in Batcher.DEFAULT_WINDOW_LADDER if k < n}
     ))
+
+
+def _parse_spec_ladder(spec: str) -> tuple[int, ...]:
+    """--spec-ladder → the warmed K_draft rung set (rung 0 — plain
+    decode — is always added by the Batcher)."""
+    try:
+        rungs = tuple(int(x) for x in spec.split(",") if x.strip())
+    except ValueError:
+        raise SystemExit(
+            f"--spec-ladder: expected comma-separated ints, got {spec!r}")
+    if not rungs or any(k < 1 for k in rungs):
+        raise SystemExit(
+            f"--spec-ladder: need at least one rung >= 1, got {spec!r}")
+    return rungs
 
 
 def _autotune_chunk_choices(args, chunk: int | None) -> tuple[int, ...] | None:
@@ -1826,6 +1874,37 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
         )
         for i in range(n_replicas)
     ]
+    spec_kw = {}
+    if getattr(args, "speculative", False):
+        if not getattr(args, "registry_dir", None):
+            raise SystemExit(
+                "--speculative needs --registry-dir (the draft loads "
+                "from the registry as a verified pair with the target; "
+                "publish one with `cli distill`)")
+        if shards > 1:
+            raise SystemExit(
+                "--speculative is not supported with --mesh-shards > 1 "
+                "(the draft's state is replica-local)")
+        from .train.distill import load_draft
+
+        try:
+            dmeta, dparams, dcfg = load_draft(
+                args.registry_dir,
+                cfg,
+                teacher_id=getattr(args, "model_id", "default"),
+                draft_id=getattr(args, "draft_model", None) or None,
+            )
+        except Exception as e:
+            raise SystemExit(f"--speculative: cannot load draft: {e}")
+        for eng in engines:
+            eng.attach_draft(dparams, dcfg, version=dmeta["version"])
+        spec_kw = {
+            "speculative": True,
+            "spec_ladder": _parse_spec_ladder(
+                getattr(args, "spec_ladder", "2,4")),
+        }
+        if getattr(args, "spec_k", None) is not None:
+            spec_kw["spec_k"] = args.spec_k
     try:
         wp, wb = (int(x) for x in args.class_weights.split(","))
     except ValueError:
@@ -1892,7 +1971,8 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
                              "require_canary_match":
                                  getattr(args, "require_canary_match",
                                          False),
-                         })
+                         },
+                         **spec_kw)
     return params, cfg, server
 
 
@@ -2342,6 +2422,160 @@ def _run_serve(argv) -> int:
                 tracer.save(args.trace)
             except OSError as e:
                 print(f"warning: could not write --trace file: {e}")
+
+
+def build_distill_parser() -> argparse.ArgumentParser:
+    """``distill`` subcommand: train + publish a speculative-decoding
+    draft LM against a trained target (train/distill.py)."""
+    p = argparse.ArgumentParser(
+        prog="lstm_tensorspark_tpu distill",
+        description="distill a draft LM (H/4, 1 layer, shared vocab) "
+                    "against a trained target's logits with a KL+CE "
+                    "mixed loss, and publish it to the model registry "
+                    "as a verified pair — the artifact `serve "
+                    "--speculative` loads",
+    )
+    # --- teacher (must match the producing training run) ---
+    p.add_argument("--vocab-size", type=int, default=89)
+    p.add_argument("--hidden-units", type=int, default=64)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--tie-embeddings", action="store_true")
+    p.add_argument("--compute-dtype", type=str, default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--registry-dir", type=str, required=True,
+                   help="model registry (serve/registry.py): the "
+                        "teacher loads from here when --checkpoint-dir "
+                        "is not given, and the draft publishes here as "
+                        "'<--model-id>-draft'")
+    p.add_argument("--model-id", type=str, default="default",
+                   help="the teacher's registry id (the id the serving "
+                        "fleet boots as)")
+    p.add_argument("--draft-id", type=str, default=None,
+                   help="publish the draft under this id instead of "
+                        "'<--model-id>-draft'")
+    p.add_argument("--checkpoint-dir", type=str, default=None,
+                   help="restore the teacher from a training "
+                        "checkpoint instead of the registry (template "
+                        "built from the model flags + --optimizer)")
+    p.add_argument("--optimizer", type=str, default="sgd",
+                   choices=["sgd", "momentum", "adam", "adamw", "rmsprop"],
+                   help="checkpoint-template optimizer (teacher "
+                        "restore only — the draft trains with "
+                        "--distill-optimizer)")
+    p.add_argument("--learning-rate", type=float, default=1.0,
+                   help="checkpoint-template learning rate (restore "
+                        "only)")
+    # --- corpus (the logit-harvest stream) ---
+    p.add_argument("--data-path", type=str, default=None,
+                   help="corpus directory (falls back to the dataset's "
+                        "synthetic stand-in)")
+    p.add_argument("--dataset", type=str, default="ptb_char",
+                   choices=list(LM_DATASETS))
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--seq-len", type=int, default=32)
+    # --- distillation ---
+    p.add_argument("--steps", type=int, default=200,
+                   help="draft optimizer steps (each scores one [B,T] "
+                        "window through the teacher first)")
+    p.add_argument("--alpha", type=float, default=0.5,
+                   help="KL(teacher||student) weight in [0,1]; 1-alpha "
+                        "weights the hard-label cross-entropy")
+    p.add_argument("--distill-temperature", type=float, default=2.0,
+                   help="softmax temperature of the KL term (Hinton "
+                        "tau; the loss scales by tau^2)")
+    p.add_argument("--distill-optimizer", type=str, default="adam",
+                   choices=["sgd", "momentum", "adam", "adamw", "rmsprop"])
+    p.add_argument("--distill-lr", type=float, default=1e-3)
+    p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--jsonl", type=str, default=None,
+                   help="metrics JSONL path for the distill run")
+    return p
+
+
+def _run_distill(argv) -> int:
+    args = build_distill_parser().parse_args(argv)
+    import json
+
+    from .data.batching import lm_batch_stream
+    from .data.datasets import get_dataset
+    from .models import LMConfig, init_lm
+    from .serve.registry import ModelRegistry, config_fingerprint
+    from .train.distill import distill, publish_draft
+    from .train.metrics import MetricsLogger
+
+    cfg = LMConfig(
+        vocab_size=args.vocab_size,
+        hidden_size=args.hidden_units,
+        num_layers=args.num_layers,
+        tie_embeddings=args.tie_embeddings,
+        compute_dtype=args.compute_dtype,
+    )
+    registry = ModelRegistry(args.registry_dir)
+    if args.checkpoint_dir:
+        from .train import make_optimizer
+        from .train.checkpoint import Checkpointer
+        from .train.loop import init_train_state
+
+        ckpt = Checkpointer(args.checkpoint_dir)
+        if not ckpt.has_checkpoint():
+            raise SystemExit(f"no checkpoint in {args.checkpoint_dir}")
+        optimizer = make_optimizer(args.optimizer, args.learning_rate)
+        template = init_train_state(
+            init_lm(jax.random.PRNGKey(args.seed), cfg), optimizer,
+            jax.random.PRNGKey(args.seed))
+        state = ckpt.restore_latest(template)
+        if state is None:
+            raise SystemExit(
+                f"every checkpoint in {args.checkpoint_dir} is corrupt "
+                "(now quarantined); refusing to distill an untrained "
+                "teacher")
+        tparams = jax.device_get(state.params)
+    else:
+        template = init_lm(jax.random.PRNGKey(args.seed), cfg)
+        try:
+            meta, tparams = registry.load_params(args.model_id, template)
+        except Exception as e:
+            raise SystemExit(
+                f"cannot load teacher {args.model_id!r} from "
+                f"{args.registry_dir}: {e} (publish one, or pass "
+                "--checkpoint-dir)")
+        if (meta.get("config_hash")
+                and meta["config_hash"] != config_fingerprint(cfg)):
+            raise SystemExit(
+                f"teacher {args.model_id} v{meta['version']} was "
+                f"published for config {meta['config_hash']}, the model "
+                f"flags describe {config_fingerprint(cfg)} — align the "
+                "flags with the producing run")
+    ds = get_dataset(args.dataset, args.data_path)
+    if len(ds["vocab"]) > cfg.vocab_size:
+        raise SystemExit(
+            f"corpus vocab ({len(ds['vocab'])}) exceeds --vocab-size "
+            f"({cfg.vocab_size}); the teacher cannot score tokens "
+            "outside its embedding")
+    logger = MetricsLogger(jsonl_path=args.jsonl)
+    dparams, dcfg = distill(
+        tparams, cfg, lm_batch_stream(ds["train"], args.batch_size,
+                                      args.seq_len),
+        num_steps=args.steps, alpha=args.alpha,
+        temperature=args.distill_temperature,
+        optimizer=args.distill_optimizer, learning_rate=args.distill_lr,
+        seed=args.seed, log_every=args.log_every, logger=logger,
+    )
+    meta = publish_draft(registry, dparams, dcfg, cfg,
+                         teacher_id=args.model_id, draft_id=args.draft_id)
+    print(json.dumps({
+        "distill": {
+            "draft": meta["model"], "version": meta["version"],
+            "hidden_size": dcfg.hidden_size,
+            "num_layers": dcfg.num_layers,
+            "config_hash": meta["config_hash"],
+            "parent": meta["parent"],
+            "payload_bytes": meta["payload_bytes"],
+            "steps": args.steps,
+        }
+    }))
+    return 0
 
 
 def _run_classifier(args, logger) -> int:

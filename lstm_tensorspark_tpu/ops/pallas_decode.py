@@ -290,3 +290,256 @@ def decode_window_call(params, fused_layers, cfg, h_in, c_in, tokens,
                                                  (V, E))
     return (h_out, c_out, toks, next_tok[0],
             alive_out[0].astype(bool), rem_out[0])
+
+
+# ---- speculative verify window (draft + target, fused) -----------------
+
+
+def spec_plan_bytes(batch_b: int, k_draft: int, num_layers: int,
+                    hidden: int, embed: int, vocab: int,
+                    draft_layers: int, draft_hidden: int,
+                    draft_embed: int, *, pbytes: int = 4) -> int:
+    """VMEM plan for the fused spec window: BOTH models' weights and
+    carries are resident for the whole propose+verify pass. Composed
+    from two greedy `plan_bytes` plans (target at W = K+1, draft
+    likewise — the draft runs every verify step teacher-forced) plus
+    the proposal block; the double-counted [B, V] working set is kept
+    as slack (the two models step sequentially, so the true live set is
+    smaller — overcounting only ever falls back to the scan window)."""
+    w = k_draft + 1
+    v = plan_bytes(batch_b, w, num_layers, hidden, embed, vocab,
+                   sampled=False, pbytes=pbytes)
+    v += plan_bytes(batch_b, w, draft_layers, draft_hidden, draft_embed,
+                    vocab, sampled=False, pbytes=pbytes)
+    v += k_draft * batch_b * 4  # proposal block
+    return v
+
+
+def spec_plan_fits(batch_b: int, k_draft: int, num_layers: int,
+                   hidden: int, embed: int, vocab: int,
+                   draft_layers: int, draft_hidden: int,
+                   draft_embed: int, *, pbytes: int = 4) -> bool:
+    return spec_plan_bytes(
+        batch_b, k_draft, num_layers, hidden, embed, vocab,
+        draft_layers, draft_hidden, draft_embed,
+        pbytes=pbytes) <= _VMEM_BUDGET
+
+
+def _model_step(tok, hs, cs, emb_ref, layer_refs, head_ref, hb_ref, *,
+                vocab: int, ldtype):
+    """One greedy decode step of one model inside the kernel — the
+    `_decode_window_kernel` per-step body, factored so the spec kernel
+    runs it for the target AND the draft. Returns ``(logits_f32,
+    new_hs, new_cs)`` (uncommitted — the caller latches)."""
+    B = tok.shape[0]
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, (B, vocab), 1)
+              == tok[:, None]).astype(jnp.float32)
+    x = jnp.dot(onehot, emb_ref[:].astype(jnp.float32),
+                preferred_element_type=jnp.float32)
+    if emb_ref.dtype != jnp.float32:
+        x = x.astype(emb_ref.dtype)
+    new_hs, new_cs = [], []
+    for l, (w_ref, u_ref, b_ref) in enumerate(layer_refs):
+        dtype = w_ref.dtype
+        z = jnp.dot(x.astype(dtype), w_ref[:],
+                    preferred_element_type=jnp.float32)
+        z = z + jnp.dot(hs[l].astype(dtype), u_ref[:],
+                        preferred_element_type=jnp.float32)
+        z = z + b_ref[0]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * cs[l] + i * g
+        h_new = o * jnp.tanh(c_new)
+        new_hs.append(h_new)
+        new_cs.append(c_new)
+        x = h_new
+    logits = (
+        jnp.dot(x.astype(head_ref.dtype), head_ref[:],
+                preferred_element_type=ldtype)
+        + hb_ref[0].astype(ldtype)
+    ).astype(jnp.float32)
+    return logits, new_hs, new_cs
+
+
+def _spec_window_kernel(*refs, num_layers: int, hidden: int,
+                        draft_layers: int, draft_hidden: int, vocab: int,
+                        k_draft: int, ldtype, dldtype):
+    """The fused speculative step, greedy-only. Phase 1: the draft
+    decodes ``k_draft`` proposals from its VMEM-resident carries (the
+    propose-time carries are discarded). Phase 2: ``W = k_draft + 1``
+    joint verify steps run the TARGET teacher-forced over [last_token,
+    proposals...] with the DRAFT stepping alongside on the same inputs;
+    both models' carries latch on the scan spec window's exact ``emit``
+    mask (serve/engine.py `_get_spec_window_fn`), the emitted prefix is
+    the plain greedy sequence by construction, and the disagreement-
+    detecting step emits the target's own argmax as the correction
+    token. The returned ``alive`` is the SESSION latch (EOS/budget) —
+    a draft miss ends the window, never the conversation."""
+    L, Ld = num_layers, draft_layers
+    idx = 0
+    emb_ref = refs[idx]; idx += 1
+    layer_refs = []
+    for _ in range(L):
+        layer_refs.append((refs[idx], refs[idx + 1], refs[idx + 2]))
+        idx += 3
+    head_ref = refs[idx]; idx += 1
+    hb_ref = refs[idx]; idx += 1
+    demb_ref = refs[idx]; idx += 1
+    dlayer_refs = []
+    for _ in range(Ld):
+        dlayer_refs.append((refs[idx], refs[idx + 1], refs[idx + 2]))
+        idx += 3
+    dhead_ref = refs[idx]; idx += 1
+    dhb_ref = refs[idx]; idx += 1
+    h0_ref = refs[idx]; idx += 1
+    c0_ref = refs[idx]; idx += 1
+    dh0_ref = refs[idx]; idx += 1
+    dc0_ref = refs[idx]; idx += 1
+    tok_ref = refs[idx]; idx += 1
+    alive_ref = refs[idx]; idx += 1
+    rem_ref = refs[idx]; idx += 1
+    eos_ref = refs[idx]; idx += 1
+    (toks_ref, next_ref, alive_out_ref, rem_out_ref,
+     h_out_ref, c_out_ref, dh_out_ref, dc_out_ref) = refs[idx:idx + 8]
+
+    tok = tok_ref[0]                  # [B] int32
+    alive = alive_ref[0] != 0         # [B] bool — window latch, step 0
+    rem = rem_ref[0]                  # [B] int32
+    eos = eos_ref[0]                  # [B] int32 (-1 = none)
+    hs = [h0_ref[l] for l in range(L)]
+    cs = [c0_ref[l] for l in range(L)]
+    dhs0 = [dh0_ref[l] for l in range(Ld)]
+    dcs0 = [dc0_ref[l] for l in range(Ld)]
+
+    # phase 1 — draft proposes K greedy tokens; its propose-time carries
+    # are discarded (the verify phase re-runs the draft teacher-forced,
+    # which is the state commit)
+    props = []
+    dhs, dcs = list(dhs0), list(dcs0)
+    ptok = tok
+    for _ in range(k_draft):
+        dlogits, dhs, dcs = _model_step(
+            ptok, dhs, dcs, demb_ref, dlayer_refs, dhead_ref, dhb_ref,
+            vocab=vocab, ldtype=dldtype)
+        ptok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+        props.append(ptok)
+
+    # phase 2 — W joint teacher-forced verify steps
+    dhs, dcs = list(dhs0), list(dcs0)
+    sess_alive = alive
+    final_tok = tok
+    for i in range(k_draft + 1):
+        inp = tok if i == 0 else props[i - 1]
+        logits, new_hs, new_cs = _model_step(
+            inp, hs, cs, emb_ref, layer_refs, head_ref, hb_ref,
+            vocab=vocab, ldtype=ldtype)
+        _, new_dhs, new_dcs = _model_step(
+            inp, dhs, dcs, demb_ref, dlayer_refs, dhead_ref, dhb_ref,
+            vocab=vocab, ldtype=dldtype)
+        t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        emit = alive
+        out_tok = jnp.where(emit, t, PAD_TOKEN).astype(jnp.int32)
+        new_rem = rem - emit.astype(rem.dtype)
+        hit_eos = emit & (eos >= 0) & (t == eos)
+        live_on = ~hit_eos & (new_rem > 0)
+        sess_alive = jnp.where(emit, live_on, sess_alive)
+        if i < k_draft:
+            agree = props[i] == t
+            alive = emit & live_on & agree
+        else:
+            # past the last proposal nothing can agree — the window
+            # always closes here (the scan fn's -2 sentinel)
+            alive = jnp.zeros_like(emit)
+        hs = [jnp.where(emit[:, None], hn, ho)
+              for ho, hn in zip(hs, new_hs)]
+        cs = [jnp.where(emit[:, None], cn, co)
+              for co, cn in zip(cs, new_cs)]
+        dhs = [jnp.where(emit[:, None], hn, ho)
+               for ho, hn in zip(dhs, new_dhs)]
+        dcs = [jnp.where(emit[:, None], cn, co)
+               for co, cn in zip(dcs, new_dcs)]
+        final_tok = jnp.where(emit, t, final_tok).astype(jnp.int32)
+        rem = new_rem
+        toks_ref[i] = out_tok
+
+    next_ref[0] = jnp.where(sess_alive, final_tok, 0).astype(jnp.int32)
+    alive_out_ref[0] = sess_alive.astype(jnp.int32)
+    rem_out_ref[0] = rem
+    for l in range(L):
+        h_out_ref[l] = hs[l].astype(jnp.float32)
+        c_out_ref[l] = cs[l].astype(jnp.float32)
+    for l in range(Ld):
+        dh_out_ref[l] = dhs[l].astype(jnp.float32)
+        dc_out_ref[l] = dcs[l].astype(jnp.float32)
+
+
+def spec_window_call(params, fused_layers, cfg, dparams, dfused_layers,
+                     dcfg, h_in, c_in, dh_in, dc_in, tokens, alive,
+                     remaining, eos_ids, *, k_draft: int, interpret: bool):
+    """Trace-level entry for the fused spec window (called inside the
+    engine's jitted wrapper). ``h_in``/``c_in`` [L, B, H] f32 target
+    carries, ``dh_in``/``dc_in`` [L_d, B, H_d] f32 draft carries; row
+    vectors as in `decode_window_call`. Returns ``(h_out, c_out,
+    dh_out, dc_out, toks [W, B] int32, next_tok [B] int32, alive_out
+    [B] bool, rem_out [B] int32)`` — the scan spec fn's exact shapes,
+    so the two programs are interchangeable behind one spec
+    `DecodeWindow`."""
+    L, B, H = h_in.shape
+    Ld, _, Hd = dh_in.shape
+    V = cfg.vocab_size
+    W = k_draft + 1
+    head = params["head"]
+    head_kernel = (params["embedding"].T if cfg.tie_embeddings
+                   else head["kernel"])
+    dhead = dparams["head"]
+    dhead_kernel = (dparams["embedding"].T if dcfg.tie_embeddings
+                    else dhead["kernel"])
+
+    operands = [params["embedding"]]
+    for fused in fused_layers:
+        operands += [fused.kernel, fused.recurrent,
+                     fused.bias.reshape(1, -1)]
+    operands += [head_kernel, head["bias"].reshape(1, -1)]
+    operands.append(dparams["embedding"])
+    for fused in dfused_layers:
+        operands += [fused.kernel, fused.recurrent,
+                     fused.bias.reshape(1, -1)]
+    operands += [
+        dhead_kernel, dhead["bias"].reshape(1, -1),
+        h_in, c_in, dh_in, dc_in,
+        tokens.reshape(1, -1).astype(jnp.int32),
+        alive.reshape(1, -1).astype(jnp.int32),
+        remaining.reshape(1, -1).astype(jnp.int32),
+        eos_ids.reshape(1, -1).astype(jnp.int32),
+    ]
+    in_specs = [pl.BlockSpec(memory_space=pltpu.VMEM)] * len(operands)
+
+    out_shape = [
+        jax.ShapeDtypeStruct((W, B), jnp.int32),        # token block
+        jax.ShapeDtypeStruct((1, B), jnp.int32),        # next token
+        jax.ShapeDtypeStruct((1, B), jnp.int32),        # session alive
+        jax.ShapeDtypeStruct((1, B), jnp.int32),        # remaining
+        jax.ShapeDtypeStruct((L, B, H), jnp.float32),   # target h out
+        jax.ShapeDtypeStruct((L, B, H), jnp.float32),   # target c out
+        jax.ShapeDtypeStruct((Ld, B, Hd), jnp.float32),  # draft h out
+        jax.ShapeDtypeStruct((Ld, B, Hd), jnp.float32),  # draft c out
+    ]
+    out_specs = [pl.BlockSpec(memory_space=pltpu.VMEM)] * 8
+
+    (toks, next_tok, alive_out, rem_out,
+     h_out, c_out, dh_out, dc_out) = pl.pallas_call(
+        functools.partial(
+            _spec_window_kernel, num_layers=L, hidden=H,
+            draft_layers=Ld, draft_hidden=Hd, vocab=V, k_draft=k_draft,
+            ldtype=cfg.ldtype, dldtype=dcfg.ldtype,
+        ),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*operands)
+    return (h_out, c_out, dh_out, dc_out, toks, next_tok[0],
+            alive_out[0].astype(bool), rem_out[0])

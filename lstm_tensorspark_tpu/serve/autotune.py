@@ -33,7 +33,17 @@ four knobs, each within PRE-WARMED bounds:
 - **best_effort** — the admission leg (``Router.set_best_effort_frac``):
   when the state plane thrashes AT its capacity ceiling (host tier
   already at ``host_tier_max``), best-effort traffic is shed earlier;
-  relaxed back toward the configured policy when the thrash clears.
+  relaxed back toward the configured policy when the thrash clears;
+- **spec_k** — the speculative-decoding draft depth
+  (``Batcher.set_spec_k``), moved one rung at a time within the warmed
+  spec ladder from the windowed ``serve_spec_accept_len`` delta: K up
+  when the draft keeps earning its depth (mean accepted length near
+  the current K), DOWN — ultimately to rung 0, plain decode — when
+  acceptance collapses and the draft's propose+verify overhead stops
+  paying. Rung 0 casts a re-probe vote whenever live decode traffic is
+  flowing (the workload may have shifted back toward draftable text),
+  so the fallback is a resting state, not a ratchet. Inert on
+  non-speculative stacks.
 
 **The no-compile invariant.** Every decision stays inside compile-key
 families ``warmup()`` already covered: ``set_window_cap`` only accepts
@@ -70,7 +80,7 @@ import threading
 from collections import deque
 
 #: the knobs, in evaluation order (also the metric label values)
-KNOBS = ("window_k", "prefill_chunk", "host_tier", "best_effort")
+KNOBS = ("window_k", "prefill_chunk", "host_tier", "best_effort", "spec_k")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -174,6 +184,12 @@ class AutoTuner:
         self._f_qwait = reg.histogram(
             "serve_queue_wait_seconds", "submit → admission wait",
             labelnames=("replica",))
+        self._f_spec_accept = reg.histogram(
+            "serve_spec_accept_len",
+            "draft proposals accepted per speculative verify window, "
+            "per live row",
+            labelnames=("replica",),
+            buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0))
         fam = reg.counter(
             "serve_autotune_moves_total",
             "autotuner knob movements, by knob and direction (both "
@@ -187,6 +203,7 @@ class AutoTuner:
         self._cur_ttft: dict | None = None
         self._cur_itl: dict | None = None
         self._cur_qwait: dict | None = None
+        self._cur_spec: dict | None = None
         self._prev_chunks: float | None = None
         self._prev_tiers: dict | None = None
         # the knobs' CONFIGURED operating points — the relax targets
@@ -285,6 +302,8 @@ class AutoTuner:
         itl, self._cur_itl = self._f_itl.snapshot_delta(self._cur_itl)
         qwait, self._cur_qwait = self._f_qwait.snapshot_delta(
             self._cur_qwait)
+        spec_accept, self._cur_spec = self._f_spec_accept.snapshot_delta(
+            self._cur_spec)
         batchers = self._local_batchers()
         queued = sum(b.queued() for b in batchers)
         chunks_now = float(sum(b.stats()["prefill_chunks_dispatched"]
@@ -319,6 +338,7 @@ class AutoTuner:
             "queue_size": self.server.router.queue_size,
             "prefill_chunks": chunk_delta,
             "tiers": tiers_sig,
+            "spec_accept": spec_accept,
         }
 
     # ---- verdicts (pure in the signals dict; unit-testable) ------------
@@ -354,6 +374,40 @@ class AutoTuner:
                 and tt.get("p99", 0.0) > cfg.slo_s * cfg.ttft_low_frac):
             return False
         return True
+
+    def _spec_batchers(self) -> list:
+        return [b for b in self._local_batchers()
+                if getattr(b, "speculative", False)]
+
+    def _spec_desire(self, sig: dict) -> int:
+        """K_draft vote from the windowed acceptance delta. The draft's
+        cost model is simple: one spec window does K_draft cheap draft
+        steps + ONE target pass of W=K+1 verify steps, and emits
+        accepted+1 tokens. Mean accepted length near the current K
+        means the draft is saturating its depth — try the next rung up
+        (patience_up: an optimization). Mean below half the depth means
+        most verify positions are wasted work — step down (fast,
+        patience_down), bottoming out at rung 0 = plain decode. At rung
+        0 no spec windows run, so no acceptance evidence can ever
+        accumulate; live decode traffic (the ITL delta) is the re-probe
+        vote instead — the workload may have shifted back."""
+        spec = self._spec_batchers()
+        if not spec:
+            return 0
+        cur = spec[0].spec_k
+        cfg = self.cfg
+        if cur == 0:
+            up = sig["itl"]["count"] >= cfg.min_events
+            return 1 if up else 0
+        acc = sig.get("spec_accept") or {}
+        if acc.get("count", 0) < cfg.min_events:
+            return 0
+        mean = acc["sum"] / acc["count"]
+        if mean >= 0.8 * cur:
+            return 1
+        if mean < 0.5 * cur:
+            return -1
+        return 0
 
     def _thrash(self, sig: dict) -> bool:
         """Spill thrash: the host tier is (near) full while states churn
@@ -395,6 +449,7 @@ class AutoTuner:
                 1 if self._tier_shrinkable(sig) else 0),
             "best_effort": -1 if (thrash and self._tier_at_max()) else (
                 1 if (not thrash and self._be_relaxable()) else 0),
+            "spec_k": self._spec_desire(sig),
         }
         applied: list[dict] = []
         for knob in KNOBS:
@@ -415,6 +470,7 @@ class AutoTuner:
                 "queue_wait": sig["queue_wait"], "queued": sig["queued"],
                 "pressure": pressure, "headroom": headroom,
                 "thrash": thrash,
+                "spec_accept": sig.get("spec_accept"),
             }
             for move in applied:
                 move["tick"] = self.ticks  # when, in control windows
@@ -563,6 +619,23 @@ class AutoTuner:
             return {"knob": knob,
                     "direction": "up" if new > cur else "down",
                     "from": cur, "to": new}
+        if knob == "spec_k":
+            # the draft-depth leg: one rung at a time within the warmed
+            # spec ladder (rung 0 = plain decode — the cost fallback);
+            # set_spec_k re-validates membership, so no compile here
+            spec = self._spec_batchers()
+            if not spec:
+                return None
+            ladder = spec[0].spec_ladder
+            cur = spec[0].spec_k
+            i = ladder.index(cur) + desired
+            if not 0 <= i < len(ladder):
+                return None
+            for b in spec:
+                b.set_spec_k(ladder[i])
+            return {"knob": knob,
+                    "direction": "up" if desired > 0 else "down",
+                    "from": cur, "to": ladder[i]}
         # best_effort: protect = tighten (shed earlier), optimize = relax
         router = self.server.router
         cur = router.best_effort_frac
@@ -600,6 +673,10 @@ class AutoTuner:
                       "max": self._slots_max,
                       "via": "rollout"},
         }
+        spec = self._spec_batchers()
+        knobs["spec_k"] = (
+            {"value": spec[0].spec_k, "ladder": list(spec[0].spec_ladder)}
+            if spec else {"value": None, "ladder": []})
         with self._lock:
             return {
                 "interval_s": self.cfg.interval_s,

@@ -352,13 +352,19 @@ class _Prefilling:
     whether the session started stateless — only such sessions' prompts
     are absolute prefixes eligible for prefix-cache insertion."""
 
-    __slots__ = ("sess", "pos", "entry", "was_fresh")
+    __slots__ = ("sess", "pos", "entry", "was_fresh", "draft_started")
 
     def __init__(self, sess: _Session, pos: int, entry, was_fresh: bool):
         self.sess = sess
         self.pos = pos
         self.entry = entry
         self.was_fresh = was_fresh
+        # speculative serving: True once the DRAFT model consumed this
+        # session's first fragment — the first draft dispatch always
+        # starts from zero (the draft has no prefix entries and no tier
+        # copies to resume from; starting cold is lossless, it only
+        # lowers acceptance until the draft catches context)
+        self.draft_started = False
 
     def src(self) -> tuple[int, bool]:
         """(src_slot, fresh) for the next prefill dispatch."""
@@ -376,6 +382,12 @@ class Batcher:
     #: every 5 admissions with both classes waiting, 4 are priority.
     DEFAULT_CLASS_WEIGHTS = (4, 1)
 
+    #: default speculative K_draft ladder: each K > 0 is a compile key
+    #: (("spec_window", bucket, K)); rung 0 is ALWAYS present — it is
+    #: the plain-decode fallback the autotuner retreats to when the
+    #: draft stops paying for itself.
+    DEFAULT_SPEC_LADDER = (0, 2, 4)
+
     def __init__(
         self,
         engine: ServeEngine,
@@ -387,6 +399,9 @@ class Batcher:
         prefill_chunk: int | None = None,
         prefill_chunk_choices: tuple[int, ...] | None = None,
         class_weights: tuple[int, int] = DEFAULT_CLASS_WEIGHTS,
+        speculative: bool = False,
+        spec_ladder: tuple[int, ...] = DEFAULT_SPEC_LADDER,
+        spec_k: int | None = None,
     ):
         if max_active < 1:
             raise ValueError(f"max_active must be >= 1, got {max_active}")
@@ -420,10 +435,33 @@ class Batcher:
             raise ValueError(
                 f"class_weights needs one positive weight per class "
                 f"{CLASSES}, got {class_weights!r}")
+        if any(int(k) < 0 for k in spec_ladder):
+            raise ValueError(
+                f"spec_ladder needs K_draft >= 0, got {spec_ladder!r}")
+        if speculative and not engine.has_draft:
+            raise ValueError(
+                "speculative=True needs a draft model attached to the "
+                "engine (attach_draft) — there is nothing to propose "
+                "tokens with")
         # rung 1 is always present: _pick_window falls back to it (near
         # budget end, pipelined tails), and warmup(windows=ladder) must
         # precompile every size the scheduler can dispatch
         ladder = tuple(sorted({1} | set(window_ladder)))
+        # rung 0 is always present in the spec ladder: the autotuner's
+        # K_draft=0 fallback must be selectable even when the operator
+        # configured only positive rungs
+        self.spec_ladder = tuple(sorted({0} | {int(k) for k in spec_ladder}))
+        self.speculative = bool(speculative)
+        if not self.speculative:
+            self.spec_k = 0
+        elif spec_k is None:
+            self.spec_k = self.spec_ladder[-1]
+        else:
+            if spec_k not in self.spec_ladder:
+                raise ValueError(
+                    f"spec_k {spec_k} is not a spec_ladder rung "
+                    f"{self.spec_ladder}")
+            self.spec_k = int(spec_k)
         self.engine = engine
         # identity within a replicated server (serve/router.py): labels
         # this scheduler's metric children and names it in /healthz —
@@ -491,6 +529,13 @@ class Batcher:
         self.prefill_chunks_dispatched = 0  # head-less chunk programs
         self.prefix_resumed = 0  # sessions that resumed from a prefix hit
         self.prefix_tokens_saved = 0  # prompt tokens skipped via the cache
+        # speculative accounting: spec windows dispatched per K_draft,
+        # and the accepted-proposal total (emitted = accepted + 1 per
+        # live row per window — the correction token always rides along)
+        self.spec_windows_dispatched: dict[int, int] = {}
+        self.spec_accepted_tokens = 0
+        self.draft_prefills_dispatched = 0
+        self.draft_prefill_failures = 0
         # liveness heartbeat for /healthz: monotonic timestamp of the last
         # scheduler pass (run-loop cycle or direct step()); None until the
         # scheduler first runs. A dead/stuck scheduler thread stops
@@ -542,6 +587,23 @@ class Batcher:
                           labelnames=("k", "replica"))
         self._m_window_k = {k: fam.labels(k=str(k), replica=rl)
                             for k in self.window_ladder}
+        # speculative telemetry: per-row accepted length per verify
+        # window (what the autotuner's spec_k knob watches), and verify
+        # outcomes — "full" = every proposal accepted, "partial" = some,
+        # "reject" = none (the row still emitted its correction token)
+        self._m_spec_accept = reg.histogram(
+            "serve_spec_accept_len",
+            "draft proposals accepted per speculative verify window, "
+            "per live row",
+            labelnames=("replica",),
+            buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0),
+        ).labels(replica=rl)
+        fam = reg.counter(
+            "serve_spec_verify_total",
+            "speculative verify windows by per-row outcome",
+            labelnames=("outcome", "replica"))
+        self._m_spec_outcome = {o: fam.labels(outcome=o, replica=rl)
+                                for o in ("full", "partial", "reject")}
         fam = reg.counter("serve_requests_total",
                           "requests by final outcome",
                           labelnames=("outcome", "replica"))
@@ -723,6 +785,24 @@ class Batcher:
                 "compile mid-traffic")
         with self._lock:
             self.prefill_chunk = int(chunk)
+
+    def set_spec_k(self, k: int) -> None:
+        """Move the speculative K_draft to spec-ladder rung ``k`` (the
+        autotuner's spec knob). Rung 0 is the plain-decode fallback —
+        speculation off until the knob moves back up. Only warmed rungs
+        are accepted, so no pick ever compiles mid-traffic; takes effect
+        at the next ``_pick_spec_k``."""
+        if not self.speculative:
+            raise ValueError(
+                "set_spec_k on a non-speculative scheduler — boot with "
+                "speculative=True (and an attached draft) first")
+        if k not in self.spec_ladder:
+            raise ValueError(
+                f"spec_k {k} is not a warmed spec-ladder rung "
+                f"{self.spec_ladder} — an off-ladder K_draft would "
+                "compile mid-traffic")
+        with self._lock:
+            self.spec_k = int(k)
 
     # ---- replica retirement (router-driven; see serve/router.py) -------
     #
@@ -1079,7 +1159,9 @@ class Batcher:
         return self.engine.warmup(
             sampling, prompt_lens=tuple(sorted(finals)),
             windows=self.window_ladder,
-            chunk_lens=tuple(sorted(chunks)))
+            chunk_lens=tuple(sorted(chunks)),
+            spec_windows=(tuple(k for k in self.spec_ladder if k > 0)
+                          if self.speculative else ()))
 
     def _select_prefill_batch(
             self, chunk: int | None) -> tuple[list[_Prefilling], bool]:
@@ -1141,6 +1223,13 @@ class Batcher:
                           chunk: int | None = None) -> None:
         prefix = self.engine.prefix
         items = []
+        draft_items = []
+        # the draft is distilled against the DEFAULT model only — other
+        # residents' sessions never speculate, so their prefills are not
+        # mirrored either
+        mirror = self.speculative and (
+            batch[0].sess.req.model is None
+            or batch[0].sess.req.model == self.engine.model_id)
         for p in batch:
             stop = self._next_stop(p, chunk)
             # stride-aligned insert point: the state after prompt[:pos]
@@ -1156,6 +1245,16 @@ class Batcher:
             src_slot, fresh = p.src()
             items.append((p.sess.slot, src_slot, fresh,
                           p.sess.req.prompt[p.pos: stop]))
+            if mirror:
+                # mirror every target dispatch so the draft's slot state
+                # tracks the consumed context. The draft's FIRST fragment
+                # always starts from zero — it has no prefix entries or
+                # tier copies to resume from (prefix-resumed and
+                # tier-restored rows rebuild draft context from the
+                # fragment alone: lossless, lower acceptance until the
+                # draft catches up)
+                draft_items.append((p.sess.slot, not p.draft_started,
+                                    p.sess.req.prompt[p.pos: stop]))
         t0 = time.perf_counter()
         try:
             if final:
@@ -1171,6 +1270,19 @@ class Batcher:
                 self._abort_prefilling(
                     p, f"prefill failed: {type(e).__name__}: {e}")
             return
+        if draft_items:
+            try:
+                self.engine.draft_prefill(draft_items)
+                self.draft_prefills_dispatched += 1
+                for p in batch:
+                    p.draft_started = True
+            except Exception:
+                # draft state is acceptance-only — a failed mirror can
+                # never corrupt output (the verify window is teacher-
+                # forced by the TARGET), so the session proceeds with a
+                # stale draft instead of failing a healthy prefill; the
+                # counter is the failure's only surface (stats/bench)
+                self.draft_prefill_failures += 1
         now = time.perf_counter()
         phase = "prefill" if final else "prefill_chunk"
         for p in batch:
@@ -1270,7 +1382,12 @@ class Batcher:
                 queue_empty = (not self._qlen_locked()
                                and not self._prefilling)
             if queue_empty:
-                k = self._pick_window(min(s.remaining for s in active))
+                min_rem = min(s.remaining for s in active)
+                kd = self._spec_k_for(active, min_rem)
+                if kd > 0:
+                    self._dispatch_spec_window(active, kd)
+                    return True
+                k = self._pick_window(min_rem)
                 if k > 1:
                     self._dispatch_window(active, k)
                     return True
@@ -1312,6 +1429,53 @@ class Batcher:
             if w <= min_remaining and w <= cap:
                 k = max(k, w)
         return k
+
+    def _spec_k_for(self, sessions: list[_Session],
+                    min_remaining: int) -> int:
+        """K_draft for a speculative window over ``sessions``, or 0 when
+        plain decode is the right call. Speculation applies only to
+        greedy default-model groups (the verify pass is pure argmax and
+        the draft pairs the default model); the rung is the largest
+        warmed ladder entry under the autotuner's ``spec_k`` cap whose
+        window W=K+1 no session would overshoot — mirroring
+        ``_pick_window``'s no-padding rule. ``min_remaining`` < 2 means
+        at most one token is wanted, where speculation cannot win."""
+        if not self.speculative:
+            return 0
+        cap = self.spec_k
+        if cap <= 0 or min_remaining < 2:
+            return 0
+        s0 = sessions[0]
+        if not s0.req.sampling.greedy:
+            return 0
+        if s0.req.model is not None and s0.req.model != self.engine.model_id:
+            return 0
+        k = 0
+        for r in self.spec_ladder:
+            if 0 < r <= cap and r + 1 <= min_remaining:
+                k = max(k, r)
+        return k
+
+    def _dispatch_spec_window(self, sessions: list[_Session],
+                              kd: int) -> None:
+        """Dispatch a speculative verify window (draft proposes ``kd``
+        tokens, target verifies all of them plus one correction in ONE
+        pass); handles park in ``_pending`` like a plain window."""
+        try:
+            win = self.engine.spec_window(
+                [s.slot for s in sessions],
+                [s.last_token for s in sessions],
+                [s.remaining for s in sessions],
+                [-1 if s.req.eos_id is None else s.req.eos_id
+                 for s in sessions],
+                k_draft=kd, model=sessions[0].req.model,
+            )
+        except Exception as e:
+            self._fail_chunk(sessions, f"decode failed: {type(e).__name__}: {e}")
+            return
+        self.spec_windows_dispatched[kd] = (
+            self.spec_windows_dispatched.get(kd, 0) + 1)
+        self._pending = (win, list(sessions))
 
     def _dispatch_window(self, sessions: list[_Session], k: int) -> None:
         """Dispatch a K-token window for ``sessions`` from host state; the
@@ -1359,9 +1523,29 @@ class Batcher:
             # remaining budgets as of AFTER the unfetched window, assuming
             # full consumption (rows that EOS'd early are latched frozen on
             # device, so overestimating their budget is harmless)
-            spec = [s.remaining - win.window for s in sessions]
-            live = [r for r in spec if r > 0]
-            if live:
+            proj = [s.remaining - win.window for s in sessions]
+            live = [r for r in proj if r > 0]
+            if live and win.spec:
+                # pipeline a speculative successor only while speculation
+                # still picks a rung; a 0 pick falls through WITHOUT a
+                # successor and the next _decode_all tick dispatches plain
+                # (spec<->plain transitions always happen at a tick, never
+                # inside the pipeline — the window types' device programs
+                # differ)
+                kd = self._spec_k_for(sessions, min(live))
+                if kd > 0:
+                    try:
+                        nxt = self.engine.spec_window_next(win, k_draft=kd)
+                    except Exception as e:
+                        self._fail_chunk(
+                            sessions,
+                            f"decode failed: {type(e).__name__}: {e}")
+                        return
+                    self.spec_windows_dispatched[kd] = (
+                        self.spec_windows_dispatched.get(kd, 0) + 1)
+                    self.windows_pipelined += 1
+                    self._pending = (nxt, list(sessions))
+            elif live:
                 try:
                     nxt = self.engine.decode_window_next(
                         win, window=self._pick_window(min(live)))
@@ -1394,8 +1578,33 @@ class Batcher:
         for i, (s, row) in enumerate(zip(sessions, toks)):
             if s.req.cancelled or s.req.done.is_set():
                 continue  # the cancel sweep / a prior window settled it
-            s.req.phases.append(("decode_window", win.t_dispatch, t_fetch))
+            s.req.phases.append((
+                "spec_window" if win.spec else "decode_window",
+                win.t_dispatch, t_fetch))
             s.req.phases.append(("readback", t_fetch, now))
+            if win.spec:
+                # accept accounting: a spec window emits accepted+1
+                # tokens per live row (the verify step that detects the
+                # first disagreement emits the target's own correction
+                # token). emitted == 0 means the row was dead at window
+                # entry — not a rejection, so it doesn't skew the
+                # histogram the autotuner steers by.
+                emitted = 0
+                for tok in row:
+                    if tok == PAD_TOKEN:
+                        break
+                    emitted += 1
+                if emitted > 0:
+                    accepted = emitted - 1
+                    self.spec_accepted_tokens += accepted
+                    self._m_spec_accept.observe(float(accepted))
+                    if accepted >= win.window - 1:
+                        outcome = "full"
+                    elif accepted > 0:
+                        outcome = "partial"
+                    else:
+                        outcome = "reject"
+                    self._m_spec_outcome[outcome].inc()
             for tok in row:
                 if tok == PAD_TOKEN:
                     break
@@ -1575,6 +1784,7 @@ class Batcher:
             submitted, rejected = self.submitted, self.rejected
             window_cap, prefill_chunk = self.window_cap, self.prefill_chunk
             max_active = self.max_active
+            spec_k = self.spec_k
         return {
             "replica": self.replica,
             "submitted": submitted,
@@ -1599,4 +1809,11 @@ class Batcher:
             "prefill_chunks_dispatched": self.prefill_chunks_dispatched,
             "prefix_resumed": self.prefix_resumed,
             "prefix_tokens_saved": self.prefix_tokens_saved,
+            "speculative": self.speculative,
+            "spec_ladder": list(self.spec_ladder),
+            "spec_k": spec_k,
+            "spec_windows_dispatched": dict(self.spec_windows_dispatched),
+            "spec_accepted_tokens": self.spec_accepted_tokens,
+            "draft_prefills_dispatched": self.draft_prefills_dispatched,
+            "draft_prefill_failures": self.draft_prefill_failures,
         }

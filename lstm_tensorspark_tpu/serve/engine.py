@@ -161,6 +161,12 @@ class DecodeWindow:
     # which resident model produced this window — decode_window_next
     # dispatches the follow-up against the same model's params
     model: str | None = None
+    # speculative verify window (spec_window): ``window`` is the verify
+    # length W = K_draft + 1 (max tokens one spec step can emit), and the
+    # follow-up dispatch goes through spec_window_next, never
+    # decode_window_next — the two programs carry different device state
+    # (the spec one also threads the draft model's carries)
+    spec: bool = False
 
 
 def _bucket_for(value: int, buckets: tuple[int, ...], what: str) -> int:
@@ -359,6 +365,19 @@ class ServeEngine:
         self._decode_fns: dict[tuple, callable] = {}
         self._decode_window_fns: dict[tuple, callable] = {}
         self._decode_window_pallas_fns: dict[tuple, callable] = {}
+        # ---- speculative decoding (draft model) ----------------------
+        # attach_draft installs a small distilled draft LM paired with
+        # the DEFAULT model; spec_window then verifies K_draft proposed
+        # tokens in one teacher-forced target pass. The draft's h/c live
+        # in their own arrays indexed by the SAME slot numbers as the
+        # state cache (never spilled through SessionTiers — draft state
+        # is acceptance-only, rebuilt from zero on restore).
+        self.draft: dict | None = None
+        self._draft_h = None
+        self._draft_c = None
+        self._draft_prefill_fns: dict[tuple, callable] = {}
+        self._spec_window_fns: dict[tuple, callable] = {}
+        self._spec_window_pallas_fns: dict[tuple, callable] = {}
         self._rng = jax.random.PRNGKey(rng_seed)
         self._dummy_rng = jax.random.PRNGKey(0)
         self._lock = threading.RLock()
@@ -378,7 +397,9 @@ class ServeEngine:
         self._m_compiles = {
             phase: fam.labels(phase=phase)
             for phase in ("prefill", "prefill_chunk", "decode",
-                          "decode_window", "decode_window_pallas")
+                          "decode_window", "decode_window_pallas",
+                          "spec_window", "spec_window_pallas",
+                          "draft_prefill")
         }
 
     # ---- limits --------------------------------------------------------
@@ -502,6 +523,70 @@ class ServeEngine:
             prefix.clear()  # takes the prefix cache's own lock
         with self._lock:
             self.cache.resize(num_slots)
+            if self.draft is not None:
+                # draft state is slot-indexed alongside the cache: resize
+                # reallocates it to the new slot count (zeros — legal,
+                # resize requires no resident sessions)
+                self._alloc_draft_state_locked()
+
+    # ---- speculative decoding: draft model ----------------------------
+
+    # ``self.draft`` follows the ``_residents`` wholesale-replace
+    # protocol above: attach_draft REPLACES the dict under _lock (never
+    # mutates it in place), so the lock-free probes below see either no
+    # draft or a whole one — and never block behind an in-flight
+    # (possibly wedged) dispatch holding _lock. The draft h/c arrays are
+    # NOT covered by this: they are swapped on every spec dispatch, so
+    # every ``_draft_h``/``_draft_c`` touch stays under _lock.
+
+    @property
+    def has_draft(self) -> bool:
+        return self.draft is not None  # graftlint: disable=cross-thread-state
+
+    def attach_draft(self, draft_params, draft_cfg: LMConfig, *,
+                     version: int | str = 0) -> None:
+        """Install the distilled draft LM paired with the DEFAULT model.
+        The draft proposes K_draft greedy tokens per :meth:`spec_window`
+        dispatch; the target verifies them all in one teacher-forced
+        pass, so greedy output stays token-identical by construction no
+        matter how bad the draft is — draft quality only moves the
+        acceptance rate. Single-device engines only: the draft cache and
+        the fused spec kernel are unsharded programs."""
+        if self.mesh_shards > 1:
+            raise ValueError(
+                "speculative decoding is not supported on a mesh "
+                f"({self.mesh_shards}-shard) engine — the draft cache and "
+                "the spec verify programs are single-device")
+        if draft_cfg.vocab_size != self.cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                f"{self.cfg.vocab_size} — proposals must share the "
+                "token space they are verified in")
+        if draft_cfg.remat_chunk is not None:
+            draft_cfg = dataclasses.replace(draft_cfg, remat_chunk=None)
+        with self._lock:
+            self.draft = {
+                "params": draft_params,
+                "fused": fuse_layers(draft_params, draft_cfg),
+                "cfg": draft_cfg,
+                "version": version,
+            }
+            self._alloc_draft_state_locked()
+
+    def _alloc_draft_state_locked(self) -> None:
+        """(Re)allocate the draft h/c arrays: ``[L_draft, num_slots + 1,
+        H_draft]`` f32, same slot indexing (scratch row included) as the
+        state cache. Zero state is always SAFE here — the draft never
+        affects emitted tokens, only how many of its proposals the
+        target accepts."""
+        dcfg = self.draft["cfg"]
+        total = int(self.cache.h.shape[1])
+        zeros = jnp.zeros((dcfg.num_layers, total, dcfg.hidden_size),
+                          jnp.float32)
+        if self.device is not None:
+            zeros = jax.device_put(zeros, self.device)
+        self._draft_h = zeros
+        self._draft_c = zeros
 
     # ---- compiled programs --------------------------------------------
 
@@ -524,7 +609,7 @@ class ServeEngine:
         return sub
 
     def _consume_prompt(self, h_cache, c_cache, params, src_slots, dst_slots,
-                        fresh, prompts, lengths, len_b):
+                        fresh, prompts, lengths, len_b, cfg=None):
         """Shared traced body of BOTH prefill programs: gather carries
         FROM src (a prefix-cache slot for resumed prefill, the session's
         own slot otherwise), consume the masked prompt tokens, and scatter
@@ -533,8 +618,11 @@ class ServeEngine:
         session's writes. Returns the updated cache arrays plus the
         per-position backbone outputs ``ys`` — the final program's head
         reads them; the chunk program drops them (XLA dead-code-eliminates
-        the head-side compute)."""
-        cfg = self.cfg
+        the head-side compute). ``cfg`` overrides the target config — the
+        draft-prefill program runs this same body over the DRAFT model's
+        arrays."""
+        if cfg is None:
+            cfg = self.cfg
         h_in = h_cache[:, src_slots, :]  # [L, B, H]
         c_in = c_cache[:, src_slots, :]
         # fresh rows start from zero state — no device-side slot
@@ -771,6 +859,223 @@ class ServeEngine:
         self._decode_window_pallas_fns[key] = fn
         return fn
 
+    def _get_draft_prefill_fn(self, batch_b: int, len_b: int):
+        """The draft model's prompt-consumption program: same masked
+        backbone body as ``prefill_chunk`` but over the DRAFT params and
+        the draft h/c arrays — no head, no sampling (the draft only
+        proposes during decode). One compile per ``("draft_prefill",
+        batch-bucket, length-bucket)``; the batcher mirrors every target
+        prefill dispatch (chunk and final alike) with one of these, so
+        the length lattice is exactly the target's."""
+        key = (batch_b, len_b)
+        fn = self._draft_prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        with self._lock:  # reentrant: the dispatch path already holds it
+            dcfg = self.draft["cfg"]
+        count_key = ("draft_prefill", batch_b, len_b)
+
+        def draft_fn(dparams, dh, dc, src_slots, dst_slots, fresh,
+                     prompts, lengths):
+            with self._counts_lock:
+                self.compile_counts[count_key] += 1
+            self._m_compiles["draft_prefill"].inc()
+            dh, dc, _ = self._consume_prompt(
+                dh, dc, dparams, src_slots, dst_slots, fresh,
+                prompts, lengths, len_b, cfg=dcfg)
+            return dh, dc
+
+        fn = jax.jit(draft_fn)
+        self._draft_prefill_fns[key] = fn
+        return fn
+
+    def _get_spec_window_fn(self, batch_b: int, k_draft: int):
+        """The speculative verify window (scan form), greedy-only. ONE
+        program does both phases:
+
+        1. **Propose** — the draft decodes ``k_draft`` greedy tokens from
+           its slot state (a plain K-step scan; its propose-time carries
+           are DISCARDED).
+        2. **Verify** — ``W = k_draft + 1`` joint steps. Step ``i`` feeds
+           the target the (i-1)-th proposal (step 0 feeds the last
+           committed token) and takes the target's argmax ``t`` as the
+           emitted token; the row keeps emitting only while the NEXT
+           proposal agrees with ``t`` (sentinel -2 at the last step never
+           agrees). The step that detects the disagreement still emits
+           its own ``t`` — that is the correction token — so every spec
+           step with a live row emits >= 1 token and the emitted
+           sequence is EXACTLY the plain greedy sequence (the target
+           carries latch on the same ``emit`` mask as the plain window,
+           so after m emissions the committed state consumed exactly the
+           plain window's inputs). The draft runs alongside
+           teacher-forced on the same inputs with the same latch, which
+           IS its state commit — rejected proposals beyond the accepted
+           prefix roll back for free because neither model's carry ever
+           latched past the last emission (the O(1)-rollback property).
+
+        A draft disagreement ends the WINDOW, not the session: the
+        returned ``alive`` handle is the session latch (EOS/budget only),
+        so the batcher's liveness authority keeps its plain-window
+        meaning."""
+        key = (batch_b, k_draft)
+        fn = self._spec_window_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        with self._lock:  # reentrant: the dispatch path already holds it
+            dcfg = self.draft["cfg"]
+        count_key = ("spec_window", batch_b, k_draft)
+
+        def spec_fn(params, fused, dparams, dfused, h_cache, c_cache,
+                    dh_cache, dc_cache, slots, tokens, alive, remaining,
+                    eos_ids):
+            with self._counts_lock:
+                self.compile_counts[count_key] += 1
+            self._m_compiles["spec_window"].inc()
+            h_in = h_cache[:, slots, :]
+            c_in = c_cache[:, slots, :]
+            carries = [(h_in[l], c_in[l]) for l in range(cfg.num_layers)]
+            dh_in = dh_cache[:, slots, :]
+            dc_in = dc_cache[:, slots, :]
+            dcarries = [(dh_in[l], dc_in[l])
+                        for l in range(dcfg.num_layers)]
+
+            def propose(carry, _):
+                dcar, tok = carry
+                logits, ndc = decode_one(dparams, dfused, dcfg, dcar, tok)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (ndc, nxt), nxt
+
+            (_, _), props = lax.scan(propose, (dcarries, tokens), None,
+                                     length=k_draft)  # [K, B]
+            # verify inputs: step 0 re-feeds the last committed token,
+            # steps 1..K feed the proposals; the "next proposal" stream
+            # ends in a sentinel no argmax can equal, so the last step
+            # always closes the window
+            inputs = jnp.concatenate([tokens[None, :], props], axis=0)
+            next_prop = jnp.concatenate(
+                [props, jnp.full((1, batch_b), -2, jnp.int32)], axis=0)
+
+            def verify(carry, xs):
+                (tcar, dcar, alive_w, sess_alive, rem, final_tok) = carry
+                inp, nprop = xs
+                logits, ntc = decode_one(params, fused, cfg, tcar, inp)
+                _, ndc = decode_one(dparams, dfused, dcfg, dcar, inp)
+                t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                emit = alive_w
+                out_tok = jnp.where(emit, t, PAD_TOKEN).astype(jnp.int32)
+                new_rem = rem - emit.astype(rem.dtype)
+                hit_eos = emit & (eos_ids >= 0) & (t == eos_ids)
+                live_on = ~hit_eos & (new_rem > 0)
+                # the session latch (plain-window rule) and the window
+                # latch (additionally needs the next proposal to agree)
+                # MUST be separate: a mismatch stops emission, not the
+                # conversation
+                new_sess = jnp.where(emit, live_on, sess_alive)
+                new_alive_w = emit & live_on & (nprop == t)
+                t_frozen = [
+                    (jnp.where(emit[:, None], hn, ho),
+                     jnp.where(emit[:, None], cn, co))
+                    for (ho, co), (hn, cn) in zip(tcar, ntc)
+                ]
+                d_frozen = [
+                    (jnp.where(emit[:, None], hn, ho),
+                     jnp.where(emit[:, None], cn, co))
+                    for (ho, co), (hn, cn) in zip(dcar, ndc)
+                ]
+                new_final = jnp.where(emit, t, final_tok).astype(jnp.int32)
+                return (t_frozen, d_frozen, new_alive_w, new_sess,
+                        new_rem, new_final), out_tok
+
+            init = (carries, dcarries, alive, alive, remaining, tokens)
+            (tcar, dcar, _aw, sess_alive, rem_out, final_tok), toks = (
+                lax.scan(verify, init, (inputs, next_prop)))
+            # next window's input is the LAST EMITTED token (dead rows
+            # feed 0 — value never used, but PAD must not hit the
+            # embedding)
+            next_tok = jnp.where(sess_alive, final_tok, 0).astype(jnp.int32)
+            new_h = jnp.stack([nc[0] for nc in tcar])
+            new_c = jnp.stack([nc[1] for nc in tcar])
+            h_cache = h_cache.at[:, slots, :].set(new_h.astype(jnp.float32))
+            c_cache = c_cache.at[:, slots, :].set(new_c.astype(jnp.float32))
+            dnew_h = jnp.stack([nc[0] for nc in dcar])
+            dnew_c = jnp.stack([nc[1] for nc in dcar])
+            dh_cache = dh_cache.at[:, slots, :].set(
+                dnew_h.astype(jnp.float32))
+            dc_cache = dc_cache.at[:, slots, :].set(
+                dnew_c.astype(jnp.float32))
+            toks = jnp.moveaxis(toks, 0, 1)  # [W, B] → [B, W]
+            return (h_cache, c_cache, dh_cache, dc_cache, toks, next_tok,
+                    sess_alive, rem_out)
+
+        fn = jax.jit(spec_fn)
+        self._spec_window_fns[key] = fn
+        return fn
+
+    def _get_spec_window_pallas_fn(self, batch_b: int, k_draft: int):
+        """The fused Pallas spec window (ops/pallas_decode.py): identical
+        host-facing contract to the scan spec fn — same handles, same
+        latch algebra — with both models' weights and carries VMEM-
+        resident for the whole propose+verify pass. Compile-key family
+        ``("spec_window_pallas", bucket, K_draft)``."""
+        key = (batch_b, k_draft)
+        fn = self._spec_window_pallas_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        with self._lock:  # reentrant: the dispatch path already holds it
+            dcfg = self.draft["cfg"]
+        count_key = ("spec_window_pallas", batch_b, k_draft)
+        interpret = self._pallas_interpret
+
+        def spec_fn(params, fused, dparams, dfused, h_cache, c_cache,
+                    dh_cache, dc_cache, slots, tokens, alive, remaining,
+                    eos_ids):
+            with self._counts_lock:
+                self.compile_counts[count_key] += 1
+            self._m_compiles["spec_window_pallas"].inc()
+            h_in = h_cache[:, slots, :]
+            c_in = c_cache[:, slots, :]
+            dh_in = dh_cache[:, slots, :]
+            dc_in = dc_cache[:, slots, :]
+            (h_out, c_out, dh_out, dc_out, toks, next_tok, sess_alive,
+             rem_out) = pallas_decode.spec_window_call(
+                params, fused, cfg, dparams, dfused, dcfg,
+                h_in, c_in, dh_in, dc_in, tokens, alive, remaining,
+                eos_ids, k_draft=k_draft, interpret=interpret)
+            h_cache = h_cache.at[:, slots, :].set(h_out)
+            c_cache = c_cache.at[:, slots, :].set(c_out)
+            dh_cache = dh_cache.at[:, slots, :].set(dh_out)
+            dc_cache = dc_cache.at[:, slots, :].set(dc_out)
+            toks = jnp.moveaxis(toks, 0, 1)  # [W, B] → [B, W]
+            return (h_cache, c_cache, dh_cache, dc_cache, toks, next_tok,
+                    sess_alive, rem_out)
+
+        fn = jax.jit(spec_fn)
+        self._spec_window_pallas_fns[key] = fn
+        return fn
+
+    def _spec_pallas_ok(self, batch_b: int, k_draft: int) -> bool:
+        cfg = self.cfg
+        with self._lock:  # reentrant: the dispatch path already holds it
+            dcfg = self.draft["cfg"]
+        return pallas_decode.spec_plan_fits(
+            batch_b, k_draft, cfg.num_layers, cfg.hidden_size, cfg.embed,
+            cfg.vocab_size, dcfg.num_layers, dcfg.hidden_size, dcfg.embed)
+
+    def _spec_window_fn_for(self, batch_b: int, k_draft: int):
+        """Spec-window program pick, same policy as ``_window_fn_for``:
+        fused Pallas when selected AND the joint (target + draft) VMEM
+        plan fits, scan otherwise — fallbacks counted in the same
+        ``decode_window_scan_fallbacks`` (a silently-switched kernel
+        would fake the measured speedup)."""
+        if self.decode_kernel == "pallas":
+            if self._spec_pallas_ok(batch_b, k_draft):
+                return self._get_spec_window_pallas_fn(batch_b, k_draft)
+            with self._counts_lock:
+                self.decode_window_scan_fallbacks += 1
+        return self._get_spec_window_fn(batch_b, k_draft)
+
     def _pallas_window_ok(self, batch_b: int, window: int,
                           sampling: SamplingParams) -> bool:
         cfg = self.cfg
@@ -894,6 +1199,31 @@ class ServeEngine:
                       jnp.asarray(prompts), jnp.asarray(lens))
             self.cache.swap(h, c)
 
+    def draft_prefill(self, items) -> None:
+        """Advance the DRAFT model's slot state over prompt fragments —
+        the batcher mirrors every target prefill dispatch (chunk and
+        final) with one of these so the draft's h/c track the session's
+        consumed context. ``items`` are ``(slot, fresh, fragment)``
+        triples; ``fresh`` starts the draft from zero (a session's first
+        fragment — including prefix-resumed rows, which the draft cannot
+        resume: it has no prefix entries, so it rebuilds from zero at
+        the offset, losslessly trading acceptance rate). Async dispatch,
+        nothing returned."""
+        if self.draft is None:  # graftlint: disable=cross-thread-state
+            raise ValueError("draft_prefill needs an attached draft "
+                             "(attach_draft)")
+        if len(items) == 0:
+            return
+        src, dst, fresh, prompts, lens, _, batch_b, len_b = (
+            self._pack_prefill(self._norm_prefill_items(items)))
+        with self._lock:
+            fn = self._get_draft_prefill_fn(batch_b, len_b)
+            dh, dc = fn(self.draft["params"], self._draft_h, self._draft_c,
+                        jnp.asarray(src), jnp.asarray(dst),
+                        jnp.asarray(fresh), jnp.asarray(prompts),
+                        jnp.asarray(lens))
+            self._draft_h, self._draft_c = dh, dc
+
     def decode(self, slots, tokens, sampling: SamplingParams = GREEDY, *,
                model: str | None = None) -> np.ndarray:
         """Advance each session one token: gather carries by ``slots`` [B],
@@ -1008,6 +1338,99 @@ class ServeEngine:
             remaining=rem, window=window, t_dispatch=time.perf_counter(),
         )
 
+    def spec_window(self, slots, tokens, remaining, eos_ids=None, *,
+                    k_draft: int, model: str | None = None) -> DecodeWindow:
+        """Dispatch one speculative step: the draft proposes ``k_draft``
+        tokens, the target verifies them all in ONE teacher-forced pass
+        of ``W = k_draft + 1`` joint steps, and the longest agreeing
+        prefix plus the target's own correction token emit (1..W tokens
+        per live row — see ``_get_spec_window_fn`` for the latch
+        algebra). Greedy-only (speculation never changes the sampled
+        distribution here because only greedy verification is
+        implemented); the emitted tokens are token-identical to plain
+        greedy decode by construction. Returns a :class:`DecodeWindow`
+        with ``spec=True`` and ``window = k_draft + 1`` — fetch with the
+        same ``fetch_window_summary``; chain with
+        :meth:`spec_window_next`."""
+        n = len(slots)
+        if self.draft is None:  # graftlint: disable=cross-thread-state
+            raise ValueError("spec_window needs an attached draft "
+                             "(attach_draft)")
+        if n == 0 or k_draft < 1:
+            raise ValueError(f"spec_window needs rows and k_draft >= 1, "
+                             f"got {n} rows, k_draft {k_draft}")
+        if not self._warming:
+            _faults.serve_decode_hook()
+        batch_b = _bucket_for(n, self.batch_buckets, "decode batch")
+        slots_p = np.full((batch_b,), self.cache.scratch_slot, np.int32)
+        slots_p[:n] = np.asarray(slots, np.int32)
+        tokens_p = np.zeros((batch_b,), np.int32)
+        tokens_p[:n] = np.asarray(tokens, np.int32)
+        rem_p = np.zeros((batch_b,), np.int32)
+        rem_p[:n] = np.asarray(remaining, np.int32)
+        eos_p = np.full((batch_b,), -1, np.int32)
+        if eos_ids is not None:
+            eos_p[:n] = np.asarray(eos_ids, np.int32)
+        alive_p = np.zeros((batch_b,), bool)
+        alive_p[:n] = rem_p[:n] > 0
+
+        with self._lock:
+            mid, params, fused, _ = self._resolve_model(model)
+            if mid != self.model_id:
+                raise ValueError(
+                    f"spec_window serves the DEFAULT model only (the "
+                    f"draft is distilled against it); got model {mid!r}")
+            fn = self._spec_window_fn_for(batch_b, k_draft)
+            slots_d = jnp.asarray(slots_p)
+            eos_d = jnp.asarray(eos_p)
+            h, c, dh, dc, toks, next_tok, alive, rem = fn(
+                params, fused, self.draft["params"], self.draft["fused"],
+                self.cache.h, self.cache.c, self._draft_h, self._draft_c,
+                slots_d, jnp.asarray(tokens_p), jnp.asarray(alive_p),
+                jnp.asarray(rem_p), eos_d,
+            )
+            self.cache.swap(h, c)
+            self._draft_h, self._draft_c = dh, dc
+        return DecodeWindow(
+            tokens=toks, next_tokens=next_tok, alive=alive, remaining=rem,
+            slots=slots_d, eos_ids=eos_d, batch_b=batch_b,
+            window=k_draft + 1, n=n, sampling=GREEDY,
+            t_dispatch=time.perf_counter(), model=mid, spec=True,
+        )
+
+    def spec_window_next(self, prev: DecodeWindow, *,
+                         k_draft: int | None = None) -> DecodeWindow:
+        """Dispatch the follow-up speculative step for the SAME packed
+        rows from ``prev``'s device handles — the spec half of the
+        dispatch-ahead pipeline (``prev.next_tokens`` is the last
+        EMITTED token per row, so the successor's step 0 re-verifies
+        from exactly the committed state). ``k_draft`` may differ from
+        ``prev``'s (the autotuner's knob moves between windows)."""
+        if not prev.spec:
+            raise ValueError("spec_window_next needs a spec DecodeWindow")
+        if self.draft is None:  # graftlint: disable=cross-thread-state
+            raise ValueError("spec_window_next needs an attached draft")
+        k = (prev.window - 1) if k_draft is None else k_draft
+        if k < 1:
+            raise ValueError(f"k_draft must be >= 1, got {k}")
+        if not self._warming:
+            _faults.serve_decode_hook()
+        with self._lock:
+            _, params, fused, _ = self._resolve_model(prev.model)
+            fn = self._spec_window_fn_for(prev.batch_b, k)
+            h, c, dh, dc, toks, next_tok, alive, rem = fn(
+                params, fused, self.draft["params"], self.draft["fused"],
+                self.cache.h, self.cache.c, self._draft_h, self._draft_c,
+                prev.slots, prev.next_tokens, prev.alive, prev.remaining,
+                prev.eos_ids,
+            )
+            self.cache.swap(h, c)
+            self._draft_h, self._draft_c = dh, dc
+        return dataclasses.replace(
+            prev, tokens=toks, next_tokens=next_tok, alive=alive,
+            remaining=rem, window=k + 1, t_dispatch=time.perf_counter(),
+        )
+
     @staticmethod
     def fetch_window(win: DecodeWindow) -> np.ndarray:
         """Block until the window's tokens are on host; returns ``[n, K]``
@@ -1036,7 +1459,8 @@ class ServeEngine:
                batch_sizes: tuple[int, ...] | None = None,
                windows: tuple[int, ...] = (),
                chunk_lens: tuple[int, ...] = (),
-               models: tuple[str, ...] | None = None) -> int:
+               models: tuple[str, ...] | None = None,
+               spec_windows: tuple[int, ...] = ()) -> int:
         """Pre-compile the bucket lattice a workload will touch (every
         batch bucket x the length buckets covering ``prompt_lens``, both
         phases, plus a ``decode_window`` program per batch bucket x each
@@ -1090,6 +1514,26 @@ class ServeEngine:
                             sampling=sampling, window=k, model=mid,
                         )
                         self.fetch_window(win)
+                    if (self.draft is not None  # graftlint: disable=cross-thread-state
+                            and mid == self.model_id):
+                        # the speculative plane's whole program lattice:
+                        # a draft_prefill per length bucket the batcher
+                        # can mirror (finals AND chunks — it mirrors
+                        # both), and a spec_window per warmed K_draft
+                        # rung, so the autotuner moving spec_k among
+                        # warmed rungs never costs a mid-traffic compile
+                        for t in sorted({*len_buckets, *chunk_buckets}):
+                            items = [(scratch, True,
+                                      np.zeros((t,), np.int32))] * bb
+                            self.draft_prefill(items)
+                        for k in sorted(set(spec_windows)):
+                            if k < 1:
+                                continue  # rung 0 = plain decode
+                            win = self.spec_window(
+                                [scratch] * bb, [0] * bb, [k + 1] * bb,
+                                k_draft=k,
+                            )
+                            self.fetch_window(win)
             if self.tiers is not None:
                 # the tier-fill scatter lattice is warmup-covered like
                 # every other program family: a continuation burst must
@@ -1099,7 +1543,9 @@ class ServeEngine:
             self._warming = False
         return (len(self._prefill_fns) + len(self._prefill_chunk_fns)
                 + len(self._decode_fns) + len(self._decode_window_fns)
-                + len(self._decode_window_pallas_fns))
+                + len(self._decode_window_pallas_fns)
+                + len(self._draft_prefill_fns) + len(self._spec_window_fns)
+                + len(self._spec_window_pallas_fns))
 
     # ---- session lifecycle (thin wrappers over the cache) -------------
 
@@ -1133,11 +1579,17 @@ class ServeEngine:
         with self._counts_lock:
             compiles = dict(self.compile_counts)
             fallbacks = self.decode_window_scan_fallbacks
+        draft = self.draft  # graftlint: disable=cross-thread-state
         return {
             "decode_kernel": self.decode_kernel,
             "mesh_shards": self.mesh_shards,
             "model_id": self.model_id,
             "models": self.resident_models(),
+            "draft": None if draft is None else {
+                "hidden_size": draft["cfg"].hidden_size,
+                "num_layers": draft["cfg"].num_layers,
+                "version": draft["version"],
+            },
             "decode_window_scan_fallbacks": fallbacks,
             "cache": self.cache.stats(),
             "prefix_cache": None if self.prefix is None else self.prefix.stats(),

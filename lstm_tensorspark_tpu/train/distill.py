@@ -1,0 +1,227 @@
+"""Draft-model distillation for speculative decoding (serve/ PR 18).
+
+The serve plane's speculative decoder (serve/engine.py ``spec_window``)
+needs a DRAFT LM that imitates the target's next-token behaviour at a
+fraction of its step cost. This module trains one: a small LM (default
+H/4, 1 layer, shared vocab — ``draft_config``) fit to the TARGET's
+logits over a corpus with a KL+CE mixed loss, driven through the
+existing train plane (``train/loop.py`` step/loop + ``make_optimizer``
+— nothing speculative about the optimization itself).
+
+The teacher's logits come from a batched SCORING pass
+(``make_teacher_fn``): one jitted forward over each [B, T] window,
+re-used across epochs is deliberately NOT done — the stream is
+contiguous and the logits array is B*T*V floats, so holding an epoch of
+them would dwarf the draft's own footprint. The map/reduce framing is
+the paper's Spark lineage: score a partition, learn from it, move on.
+
+Artifacts publish through the PR 16 model registry as a VERIFIED PAIR:
+the draft's record carries ``config_hash`` = fingerprint of the draft's
+own config and ``parent`` = ``"<teacher_id>:<teacher config hash>"``.
+``load_draft`` re-derives the draft config from the teacher's
+(``draft_config`` is deterministic) and refuses artifacts whose hashes
+disagree — serve never pairs a draft with a teacher it was not
+distilled from (the "version skew" runbook row, speculative edition).
+
+Greedy speculative decode is token-identical to plain decode REGARDLESS
+of draft quality (the target verifies every token); distillation only
+buys acceptance length. So a bad draft is a PERFORMANCE bug, and this
+module's only correctness duty is the pairing check above.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..models import LMConfig, init_lm, lm_forward
+from ..serve.registry import ModelRegistry, config_fingerprint
+from .loop import init_train_state, make_train_step, train_loop
+from .optimizer import make_optimizer
+
+#: the default draft shape relative to the teacher — ONE definition,
+#: shared by `cli distill` and serve's `load_draft` derivation
+DRAFT_HIDDEN_DIV = 4
+DRAFT_NUM_LAYERS = 1
+
+
+def draft_config(teacher_cfg: LMConfig, *,
+                 hidden_div: int = DRAFT_HIDDEN_DIV,
+                 num_layers: int = DRAFT_NUM_LAYERS) -> LMConfig:
+    """The draft LM's config, derived DETERMINISTICALLY from the
+    teacher's: shared vocab (proposals must be teacher tokens), hidden
+    size divided by ``hidden_div`` (floored at 8 — below that the LSTM
+    cannot even capture bigram structure), ``num_layers`` layers. The
+    derivation is the pairing contract: serve re-derives this config
+    from its resident teacher and verifies the published draft's
+    ``config_hash`` against it, so the two sides agree on the
+    architecture without shipping a config blob."""
+    if hidden_div < 1:
+        raise ValueError(f"hidden_div must be >= 1, got {hidden_div}")
+    return LMConfig(
+        vocab_size=teacher_cfg.vocab_size,
+        hidden_size=max(8, teacher_cfg.hidden_size // hidden_div),
+        num_layers=num_layers,
+        tie_embeddings=teacher_cfg.tie_embeddings,
+        compute_dtype=teacher_cfg.compute_dtype,
+    )
+
+
+def make_teacher_fn(teacher_params, teacher_cfg: LMConfig):
+    """Jitted batched scoring pass: inputs [B, T] int32 → the teacher's
+    logits [B, T, V] float32 (stop-gradient by construction — the
+    teacher is data here, not a trainable)."""
+    # strip training-only knobs: scoring is a plain forward, and e.g. a
+    # teacher remat_chunk would just slow it down
+    cfg = dataclasses.replace(teacher_cfg, dropout=0.0, remat_chunk=None)
+
+    @jax.jit
+    def score(inputs):
+        logits, _ = lm_forward(teacher_params, inputs, cfg,
+                               deterministic=True)
+        return logits.astype(jnp.float32)
+
+    return score
+
+
+def make_distill_loss(cfg: LMConfig, *, alpha: float = 0.5,
+                      temperature: float = 2.0):
+    """KL+CE mixed distillation loss for ``make_train_step``:
+    ``loss_fn(params, batch, rng) -> (loss, aux)`` over batches with
+    ``inputs``/``targets`` [B, T] and ``teacher_logits`` [B, T, V].
+
+    ``alpha`` weights the KL(teacher ‖ student) term at softmax
+    temperature ``temperature`` (scaled by temperature² so the gradient
+    magnitude is temperature-invariant — the standard Hinton scaling);
+    ``1 - alpha`` weights the hard-label cross-entropy. ``alpha=1`` is
+    pure imitation, ``alpha=0`` plain LM training on the same stream."""
+    if not 0.0 <= alpha <= 1.0:
+        raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+    if temperature <= 0.0:
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+    tau = float(temperature)
+
+    def loss_fn(params, batch, dropout_rng):
+        logits, _ = lm_forward(
+            params, batch["inputs"], cfg, dropout_rng=dropout_rng,
+            deterministic=dropout_rng is None,
+        )
+        logits = logits.astype(jnp.float32)
+        # soft target: KL(teacher ‖ student) at temperature tau
+        t_logp = jax.nn.log_softmax(batch["teacher_logits"] / tau, axis=-1)
+        s_logp = jax.nn.log_softmax(logits / tau, axis=-1)
+        kl = jnp.mean(jnp.sum(jnp.exp(t_logp) * (t_logp - s_logp),
+                              axis=-1)) * tau * tau
+        # hard target: next-token NLL on the corpus labels
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, batch["targets"][..., None].astype(jnp.int32), axis=-1,
+        )[..., 0]
+        ce = jnp.mean(nll)
+        loss = alpha * kl + (1.0 - alpha) * ce
+        aux = {"loss": loss, "kl": kl, "ce": ce,
+               "tokens": batch["targets"].size}
+        return loss, aux
+
+    return loss_fn
+
+
+def distill_batches(batches, teacher_fn):
+    """Wrap an ``{"inputs", "targets"}`` batch stream with the
+    teacher's logits, computed per window by the jitted scoring pass —
+    the batched logit-harvest leg of the distillation loop (epoch-sized
+    logit caches would be B*T*V floats per window; see module
+    docstring)."""
+    for batch in batches:
+        out = dict(batch)
+        out["teacher_logits"] = teacher_fn(batch["inputs"])
+        yield out
+
+
+def distill(teacher_params, teacher_cfg: LMConfig, batches, *,
+            num_steps: int, hidden_div: int = DRAFT_HIDDEN_DIV,
+            num_layers: int = DRAFT_NUM_LAYERS, alpha: float = 0.5,
+            temperature: float = 2.0, optimizer: str = "adam",
+            learning_rate: float = 1e-3, seed: int = 0,
+            log_every: int = 50, logger=None):
+    """Train a draft against ``teacher_params`` over an
+    ``{"inputs", "targets"}`` batch stream. Returns
+    ``(draft_params, draft_cfg)`` with params on host (ready to publish
+    or attach)."""
+    dcfg = draft_config(teacher_cfg, hidden_div=hidden_div,
+                        num_layers=num_layers)
+    params = init_lm(jax.random.PRNGKey(seed), dcfg)
+    opt = make_optimizer(optimizer, learning_rate)
+    state = init_train_state(params, opt, jax.random.PRNGKey(seed + 1))
+    step = make_train_step(
+        make_distill_loss(dcfg, alpha=alpha, temperature=temperature), opt)
+    teacher_fn = make_teacher_fn(teacher_params, teacher_cfg)
+    state = train_loop(
+        state, step, distill_batches(batches, teacher_fn),
+        num_steps=num_steps, log_every=log_every, logger=logger,
+    )
+    return jax.device_get(state.params), dcfg
+
+
+# ---- registry pairing ----------------------------------------------------
+
+
+def draft_model_id(teacher_id: str) -> str:
+    """The registry id a teacher's draft publishes under by default."""
+    return f"{teacher_id}-draft"
+
+
+def publish_draft(registry, draft_params, draft_cfg: LMConfig,
+                  teacher_cfg: LMConfig, *, teacher_id: str = "default",
+                  draft_id: str | None = None,
+                  version: int | None = None) -> dict:
+    """Publish a distilled draft as the VERIFIED PAIR record (module
+    docstring): ``config_hash`` fingerprints the draft's own config,
+    ``parent`` names the teacher id and its config fingerprint.
+    ``registry`` is a :class:`ModelRegistry` or a directory path."""
+    from flax import serialization
+
+    if isinstance(registry, str):
+        registry = ModelRegistry(registry)
+    return registry.publish(
+        draft_id or draft_model_id(teacher_id),
+        serialization.to_bytes(draft_params),
+        version=version,
+        config_hash=config_fingerprint(draft_cfg),
+        parent=f"{teacher_id}:{config_fingerprint(teacher_cfg)}",
+    )
+
+
+def load_draft(registry, teacher_cfg: LMConfig, *,
+               teacher_id: str = "default", draft_id: str | None = None,
+               version: int | None = None):
+    """Load a published draft for serving, verifying the pair: the
+    draft config is RE-DERIVED from ``teacher_cfg`` (``draft_config``)
+    and the artifact's ``config_hash`` must match it; the record's
+    ``parent`` teacher fingerprint must match ``teacher_cfg``. Returns
+    ``(meta, draft_params, draft_cfg)``; raises ``ValueError`` on any
+    mismatch (serving an unpaired draft only costs acceptance, but a
+    silent pairing bug would make every acceptance histogram a lie)."""
+    if isinstance(registry, str):
+        registry = ModelRegistry(registry)
+    mid = draft_id or draft_model_id(teacher_id)
+    dcfg = draft_config(teacher_cfg)
+    want_hash = config_fingerprint(dcfg)
+    meta = registry.meta(mid, version)
+    if meta.get("config_hash") != want_hash:
+        raise ValueError(
+            f"draft {mid} v{meta['version']}: config_hash "
+            f"{meta.get('config_hash')!r} does not match the derived "
+            f"draft config {want_hash!r} (distilled for a different "
+            "teacher shape, or with non-default draft dimensions)")
+    want_parent = f"{teacher_id}:{config_fingerprint(teacher_cfg)}"
+    if meta.get("parent") != want_parent:
+        raise ValueError(
+            f"draft {mid} v{meta['version']}: parent "
+            f"{meta.get('parent')!r} does not match the serving teacher "
+            f"{want_parent!r} — refusing the unverified pair")
+    template = init_lm(jax.random.PRNGKey(0), dcfg)
+    meta, params = registry.load_params(mid, template, meta["version"])
+    return meta, jax.device_get(params), dcfg

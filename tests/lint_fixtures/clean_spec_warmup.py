@@ -1,0 +1,39 @@
+"""graftlint fixture: clean twin of viol_spec_warmup — warmup() reaches
+the window dispatcher that covers BOTH the plain decode family and the
+("spec_window", ...) verify family over every spec-ladder rung, so a
+`--speculative` boot has its joint draft+verify programs compiled
+before the first drafted step."""
+
+
+class MiniEngine:
+    def __init__(self, speculative=False, spec_ladder=(2, 4)):
+        self.speculative = speculative
+        self.spec_ladder = spec_ladder
+        self.compile_counts = {}
+        self._fns = {}
+
+    def _get_window_fn(self, bucket, k):
+        count_key = ("decode_window", bucket, k)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def _get_spec_window_fn(self, bucket, k_draft):
+        count_key = ("spec_window", bucket, k_draft)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def decode_window(self, tokens, k):
+        if self.speculative and k in self.spec_ladder:
+            return self._get_spec_window_fn(len(tokens), k)(tokens)
+        return self._get_window_fn(len(tokens), k)(tokens)
+
+    def warmup(self):
+        # warms through the dispatcher at every ladder rung plus the
+        # plain window: every family a real dispatch can reach is
+        # reachable from here, speculative or not
+        out = self.decode_window([0], 1)
+        for k in self.spec_ladder:
+            out = self.decode_window([0], k)
+        return out

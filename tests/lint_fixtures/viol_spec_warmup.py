@@ -1,0 +1,36 @@
+"""graftlint fixture: warmup-coverage true positive for the speculative
+verify-window family — the engine grows a ("spec_window", ...) compile
+family next to the plain decode window's, but warmup() only dispatches
+the plain path: the first speculative step after `--speculative` boots
+pays the joint draft+verify program's XLA compile mid-traffic, exactly
+the latency spike speculation exists to avoid."""
+
+
+class MiniEngine:
+    def __init__(self, speculative=False, spec_ladder=(2, 4)):
+        self.speculative = speculative
+        self.spec_ladder = spec_ladder
+        self.compile_counts = {}
+        self._fns = {}
+
+    def _get_window_fn(self, bucket, k):
+        count_key = ("decode_window", bucket, k)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def _get_spec_window_fn(self, bucket, k_draft):
+        count_key = ("spec_window", bucket, k_draft)
+        self.compile_counts[count_key] = (
+            self.compile_counts.get(count_key, 0) + 1)
+        return self._fns.setdefault(count_key, lambda t: t)
+
+    def decode_window(self, tokens, k):
+        if self.speculative and k in self.spec_ladder:
+            return self._get_spec_window_fn(len(tokens), k)(tokens)
+        return self._get_window_fn(len(tokens), k)(tokens)
+
+    def warmup(self):
+        # only the plain family: a speculative engine compiles its
+        # verify windows mid-traffic on the first drafted step
+        return self._get_window_fn(1, 4)([0])

@@ -42,6 +42,7 @@ VIOLATIONS = {
     "viol_warmup_pallas": "warmup-coverage",
     "viol_warmup_mesh": "warmup-coverage",
     "viol_warmup_train": "warmup-coverage",
+    "viol_spec_warmup": "warmup-coverage",
     "viol_lock_abba": "lock-order",
     "viol_lock_listener": "lock-order",
     "viol_warmup": "warmup-coverage",
@@ -71,6 +72,7 @@ CLEAN_TWINS = {
     "clean_warmup_pallas": "warmup-coverage",
     "clean_warmup_mesh": "warmup-coverage",
     "clean_warmup_train": "warmup-coverage",
+    "clean_spec_warmup": "warmup-coverage",
     "clean_lock_order": "lock-order",
     "clean_lock_shared_rlock": "lock-order",
     "clean_warmup": "warmup-coverage",

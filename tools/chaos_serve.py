@@ -796,14 +796,22 @@ def _phase_burst_shed(params, seed, slo_ms, failures):
     res["fifo"] = _burst(params, rate=rate, seed=seed + 1, slo_aware=False)
     pr = res["slo_aware"]["classes"]["priority"]
     be = res["slo_aware"]["classes"]["best_effort"]
-    if be["shed"] < 1:
+    # "policy engaged" / "bound not inverted" read the ROUTER's per-class
+    # shed counts (requests 429'd at admission), not the loadgen's gave-up
+    # counter: whether a shed request's retries eventually land depends on
+    # how fast the burst drains — a drain race on the calibrated rate —
+    # while the admission bound rejecting best-effort (and only
+    # best-effort) under a 4x burst is structural.
+    ra = res["slo_aware"]["router"].get("shed_by_class", {})
+    if ra.get("best_effort", 0) < 1:
         failures.append(
             "burst_shed: a 4x burst shed ZERO best-effort requests — "
             "the SLO-aware policy never engaged")
-    if pr["shed"] > 0:
+    if ra.get("priority", 0) > 0:
         failures.append(
-            f"burst_shed: {pr['shed']} PRIORITY requests shed while "
-            "best-effort headroom existed — the class bound is inverted")
+            f"burst_shed: {ra.get('priority')} PRIORITY requests shed "
+            "while best-effort headroom existed — the class bound is "
+            "inverted")
     p99 = pr["p99_ttft_ms"]
     res["priority_p99_ttft_ms"] = p99
     res["best_effort_p99_ttft_ms"] = be["p99_ttft_ms"]
@@ -811,7 +819,6 @@ def _phase_burst_shed(params, seed, slo_ms, failures):
         failures.append(
             f"burst_shed: priority p99 TTFT {p99} ms missed the "
             f"{slo_ms} ms SLO under the 4x burst")
-    ra = res["slo_aware"]["router"].get("shed_by_class", {})
     res["retry_after_honored"] = (
         be["retried"] >= 1 and ra.get("best_effort", 0) >= 1)
     if be["retried"] < 1:

@@ -34,6 +34,14 @@ XLA_FLAGS=--xla_force_host_platform_device_count — sharding must not
 change a single token, and /metrics keeps its replica-labelled
 families).
 
+Then the SPECULATIVE boot (PR 18): a tiny random-init draft is
+published as the verified (config-hash + parent-fingerprint) pair into
+a fresh registry, the server boots `--speculative`, and the greedy
+reply must be token-identical to the main boot — lossless by
+construction even with an undistilled draft — with at least one spec
+window actually dispatched (so parity can't pass with speculation
+inert).
+
 Run by tools/verify.sh after the tier-1 gate. CPU, tiny model, pinned
 --decode-window 1 and two prefill buckets to keep the warmup lattice
 (compiled once PER replica) to a few seconds. Exit 0 on PASS, 1 on any
@@ -101,6 +109,22 @@ _MESH_ARGS = [
     "--decode-window", "4", "--prefix-cache", "off",
     "--tiered-cache", "off", "--mesh-shards", str(_MESH_SHARDS),
     "--replicas", "1",
+]
+# the speculative boot (ISSUE-18): one replica with a tiny RANDOM-init
+# draft published as the verified pair (config_hash + parent teacher
+# fingerprint) into a fresh registry — greedy speculative output is
+# token-identical to plain decode BY CONSTRUCTION regardless of draft
+# weights (the target verifies every token; draft quality only moves
+# acceptance), so an undistilled fixture draft is exactly the right
+# smoke: it exercises the propose/verify/rollback plane while the
+# token-parity assertion below carries the whole correctness claim
+_SPEC_ARGS = [
+    "serve", "--http", "--port", "0", "--vocab-size", "31",
+    "--hidden-units", "12", "--num-layers", "1",
+    "--prefill-buckets", "4,8", "--batch-buckets", "1,2",
+    "--decode-window", "4", "--prefix-cache", "off",
+    "--tiered-cache", "off", "--replicas", "1",
+    "--speculative", "--spec-ladder", "2",
 ]
 
 
@@ -421,6 +445,53 @@ def main(argv=None) -> int:
         if "0" not in mseen:
             return _fail(proc, lines,
                          f"mesh /metrics replica labels wrong: {mseen}")
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+        # ---- speculative boot (draft fixture pair) --------------------
+        # publish the fixture draft into a FRESH registry as the
+        # verified pair for this smoke's teacher config, boot
+        # --speculative, and require token-identical greedy output to
+        # the main boot plus at least one spec window actually
+        # dispatched (parity alone could pass with speculation inert)
+        from lstm_tensorspark_tpu.train.distill import (  # noqa: E402
+            draft_config,
+            publish_draft,
+        )
+
+        tcfg = LMConfig(vocab_size=31, hidden_size=12, num_layers=1)
+        dcfg = draft_config(tcfg)
+        spec_registry = tempfile.mkdtemp(prefix="serve_smoke_specreg_")
+        publish_draft(spec_registry,
+                      jax.device_get(init_lm(jax.random.PRNGKey(5), dcfg)),
+                      dcfg, tcfg, teacher_id="default")
+        spec_cmd = [sys.executable, "-m", "lstm_tensorspark_tpu.cli",
+                    *_SPEC_ARGS, "--registry-dir", spec_registry]
+        proc, lines, base = _boot(spec_cmd, env, args.timeout)
+        if base is None:
+            return _fail(proc, lines,
+                         "--speculative server never reported its address")
+        sreply = _generate(base, {"prompt": [1, 2, 3], "max_new_tokens": 4,
+                                  "greedy": True})
+        if sreply.get("tokens") != reply.get("tokens"):
+            return _fail(proc, lines,
+                         "speculative greedy tokens diverge from plain "
+                         f"decode: {sreply.get('tokens')} != "
+                         f"{reply.get('tokens')}")
+        with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+            sstats = json.loads(r.read())
+        sb = (sstats.get("replicas") or [{}])[0].get("batcher", {})
+        if not sb.get("speculative"):
+            return _fail(proc, lines,
+                         f"--speculative boot but batcher not "
+                         f"speculative: {sb}")
+        if sum(sb.get("spec_windows_dispatched", {}).values()) < 1:
+            return _fail(proc, lines,
+                         "speculative boot dispatched no spec windows "
+                         f"(speculation inert): {sb}")
 
         print(f"serve_smoke: PASS ({scan_base}: healthz fan-in "
               f"({len(reps)} replicas) + routed generate + stats + "
@@ -430,8 +501,12 @@ def main(argv=None) -> int:
               "token-identically with the kept session intact; "
               "--decode-kernel pallas + --autotune on boot "
               "token-identical with a quiet error-free controller; "
-              f"{base}: {_MESH_SHARDS}-shard mesh boot token-identical "
-              "with replica-labelled metrics)")
+              f"{_MESH_SHARDS}-shard mesh boot token-identical "
+              "with replica-labelled metrics; "
+              f"{base}: --speculative boot with a fixture draft pair "
+              "token-identical with "
+              f"{sum(sb['spec_windows_dispatched'].values())} spec "
+              "windows dispatched)")
         proc.terminate()
         try:
             proc.wait(timeout=10)
