@@ -1380,6 +1380,28 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefix-entries", type=int, default=16,
                    help="max cached prefix entries (each holds one "
                         "state-cache slot; LRU beyond this)")
+    p.add_argument("--prefix-fabric", type=str, default="off",
+                   choices=["on", "off"],
+                   help="prefix-state FABRIC (serve/prefix_trie.py): "
+                        "replaces the exact-match prefix cache with a "
+                        "radix trie over token sequences — lookups match "
+                        "the LONGEST shared prefix (tenant preambles, "
+                        "few-shot templates), cold nodes spill to the "
+                        "host tier under --prefix-host-mb, and hot "
+                        "inserts propagate to --remote-replica peers "
+                        "(idempotent by token hash). Supersedes "
+                        "--prefix-cache when on; greedy output stays "
+                        "token-identical (docs/OPERATIONS.md)")
+    p.add_argument("--prefix-nodes", type=int, default=64,
+                   help="max stateful trie nodes per replica with "
+                        "--prefix-fabric on (device-resident ones each "
+                        "hold a state-cache slot; eviction is leaf-first "
+                        "LRU over zero-ref nodes)")
+    p.add_argument("--prefix-host-mb", type=float, default=64.0,
+                   help="host-RAM bound (MiB) for SPILLED fabric nodes "
+                        "(a spilled node is one (h, c) pair per layer "
+                        "held by the tiers); the coldest zero-ref "
+                        "spilled nodes are dropped past this")
     p.add_argument("--tiered-cache", type=str, default="on",
                    choices=["on", "off"],
                    help="tiered session-state cache (serve/state_cache.py "
@@ -1574,6 +1596,34 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--inject-delay", type=float, default=0.25,
                    help="seconds into the run to submit the injected "
                         "request")
+    p.add_argument("--workload", type=str, default="random",
+                   choices=["random", "template-mix"],
+                   help="loadgen prompt shape: 'random' = the classic "
+                        "per-session random prompts; 'template-mix' = "
+                        "tenant preamble x few-shot template x unique "
+                        "suffix (--tenants/--templates/--preamble-len/"
+                        "--template-len/--suffix-len) — the shared-"
+                        "structure workload the prefix-state fabric is "
+                        "gated on (radix lookup reuses the preamble+"
+                        "template prefix; exact-match only full re-"
+                        "prompts). Runs on a bounded worker pool, so "
+                        "--sessions can be 10k+")
+    p.add_argument("--tenants", type=int, default=4,
+                   help="--workload template-mix: distinct tenant "
+                        "preambles")
+    p.add_argument("--templates", type=int, default=25,
+                   help="--workload template-mix: few-shot templates per "
+                        "tenant")
+    p.add_argument("--preamble-len", type=int, default=128,
+                   help="--workload template-mix: tenant preamble tokens")
+    p.add_argument("--template-len", type=int, default=32,
+                   help="--workload template-mix: template tokens")
+    p.add_argument("--suffix-len", type=int, default=8,
+                   help="--workload template-mix: unique per-session "
+                        "suffix tokens")
+    p.add_argument("--workers", type=int, default=32,
+                   help="--workload template-mix: bounded worker-pool "
+                        "size (closed-loop threads)")
     p.add_argument("--idle-churn", action="store_true",
                    help="loadgen: long-tail multi-tenant workload — "
                         "--sessions LIVE kept sessions (size it ~10x "
@@ -1848,6 +1898,11 @@ def _build_serve_stack(args, n_replicas: int = 1, registry=None):
             prefix_cache=args.prefix_cache == "on",
             prefix_stride=args.prefix_stride,
             prefix_entries=args.prefix_entries,
+            # prefix-state fabric: the radix-trie store supersedes the
+            # exact-match cache when on (engine picks trie over cache)
+            prefix_fabric=getattr(args, "prefix_fabric", "off") == "on",
+            prefix_nodes=getattr(args, "prefix_nodes", 64),
+            prefix_host_mb=getattr(args, "prefix_host_mb", 64.0),
             # tiered session-state cache: host-RAM spill of evicted
             # slots + durable disk tier / restart-surviving session
             # checkpoints under --session-dir (shared by all replicas —
@@ -2076,6 +2131,13 @@ def _serve_loadgen(args) -> int:
                   "(--replicas N, not a comma list)", file=sys.stderr)
             return 2
         return _serve_loadgen_longtail(args, replica_levels[0])
+    if getattr(args, "workload", "random") == "template-mix":
+        if len(replica_levels) > 1:
+            print("error: --workload template-mix runs at one replica "
+                  "count (--replicas N, not a comma list)",
+                  file=sys.stderr)
+            return 2
+        return _serve_loadgen_template_mix(args, replica_levels[0])
     if len(replica_levels) > 1:
         return _serve_loadgen_replica_sweep(args, replica_levels)
     _, cfg, server = _build_serve_stack(args, replica_levels[0])
@@ -2261,6 +2323,58 @@ def _serve_loadgen_longtail(args, n_replicas: int) -> int:
         f"device {hr.get('device', '?')} / host {hr.get('host', '?')} / "
         f"disk {hr.get('disk', '?')}, re-prefills {out['re_prefills']} "
         f"({out['re_prefill_tokens']} tokens)", file=sys.stderr)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        print(f"loadgen: report written to {args.json}", file=sys.stderr)
+    return 0
+
+
+def _serve_loadgen_template_mix(args, n_replicas: int) -> int:
+    """``serve --loadgen --workload template-mix``: the shared-structure
+    workload the prefix-state fabric is gated on — tenant preamble x
+    few-shot template x unique suffix on a bounded worker pool, with
+    computed-vs-offered prefill token accounting in the report
+    (tools/bench_serve.py --prefix-trie pairs this against the
+    exact-match cache for BENCH_serve_r11.json)."""
+    import json
+
+    from .serve import run_template_mix
+
+    _, cfg, server = _build_serve_stack(args, n_replicas)
+    sampling = _serve_sampling(args)
+    prompt_len = args.preamble_len + args.template_len + args.suffix_len
+    with server:
+        # one final-prefill length (all prompts are the same shape) plus
+        # the resume lattice the batcher's warmup derives from it
+        server.warmup(sampling, prompt_lens=(prompt_len,))
+        out = run_template_mix(
+            server, vocab_size=cfg.vocab_size, sessions=args.sessions,
+            tenants=args.tenants, templates=args.templates,
+            preamble_len=args.preamble_len,
+            template_len=args.template_len, suffix_len=args.suffix_len,
+            max_new_tokens=args.max_new_tokens, sampling=sampling,
+            workers=args.workers, seed=args.seed,
+        )
+        out["engine"] = {
+            "compiles_prefill": sum(
+                r.engine.num_compiles("prefill") for r in server.replicas),
+            "compiles_prefill_chunk": sum(
+                r.engine.num_compiles("prefill_chunk")
+                for r in server.replicas),
+        }
+    print(json.dumps(out))
+    pf = out.get("prefill", {})
+    px = out.get("prefix_cache") or {}
+    print(
+        f"template-mix summary: {out['completed']} req over "
+        f"{args.sessions} sessions ({args.tenants}x{args.templates} "
+        f"pairs), {out.get('tokens_per_sec', '?')} tok/s, prefill "
+        f"computed {pf.get('tokens_computed', '?')}/"
+        f"{pf.get('tokens_offered', '?')} offered "
+        f"(ratio {pf.get('compute_ratio', '?')}), prefix mode "
+        f"{px.get('mode', 'n/a')} hit rate {px.get('hit_rate', 'n/a')}",
+        file=sys.stderr)
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=1, sort_keys=True)

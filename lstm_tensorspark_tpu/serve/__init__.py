@@ -16,6 +16,12 @@ into a serving engine:
   continuation, sha256/fsync-durable session files so a restarted server
   resumes kept sessions token-identically; prefix entries spill/promote
   through the same tiers);
+- ``prefix_trie``: the prefix-state FABRIC (``--prefix-fabric on``) — a
+  radix trie over token sequences whose nodes own carry snapshots:
+  longest-match over ANY shared prefix (tenant preambles, few-shot
+  templates), leaf-first eviction with subtree accounting, tiered spill
+  under a host-byte bound, and cross-replica propagation of hot nodes
+  over the remote transport (idempotent by token-bytes hash);
 - ``engine``: bucketed jitted prefill/decode programs over the cache —
   compile count bounded per (phase, bucket[, window], sampling), never
   per batch composition — including ``decode_window``: K tokens per XLA
@@ -86,6 +92,7 @@ CLI: ``python -m lstm_tensorspark_tpu.cli serve --selftest`` (see cli.py).
 """
 
 from .state_cache import CacheFullError, PrefixCache, SessionTiers, StateCache
+from .prefix_trie import PrefixPropagator, PrefixTrie
 from .autotune import AutoTuneConfig, AutoTuner
 from .engine import (
     PAD_TOKEN,
@@ -106,7 +113,14 @@ from .rollout import RolloutController, RolloutError
 from .router import Replica, Router
 from .remote import RemoteBatcher, RemoteReplica
 from .server import InprocessClient, ServeServer
-from .loadgen import mesh_sweep, replica_sweep, run_loadgen, run_longtail
+from .loadgen import (
+    mesh_sweep,
+    replica_sweep,
+    run_loadgen,
+    run_longtail,
+    run_template_mix,
+    template_mix_prompts,
+)
 
 __all__ = [
     "AutoTuneConfig",
@@ -120,6 +134,8 @@ __all__ = [
     "ModelRegistry",
     "PAD_TOKEN",
     "PrefixCache",
+    "PrefixPropagator",
+    "PrefixTrie",
     "QueueFullError",
     "RegistryError",
     "RolloutController",
@@ -140,4 +156,6 @@ __all__ = [
     "replica_sweep",
     "run_loadgen",
     "run_longtail",
+    "run_template_mix",
+    "template_mix_prompts",
 ]

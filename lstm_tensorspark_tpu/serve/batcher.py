@@ -529,6 +529,7 @@ class Batcher:
         self.prefill_chunks_dispatched = 0  # head-less chunk programs
         self.prefix_resumed = 0  # sessions that resumed from a prefix hit
         self.prefix_tokens_saved = 0  # prompt tokens skipped via the cache
+        self.prefill_tokens_computed = 0  # prompt tokens actually run
         # speculative accounting: spec windows dispatched per K_draft,
         # and the accepted-proposal total (emitted = accepted + 1 per
         # live row per window — the correction token always rides along)
@@ -1224,6 +1225,7 @@ class Batcher:
         prefix = self.engine.prefix
         items = []
         draft_items = []
+        computed = 0  # prompt tokens this dispatch runs through the model
         # the draft is distilled against the DEFAULT model only — other
         # residents' sessions never speculate, so their prefills are not
         # mirrored either
@@ -1245,6 +1247,7 @@ class Batcher:
             src_slot, fresh = p.src()
             items.append((p.sess.slot, src_slot, fresh,
                           p.sess.req.prompt[p.pos: stop]))
+            computed += stop - p.pos
             if mirror:
                 # mirror every target dispatch so the draft's slot state
                 # tracks the consumed context. The draft's FIRST fragment
@@ -1270,6 +1273,10 @@ class Batcher:
                 self._abort_prefilling(
                     p, f"prefill failed: {type(e).__name__}: {e}")
             return
+        # count AFTER the dispatch lands: the compute-savings gate
+        # (saved vs computed) must not credit work an aborted batch
+        # never did
+        self.prefill_tokens_computed += computed
         if draft_items:
             try:
                 self.engine.draft_prefill(draft_items)
@@ -1809,6 +1816,7 @@ class Batcher:
             "prefill_chunks_dispatched": self.prefill_chunks_dispatched,
             "prefix_resumed": self.prefix_resumed,
             "prefix_tokens_saved": self.prefix_tokens_saved,
+            "prefill_tokens_computed": self.prefill_tokens_computed,
             "speculative": self.speculative,
             "spec_ladder": list(self.spec_ladder),
             "spec_k": spec_k,

@@ -195,6 +195,9 @@ class ServeEngine:
         prefix_cache: bool = False,
         prefix_stride: int = 8,
         prefix_entries: int = 16,
+        prefix_fabric: bool = False,
+        prefix_nodes: int = 64,
+        prefix_host_mb: float = 64.0,
         tiered_cache: bool = False,
         host_tier_entries: int = 256,
         session_dir: str | None = None,
@@ -292,16 +295,28 @@ class ServeEngine:
                          replica=replica)
             if (tiered_cache or session_dir is not None) else None
         )
-        # shared-prompt prefix reuse (state_cache.PrefixCache): opt-in at
-        # engine construction; the batcher consults engine.prefix on every
-        # fresh admission when present. With tiers attached, an evicted
-        # backing slot SPILLS the entry instead of invalidating it.
-        self.prefix = (
-            PrefixCache(self.cache, stride=prefix_stride,
-                        max_entries=prefix_entries, registry=self.metrics,
-                        tiers=self.tiers)
-            if prefix_cache else None
-        )
+        # shared-prompt prefix reuse: opt-in at engine construction; the
+        # batcher consults engine.prefix on every fresh admission when
+        # present. ``prefix_fabric`` selects the radix PrefixTrie
+        # (longest-match over ANY shared prefix, host-byte-bounded
+        # spill, cross-replica propagation hooks) over the exact-match
+        # PrefixCache — both duck-type the same store contract, so
+        # everything downstream of engine.prefix is agnostic. With
+        # tiers attached, an evicted backing slot SPILLS the entry
+        # instead of invalidating it (either store).
+        if prefix_fabric:
+            from .prefix_trie import PrefixTrie
+            self.prefix = PrefixTrie(
+                self.cache, stride=prefix_stride, max_nodes=prefix_nodes,
+                host_bytes=int(prefix_host_mb * 2 ** 20),
+                registry=self.metrics, tiers=self.tiers)
+        elif prefix_cache:
+            self.prefix = PrefixCache(
+                self.cache, stride=prefix_stride,
+                max_entries=prefix_entries, registry=self.metrics,
+                tiers=self.tiers)
+        else:
+            self.prefix = None
         # sampling params are compile keys and client-controlled at the
         # HTTP boundary: bound how many distinct configs this engine will
         # ever compile, or a client sweeping temperatures could thrash
@@ -1539,6 +1554,17 @@ class ServeEngine:
                 # every other program family: a continuation burst must
                 # never pay a mid-traffic compile for its batched fill
                 self.tiers.warmup_fills(self.batch_buckets[-1])
+            if self.prefix is not None and hasattr(self.prefix,
+                                                   "adopt_remote"):
+                # the fabric's remote-adopt path lands a propagated node
+                # via a batch-1 write_slots scatter; warm it against the
+                # scratch slot so the first mid-traffic adoption does
+                # not compile (slot S is scratch — nothing reads it back)
+                scratch = self.cache.scratch_slot
+                zeros = np.zeros((self.cfg.num_layers, 1,
+                                  self.cfg.hidden_size), np.float32)
+                self.cache.write_slots(np.asarray([scratch]), zeros,
+                                       zeros)
         finally:
             self._warming = False
         return (len(self._prefill_fns) + len(self._prefill_chunk_fns)

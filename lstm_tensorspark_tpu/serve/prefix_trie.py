@@ -1,0 +1,699 @@
+"""Global prefix-state fabric: a radix trie of recurrent carries
+(ISSUE 19 — ROADMAP open item 4 generalised from the exact-match
+``PrefixCache``).
+
+An LSTM's state after ANY prefix is one O(1) ``(h, c)`` pair per layer,
+so prefix sharing needs no length-proportional KV plumbing — a shared
+prefix is one device slot. The exact-match ``PrefixCache`` only reuses
+prefixes that byte-match a previously-inserted stride-aligned key and
+caps capacity at ``max_entries`` device-backed entries; at template-mix
+scale (tenant preamble x few-shot template x unique suffix) that LRU
+thrashes and most admissions recompute a preamble the fleet has run
+thousands of times. :class:`PrefixTrie` replaces the flat dict with a
+**radix tree over token sequences whose nodes own carry snapshots**:
+
+- :meth:`lookup` walks the trie to the LONGEST stateful node on the
+  prompt's path — any shared prefix wins, not just exact re-prompts —
+  with the matched length still capped at ``len(prompt) - 1`` so greedy
+  output stays token-identical to an uncached run;
+- the batcher's stride-aligned insert points (every chunk stop of a
+  resumed prefill) become interior nodes, so ONE cold tenant-preamble
+  prefill warms every descendant template;
+- cold nodes spill through :class:`SessionTiers` (the state cache's
+  eviction listener keeps the state host-side, ``slot=None``) under a
+  configurable **host-tier byte bound**; a later hit promotes the node
+  back for one host->device copy;
+- eviction is leaf-first over zero-ref nodes with subtree accounting
+  (``stateful_desc``): interior nodes — the high-fanout preambles —
+  outlive their leaves;
+- hot inserts propagate cross-replica (:class:`PrefixPropagator`) over
+  the PR 13/17 remote transport: circuit-breaker aware, idempotent by
+  node token-bytes hash, so one replica's prefill warms the fleet.
+
+Every contract the exact-match cache established holds here: backing
+slots live in the reserved ``prefix/`` namespace, lookups refcount-pin
+their node's backing slot until the resumed prefill is dispatched, and
+the trie shares the state cache's reentrant lock — the eviction
+listener fires under it, and a private lock would ABBA with the
+``acquire``/``pin`` calls made from trie methods (the
+``viol_trie_lock`` / ``clean_trie_lock`` graftlint fixture pair keeps
+that discipline checked). The propagator's enqueue under the lock is a
+deque append only; the device fetch and the network POST happen on its
+worker thread outside the lock (graftlint io-under-lock / host-sync).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from .. import obs
+from .state_cache import (
+    PREFIX_SID_NAMESPACE,
+    CacheFullError,
+    DetachedState,
+    StateCache,
+)
+
+__all__ = ["PrefixPropagator", "PrefixTrie", "TrieNode"]
+
+
+class TrieNode:
+    """One radix-trie node: the compressed token ``edge`` from its
+    parent, children keyed by their edge's first token, and — when
+    stateful (``sid`` is not None) — a carry snapshot in a refcounted
+    state-cache slot under the ``prefix/`` namespace. ``slot`` is None
+    while the node is SPILLED (state lives in the host tier until a
+    lookup promotes it back). ``stateful_desc`` counts stateful nodes
+    strictly below — the leaf-first eviction's subtree accounting."""
+
+    __slots__ = ("edge", "children", "parent", "length", "key", "sid",
+                 "slot", "refs", "stateful_desc")
+
+    def __init__(self, edge: tuple, parent: "TrieNode | None"):
+        self.edge = edge
+        self.children: dict[int, TrieNode] = {}
+        self.parent = parent
+        self.length = (0 if parent is None
+                       else parent.length + len(edge))
+        self.key: bytes | None = None   # set while stateful
+        self.sid: str | None = None
+        self.slot: int | None = None
+        self.refs = 0
+        self.stateful_desc = 0
+
+
+class PrefixTrie:
+    """Radix-trie prefix-state store over the :class:`StateCache` —
+    duck-type compatible with ``PrefixCache`` (``lookup`` / ``release``
+    / ``insert`` / ``boundary`` / ``clear`` / ``stats`` and entry
+    objects exposing ``slot`` / ``length`` / ``sid`` / ``refs``), so the
+    batcher's admission and insert paths drive it unchanged.
+
+    ``max_nodes`` bounds STATEFUL nodes (each device-resident one holds
+    a state-cache slot); ``host_bytes`` bounds the spilled-node host
+    footprint (each spilled state is ``state_bytes`` =
+    2 * layers * hidden * 4). Structural split nodes are token tuples
+    only — a few dozen bytes each — and are pruned/merged when the
+    state they separated is evicted."""
+
+    def __init__(self, cache: StateCache, *, stride: int = 8,
+                 max_nodes: int = 64, host_bytes: int = 64 * 2 ** 20,
+                 registry=None, tiers=None):
+        if stride < 1:
+            raise ValueError(f"stride must be >= 1, got {stride}")
+        if max_nodes < 1:
+            raise ValueError(f"max_nodes must be >= 1, got {max_nodes}")
+        if host_bytes < 0:
+            raise ValueError(f"host_bytes must be >= 0, got {host_bytes}")
+        self.cache = cache
+        self.stride = stride
+        self.max_nodes = max_nodes
+        self.host_bytes = int(host_bytes)
+        self.tiers = tiers
+        self._lock = cache._lock  # shared on purpose (see module doc)
+        self.root = TrieNode((), None)
+        # LRU over stateful nodes (key -> node, oldest first): the
+        # eviction scan order AND the exact-key dedup index
+        self._stateful: OrderedDict[bytes, TrieNode] = OrderedDict()
+        self._by_sid: dict[str, TrieNode] = {}
+        self._sid_counter = 0
+        self._spilled_nodes = 0
+        self.state_bytes = 2 * cache.num_layers * cache.hidden_size * 4
+        # recently-applied remote insert hashes (idempotent replay
+        # dedup for at-least-once propagation delivery), bounded LRU
+        self._applied: OrderedDict[str, None] = OrderedDict()
+        self._applied_max = 4096
+        self._propagator: PrefixPropagator | None = None
+        self.hits = 0
+        self.misses = 0
+        self.inserts = 0
+        self.evictions = 0
+        self.invalidated = 0
+        self.spilled = 0
+        self.promoted = 0
+        self.propagated_in = 0       # remote inserts applied locally
+        self.propagation_dedup = 0   # remote inserts already resident
+        reg = obs.REGISTRY if registry is None else registry
+        self._m = reg.counter(
+            "serve_prefix_trie_events_total",
+            "prefix-trie outcomes (hit/miss/insert/evict/invalidate/"
+            "spill/promote)",
+            labelnames=("event",))
+        self._m_hit = self._m.labels(event="hit")
+        self._m_miss = self._m.labels(event="miss")
+        self._m_insert = self._m.labels(event="insert")
+        self._m_evict = self._m.labels(event="evict")
+        self._m_invalidate = self._m.labels(event="invalidate")
+        self._m_spill = self._m.labels(event="spill")
+        self._m_promote = self._m.labels(event="promote")
+        self._m_prop = reg.counter(
+            "serve_prefix_trie_propagation_total",
+            "cross-replica prefix-node propagation events (out = sent "
+            "to a peer, in = applied from a peer, dedup = replay or "
+            "already-resident, error = transport/peer failure)",
+            labelnames=("direction",))
+        cache.evict_listeners.append(self._on_slot_evicted_locked)
+
+    # ---- key helpers ---------------------------------------------------
+
+    @staticmethod
+    def _key(tokens) -> bytes:
+        return np.asarray(tokens, np.int32).tobytes()
+
+    @staticmethod
+    def token_hash(tokens) -> str:
+        """sha256 of the node's token bytes — the propagation plane's
+        idempotency key."""
+        return hashlib.sha256(PrefixTrie._key(tokens)).hexdigest()
+
+    def boundary(self, length: int) -> int:
+        """Largest cacheable prefix length for a ``length``-token
+        prompt (same contract as ``PrefixCache.boundary``)."""
+        k = ((length - 1) // self.stride) * self.stride
+        return k if k >= self.stride else 0
+
+    def attach_propagator(self, propagator: "PrefixPropagator") -> None:
+        self._propagator = propagator
+
+    # ---- lookup / promote ----------------------------------------------
+
+    def lookup(self, prompt) -> tuple[TrieNode | None, int]:
+        """Longest-match walk: returns ``(node, matched_len)`` for the
+        DEEPEST stateful node on the prompt's path with
+        ``length <= len(prompt) - 1`` whose state is available (device
+        slot, or promotable from the host tier), ref-held and pinned —
+        the caller MUST :meth:`release` after dispatching the resumed
+        prefill. Unlike the exact-match cache, any shared prefix
+        matches — the prompt need never have been seen before."""
+        toks = np.asarray(prompt, np.int32).reshape(-1).tolist()
+        limit = len(toks) - 1
+        with self._lock:
+            candidates = []
+            node = self.root
+            depth = 0
+            while depth < limit:
+                child = node.children.get(toks[depth])
+                if child is None:
+                    break
+                n = len(child.edge)
+                # stateful nodes live only at node boundaries, so an
+                # edge overrunning the match limit (or mismatching
+                # partway) ends the walk — nothing deeper can qualify
+                if depth + n > limit:
+                    break
+                if tuple(toks[depth:depth + n]) != child.edge:
+                    break
+                depth += n
+                node = child
+                if node.sid is not None:
+                    candidates.append(node)
+            # deepest-first: a spilled candidate whose tiered state was
+            # lost drops out (invalidated) and the next-shallower
+            # stateful ancestor still saves most of the prefill
+            for cand in reversed(candidates):
+                if cand.slot is None and not self._promote_locked(cand):
+                    continue
+                self._stateful.move_to_end(cand.key)
+                # refresh the BACKING slot's state-cache recency too —
+                # pin/unpin never reorder the LRU (reentrant RLock)
+                self.cache.lookup(cand.sid)
+                if cand.refs == 0:
+                    self.cache.pin(cand.sid)
+                cand.refs += 1
+                self.hits += 1
+                self._m_hit.inc()
+                return cand, cand.length
+            self.misses += 1
+            self._m_miss.inc()
+            return None, 0
+
+    def _promote_locked(self, node: TrieNode) -> bool:
+        """Restore a SPILLED node's state from the tiers into a fresh
+        slot. Returns False and drops the node when the tiered state is
+        gone; False without dropping when no slot can be had right now
+        (every slot pinned — transient miss). Memory-only fill: this
+        runs with the shared cache lock HELD (graftlint io-under-lock —
+        prefix states never reach the disk tier)."""
+        try:
+            slot, fresh = self.cache.acquire(node.sid)
+        except CacheFullError:
+            return False
+        if fresh and (self.tiers is None
+                      or not self.tiers.fill_memory(node.sid, slot)):
+            self.cache.release(node.sid)
+            self._drop_state_locked(node)
+            self.invalidated += 1
+            self._m_invalidate.inc()
+            return False
+        node.slot = slot
+        self._spilled_nodes -= 1
+        self.promoted += 1
+        self._m_promote.inc()
+        return True
+
+    def release(self, node: TrieNode) -> None:
+        """Drop one ref; the last ref unpins the backing slot. Safe
+        after invalidation (the sid index no longer points here)."""
+        with self._lock:
+            if node.refs > 0:
+                node.refs -= 1
+            if node.refs == 0 and node.sid is not None \
+                    and self._by_sid.get(node.sid) is node:
+                self.cache.unpin(node.sid)
+
+    # ---- insert --------------------------------------------------------
+
+    def insert(self, tokens, src_slot: int) -> bool:
+        """Snapshot the state in ``src_slot`` (== the state after
+        exactly ``tokens``) into the trie node at that token path,
+        creating/splitting radix nodes as needed. Returns False — never
+        raises — on dedup, all-nodes-ref-held, or slot exhaustion:
+        prefix caching degrades, it does not fail requests."""
+        toks = tuple(int(t) for t in np.asarray(tokens, np.int32).reshape(-1))
+        if not toks:
+            return False
+        key = self._key(toks)
+        with self._lock:
+            existing = self._stateful.get(key)
+            if existing is not None:
+                # dedup-hit is a hotness signal: refresh both LRUs
+                self._stateful.move_to_end(key)
+                self.cache.lookup(existing.sid)
+                return False
+            if not self._make_room_locked():
+                return False
+            self._sid_counter += 1
+            sid = f"{PREFIX_SID_NAMESPACE}{self._sid_counter}"
+            try:
+                slot, _ = self.cache.acquire(sid)
+            except CacheFullError:
+                return False
+            self.cache.copy_slot(src_slot, slot)
+            node = self._ensure_path_locked(toks)
+            self._set_state_locked(node, key, sid, slot)
+            self.inserts += 1
+            self._m_insert.inc()
+            if self._propagator is not None:
+                self._propagator.enqueue_locked(toks, sid)
+            return True
+
+    def adopt_remote(self, tokens, state: DetachedState,
+                     token_hash: str | None = None) -> str:
+        """Apply one propagated node from a peer: idempotent by token
+        path (already-stateful node = dedup) and by recently-applied
+        hash (at-least-once delivery replay). The state lands in a
+        device slot via the warmed batch-1 scatter; a cold adoptee just
+        LRU-spills into the host tier like any local node. Returns
+        ``"applied"`` | ``"dedup"`` | ``"rejected"``."""
+        toks = tuple(int(t) for t in np.asarray(tokens, np.int32).reshape(-1))
+        # propagated lengths must stay stride multiples: the batcher's
+        # warmup only covers resume starts at stride multiples, and an
+        # off-stride node would dispatch an unwarmed remainder program
+        if not toks or len(toks) % self.stride != 0:
+            return "rejected"
+        if state.h.shape != (self.cache.num_layers, self.cache.hidden_size):
+            return "rejected"
+        h = token_hash or self.token_hash(toks)
+        key = self._key(toks)
+        with self._lock:
+            if h in self._applied or key in self._stateful:
+                if key in self._stateful:
+                    self._stateful.move_to_end(key)
+                self.propagation_dedup += 1
+                self._m_prop.labels(direction="dedup").inc()
+                return "dedup"
+            if not self._make_room_locked():
+                return "rejected"
+            self._sid_counter += 1
+            sid = f"{PREFIX_SID_NAMESPACE}{self._sid_counter}"
+            try:
+                slot, _ = self.cache.acquire(sid)
+            except CacheFullError:
+                return "rejected"
+            self.cache.write_slots(
+                np.asarray([slot]), np.asarray(state.h)[:, None, :],
+                np.asarray(state.c)[:, None, :])
+            node = self._ensure_path_locked(toks)
+            self._set_state_locked(node, key, sid, slot)
+            self._applied[h] = None
+            self._applied.move_to_end(h)
+            while len(self._applied) > self._applied_max:
+                self._applied.popitem(last=False)
+            self.propagated_in += 1
+            self._m_prop.labels(direction="in").inc()
+            return "applied"
+
+    # ---- radix structure (all under the shared lock) -------------------
+
+    def _ensure_path_locked(self, toks: tuple) -> TrieNode:
+        """Walk/create the radix path for ``toks``, splitting compressed
+        edges as needed, and return the node at exactly that depth."""
+        node = self.root
+        depth = 0
+        while depth < len(toks):
+            first = toks[depth]
+            child = node.children.get(first)
+            if child is None:
+                leaf = TrieNode(toks[depth:], node)
+                node.children[first] = leaf
+                return leaf
+            edge = child.edge
+            # longest common prefix of the remaining tokens and the edge
+            m = 0
+            remaining = len(toks) - depth
+            while m < len(edge) and m < remaining \
+                    and edge[m] == toks[depth + m]:
+                m += 1
+            if m == len(edge):
+                node = child
+                depth += m
+                continue
+            # split child's edge at m: mid owns edge[:m], child keeps
+            # the tail. mid inherits child's subtree accounting.
+            mid = TrieNode(edge[:m], node)
+            mid.stateful_desc = child.stateful_desc + (
+                1 if child.sid is not None else 0)
+            node.children[first] = mid
+            child.edge = edge[m:]
+            child.parent = mid
+            mid.children[edge[m]] = child
+            if m == remaining:
+                return mid
+            node = mid
+            depth += m
+        return node
+
+    def _set_state_locked(self, node: TrieNode, key: bytes, sid: str,
+                          slot: int) -> None:
+        node.key, node.sid, node.slot, node.refs = key, sid, slot, 0
+        self._stateful[key] = node
+        self._stateful.move_to_end(key)
+        self._by_sid[sid] = node
+        p = node.parent
+        while p is not None:
+            p.stateful_desc += 1
+            p = p.parent
+
+    def _drop_state_locked(self, node: TrieNode) -> None:
+        """Remove a node's state (NOT its slot — callers own that) and
+        prune/merge the structure it no longer justifies."""
+        if node.sid is None:
+            return
+        if node.slot is None:
+            self._spilled_nodes -= 1
+        self._stateful.pop(node.key, None)
+        self._by_sid.pop(node.sid, None)
+        node.key = node.sid = node.slot = None
+        node.refs = 0
+        p = node.parent
+        while p is not None:
+            p.stateful_desc -= 1
+            p = p.parent
+        self._prune_locked(node)
+
+    def _prune_locked(self, node: TrieNode) -> None:
+        # delete childless structural nodes upward, then merge a
+        # single-child structural survivor with its child (radix
+        # compression is an invariant, not a one-time construction)
+        while (node.parent is not None and node.sid is None
+               and not node.children):
+            parent = node.parent
+            parent.children.pop(node.edge[0], None)
+            node = parent
+        if (node.parent is not None and node.sid is None
+                and len(node.children) == 1):
+            (child,) = node.children.values()
+            child.edge = node.edge + child.edge
+            child.parent = node.parent
+            node.parent.children[node.edge[0]] = child
+
+    # ---- eviction / spill ----------------------------------------------
+
+    def _victim_locked(self) -> TrieNode | None:
+        """Leaf-first zero-ref victim in LRU order: prefer nodes with
+        no stateful descendants (evicting an interior preamble node
+        before its template leaves would re-cost the shared prefill the
+        subtree exists to save); fall back to any zero-ref node so the
+        cap stays hard."""
+        fallback = None
+        for node in self._stateful.values():
+            if node.refs:
+                continue
+            if node.stateful_desc == 0:
+                return node
+            if fallback is None:
+                fallback = node
+        return fallback
+
+    def _make_room_locked(self) -> bool:
+        while len(self._stateful) >= self.max_nodes:
+            victim = self._victim_locked()
+            if victim is None:
+                return False  # every node is mid-use
+            self._evict_node_locked(victim)
+        return True
+
+    def _evict_node_locked(self, node: TrieNode) -> None:
+        sid = node.sid
+        self._drop_state_locked(node)
+        self.cache.release(sid)
+        if self.tiers is not None:
+            # memory tiers only: this fires under the shared cache lock
+            # and prefix states never reach the disk tier
+            self.tiers.discard_memory(sid)
+        self.evictions += 1
+        self._m_evict.inc()
+
+    def _on_slot_evicted_locked(self, sid: str, slot: int) -> None:
+        # state-cache LRU took a backing slot. Tiered: the SessionTiers
+        # listener captured the state, so the node survives SPILLED and
+        # a later hit promotes it back. Untiered: the node is garbage.
+        # The _locked suffix is the held-lock calling contract.
+        node = self._by_sid.get(sid)
+        if node is None:
+            return
+        if self.tiers is not None:
+            node.slot = None
+            self._spilled_nodes += 1
+            self.spilled += 1
+            self._m_spill.inc()
+            self._enforce_host_bound_locked()
+            return
+        self._drop_state_locked(node)
+        self.invalidated += 1
+        self._m_invalidate.inc()
+
+    def _enforce_host_bound_locked(self) -> None:
+        """Keep the spilled-node host footprint within ``host_bytes``:
+        evict the coldest zero-ref SPILLED nodes (memory-only discard —
+        no IO under the hot lock) until the bound holds."""
+        while self._spilled_nodes * self.state_bytes > self.host_bytes:
+            victim = None
+            for node in self._stateful.values():
+                if node.slot is None and node.refs == 0:
+                    victim = node
+                    break
+            if victim is None:
+                return
+            self._evict_node_locked(victim)
+
+    def clear(self) -> None:
+        """Evict every node that is not mid-use (refs == 0) — the
+        rollout controller's drained-replica reset, same contract as
+        ``PrefixCache.clear``."""
+        with self._lock:
+            for node in list(self._stateful.values()):
+                if node.refs == 0:
+                    self._evict_node_locked(node)
+
+    # ---- views ---------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._stateful)
+
+    def _structural_count_locked(self) -> int:
+        count = 0
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            if node is not self.root and node.sid is None:
+                count += 1
+            stack.extend(node.children.values())
+        return count
+
+    def stats(self) -> dict:
+        with self._lock:
+            spilled_nodes = self._spilled_nodes
+            return {
+                "mode": "trie",
+                "entries": len(self._stateful),
+                "stride": self.stride,
+                "max_nodes": self.max_nodes,
+                "nodes_device": len(self._stateful) - spilled_nodes,
+                "nodes_spilled": spilled_nodes,
+                "nodes_structural": self._structural_count_locked(),
+                "host_bytes": self.host_bytes,
+                "state_bytes": self.state_bytes,
+                "spilled_bytes": spilled_nodes * self.state_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "inserts": self.inserts,
+                "evictions": self.evictions,
+                "invalidated": self.invalidated,
+                "spilled": self.spilled,
+                "promoted": self.promoted,
+                "propagated_out": (0 if self._propagator is None
+                                   else self._propagator.sent),
+                "propagated_in": self.propagated_in,
+                "propagation_dedup": self.propagation_dedup,
+                "propagation_errors": (0 if self._propagator is None
+                                       else self._propagator.errors),
+            }
+
+
+class PrefixPropagator:
+    """Cross-replica prefix-node propagation worker.
+
+    ``enqueue_locked`` (called by the trie under the shared cache lock)
+    appends a job and returns — no device op, no IO. The daemon worker
+    drains jobs in batches: it captures array REFERENCES + slot under
+    the lock (zero device ops — jax arrays are immutable functional
+    snapshots), performs the ONE designated device->host fetch
+    (``StateCache.fetch_detached_batch``) outside it, and POSTs each
+    node to every peer over the retrying :class:`PeerTransport` —
+    skipping peers whose circuit is open or flap-damped (``suspect``),
+    with ``replay_safe=True`` because the receiver dedups by token-hash
+    (idempotent inserts over at-least-once delivery)."""
+
+    BATCH = 16
+
+    def __init__(self, trie: PrefixTrie, peers, *,
+                 rpc_timeout: float = 5.0, max_queue: int = 256):
+        self.trie = trie
+        # peers: objects exposing ``transport`` (PeerTransport) and
+        # ``suspect()`` — serve/remote.RemoteBatcher shims in production
+        self.peers = list(peers)
+        self.rpc_timeout = float(rpc_timeout)
+        self.max_queue = int(max_queue)
+        self._lock = trie._lock  # shared: enqueue fires mid-insert
+        self._queue: deque = deque()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.sent = 0        # node deliveries accepted by a peer
+        self.errors = 0      # transport/peer failures (after retries)
+        self.dropped = 0     # queue overflow (newest-first kept)
+
+    def enqueue_locked(self, toks: tuple, sid: str) -> None:
+        if not self.peers:
+            return
+        if len(self._queue) >= self.max_queue:
+            self._queue.popleft()
+            self.dropped += 1
+        self._queue.append((toks, sid))
+        self._ensure_worker_locked()
+
+    def _ensure_worker_locked(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self.run, name="serve-prefix-propagate",
+                daemon=True)
+            self._thread.start()
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+    def run(self) -> None:
+        """Worker loop (scheduler-closure discipline: the only blocking
+        device call is the designated batched fetch)."""
+        while not self._stop.is_set():
+            jobs = []
+            with self._lock:
+                while self._queue and len(jobs) < self.BATCH:
+                    toks, sid = self._queue.popleft()
+                    node = self.trie._by_sid.get(sid)
+                    if node is None or node.slot is None:
+                        continue  # evicted/spilled before send: cold
+                    jobs.append((toks, self.trie.cache.h,
+                                 self.trie.cache.c, node.slot))
+            if not jobs:
+                # poll, batching bursts of inserts into one fetch
+                self._stop.wait(0.05)
+                continue
+            states = StateCache.fetch_detached_batch(
+                [(h, c, slot) for _, h, c, slot in jobs])
+            for (toks, _, _, _), state in zip(jobs, states):
+                self._send(toks, state)
+
+    def _send(self, toks: tuple, state: DetachedState) -> None:
+        from .transport import PeerHTTPError, TransportError
+
+        body = {
+            "tokens": list(toks),
+            "hash": PrefixTrie.token_hash(toks),
+            "layers": int(state.h.shape[0]),
+            "hidden": int(state.h.shape[1]),
+            "h": base64.b64encode(
+                np.ascontiguousarray(state.h, np.float32).tobytes()
+            ).decode("ascii"),
+            "c": base64.b64encode(
+                np.ascontiguousarray(state.c, np.float32).tobytes()
+            ).decode("ascii"),
+        }
+        for peer in self.peers:
+            if peer.suspect():
+                continue  # circuit open / flap-damped: skip, not queue
+            try:
+                peer.transport.rpc_post(
+                    "/replica/prefix", body, method="prefix",
+                    timeout=self.rpc_timeout, replay_safe=True)
+            except (TransportError, PeerHTTPError):
+                self.errors += 1
+                self.trie._m_prop.labels(direction="error").inc()
+            else:
+                self.sent += 1
+                self.trie._m_prop.labels(direction="out").inc()
+
+
+def decode_propagated_state(body: dict, *, num_layers: int,
+                            hidden_size: int) -> DetachedState | None:
+    """Decode + validate a ``/replica/prefix`` POST body into a
+    :class:`DetachedState`; None when malformed or the hash does not
+    match the token bytes (the idempotency key doubles as an integrity
+    check). Runs on the HTTP handler thread, never under a hot lock."""
+    try:
+        toks = np.asarray(body["tokens"], np.int32).reshape(-1)
+        layers = int(body["layers"])
+        hidden = int(body["hidden"])
+        if (layers, hidden) != (num_layers, hidden_size):
+            return None
+        want = hashlib.sha256(toks.tobytes()).hexdigest()
+        if body.get("hash") != want:
+            return None
+        n = layers * hidden
+        h = np.frombuffer(base64.b64decode(body["h"]),
+                          np.float32)
+        c = np.frombuffer(base64.b64decode(body["c"]),
+                          np.float32)
+        if h.size != n or c.size != n:
+            return None
+        return DetachedState(h=h.reshape(layers, hidden).copy(),
+                             c=c.reshape(layers, hidden).copy())
+    except (KeyError, ValueError, TypeError):
+        return None
+
+
+# time is imported for parity with the sibling modules' worker idiom;
+# the propagator paces itself off the stop event's timed wait instead
+# of wall-clock arithmetic (graftlint wallclock-timing).
+_ = time

@@ -140,7 +140,11 @@ class _RemoteEngine:
             "mesh_shards": None,
             "decode_window_scan_fallbacks": 0,
             "cache": self.cache.stats(),
-            "prefix_cache": None,
+            # the peer's real prefix-store section, mirrored off its
+            # heartbeat (None until the first poll lands, or when the
+            # peer runs without a prefix store) — a hardcoded None here
+            # made /stats lie for remote hosts
+            "prefix_cache": self._shim.remote_prefix(),
             "tiers": None,
             "compiles": {},
             "heartbeat_age_s": self._shim.heartbeat_age(),
@@ -189,6 +193,7 @@ class RemoteBatcher:
         self._lock = threading.Lock()
         self._inflight: set[Request] = set()
         self._remote: dict = {}  # last heartbeat's batcher aggregate
+        self._remote_prefix: dict | None = None  # ... prefix-store section
         self._last_ok: float | None = None
         # residency cache: the last heartbeat's resident session ids
         # (None = peer didn't report / truncated list) plus an overlay
@@ -236,6 +241,21 @@ class RemoteBatcher:
         flap-damping threshold below full circuit-open)."""
         return self.circuit.suspect(self.damp_after)
 
+    def remote_prefix(self) -> dict | None:
+        """The peer's prefix-store stats section as of the last good
+        heartbeat (None before the first poll, or when the peer serves
+        without a prefix store)."""
+        with self._lock:
+            return self._remote_prefix
+
+    @property
+    def transport(self):
+        """The peer's retrying :class:`PeerTransport` — the propagation
+        plane (``PrefixPropagator``) posts fabric nodes through it so
+        every delivery shares this peer's circuit breaker and retry
+        provenance."""
+        return self._transport
+
     def run(self, stop_event: threading.Event,
             idle_wait: float = 0.05) -> None:
         """Heartbeat poller — THE liveness proxy AND the circuit's
@@ -269,6 +289,7 @@ class RemoteBatcher:
                 ids = hb.get("session_ids")
                 with self._lock:
                     self._remote = hb.get("batcher") or {}
+                    self._remote_prefix = hb.get("prefix_cache")
                     self._last_ok = now
                     if ids is None:
                         self._residency = None

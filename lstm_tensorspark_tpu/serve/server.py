@@ -63,7 +63,7 @@ from .batcher import (
 )
 from .engine import GREEDY, SamplingParams, ServeEngine, UnknownModelError
 from .router import Replica, Router
-from .state_cache import PREFIX_SID_NAMESPACE
+from .state_cache import PREFIX_SID_NAMESPACE, PREFIX_STATS_CONFIG_KEYS
 
 
 class _ReplicaStop:
@@ -106,6 +106,7 @@ _SUMMED_BATCHER_KEYS = (
     "queued", "active", "prefilling", "windows_pipelined",
     "tokens_generated",
     "prefill_chunks_dispatched", "prefix_resumed", "prefix_tokens_saved",
+    "prefill_tokens_computed",
 )
 
 
@@ -219,6 +220,23 @@ class ServeServer:
         for rep in remotes:
             rep.batcher.set_reroute(
                 lambda req, _r=rep: self.router.reroute(req, _r))
+        # prefix-state fabric propagation (serve/prefix_trie.py): every
+        # LOCAL trie pushes its hot inserts to every remote peer through
+        # that peer's OWN transport/circuit (RemoteBatcher.transport), so
+        # one replica's cold prefill warms the fleet. Exact-match
+        # PrefixCache stores have no adopt path and are left alone.
+        self._propagators = []
+        if remotes:
+            from .prefix_trie import PrefixPropagator
+
+            peer_shims = [rep.batcher for rep in remotes]
+            for r in self.replicas:
+                trie = getattr(r.engine, "prefix", None)
+                if trie is not None and hasattr(trie, "attach_propagator"):
+                    prop = PrefixPropagator(
+                        trie, peer_shims, rpc_timeout=remote_rpc_timeout_s)
+                    trie.attach_propagator(prop)
+                    self._propagators.append(prop)
         # peer-side replay dedup for the generate POST: remote fronts
         # mint a request_id per request; a retried delivery whose first
         # attempt executed replays the settled reply instead of
@@ -369,6 +387,10 @@ class ServeServer:
                 # polling threads
                 r.engine.tiers.flush(timeout=10.0)
                 r.engine.tiers.close()
+        for prop in self._propagators:
+            # park the fabric's propagation workers: undelivered queue
+            # entries are best-effort warmth, not durable state
+            prop.close()
 
     def warmup(self, sampling: SamplingParams = GREEDY,
                prompt_lens: tuple[int, ...] = (1,)) -> int:
@@ -522,7 +544,29 @@ class ServeServer:
             # shared-disk probe front-side — correct, just less warm).
             "session_ids": self._resident_session_ids(),
             "batcher": agg,
+            # the prefix-store section a polling front mirrors into its
+            # _RemoteEngine.stats() (None when no local replica runs a
+            # prefix store) — keeps /stats honest fleet-wide
+            "prefix_cache": self._aggregate_prefix(),
         }
+
+    def _aggregate_prefix(self) -> dict | None:
+        """Sum prefix-store counters across local replicas; config keys
+        (:data:`PREFIX_STATS_CONFIG_KEYS`) keep the first store's value
+        — stride/max/mode are fleet-uniform by construction (one CLI
+        builds every replica). Works for both store modes: the stats
+        contract is a FLAT dict of ints plus config scalars."""
+        stats_list = [r.engine.prefix.stats() for r in self.replicas
+                      if getattr(r.engine, "prefix", None) is not None]
+        if not stats_list:
+            return None
+        agg = dict(stats_list[0])
+        for s in stats_list[1:]:
+            for k, v in s.items():
+                if k in PREFIX_STATS_CONFIG_KEYS:
+                    continue
+                agg[k] = agg.get(k, 0) + v
+        return agg
 
     #: heartbeat residency-list cap: past this the payload reports None
     #: (truncated) instead of shipping an unbounded id list every poll
@@ -612,10 +656,26 @@ class ServeServer:
             fam.labels(replica=rl, state="pinned").set(c["pinned"])
             fam.labels(replica=rl, state="free").set(c["free"])
             if r.engine.prefix is not None:
+                ps = r.engine.prefix.stats()
                 reg.gauge("serve_prefix_cache_entries",
                           "live prefix-cache entries",
                           labelnames=("replica",)).labels(replica=rl).set(
-                    r.engine.prefix.stats()["entries"])
+                    ps["entries"])
+                if "nodes_device" in ps:
+                    # fabric mode: node population by residency kind —
+                    # device (slot-backed), spilled (host tier, within
+                    # the byte bound), structural (stateless radix
+                    # splits)
+                    fam = reg.gauge(
+                        "serve_prefix_trie_nodes",
+                        "prefix-trie nodes by residency kind",
+                        labelnames=("replica", "kind"))
+                    fam.labels(replica=rl, kind="device").set(
+                        ps["nodes_device"])
+                    fam.labels(replica=rl, kind="spilled").set(
+                        ps["nodes_spilled"])
+                    fam.labels(replica=rl, kind="structural").set(
+                        ps["nodes_structural"])
             if r.engine.tiers is not None:
                 ts = r.engine.tiers.stats()
                 fam = reg.gauge("serve_tier_entries",
@@ -887,6 +947,55 @@ class _Handler(BaseHTTPRequestHandler):
                             f"{type(e).__name__}: {e}", retryable=False)
                 return
             self._reply(200, {"programs": n})
+            return
+        if self.path == "/replica/prefix":
+            # fabric propagation receiver: a peer pushes one trie node
+            # (token path + carry snapshot). Idempotent by token-hash —
+            # the retrying transport may deliver twice (replay_safe) and
+            # a replay answers dedup, not a double insert. Applied to
+            # every LOCAL replica running a fabric trie so a
+            # multi-replica host warms uniformly.
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._error(400, "bad_request", f"bad request: {e}",
+                            retryable=False)
+                return
+            from .prefix_trie import decode_propagated_state
+
+            applied = dedup = rejected = 0
+            tries = [r.engine.prefix for r in self._serve.replicas
+                     if hasattr(getattr(r.engine, "prefix", None),
+                                "adopt_remote")]
+            if not tries:
+                self._error(404, "not_found",
+                            "no prefix fabric on this host (boot with "
+                            "--prefix-fabric on)", retryable=False)
+                return
+            for trie in tries:
+                state = decode_propagated_state(
+                    body, num_layers=trie.cache.num_layers,
+                    hidden_size=trie.cache.hidden_size)
+                if state is None:
+                    rejected += 1
+                    continue
+                outcome = trie.adopt_remote(body.get("tokens", ()), state,
+                                            body.get("hash"))
+                if outcome == "applied":
+                    applied += 1
+                elif outcome == "dedup":
+                    dedup += 1
+                else:
+                    rejected += 1
+            if applied == dedup == 0 and rejected:
+                self._error(400, "bad_request",
+                            "malformed or rejected fabric node "
+                            "(hash/shape/stride mismatch, or store "
+                            "full of pinned nodes)", retryable=False)
+                return
+            self._reply(200, {"applied": applied, "dedup": dedup,
+                              "rejected": rejected})
             return
         if self.path == "/rollout":
             # enqueue a rolling swap ({"model": ..., "version": N?}) or

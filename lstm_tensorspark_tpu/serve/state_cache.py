@@ -92,6 +92,15 @@ def _gather_scatter_slots(h, c, src_h, src_c, src, dst):
 #: entry's session would inherit — and corrupt — the shared prefix state.
 PREFIX_SID_NAMESPACE = "prefix/"
 
+#: prefix-store stats() keys that are per-replica CONFIG (or mode
+#: labels), not counters — cross-replica aggregation (loadgen
+#: ``prefix_totals``, ServeServer's heartbeat fan-in) keeps replica 0's
+#: value for these instead of summing. One constant shared by the
+#: exact-match PrefixCache and the radix PrefixTrie so the two
+#: aggregations can never drift.
+PREFIX_STATS_CONFIG_KEYS = ("stride", "max_entries", "max_nodes",
+                            "host_bytes", "state_bytes", "mode")
+
 
 class DetachedState(NamedTuple):
     """Host-resident session state: h, c each ``[L, H]`` float32 numpy."""
@@ -490,6 +499,11 @@ class PrefixCache:
         self._lock = cache._lock  # shared on purpose (see docstring)
         self._entries: OrderedDict[bytes, PrefixEntry] = OrderedDict()
         self._by_sid: dict[str, bytes] = {}
+        # distinct entry lengths, maintained incrementally (descending
+        # list + per-length entry counts) so lookup never re-sorts the
+        # whole entry set under the shared lock on every admission
+        self._lengths_desc: list[int] = []
+        self._length_counts: dict[int, int] = {}
         self._sid_counter = 0
         self.hits = 0
         self.misses = 0
@@ -526,6 +540,34 @@ class PrefixCache:
         k = ((length - 1) // self.stride) * self.stride
         return k if k >= self.stride else 0
 
+    # ---- incremental distinct-length index (lookup's probe order) ------
+
+    def _length_add_locked(self, n: int) -> None:
+        count = self._length_counts.get(n, 0)
+        self._length_counts[n] = count + 1
+        if count == 0:
+            # descending insert: bisect on the negated view keeps the
+            # list sorted without a per-lookup re-sort
+            lo, hi = 0, len(self._lengths_desc)
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if self._lengths_desc[mid] > n:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            self._lengths_desc.insert(lo, n)
+
+    def _length_drop_locked(self, n: int) -> None:
+        count = self._length_counts.get(n, 0) - 1
+        if count > 0:
+            self._length_counts[n] = count
+            return
+        self._length_counts.pop(n, None)
+        try:
+            self._lengths_desc.remove(n)
+        except ValueError:
+            pass
+
     def lookup(self, prompt) -> tuple[PrefixEntry | None, int]:
         """Longest exact-prefix match for ``prompt`` with matched length
         <= len(prompt) - 1. A hit returns ``(entry, matched_len)`` with
@@ -533,9 +575,12 @@ class PrefixCache:
         :meth:`release` after dispatching the resumed prefill."""
         p = np.asarray(prompt, np.int32).reshape(-1)
         with self._lock:
-            lengths = sorted({e.length for e in self._entries.values()},
-                             reverse=True)
-            for n in lengths:
+            # the distinct-length probe order is maintained incrementally
+            # on insert/evict (_length_add/drop_locked) — re-sorting the
+            # entry set here would put an O(entries log entries) scan on
+            # every fresh admission's hot path. list() snapshot: a probe
+            # can drop an entry (_promote_locked loss) mid-iteration.
+            for n in list(self._lengths_desc):
                 if n > p.size - 1:
                     continue
                 entry = self._entries.get(self._key(p[:n]))
@@ -580,7 +625,8 @@ class PrefixCache:
                       or not self.tiers.fill_memory(entry.sid, slot)):
             self.cache.release(entry.sid)
             self._by_sid.pop(entry.sid, None)
-            self._entries.pop(entry.key, None)
+            if self._entries.pop(entry.key, None) is not None:
+                self._length_drop_locked(entry.length)
             self.invalidated += 1
             self._m_invalidate.inc()
             return False
@@ -629,12 +675,14 @@ class PrefixCache:
             entry = PrefixEntry(key, length, sid, slot)
             self._entries[key] = entry
             self._by_sid[sid] = key
+            self._length_add_locked(length)
             self.inserts += 1
             self._m_insert.inc()
             return True
 
     def _evict_entry_locked(self, entry: PrefixEntry) -> None:
-        self._entries.pop(entry.key, None)
+        if self._entries.pop(entry.key, None) is not None:
+            self._length_drop_locked(entry.length)
         self._by_sid.pop(entry.sid, None)
         self.cache.release(entry.sid)
         if self.tiers is not None:
@@ -678,7 +726,9 @@ class PrefixCache:
             self._m_spill.inc()
             return
         self._by_sid.pop(sid, None)
-        self._entries.pop(key, None)
+        dropped = self._entries.pop(key, None)
+        if dropped is not None:
+            self._length_drop_locked(dropped.length)
         self.invalidated += 1
         self._m_invalidate.inc()
 
@@ -689,6 +739,7 @@ class PrefixCache:
     def stats(self) -> dict:
         with self._lock:
             return {
+                "mode": "exact",
                 "entries": len(self._entries),
                 "stride": self.stride,
                 "max_entries": self.max_entries,

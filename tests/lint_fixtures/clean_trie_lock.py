@@ -1,0 +1,49 @@
+"""graftlint fixture: the prefix-trie shared-RLock pattern that must
+NOT fire.
+
+Exactly the PrefixTrie/StateCache arrangement: the trie overlay shares
+the slot cache's reentrant lock (``self._lock = cache._lock``), so the
+eviction listener already runs under the only lock the trie ever
+takes, and trie methods re-enter cache methods under it. One merged
+reentrant lock has no order to violate — this is the sanctioned
+design, not an ABBA (contrast viol_trie_lock.py)."""
+
+import threading
+
+
+class SlotCache:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._slots = {}
+        self._pinned = set()
+        self.evict_listeners = []
+
+    def pin(self, sid):
+        with self._lock:
+            self._pinned.add(sid)
+
+    def evict(self, sid):
+        with self._lock:
+            slot = self._slots.pop(sid, None)
+            for listener in self.evict_listeners:
+                listener(sid, slot)
+
+
+class Trie:
+    def __init__(self, cache: SlotCache):
+        self.cache = cache
+        self._lock = cache._lock  # shared on purpose (see module doc)
+        self._nodes = {}
+        cache.evict_listeners.append(self._on_slot_evicted_locked)
+
+    def lookup(self, key):
+        with self._lock:
+            node = self._nodes.get(key)
+            if node is not None:
+                self.cache.pin(node["sid"])  # reentrant: same merged lock
+            return node
+
+    def _on_slot_evicted_locked(self, sid, slot):
+        # fired under the shared lock; taking it again would merely
+        # re-enter, so the body stays lock-free by convention
+        self._nodes.pop(sid, None)

@@ -1,0 +1,50 @@
+"""graftlint fixture: lock-order true positive — a prefix TRIE overlay
+that takes its OWN lock while serving the state cache's eviction
+listener (the PrefixTrie shape done wrong):
+
+    SlotCache._lock  --(evict fires listeners)-->  Trie._lock
+    Trie._lock       --(lookup pins the slot)-->   SlotCache._lock
+
+Each class looks locally consistent; only the listener edge closes the
+ABBA cycle. The sanctioned design shares the cache's reentrant lock
+(see clean_trie_lock.py) — a private trie lock deadlocks the first
+time an eviction races a lookup."""
+
+import threading
+
+
+class SlotCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = {}
+        self._pinned = set()
+        self.evict_listeners = []
+
+    def pin(self, sid):
+        with self._lock:
+            self._pinned.add(sid)
+
+    def evict(self, sid):
+        with self._lock:
+            slot = self._slots.pop(sid, None)
+            for listener in self.evict_listeners:
+                listener(sid, slot)
+
+
+class Trie:
+    def __init__(self, cache: SlotCache):
+        self.cache = cache
+        self._lock = threading.Lock()  # PRIVATE lock: the hazard
+        self._nodes = {}
+        cache.evict_listeners.append(self._on_slot_evicted)
+
+    def lookup(self, key):
+        with self._lock:
+            node = self._nodes.get(key)
+            if node is not None:
+                self.cache.pin(node["sid"])  # Trie -> SlotCache edge
+            return node
+
+    def _on_slot_evicted(self, sid, slot):
+        with self._lock:  # SlotCache -> Trie edge: closes the cycle
+            self._nodes.pop(sid, None)

@@ -45,6 +45,7 @@ VIOLATIONS = {
     "viol_spec_warmup": "warmup-coverage",
     "viol_lock_abba": "lock-order",
     "viol_lock_listener": "lock-order",
+    "viol_trie_lock": "lock-order",
     "viol_warmup": "warmup-coverage",
     "viol_exit_code": "exit-code-literal",
     "viol_metrics": "metrics-consistency",
@@ -75,6 +76,7 @@ CLEAN_TWINS = {
     "clean_spec_warmup": "warmup-coverage",
     "clean_lock_order": "lock-order",
     "clean_lock_shared_rlock": "lock-order",
+    "clean_trie_lock": "lock-order",
     "clean_warmup": "warmup-coverage",
     "clean_exit_code": "exit-code-literal",
     "clean_metrics": "metrics-consistency",

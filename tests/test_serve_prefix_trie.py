@@ -1,0 +1,489 @@
+"""Prefix-state fabric (serve/prefix_trie.py: radix trie of recurrent
+carries, tiered spill, cross-replica propagation).
+
+The ISSUE-19 acceptance surface:
+
+- longest-match correctness: ``PrefixTrie.lookup`` returns the deepest
+  stateful node on the prompt's path, capped at ``len(prompt) - 1``,
+  exactly matching a brute-force longest-common-prefix reference over
+  randomized token sets (including interior nodes created by edge
+  splits);
+- leaf-first LRU eviction: capacity pressure evicts zero-ref LEAVES
+  before interior nodes with live descendants, and ref-held (pinned)
+  nodes are never evicted;
+- tiered spill/promote: a slot eviction spills the node's state into
+  the host tier and a later lookup promotes it back bit-identically;
+  the configurable host-byte bound evicts the coldest spilled node;
+- cross-replica propagation: the propagator worker posts inserted
+  nodes to peers, ``adopt_remote`` is idempotent by token path AND by
+  recently-applied hash (at-least-once replay), rejects off-stride or
+  wrong-shape payloads, and skips circuit-suspect peers;
+- PARITY: greedy generation through a ``prefix_fabric=True`` engine +
+  batcher (scan and Pallas decode kernels, chunked and monolithic
+  prefill) is token-identical to models/generate.py, cold and hot.
+
+Parity stacks build their own engines; the configs are tiny so each
+XLA compile is subsecond on CPU.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+
+from lstm_tensorspark_tpu.models import LMConfig, init_lm, make_generate_fn
+from lstm_tensorspark_tpu.serve import (
+    Batcher,
+    PrefixPropagator,
+    PrefixTrie,
+    Request,
+    ServeEngine,
+    ServeServer,
+    SessionTiers,
+    StateCache,
+)
+from lstm_tensorspark_tpu.serve.prefix_trie import decode_propagated_state
+from lstm_tensorspark_tpu.serve.state_cache import DetachedState
+
+_CFG = LMConfig(vocab_size=37, hidden_size=16, num_layers=2)
+
+
+def _make_engine(**kw):
+    params = init_lm(jax.random.PRNGKey(0), _CFG)
+    kw.setdefault("num_slots", 16)
+    kw.setdefault("prefill_buckets", (4, 8, 16))
+    kw.setdefault("batch_buckets", (1, 2, 4))
+    return params, ServeEngine(params, _CFG, **kw)
+
+
+def _refs(params, prompts, n_new):
+    gen = make_generate_fn(_CFG, max_new_tokens=n_new, greedy=True)
+    return [
+        np.asarray(gen(params, p[None, :], jax.random.PRNGKey(0)))[
+            0, p.size:].tolist()
+        for p in prompts
+    ]
+
+
+def _run(batcher, prompts, n_new):
+    reqs = [Request(p, n_new) for p in prompts]
+    for r in reqs:
+        batcher.submit(r)
+    batcher.drain()
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    return [r.tokens for r in reqs]
+
+
+def _seeded(num_layers=2, hidden=4, num_slots=8, **trie_kw):
+    """A small cache + trie + one pinned seed slot holding a
+    distinctive state (h = +arange, c = -arange per layer)."""
+    cache = StateCache(num_layers=num_layers, num_slots=num_slots,
+                       hidden_size=hidden)
+    trie_kw.setdefault("stride", 2)
+    trie_kw.setdefault("max_nodes", 8)
+    trie_kw.setdefault("host_bytes", 1 << 20)
+    trie = PrefixTrie(cache, **trie_kw)
+    slot, _ = cache.acquire_pinned("seed")
+    h = np.arange(num_layers * hidden, dtype=np.float32).reshape(
+        num_layers, 1, hidden)
+    cache.write_slots(np.asarray([slot]), h, -h)
+    return cache, trie, slot, (h[:, 0, :], -h[:, 0, :])
+
+
+# ---- longest-match correctness ------------------------------------------
+
+
+def test_longest_match_vs_bruteforce_randomized():
+    """Random stride-aligned inserts over a 4-token alphabet (maximal
+    prefix sharing → lots of edge splits), then random lookups checked
+    against a brute-force longest-prefix-over-inserted-keys reference
+    capped at len(prompt) - 1."""
+    rng = np.random.RandomState(0)
+    cache = StateCache(num_layers=1, num_slots=300, hidden_size=4)
+    trie = PrefixTrie(cache, stride=2, max_nodes=256, host_bytes=1 << 20)
+    slot, _ = cache.acquire_pinned("seed")
+    keys = set()
+    for _ in range(120):
+        length = 2 * int(rng.randint(1, 8))
+        toks = tuple(int(t) for t in rng.randint(0, 4, size=length))
+        trie.insert(np.asarray(toks, np.int32), slot)
+        keys.add(toks)   # insert() returning False here can only be dedup
+    assert len(trie) == len(keys)
+    for _ in range(300):
+        plen = int(rng.randint(1, 17))
+        prompt = rng.randint(0, 4, size=plen).astype(np.int32)
+        node, matched = trie.lookup(prompt)
+        want = max(
+            (len(k) for k in keys
+             if len(k) <= plen - 1 and tuple(prompt[:len(k)]) == k),
+            default=0)
+        assert matched == want, (prompt.tolist(), matched, want)
+        if node is not None:
+            assert node.length == matched
+            trie.release(node)
+        else:
+            assert want == 0
+
+
+def test_interior_insert_splits_edge():
+    """Inserting a shorter key AFTER a longer one splits the existing
+    edge: both depths must then match, and the full-prompt cap (>= 1
+    token must remain to prefill) still binds."""
+    cache, trie, slot, _ = _seeded()
+    assert trie.insert(np.array([1, 2, 3, 4], np.int32), slot)
+    assert trie.insert(np.array([1, 2], np.int32), slot)   # splits [1,2,3,4]
+    node, n = trie.lookup(np.array([1, 2, 9], np.int32))
+    assert node is not None and n == 2
+    trie.release(node)
+    node, n = trie.lookup(np.array([1, 2, 3, 4, 9], np.int32))
+    assert node is not None and n == 4
+    trie.release(node)
+    # cap: matched length never covers the FULL prompt
+    node, n = trie.lookup(np.array([1, 2, 3, 4], np.int32))
+    assert node is not None and n == 2
+    trie.release(node)
+    st = trie.stats()
+    assert st["entries"] == 2 and st["nodes_device"] == 2
+    assert st["misses"] == 0
+
+
+# ---- leaf-first eviction + refcount pins --------------------------------
+
+
+def test_leaf_first_eviction_and_refcount_pins():
+    cache = StateCache(num_layers=1, num_slots=12, hidden_size=4)
+    trie = PrefixTrie(cache, stride=2, max_nodes=3, host_bytes=1 << 20)
+    slot, _ = cache.acquire_pinned("seed")
+    assert trie.insert(np.array([1, 2], np.int32), slot)
+    assert trie.insert(np.array([1, 2, 3, 4], np.int32), slot)
+    assert trie.insert(np.array([1, 2, 5, 6], np.int32), slot)
+    # capacity pressure: the victim must be the LRU zero-ref LEAF
+    # ([1,2,3,4]) — NOT the interior [1,2], which has live descendants
+    assert trie.insert(np.array([7, 8], np.int32), slot)
+    node, n = trie.lookup(np.array([1, 2, 3, 4, 9], np.int32))
+    assert n == 2 and node.length == 2   # fell back to the interior node
+    trie.release(node)
+    node, n = trie.lookup(np.array([1, 2, 5, 6, 9], np.int32))
+    assert n == 4
+    # hold the ref: [1,2,5,6] is now pinned and must survive eviction
+    assert trie.insert(np.array([9, 9], np.int32), slot)    # evicts [7,8]
+    assert trie.insert(np.array([11, 12], np.int32), slot)  # evicts [9,9]
+    held, m = trie.lookup(np.array([1, 2, 5, 6, 0], np.int32))
+    assert m == 4 and held is node
+    trie.release(held)
+    trie.release(node)
+    st = trie.stats()
+    assert st["evictions"] >= 3 and st["entries"] == 3
+    # all nodes ref-held -> insert degrades to False, never raises
+    holds = [trie.lookup(np.array(list(k) + [0], np.int32))
+             for k in ([1, 2], [1, 2, 5, 6], [11, 12])]
+    assert all(h[0] is not None for h in holds)
+    assert not trie.insert(np.array([13, 14], np.int32), slot)
+    for h, _ in holds:
+        trie.release(h)
+    assert trie.insert(np.array([13, 14], np.int32), slot)
+
+
+# ---- tiered spill / promote ---------------------------------------------
+
+
+def test_spill_promote_roundtrip_identity():
+    """Slot pressure spills a trie node into the host tier; the next
+    lookup promotes it back into a fresh slot BIT-IDENTICALLY."""
+    cache = StateCache(num_layers=2, num_slots=4, hidden_size=4)
+    tiers = SessionTiers(cache, host_entries=8)
+    trie = PrefixTrie(cache, stride=2, max_nodes=8, host_bytes=1 << 20,
+                      tiers=tiers)
+    try:
+        slot, _ = cache.acquire_pinned("seed")
+        h = np.arange(8, dtype=np.float32).reshape(2, 1, 4)
+        cache.write_slots(np.asarray([slot]), h, -h)
+        assert trie.insert(np.array([1, 2], np.int32), slot)
+        # pin enough sessions to evict the (unpinned) prefix slot
+        cache.acquire_pinned("a")
+        cache.acquire_pinned("b")
+        cache.acquire_pinned("c")
+        st = trie.stats()
+        assert st["nodes_spilled"] == 1 and st["spilled"] == 1
+        assert st["spilled_bytes"] == st["state_bytes"]
+        cache.release("a")   # make a slot reclaimable for the promote
+        node, n = trie.lookup(np.array([1, 2, 9], np.int32))
+        assert node is not None and n == 2 and node.slot is not None
+        np.testing.assert_array_equal(
+            np.asarray(cache.h[:, node.slot, :]), h[:, 0, :])
+        np.testing.assert_array_equal(
+            np.asarray(cache.c[:, node.slot, :]), -h[:, 0, :])
+        trie.release(node)
+        st = trie.stats()
+        assert st["promoted"] == 1 and st["nodes_spilled"] == 0
+    finally:
+        tiers.close()
+
+
+def test_host_byte_bound_evicts_coldest_spilled():
+    """``host_bytes`` bounds SPILLED trie state: overflow evicts the
+    coldest spilled zero-ref node instead of growing without bound."""
+    cache = StateCache(num_layers=1, num_slots=4, hidden_size=4)
+    tiers = SessionTiers(cache, host_entries=8)
+    # state_bytes = 2 * 1 * 4 * 4 = 32 -> bound admits exactly ONE
+    # spilled node
+    trie = PrefixTrie(cache, stride=2, max_nodes=8, host_bytes=32,
+                      tiers=tiers)
+    try:
+        slot, _ = cache.acquire_pinned("seed")
+        assert trie.insert(np.array([1, 2], np.int32), slot)
+        assert trie.insert(np.array([3, 4], np.int32), slot)
+        cache.acquire_pinned("a")   # takes the last free slot
+        cache.acquire_pinned("b")   # spills [1,2] (LRU): 32 <= 32, kept
+        cache.acquire_pinned("c")   # spills [3,4]: 64 > 32 -> evict [1,2]
+        st = trie.stats()
+        assert st["nodes_spilled"] == 1 and st["entries"] == 1
+        assert st["spilled_bytes"] <= st["host_bytes"]
+        node, n = trie.lookup(np.array([1, 2, 9], np.int32))
+        assert node is None and n == 0   # the cold node is honestly gone
+        cache.release("a")
+        node, n = trie.lookup(np.array([3, 4, 9], np.int32))
+        assert node is not None and n == 2   # the hot one promotes
+        trie.release(node)
+    finally:
+        tiers.close()
+
+
+# ---- cross-replica propagation ------------------------------------------
+
+
+class _FakeTransport:
+    def __init__(self):
+        self.posts = []
+
+    def rpc_post(self, path, body, **kw):
+        self.posts.append((path, json.loads(json.dumps(body)), kw))
+        return {"applied": 1}
+
+
+class _FakePeer:
+    def __init__(self):
+        self.transport = _FakeTransport()
+        self.suspected = False
+
+    def suspect(self):
+        return self.suspected
+
+
+def test_propagation_roundtrip_dedup_and_rejection():
+    cache_a, trie_a, slot_a, (h0, c0) = _seeded()
+    cache_b, trie_b, _, _ = _seeded()
+    peer = _FakePeer()
+    prop = PrefixPropagator(trie_a, [peer], rpc_timeout=1.0)
+    trie_a.attach_propagator(prop)
+    try:
+        assert trie_a.insert(np.array([1, 2, 3, 4], np.int32), slot_a)
+        deadline = time.monotonic() + 10.0
+        while not peer.transport.posts and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert peer.transport.posts, "propagator never posted"
+        path, body, kw = peer.transport.posts[0]
+        assert path == "/replica/prefix" and kw.get("replay_safe") is True
+        assert body["tokens"] == [1, 2, 3, 4]
+        assert body["hash"] == PrefixTrie.token_hash((1, 2, 3, 4))
+        assert prop.sent == 1 and prop.errors == 0
+
+        # receiver side: decode + adopt, byte-identical state
+        state = decode_propagated_state(
+            body, num_layers=2, hidden_size=4)
+        assert state is not None
+        np.testing.assert_array_equal(state.h, h0)
+        np.testing.assert_array_equal(state.c, c0)
+        assert trie_b.adopt_remote(body["tokens"], state,
+                                   body["hash"]) == "applied"
+        node, n = trie_b.lookup(np.array([1, 2, 3, 4, 9], np.int32))
+        assert node is not None and n == 4
+        np.testing.assert_array_equal(
+            np.asarray(cache_b.h[:, node.slot, :]), h0)
+        trie_b.release(node)
+
+        # idempotency leg 1: token path already stateful -> dedup
+        assert trie_b.adopt_remote(body["tokens"], state,
+                                   body["hash"]) == "dedup"
+        # idempotency leg 2: node evicted but hash recently applied ->
+        # an at-least-once replay still dedups instead of resurrecting
+        trie_b.clear()
+        assert len(trie_b) == 0
+        assert trie_b.adopt_remote(body["tokens"], state,
+                                   body["hash"]) == "dedup"
+        st = trie_b.stats()
+        assert st["propagated_in"] == 1 and st["propagation_dedup"] == 2
+
+        # rejection: off-stride length, wrong state shape
+        assert trie_b.adopt_remote([1, 2, 3], state, None) == "rejected"
+        bad = DetachedState(h=np.zeros((3, 4), np.float32),
+                            c=np.zeros((3, 4), np.float32))
+        assert trie_b.adopt_remote([5, 6], bad, None) == "rejected"
+
+        # circuit-suspect peers are skipped, not queued behind
+        peer.suspected = True
+        before = len(peer.transport.posts)
+        prop._send((7, 8), DetachedState(h=h0, c=c0))
+        assert len(peer.transport.posts) == before and prop.sent == 1
+    finally:
+        prop.close()
+
+
+def test_decode_propagated_state_rejects_malformed():
+    cache, trie, slot, (h0, c0) = _seeded()
+    assert trie.insert(np.array([1, 2], np.int32), slot)
+    prop = PrefixPropagator(trie, [])
+    body = None
+    # build a valid body through the real serializer path
+    peer = _FakePeer()
+    prop.peers = [peer]
+    prop._send((1, 2), DetachedState(h=h0, c=c0))
+    _, body, _ = peer.transport.posts[0]
+    assert decode_propagated_state(
+        body, num_layers=2, hidden_size=4) is not None
+    # wrong geometry
+    assert decode_propagated_state(
+        body, num_layers=3, hidden_size=4) is None
+    # tampered tokens no longer match the hash (integrity check)
+    bad = dict(body, tokens=[9, 9])
+    assert decode_propagated_state(bad, num_layers=2, hidden_size=4) is None
+    # truncated payload
+    bad = dict(body, h=body["h"][:8])
+    assert decode_propagated_state(bad, num_layers=2, hidden_size=4) is None
+    # missing field
+    bad = {k: v for k, v in body.items() if k != "c"}
+    assert decode_propagated_state(bad, num_layers=2, hidden_size=4) is None
+    prop.close()
+
+
+def test_remote_engine_forwards_peer_prefix_section():
+    """ISSUE-19 satellite: _RemoteEngine.stats() must mirror the peer's
+    real prefix-cache section off the heartbeat, not hardcode None."""
+    from lstm_tensorspark_tpu.serve.remote import RemoteBatcher, _RemoteEngine
+    from lstm_tensorspark_tpu.obs import MetricsRegistry
+
+    shim = RemoteBatcher("http://127.0.0.1:9", replica=0,
+                         registry=MetricsRegistry())
+    eng = _RemoteEngine(shim, None)
+    assert eng.stats()["prefix_cache"] is None   # no heartbeat yet
+    with shim._lock:
+        shim._remote_prefix = {"mode": "trie", "hits": 3}
+    assert eng.stats()["prefix_cache"] == {"mode": "trie", "hits": 3}
+    assert shim.remote_prefix() == {"mode": "trie", "hits": 3}
+
+
+def test_server_replica_prefix_route_applies_and_dedups():
+    """POST /replica/prefix on a fabric server lands the node in the
+    local trie (applied), replays dedup, malformed bodies reject."""
+    from lstm_tensorspark_tpu.serve.server import make_http_server
+
+    _, engine = _make_engine(prefix_fabric=True)
+    server = ServeServer(engine, max_active=2, queue_size=4)
+    httpd = make_http_server(server, port=0)
+    host, port = httpd.server_address[:2]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+
+    h = np.arange(_CFG.num_layers * _CFG.hidden_size,
+                  dtype=np.float32).reshape(_CFG.num_layers,
+                                            _CFG.hidden_size)
+    toks = list(range(8))   # stride multiple (engine default stride 8)
+    import base64 as _b64
+    body = {
+        "tokens": toks,
+        "hash": PrefixTrie.token_hash(tuple(toks)),
+        "layers": _CFG.num_layers,
+        "hidden": _CFG.hidden_size,
+        "h": _b64.b64encode(h.tobytes()).decode("ascii"),
+        "c": _b64.b64encode((-h).tobytes()).decode("ascii"),
+    }
+
+    def _post(payload):
+        req = urllib.request.Request(
+            f"http://{host}:{port}/replica/prefix",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    try:
+        with server:
+            thread.start()
+            status, out = _post(body)
+            assert status == 200 and out["applied"] == 1
+            status, out = _post(body)
+            assert status == 200 and out["dedup"] == 1
+            status, _ = _post(dict(body, tokens=toks[:3]))   # off-stride
+            assert status == 400
+            node, n = engine.prefix.lookup(
+                np.asarray(toks + [1], np.int32))
+            assert node is not None and n == 8
+            engine.prefix.release(node)
+            hb = server.replica_heartbeat()
+            px = hb["prefix_cache"]
+            assert px is not None and px["mode"] == "trie"
+            assert px["propagated_in"] == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---- greedy parity through the full stack -------------------------------
+
+
+def test_parity_fabric_cold_hot_chunked_and_pallas():
+    """Greedy output is token-identical across {fabric off, fabric on,
+    fabric on + chunked prefill, fabric on + Pallas decode kernel},
+    cold and hot, all matching models/generate.py — and the hot passes
+    genuinely resume from trie nodes."""
+    rng = np.random.RandomState(3)
+    shared = rng.randint(0, 37, size=8).astype(np.int32)
+    prompts = [
+        np.concatenate([shared, rng.randint(0, 37, size=5).astype(np.int32)])
+        for _ in range(3)
+    ]
+    n_new = 6
+    refs = None
+    for kw_e, kw_b in [
+        ({}, {}),
+        ({"prefix_fabric": True}, {}),
+        ({"prefix_fabric": True}, {"prefill_chunk": 4}),
+        ({"prefix_fabric": True, "decode_kernel": "pallas"}, {}),
+    ]:
+        params, engine = _make_engine(**kw_e)
+        if refs is None:
+            refs = _refs(params, prompts, n_new)
+        batcher = Batcher(engine, max_active=4, queue_size=8, **kw_b)
+        assert _run(batcher, prompts, n_new) == refs   # cold
+        assert _run(batcher, prompts, n_new) == refs   # hot
+        if engine.prefix is not None:
+            st = engine.prefix.stats()
+            assert st["mode"] == "trie"
+            assert st["hits"] >= 3, st
+            assert st["inserts"] >= 1, st
+            assert batcher.prefix_tokens_saved >= 8 * 3
+            assert batcher.prefill_tokens_computed > 0
+
+
+def test_fabric_resume_zero_mid_traffic_compiles():
+    """A trie-resumed hot pass reuses only warmed programs: the compile
+    counters must not move after the cold pass."""
+    rng = np.random.RandomState(9)
+    shared = rng.randint(0, 37, size=8).astype(np.int32)
+    cold = np.concatenate([shared,
+                           rng.randint(0, 37, size=5).astype(np.int32)])
+    hot = np.concatenate([shared,
+                          rng.randint(0, 37, size=5).astype(np.int32)])
+    params, engine = _make_engine(prefix_fabric=True)
+    refs = _refs(params, [cold, hot], 4)
+    batcher = Batcher(engine, max_active=4, queue_size=8)
+    assert _run(batcher, [cold], 4) == refs[:1]
+    before = dict(engine.compile_counts)
+    assert _run(batcher, [hot], 4) == refs[1:]
+    assert dict(engine.compile_counts) == before
+    assert engine.prefix.stats()["hits"] >= 1

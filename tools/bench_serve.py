@@ -1339,6 +1339,139 @@ def run_spec_bench(out_path: str) -> int:
     return 0 if all(gates.values()) else 1
 
 
+# ---- prefix-state fabric probe (--prefix-trie; BENCH_serve_r11) ---------
+#
+# The template-mix gate (ISSUE-19 / ROADMAP item 4): tenant preamble x
+# few-shot template x unique suffix at fleet scale. Exact-match prefix
+# caching needs a byte-identical stride-aligned re-prompt, so across 100
+# distinct (tenant, template) pairs its 16-entry LRU thrashes and nearly
+# every admission recomputes the shared 160 tokens; the radix trie keys
+# nodes by token PATH — the first session of a pair warms its preamble+
+# template prefix for every later sibling (and the preamble alone for
+# every later template of that tenant). Paired arms, same workload, same
+# seed: gate on >= 10x fewer prefill tokens actually computed, greedy
+# token parity per session, zero mid-traffic compiles, and the spilled-
+# node footprint within the configured host-tier byte bound.
+
+T_CFG = dict(vocab_size=89, hidden_size=64, num_layers=2)
+T_SESSIONS = 10_000
+T_TENANTS = 4
+T_TEMPLATES = 25          # 4 x 25 = 100 (tenant, template) pairs
+T_PREAMBLE = 128
+T_TEMPLATE = 32
+T_SUFFIX = 8              # prompt = 168; boundary(168) = 160 = shared
+T_STRIDE = 8
+T_CHUNK = 32              # chunk stops = insert points at both depths
+T_MAX_NEW = 4
+T_WORKERS = 32
+T_NODES = 160             # >= 100 pairs + per-tenant interior nodes
+T_HOST_MB = 1.0           # state_bytes = 2*2*64*4 = 1 KiB; 160 KiB max
+T_SLOTS = 96              # < stateful nodes: the spill plane must work
+
+
+def _trie_arm(mode: str, sessions: int) -> dict:
+    from lstm_tensorspark_tpu.serve.loadgen import run_template_mix
+
+    cfg = LMConfig(**T_CFG)
+    params = init_lm(jax.random.PRNGKey(0), cfg)
+    engine = ServeEngine(
+        params, cfg, num_slots=T_SLOTS,
+        prefill_buckets=(8, 16, 32, 64, 128, 256),
+        batch_buckets=(1, 2, 4, 8, 16),
+        prefix_cache=mode == "exact", prefix_fabric=mode == "trie",
+        prefix_stride=T_STRIDE, prefix_entries=16,
+        prefix_nodes=T_NODES, prefix_host_mb=T_HOST_MB,
+        tiered_cache=True, host_tier_entries=512,
+        registry=MetricsRegistry(),
+    )
+    server = ServeServer(engine, max_active=16, queue_size=64,
+                         prefill_chunk=T_CHUNK)
+    prompt_len = T_PREAMBLE + T_TEMPLATE + T_SUFFIX
+    with server:
+        server.warmup(prompt_lens=(prompt_len,))
+        compiles_before = engine.num_compiles()
+        report = run_template_mix(
+            server, vocab_size=cfg.vocab_size, sessions=sessions,
+            tenants=T_TENANTS, templates=T_TEMPLATES,
+            preamble_len=T_PREAMBLE, template_len=T_TEMPLATE,
+            suffix_len=T_SUFFIX, max_new_tokens=T_MAX_NEW,
+            workers=T_WORKERS, seed=11, collect_tokens=True,
+        )
+        report["compiles_during_run"] = (engine.num_compiles()
+                                         - compiles_before)
+        report["prefix_stats_final"] = engine.prefix.stats()
+    return report
+
+
+def run_prefix_trie_bench(out_path: str, sessions: int = T_SESSIONS) -> int:
+    print(f"bench_serve: template-mix arm (radix trie, {sessions} "
+          "sessions)...", flush=True)
+    trie = _trie_arm("trie", sessions)
+    print(f"bench_serve: template-mix arm (exact-match, {sessions} "
+          "sessions)...", flush=True)
+    exact = _trie_arm("exact", sessions)
+
+    # per-session greedy parity: identical prompts (same seed) must
+    # decode identical tokens whether the prefill was trie-resumed,
+    # exact-resumed, or cold
+    t_tok = trie.pop("tokens_by_session")
+    e_tok = exact.pop("tokens_by_session")
+    compared = [i for i in t_tok if i in e_tok]
+    mismatches = [i for i in compared if t_tok[i] != e_tok[i]]
+
+    t_computed = trie["prefill"]["tokens_computed"]
+    e_computed = exact["prefill"]["tokens_computed"]
+    ratio = round(e_computed / t_computed, 3) if t_computed else None
+    ts = trie["prefix_stats_final"]
+    gates = {
+        "pass_compute_drop_10x": bool(ratio is not None and ratio >= 10.0),
+        "pass_token_identical": (not mismatches
+                                 and len(compared) == len(t_tok) > 0),
+        "pass_zero_mid_traffic_compiles":
+            trie["compiles_during_run"] == 0
+            and exact["compiles_during_run"] == 0,
+        "pass_host_bound_held":
+            ts["spilled_bytes"] <= ts["host_bytes"]
+            and ts["entries"] <= T_NODES,
+    }
+    out = {
+        "note": "serve_bench_r11 prefix-state fabric: radix-trie vs "
+                "exact-match prefix store on the template-mix workload "
+                "(tools/bench_serve.py --prefix-trie)",
+        "config": {
+            **T_CFG, "sessions": sessions, "tenants": T_TENANTS,
+            "templates_per_tenant": T_TEMPLATES,
+            "preamble_len": T_PREAMBLE, "template_len": T_TEMPLATE,
+            "suffix_len": T_SUFFIX, "stride": T_STRIDE,
+            "prefill_chunk": T_CHUNK, "max_new_tokens": T_MAX_NEW,
+            "workers": T_WORKERS, "num_slots": T_SLOTS,
+            "prefix_nodes": T_NODES, "prefix_host_mb": T_HOST_MB,
+            "platform": jax.devices()[0].platform,
+        },
+        "trie": trie,
+        "exact": exact,
+        "prefill_tokens_computed": {"trie": t_computed,
+                                    "exact": e_computed},
+        "compute_drop_ratio": ratio,
+        "parity_sessions_compared": len(compared),
+        "parity_mismatches": len(mismatches),
+        **gates,
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "compute_drop_ratio": ratio,
+        "tokens_computed_trie": t_computed,
+        "tokens_computed_exact": e_computed,
+        "trie_hit_rate": trie["prefix_cache"]["hit_rate"],
+        "exact_hit_rate": exact["prefix_cache"]["hit_rate"],
+        **gates,
+    }))
+    print(f"bench_serve: report written to {out_path}")
+    return 0 if all(gates.values()) else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
@@ -1391,6 +1524,19 @@ def main(argv=None) -> int:
                          "fraction from paired program latencies, greedy "
                          "parity, zero mid-traffic compiles; writes "
                          "BENCH_serve_r10.json")
+    ap.add_argument("--prefix-trie", action="store_true",
+                    help="run the prefix-state fabric probe: the paired "
+                         "template-mix workload (tenant preamble x few-"
+                         "shot template x unique suffix, 10k sessions "
+                         "over 100 pairs) through a radix-trie and an "
+                         "exact-match prefix store — gating on >= 10x "
+                         "fewer prefill tokens computed, greedy token "
+                         "parity, zero mid-traffic compiles, and the "
+                         "spilled-node footprint within the host-tier "
+                         "byte bound; writes BENCH_serve_r11.json")
+    ap.add_argument("--trie-sessions", type=int, default=T_SESSIONS,
+                    help="--prefix-trie: session count (the gate's "
+                         "population; smaller for a quick sanity run)")
     ap.add_argument("--decode-kernel", default=None,
                     help="comma list of kernels (e.g. pallas,scan): run "
                          "the decode-kernel comparison (tokens/s + ITL "
@@ -1429,6 +1575,9 @@ def main(argv=None) -> int:
     if args.speculative:
         out_path = args.out or os.path.join(_REPO, "BENCH_serve_r10.json")
         return run_spec_bench(out_path)
+    if args.prefix_trie:
+        out_path = args.out or os.path.join(_REPO, "BENCH_serve_r11.json")
+        return run_prefix_trie_bench(out_path, sessions=args.trie_sessions)
     if args.decode_kernel:
         kernels = tuple(k.strip() for k in args.decode_kernel.split(",")
                         if k.strip())

@@ -126,6 +126,19 @@ _SPEC_ARGS = [
     "--tiered-cache", "off", "--replicas", "1",
     "--speculative", "--spec-ladder", "2",
 ]
+# the prefix-fabric pair boot (ISSUE-19): host A runs the trie alone;
+# host B boots with --remote-replica A, so B's propagator pushes every
+# inserted trie node to A over POST /replica/prefix. One local replica
+# and tiers off keep the two extra boots to a few seconds each.
+_FABRIC_ARGS = [
+    "serve", "--http", "--port", "0", "--vocab-size", "31",
+    "--hidden-units", "12", "--num-layers", "1",
+    # bucket 16 admits the 9-token preamble+suffix prompts below (the
+    # 8-token preamble node inserts at the stride-8 split point)
+    "--prefill-buckets", "4,8,16", "--batch-buckets", "1,2",
+    "--decode-window", "1", "--prefix-fabric", "on",
+    "--tiered-cache", "off", "--replicas", "1",
+]
 
 
 def _fail(proc: subprocess.Popen, lines: list[str], why: str) -> int:
@@ -492,6 +505,100 @@ def main(argv=None) -> int:
             return _fail(proc, lines,
                          "speculative boot dispatched no spec windows "
                          f"(speculation inert): {sb}")
+        spec_base = base
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+        # ---- prefix-fabric replica pair (cross-host propagation) ------
+        # host A boots the fabric alone; host B boots with
+        # --remote-replica A. A cold long-prompt generate on B's LOCAL
+        # replica inserts the 8-token preamble trie node and B's
+        # propagator pushes it to A; A must then report the adoption
+        # (propagated_in >= 1), serve a same-preamble prompt WARM (a
+        # trie hit), token-identically to B's cold reply — and the
+        # fabric boot must match the main boot on the parity prompt
+        fabric_cmd = [sys.executable, "-m", "lstm_tensorspark_tpu.cli",
+                      *_FABRIC_ARGS]
+        proc_a, lines_a, base_a = _boot(fabric_cmd, env, args.timeout)
+        try:
+            if base_a is None:
+                return _fail(proc_a, lines_a,
+                             "--prefix-fabric host A never reported its "
+                             "address")
+            proc, lines, base = _boot(
+                fabric_cmd + ["--remote-replica", base_a], env,
+                args.timeout)
+            if base is None:
+                return _fail(proc, lines,
+                             "--prefix-fabric host B never reported its "
+                             "address")
+            freply = _generate(base_a, {"prompt": [1, 2, 3],
+                                        "max_new_tokens": 4,
+                                        "greedy": True})
+            if freply.get("tokens") != reply.get("tokens"):
+                return _fail(proc, lines,
+                             "--prefix-fabric tokens diverge from the "
+                             f"main boot: {freply.get('tokens')} != "
+                             f"{reply.get('tokens')}")
+            # land the cold insert on B's LOCAL replica: with the remote
+            # peer in B's router a request may route to A, which would
+            # insert the preamble on A directly — so each attempt uses a
+            # FRESH preamble, and only a locally-served one counts (its
+            # node is then unknown to A and must arrive by propagation)
+            cold = None
+            for i in range(1, 7):
+                pre = list(range(i, i + 8))
+                r2 = _generate(base, {"prompt": pre + [29],
+                                      "max_new_tokens": 4,
+                                      "greedy": True})
+                if r2.get("replica") == 0 and len(r2.get("tokens", [])) == 4:
+                    cold = (pre, r2)
+                    break
+            if cold is None:
+                return _fail(proc, lines,
+                             "no fabric generate landed on host B's "
+                             "local replica")
+            pre, breply = cold
+
+            def _a_prefix() -> dict:
+                with urllib.request.urlopen(base_a + "/stats",
+                                            timeout=30) as r:
+                    a_stats = json.loads(r.read())
+                return ((a_stats.get("replicas") or [a_stats])[0]
+                        .get("prefix_cache") or {})
+
+            deadline = time.monotonic() + 30
+            a_px = _a_prefix()
+            while (a_px.get("propagated_in", 0) < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.25)
+                a_px = _a_prefix()
+            if a_px.get("propagated_in", 0) < 1:
+                return _fail(proc, lines,
+                             "host A never adopted a propagated trie "
+                             f"node: {a_px}")
+            wreply = _generate(base_a, {"prompt": pre + [29],
+                                        "max_new_tokens": 4,
+                                        "greedy": True})
+            if wreply.get("tokens") != breply.get("tokens"):
+                return _fail(proc, lines,
+                             "cross-replica warm generate diverges from "
+                             f"the cold one: {wreply.get('tokens')} != "
+                             f"{breply.get('tokens')}")
+            a_px = _a_prefix()
+            if a_px.get("hits", 0) < 1:
+                return _fail(proc, lines,
+                             "host A served the propagated preamble "
+                             f"COLD (no trie hit): {a_px}")
+        finally:
+            proc_a.terminate()
+            try:
+                proc_a.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc_a.kill()
 
         print(f"serve_smoke: PASS ({scan_base}: healthz fan-in "
               f"({len(reps)} replicas) + routed generate + stats + "
@@ -503,10 +610,13 @@ def main(argv=None) -> int:
               "token-identical with a quiet error-free controller; "
               f"{_MESH_SHARDS}-shard mesh boot token-identical "
               "with replica-labelled metrics; "
-              f"{base}: --speculative boot with a fixture draft pair "
-              "token-identical with "
+              f"{spec_base}: --speculative boot with a fixture draft "
+              "pair token-identical with "
               f"{sum(sb['spec_windows_dispatched'].values())} spec "
-              "windows dispatched)")
+              "windows dispatched; "
+              f"--prefix-fabric pair {base} -> {base_a}: propagated "
+              "trie node adopted cross-host with a warm token-identical "
+              "hit)")
         proc.terminate()
         try:
             proc.wait(timeout=10)
